@@ -1,0 +1,103 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas.
+
+Architecture (vs the reference at /root/reference, see SURVEY.md):
+  - eager Tensor + tape autograd over jax.vjp (≈ imperative/ dygraph engine)
+  - `jit.to_static` functionalizes state and lowers whole train steps to
+    cached XLA computations (≈ ProgramDesc + executors, but compiled)
+  - distribution = jax.sharding Mesh + collectives (≈ fleet + NCCL rings)
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import autograd as _autograd_mod  # noqa: F401
+from .core.autograd import enable_grad, no_grad, set_grad_enabled  # noqa: F401
+from .core.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, Place, TPUPlace, get_device, set_device,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from .core.dtypes import (  # noqa: F401
+    bfloat16, complex64, complex128, float16, float32, float64,
+    get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
+)
+from .core.dtypes import bool_ as bool  # noqa: F401,A001
+from .core.random import get_state as get_cuda_rng_state  # noqa: F401
+from .core.random import seed  # noqa: F401
+from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+
+# functional tensor API (also patches Tensor methods)
+from .tensor import *  # noqa: F401,F403
+from .tensor import math as _tensor_math  # noqa: F401
+
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from .framework import io_utils as _io_utils  # noqa: F401,E402
+from .framework.io_utils import load, save  # noqa: F401,E402
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad parity (python/paddle/autograd/backward_mode.py)."""
+    from .core.autograd import grad_for_tensors
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gouts = grad_outputs if grad_outputs is None or isinstance(grad_outputs, (list, tuple)) else [grad_outputs]
+    retain = bool(retain_graph) if retain_graph is not None else create_graph
+    return grad_for_tensors(outs, ins, gouts, retain_graph=retain,
+                            allow_unused=allow_unused)
+
+
+def disable_static(place=None):
+    """Dygraph is the default and only eager mode; kept for API parity."""
+    return None
+
+
+def enable_static():
+    from . import static as static_mod
+    static_mod._enable()
+
+
+def in_dynamic_mode():
+    from . import static as static_mod
+    return not static_mod._static_mode[0]
+
+
+def is_grad_enabled():
+    from .core.autograd import is_grad_enabled as _ig
+    return _ig()
+
+
+def set_printoptions(**kwargs):
+    import numpy as np
+    np.set_printoptions(**{k: v for k, v in kwargs.items()
+                           if k in ("precision", "threshold", "edgeitems", "linewidth")})
+
+
+def get_flags(flags=None):
+    from .framework.flags import get_flags as _gf
+    return _gf(flags)
+
+
+def set_flags(flags):
+    from .framework.flags import set_flags as _sf
+    return _sf(flags)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    from .hapi.model_summary import summary as _summary
+    return _summary(net, input_size, dtypes=dtypes, input=input)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.dynamic_flops import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops, print_detail=print_detail)

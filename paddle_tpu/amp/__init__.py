@@ -1,0 +1,4 @@
+from .auto_cast import amp_guard, auto_cast, decorate  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+
+__all__ = ["auto_cast", "decorate", "GradScaler", "AmpScaler", "amp_guard"]

@@ -1,0 +1,83 @@
+"""AMP autocast (python/paddle/amp/auto_cast.py + imperative/amp_auto_cast.cc
+parity).
+
+TPU-native: bf16 is the native low precision (no loss scaling needed); fp16
+supported for parity. O1 = allow/block lists applied at op dispatch; O2 = cast
+the whole model (decorate). The cast hook lives here and is consulted by
+nn.functional entry points via `current_dtype_for(op)`.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..core.dtypes import bfloat16, convert_dtype, float16, float32
+
+# mirrors fluid/contrib/mixed_precision/fp16_lists.py
+WHITE_LIST = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm",
+              "einsum", "sdpa", "flash_attention"}
+BLACK_LIST = {"exp", "log", "softmax", "log_softmax", "cross_entropy",
+              "mean", "sum", "layer_norm", "batch_norm", "norm",
+              "softmax_with_cross_entropy", "cumsum", "logsumexp"}
+
+_state = {"enabled": False, "dtype": bfloat16, "level": "O1",
+          "custom_white": set(), "custom_black": set()}
+
+
+def is_enabled():
+    return _state["enabled"]
+
+
+def amp_dtype():
+    return _state["dtype"]
+
+
+def amp_level():
+    return _state["level"]
+
+
+def should_cast_to_low(op_name: str) -> bool:
+    if not _state["enabled"]:
+        return False
+    if _state["level"] == "O2":
+        return op_name not in BLACK_LIST | _state["custom_black"]
+    return op_name in (WHITE_LIST | _state["custom_white"]) \
+        and op_name not in _state["custom_black"]
+
+
+def should_cast_to_high(op_name: str) -> bool:
+    if not _state["enabled"]:
+        return False
+    return op_name in BLACK_LIST | _state["custom_black"]
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast parity; dtype defaults to bfloat16 (TPU-native)."""
+    prev = dict(_state)
+    _state["enabled"] = bool(enable)
+    _state["dtype"] = convert_dtype(dtype)
+    _state["level"] = level
+    _state["custom_white"] = set(custom_white_list or ())
+    _state["custom_black"] = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        _state.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate parity: O2 casts model params to the low dtype."""
+    d = convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=d)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers

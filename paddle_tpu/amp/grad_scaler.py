@@ -1,0 +1,151 @@
+"""GradScaler (python/paddle/amp/grad_scaler.py:26 + fluid loss_scaler.py
+parity).
+
+Dynamic loss scaling: scale_ held in a Tensor (traced state); found_inf
+computed with jnp.isfinite over grads (check_finite_and_unscale op parity,
+operators/amp/check_finite_and_unscale_op.cc); growth bookkeeping mirrors
+update_loss_scaling_op.cc. On TPU with bf16 scaling is typically unnecessary —
+enable=False makes all methods passthrough (as the reference does on CPU).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["GradScaler", "AmpScaler"]
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = Tensor(jnp.asarray(init_loss_scaling, dtype=jnp.float32))
+        self._scale.persistable = True
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._good_steps = Tensor(jnp.asarray(0, dtype=jnp.int32))
+        self._bad_steps = Tensor(jnp.asarray(0, dtype=jnp.int32))
+        self._found_inf = Tensor(jnp.asarray(False))
+        self._unscaled_opts = set()  # ids of optimizers already unscaled
+
+    def is_enable(self):
+        return self._enable
+
+    is_use_dynamic_loss_scaling = lambda self: self._use_dynamic  # noqa: E731
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return apply(lambda v, s: v * s.astype(v.dtype), var, self._scale,
+                     name="scale_loss")
+
+    def unscale_(self, optimizer):
+        if not self._enable or id(optimizer) in self._unscaled_opts:
+            return
+        self._unscaled_opts.add(id(optimizer))
+        pairs = optimizer._collect_params_grads()
+        inv = 1.0 / self._scale._value
+        found = jnp.asarray(False)
+        for p, g in pairs:
+            if g is None:
+                continue
+            gv = unwrap(g) * inv.astype(g._val.dtype)
+            found = found | ~jnp.all(jnp.isfinite(gv))
+            g._value = gv
+        self._found_inf._value = found
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+        optimizer.clear_grad()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        self._unscaled_opts.discard(id(optimizer))
+        # skip semantics on inf (update_loss_scaling_op.cc parity): the whole
+        # optimizer step — params AND accumulator/aux state — must be a no-op.
+        # Traceable version: snapshot every state tensor, run the step, then
+        # select(found, old, new) elementwise. XLA folds the selects.
+        found = self._found_inf._value
+        pairs = optimizer._collect_params_grads()
+        state_tensors = [p for p, _ in pairs]
+        for by_param in optimizer._accumulators.values():
+            state_tensors.extend(by_param.values())
+        state_tensors.extend(optimizer._aux.values())
+        snapshot = [(t, t._val) for t in state_tensors]
+        optimizer.step()
+        for t, old in snapshot:
+            t._value = jnp.where(found, old, t._val)
+        # accumulators created lazily DURING this step (first call) also need
+        # masking back to their init values — they were not in the snapshot
+        seen = {id(t) for t, _ in snapshot}
+        for name, by_param in optimizer._accumulators.items():
+            init = optimizer._acc_inits.get(name, 0.0)
+            for t in by_param.values():
+                if id(t) not in seen:
+                    t._value = jnp.where(found, jnp.full_like(t._val, init),
+                                         t._val)
+
+    def update(self):
+        if not (self._enable and self._use_dynamic):
+            return
+        found = self._found_inf._value
+        good = self._good_steps._value
+        bad = self._bad_steps._value
+        scale = self._scale._value
+        good_new = jnp.where(found, 0, good + 1)
+        bad_new = jnp.where(found, bad + 1, 0)
+        scale_new = jnp.where(
+            bad_new >= self._decr_every_n_nan_or_inf,
+            jnp.maximum(scale * self._decr_ratio, 1.0), scale)
+        bad_new = jnp.where(bad_new >= self._decr_every_n_nan_or_inf, 0,
+                            bad_new)
+        scale_new = jnp.where(good_new >= self._incr_every_n_steps,
+                              scale_new * self._incr_ratio, scale_new)
+        good_new = jnp.where(good_new >= self._incr_every_n_steps, 0, good_new)
+        self._good_steps._value = good_new
+        self._bad_steps._value = bad_new
+        self._scale._value = scale_new
+
+    def get_loss_scaling(self):
+        return Tensor(self._scale._value)
+
+    def set_init_loss_scaling(self, v):
+        self._scale._value = jnp.asarray(float(v), dtype=jnp.float32)
+
+    def state_dict(self):
+        return {"scale": Tensor(self._scale._val),
+                "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+                "good_steps": Tensor(self._good_steps._val),
+                "bad_steps": Tensor(self._bad_steps._val)}
+
+    def load_state_dict(self, sd):
+        self._scale._value = unwrap(sd["scale"])
+        self._good_steps._value = unwrap(sd["good_steps"])
+        self._bad_steps._value = unwrap(sd["bad_steps"])
+
+
+def bool_is_concrete(v):
+    try:
+        bool(v)
+        return True
+    except Exception:
+        return False
+
+
+class GradScaler(AmpScaler):
+    """Public API class (amp/grad_scaler.py:26)."""

@@ -1,0 +1,158 @@
+"""paddle.autograd parity (python/paddle/autograd/__init__.py):
+backward, PyLayer, functional jacobian/hessian.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as _engine
+from ..core.autograd import GradNode, no_grad  # noqa: F401
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["backward", "PyLayer", "PyLayerContext", "jacobian", "hessian", "no_grad"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    _engine.backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    """Parity: python/paddle/autograd/py_layer.py:21."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd function (py_layer.py parity).
+
+    Subclass with @staticmethod forward(ctx, *args) and backward(ctx, *grads).
+    TPU-native note: forward/backward bodies run our Tensor ops, so they remain
+    jax-traceable and compose with to_static.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with _engine._GradGuard(False):
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        diff_inputs = [a for a in args
+                       if isinstance(a, Tensor) and not a.stop_gradient]
+        if not _engine.is_grad_enabled() or not diff_inputs:
+            return outs
+
+        def vjp_fn(cotangents):
+            cots = cotangents if multi else (cotangents,)
+            grad_in = cls.backward(
+                ctx, *[Tensor(c, stop_gradient=True) for c in cots])
+            if not isinstance(grad_in, (tuple, list)):
+                grad_in = (grad_in,)
+            # map returned grads (aligned with *tensor* args) onto diff inputs
+            tensor_args = [a for a in args if isinstance(a, Tensor)]
+            gmap = {}
+            for a, g in zip(tensor_args, grad_in):
+                if g is not None:
+                    gmap[id(a)] = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+            return tuple(gmap.get(id(a)) for a in diff_inputs)
+
+        node = GradNode(
+            vjp_fn=vjp_fn,
+            inputs=diff_inputs,
+            out_meta=[(tuple(o.shape), o._value.dtype) for o in out_list],
+            multi_output=multi,
+            name=cls.__name__,
+        )
+        wrapped = []
+        for slot, o in enumerate(out_list):
+            t = Tensor(o._value, stop_gradient=False)
+            t._grad_node = node
+            t._out_index = slot
+            wrapped.append(t)
+        return tuple(wrapped) if multi else wrapped[0]
+
+
+def _functionalize(func, xs):
+    """Build a pure jax fn over the raw values of xs for functional transforms."""
+    def pure(*vals):
+        wrapped = [Tensor(v, stop_gradient=False) for v in vals]
+        out = func(*wrapped) if len(wrapped) > 1 else func(wrapped[0])
+        return unwrap(out)
+    return pure
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """paddle.autograd.jacobian parity (autograd/functional.py:247)."""
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func, xs_list)
+    vals = [unwrap(x) for x in xs_list]
+    jac = jax.jacobian(pure, argnums=tuple(range(len(vals))))(*vals)
+    if single:
+        return Tensor(jac[0])
+    return tuple(Tensor(j) for j in jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """paddle.autograd.hessian parity (autograd/functional.py:389)."""
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func, xs_list)
+    vals = [unwrap(x) for x in xs_list]
+    hes = jax.hessian(pure, argnums=tuple(range(len(vals))))(*vals)
+    if single:
+        return Tensor(hes[0][0])
+    return tuple(tuple(Tensor(h) for h in row) for row in hes)
+
+
+def vjp(func, xs, v=None):
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func, xs_list)
+    vals = [unwrap(x) for x in xs_list]
+    out, vjp_fn = jax.vjp(pure, *vals)
+    cot = unwrap(v) if v is not None else jnp.ones_like(out)
+    grads = vjp_fn(cot)
+    gt = [Tensor(g) for g in grads]
+    return Tensor(out), (gt[0] if single else tuple(gt))
+
+
+def jvp(func, xs, v=None):
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func, xs_list)
+    vals = [unwrap(x) for x in xs_list]
+    tangents = [unwrap(t) for t in (v if isinstance(v, (list, tuple)) else [v])] \
+        if v is not None else [jnp.ones_like(x) for x in vals]
+    out, tan = jax.jvp(pure, vals, tangents)
+    return Tensor(out), Tensor(tan)

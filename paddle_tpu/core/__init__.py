@@ -1,0 +1,8 @@
+from . import autograd, device, dispatch, dtypes, random, tensor  # noqa: F401
+from .device import CPUPlace, CUDAPlace, Place, TPUPlace, get_device, set_device  # noqa: F401
+from .dtypes import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, convert_dtype, float16, float32,
+    float64, get_default_dtype, int8, int16, int32, int64, set_default_dtype,
+    uint8,
+)
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

@@ -1,0 +1,93 @@
+"""Op dispatch: the seam between the paddle-style eager API and JAX/XLA.
+
+Reference parity: paddle/fluid/imperative/tracer.cc TraceOp +
+prepared_operator.cc kernel selection. TPU-native redesign: there is no kernel
+registry keyed by (backend, dtype, layout) — XLA is the single backend; an "op"
+is a pure function over jax.Arrays. `apply` runs it eagerly, and when autograd
+is on it records a GradNode holding the `jax.vjp` closure (forward runs once;
+residuals live in the closure). Under `to_static` tracing the same path runs on
+tracers, so the whole tape lowers into one XLA computation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .autograd import GradNode
+from .tensor import Tensor
+
+__all__ = ["apply", "unwrap", "wrap"]
+
+
+def unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_diff_value(v):
+    return hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact)
+
+
+def apply(prim, *args, name=None, **kwargs):
+    """Run `prim(*raw_args, **kwargs)` with autograd recording.
+
+    - args may mix Tensors and python values; kwargs are static.
+    - prim must be a jax-traceable pure function returning an array or a
+      tuple/list of arrays.
+    - differentiable inputs = Tensor args with inexact dtype and
+      stop_gradient=False (while grad mode enabled).
+    """
+    raw = [unwrap(a) for a in args]
+    record = autograd.is_grad_enabled()
+    diff_idx = []
+    if record:
+        for i, a in enumerate(args):
+            if (
+                isinstance(a, Tensor)
+                and not a.stop_gradient
+                and _is_diff_value(raw[i])
+            ):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        out = prim(*raw, **kwargs)
+        return _wrap_outputs(out, stop_gradient=True)
+
+    def closed(*diff_vals):
+        vals = list(raw)
+        for i, dv in zip(diff_idx, diff_vals):
+            vals[i] = dv
+        r = prim(*vals, **kwargs)
+        # normalize list->tuple so the vjp cotangent structure is always tuple
+        return tuple(r) if isinstance(r, list) else r
+
+    out, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    out_meta = [(o.shape, o.dtype) for o in outs]
+    node = GradNode(
+        vjp_fn=vjp_fn,
+        inputs=[args[i] for i in diff_idx],
+        out_meta=out_meta,
+        multi_output=multi,
+        name=name or getattr(prim, "__name__", "op"),
+    )
+    tensors = []
+    for slot, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=False)
+        t._grad_node = node
+        t._out_index = slot
+        tensors.append(t)
+    if multi:
+        return tuple(tensors)
+    return tensors[0]
+
+
+def _wrap_outputs(out, stop_gradient):
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o, stop_gradient=stop_gradient) for o in out)
+    return Tensor(out, stop_gradient=stop_gradient)
+
+
+def wrap(value, stop_gradient=True):
+    return Tensor(value, stop_gradient=stop_gradient)

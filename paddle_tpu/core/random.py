@@ -1,0 +1,62 @@
+"""Global RNG.
+
+Reference parity: paddle.seed / fluid Generator (paddle/fluid/framework/generator.cc).
+TPU-native redesign: the generator state is a JAX PRNG key held inside a Tensor,
+so `to_static` functionalization captures it as mutable state — every jitted
+step consumes and writes back a fresh key (dropout differs per step inside one
+compiled computation), exactly like the reference's per-device Generator but
+functional.
+"""
+from __future__ import annotations
+
+import jax
+
+from .tensor import Tensor
+
+__all__ = ["seed", "next_key", "get_state", "set_state", "Generator", "default_generator"]
+
+
+class Generator:
+    def __init__(self, seed_: int = 0):
+        self._key = Tensor(jax.random.key_data(jax.random.PRNGKey(seed_)),
+                           stop_gradient=True)
+        self._key.persistable = True
+        self._key.name = "generator_key"
+
+    def manual_seed(self, seed_: int):
+        self._key._value = jax.random.key_data(jax.random.PRNGKey(int(seed_)))
+        return self
+
+    def next_key(self):
+        """Split the state; returns a raw jax PRNG key for one sampling op."""
+        key = jax.random.wrap_key_data(self._key._value)
+        new_key, sub = jax.random.split(key)
+        self._key._value = jax.random.key_data(new_key)
+        return sub
+
+    def get_state(self):
+        return Tensor(self._key._value, stop_gradient=True)
+
+    def set_state(self, state):
+        self._key._value = state._value if isinstance(state, Tensor) else state
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int):
+    """paddle.seed parity."""
+    default_generator.manual_seed(s)
+    return default_generator
+
+
+def next_key():
+    return default_generator.next_key()
+
+
+def get_state():
+    return default_generator.get_state()
+
+
+def set_state(state):
+    default_generator.set_state(state)

@@ -1,0 +1,297 @@
+"""Eager Tensor.
+
+Reference parity: paddle/fluid/imperative/layer.h (VarBase = value + grad +
+hooks) and python/paddle/fluid/dygraph/varbase_patch_methods.py. TPU-native
+redesign: the value is a jax.Array (PJRT buffer on TPU); eager ops run through
+JAX's eager dispatch; the tape is attached here (`_grad_node`); mutation of
+`_value` is hooked so the `to_static` functionalizer can treat any Tensor
+(parameters, optimizer moments, RNG keys) as traced state.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtypes import convert_dtype, get_default_dtype
+
+__all__ = ["Tensor", "Parameter", "to_tensor"]
+
+
+class _TraceHooks:
+    """Module-level hooks installed by the jit/to_static functionalizer."""
+
+    on_read = None    # fn(tensor) — called when ._value is read
+    on_write = None   # fn(tensor) — called when ._value is assigned
+    on_create = None  # fn(tensor) — called from Tensor.__init__
+
+
+class Tensor:
+    __slots__ = (
+        "_val",
+        "grad",
+        "stop_gradient",
+        "_grad_node",
+        "_out_index",
+        "_grad_capture",
+        "name",
+        "persistable",
+        "trainable",
+        "_hooks",
+        "__weakref__",
+    )
+
+    def __init__(self, value, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        if isinstance(value, Tensor):
+            value = value._val
+        dtype = convert_dtype(dtype)
+        if not isinstance(value, jax.Array):
+            arr = np.asarray(value)
+            if dtype is None and arr.dtype == np.float64:
+                dtype = get_default_dtype()
+            value = jnp.asarray(arr, dtype=dtype)
+        elif dtype is not None and value.dtype != dtype:
+            value = value.astype(dtype)
+        if place is not None:
+            value = jax.device_put(value, place.jax_device)
+        self._val = value
+        self.grad = None
+        self.stop_gradient = stop_gradient
+        self._grad_node = None
+        self._out_index = 0
+        self._grad_capture = None
+        self.name = name
+        self.persistable = False
+        self.trainable = True
+        self._hooks = None
+        if _TraceHooks.on_create is not None:
+            _TraceHooks.on_create(self)
+
+    # -- value access (hooked for trace capture) --------------------------------
+    @property
+    def _value(self):
+        if _TraceHooks.on_read is not None:
+            _TraceHooks.on_read(self)
+        return self._val
+
+    @_value.setter
+    def _value(self, v):
+        # hook fires BEFORE the write so tracers can snapshot the old value
+        if _TraceHooks.on_write is not None:
+            _TraceHooks.on_write(self)
+        self._val = v
+
+    @property
+    def value(self):
+        return self._value
+
+    # -- metadata ---------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._val.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._val.dtype)
+
+    @property
+    def ndim(self):
+        return self._val.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._val.shape)) if self._val.shape else 1
+
+    @property
+    def place(self):
+        from .device import CPUPlace, TPUPlace
+        try:
+            dev = list(self._val.devices())[0]
+        except Exception:
+            return CPUPlace(0)
+        if dev.platform == "cpu":
+            return CPUPlace(dev.id)
+        return TPUPlace(dev.id)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    # -- conversion -------------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def astype(self, dtype):
+        from ..tensor.manipulation import cast
+        return cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def detach(self):
+        t = Tensor(self._val, stop_gradient=True)
+        return t
+
+    def clone(self):
+        from .dispatch import apply
+        return apply(lambda x: x + 0, self, name="clone")
+
+    def cpu(self):
+        from .device import CPUPlace
+        return Tensor(jax.device_put(self._val, CPUPlace(0).jax_device),
+                      stop_gradient=self.stop_gradient)
+
+    def tpu(self, device_id=0):
+        from .device import TPUPlace
+        return Tensor(jax.device_put(self._val, TPUPlace(device_id).jax_device),
+                      stop_gradient=self.stop_gradient)
+
+    cuda = tpu  # reference-API shim
+
+    def pin_memory(self):
+        return self
+
+    # -- autograd ---------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._val), stop_gradient=True)
+        else:
+            self.grad = None
+
+    def _accumulate_grad(self, g):
+        if self._grad_capture is not None:
+            self._grad_capture(g)
+            return
+        if self._hooks:
+            for hook in self._hooks:
+                out = hook(Tensor(g, stop_gradient=True))
+                if out is not None:
+                    g = out._val if isinstance(out, Tensor) else jnp.asarray(out)
+        if self.grad is None:
+            self.grad = Tensor(g, stop_gradient=True)
+        else:
+            self.grad = Tensor(self.grad._val + g, stop_gradient=True)
+
+    def register_hook(self, hook):
+        """Gradient hook on a leaf (imperative/hooks.h parity)."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+        idx = len(self._hooks) - 1
+
+        class _Removable:
+            def remove(_self):
+                self._hooks[idx] = lambda g: None
+        return _Removable()
+
+    # -- in-place (optimizer/runtime use; not differentiated through) -----------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._val
+        value = jnp.asarray(value, dtype=self._val.dtype)
+        if tuple(value.shape) != tuple(self._val.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._val.shape}")
+        self._value = value
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def _replace_value(self, v):
+        """Internal raw replacement (functional state update)."""
+        self._value = v
+
+    def scale_(self, factor):
+        self._value = self._val * factor
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._val)
+        return self
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._val, v)
+        return self
+
+    # -- python protocol --------------------------------------------------------
+    def __len__(self):
+        if not self._val.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._val.shape[0]
+
+    def __repr__(self):
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"stop_gradient={self.stop_gradient},\n{np.asarray(self._val)!r})"
+        )
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __format__(self, spec):
+        if self._val.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # Arithmetic dunders are patched in paddle_tpu/tensor/__init__.py (the
+    # reference monkey-patches VarBase the same way:
+    # python/paddle/fluid/dygraph/math_op_patch.py).
+
+    # jax interop: allow jnp.asarray(tensor)
+    def __jax_array__(self):
+        return self._value
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._val)
+        return a.astype(dtype) if dtype is not None else a
+
+
+class Parameter(Tensor):
+    """Trainable leaf (python/paddle/fluid/framework.py Parameter parity)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed",
+                 "sharding_spec")
+
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.sharding_spec = None
+
+    def __repr__(self):
+        return "Parameter: " + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

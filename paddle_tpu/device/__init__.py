@@ -1,0 +1,67 @@
+"""paddle.device parity (python/paddle/device/__init__.py)."""
+from __future__ import annotations
+
+from ..core.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, Place, TPUPlace, device_count, get_all_devices,
+    get_device, is_compiled_with_cuda, is_compiled_with_tpu, set_device,
+)
+
+__all__ = ["set_device", "get_device", "TPUPlace", "CPUPlace", "CUDAPlace",
+           "device_count", "is_compiled_with_tpu", "is_compiled_with_cuda",
+           "synchronize", "cuda", "tpu"]
+
+
+def synchronize(device=None):
+    """Block until all queued work completes (cudaDeviceSynchronize parity —
+    on jax, realize by blocking on a trivial transfer)."""
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+class _DeviceNS:
+    """paddle.device.cuda-style namespace (streams are XLA-managed; the
+    synchronization entry points exist for API parity)."""
+
+    @staticmethod
+    def device_count():
+        return device_count("tpu")
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)
+
+    @staticmethod
+    def current_stream(device=None):
+        return None
+
+    @staticmethod
+    def stream_guard(stream):
+        import contextlib
+        return contextlib.nullcontext()
+
+    @staticmethod
+    def empty_cache():
+        import gc
+        gc.collect()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+
+cuda = _DeviceNS()
+tpu = _DeviceNS()

@@ -1,0 +1,44 @@
+"""paddle.distributed parity — TPU-native SPMD design.
+
+Reference: python/paddle/distributed (collective.py, parallel.py:69
+init_parallel_env, fleet/). Mapping (SURVEY.md §2.7):
+  NCCL ring (ring_id)        →  named mesh axis on a jax.sharding.Mesh
+  ncclUniqueId bootstrap     →  jax.distributed coordination service
+  c_allreduce_sum etc.       →  lax collectives inside compiled programs /
+                                 eager device_put+reduce fallback
+  rank / world_size          →  process_index over the mesh ("data" axis by
+                                 default for DP scripts)
+"""
+from __future__ import annotations
+
+from .collective import (  # noqa: F401
+    all_gather, all_reduce, alltoall, barrier, broadcast, get_group,
+    new_group, recv, reduce, reduce_scatter, scatter, send, split,
+    ReduceOp,
+)
+from .env import (  # noqa: F401
+    get_rank, get_world_size, init_parallel_env, is_initialized,
+    parallel_device_count,
+)
+from .mesh import get_mesh, global_mesh, set_mesh  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "all_reduce",
+    "all_gather", "reduce", "broadcast", "scatter", "alltoall", "barrier",
+    "send", "recv", "reduce_scatter", "new_group", "get_group", "split",
+    "ReduceOp", "DataParallel", "fleet", "get_mesh", "set_mesh",
+    "spawn", "launch",
+]
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """paddle.distributed.spawn parity: under SPMD a single process drives all
+    local devices — run func once (the mesh covers the chips)."""
+    init_parallel_env()
+    return func(*args)
+
+
+def launch():
+    raise NotImplementedError("use python -m paddle_tpu.distributed.launch")

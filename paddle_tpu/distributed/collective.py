@@ -1,0 +1,295 @@
+"""Collective API (distributed/collective.py parity).
+
+Reference mechanism: c_* ops carrying ring_id, launched on NCCL comm streams
+(operators/collective/c_allreduce_op.h:341). TPU-native: a Group names a mesh
+axis; inside SPMD-traced code (shard_map under to_static / fleet wrappers) each
+collective lowers to the XLA collective on that axis (psum/all_gather/
+ppermute/all_to_all ride the ICI); called eagerly outside a mesh context they
+are cross-process host collectives (DCN) or no-ops for world_size 1 — matching
+the reference's use_calc_stream=True semantics (synchronous).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import core as jax_core
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+from .env import get_world_size
+from .mesh import get_mesh
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """≈ NCCL ring: identifies a mesh axis (+ optional rank subset)."""
+
+    _next_id = [1]
+
+    def __init__(self, axis="data", ranks=None, gid=None):
+        self.axis = axis
+        self.ranks = ranks
+        self.id = gid if gid is not None else Group._next_id[0]
+        Group._next_id[0] += 1
+
+    @property
+    def nranks(self):
+        from .mesh import axis_degree
+        return axis_degree(self.axis)
+
+    @property
+    def rank(self):
+        return 0  # per-device rank is only meaningful inside shard_map
+
+    def __repr__(self):
+        return f"Group(axis={self.axis}, nranks={self.nranks})"
+
+
+_GROUPS = {0: Group(axis="data", gid=0)}
+
+
+def _default_group():
+    return _GROUPS[0]
+
+
+def new_group(ranks=None, backend=None, axis="data"):
+    g = Group(axis=axis, ranks=ranks)
+    _GROUPS[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    return _GROUPS.get(gid)
+
+
+def _is_traced(v):
+    return isinstance(v, jax_core.Tracer)
+
+
+def _axis_in_scope(axis):
+    """True if `axis` is a bound axis name in the current trace (shard_map)."""
+    try:
+        jax_core.get_axis_env().axis_size(axis)  # jax>=0.9 internal
+        return True
+    except Exception:
+        try:
+            jax.lax.axis_index(axis)
+            return True
+        except Exception:
+            return False
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """c_allreduce_{sum,max,min,prod} parity; in-place like the reference."""
+    g = group or _default_group()
+    v = unwrap(tensor)
+    if _is_traced(v):
+        def prim(x):
+            if op == ReduceOp.SUM:
+                return jax.lax.psum(x, g.axis)
+            if op == ReduceOp.MAX:
+                return jax.lax.pmax(x, g.axis)
+            if op == ReduceOp.MIN:
+                return jax.lax.pmin(x, g.axis)
+            if op == ReduceOp.AVG:
+                return jax.lax.pmean(x, g.axis)
+            if op == ReduceOp.PROD:
+                return jnp.exp(jax.lax.psum(jnp.log(x), g.axis))
+            raise ValueError(op)
+        out = apply(prim, tensor, name="c_allreduce")
+        tensor._value = out._value
+        return tensor
+    if get_world_size() <= 1:
+        return tensor
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(v)
+    red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+           "prod": jnp.prod, "avg": jnp.mean}[op](gathered, axis=0)
+    tensor._value = red
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    g = group or _default_group()
+    v = unwrap(tensor)
+    if _is_traced(v):
+        out = apply(lambda x: jax.lax.all_gather(x, g.axis), tensor,
+                    name="c_allgather")
+        n = out.shape[0]
+        from ..tensor.manipulation import unstack
+        parts = unstack(out, axis=0)
+        tensor_list.clear()
+        tensor_list.extend(parts)
+        return tensor_list
+    if get_world_size() <= 1:
+        tensor_list.clear()
+        tensor_list.append(Tensor(v))
+        return tensor_list
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(v)
+    tensor_list.clear()
+    tensor_list.extend(Tensor(gathered[i]) for i in range(gathered.shape[0]))
+    return tensor_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # on SPMD every participant holds the result; semantics match dst's view
+    return all_reduce(tensor, op=op, group=group)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = group or _default_group()
+    v = unwrap(tensor)
+    if _is_traced(v):
+        def prim(x):
+            # take src's shard on the axis: gather then index (XLA optimizes
+            # this into a broadcast from src)
+            return jax.lax.all_gather(x, g.axis)[src]
+        out = apply(prim, tensor, name="c_broadcast")
+        tensor._value = out._value
+        return tensor
+    return tensor  # single-controller SPMD: host arrays are already replicated
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = group or _default_group()
+    if tensor_list is not None:
+        v = unwrap(tensor_list[0] if isinstance(tensor_list, list) else tensor_list)
+        if _is_traced(v):
+            from ..tensor.manipulation import stack
+            stacked = stack(list(tensor_list), axis=0)
+            def prim(x):
+                idx = jax.lax.axis_index(g.axis)
+                return jax.lax.dynamic_index_in_dim(x, idx, 0, keepdims=False)
+            out = apply(prim, stacked, name="c_scatter")
+            tensor._value = out._value
+            return tensor
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    g = group or _default_group()
+    src = tensor_list if tensor_list is not None else tensor
+    if isinstance(src, list):
+        from ..tensor.manipulation import concat
+        src = concat(src, axis=0)
+    v = unwrap(src)
+    if _is_traced(v):
+        out = apply(
+            lambda x: jax.lax.psum_scatter(x, g.axis, scatter_dimension=0,
+                                           tiled=True),
+            src, name="c_reducescatter")
+        tensor._value = out._value
+        return tensor
+    if get_world_size() <= 1:
+        tensor._value = v
+        return tensor
+    raise NotImplementedError("eager multi-host reduce_scatter")
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """global all-to-all (reference alltoall_op.cc; MoE global_scatter base)."""
+    g = group or _default_group()
+    if isinstance(in_tensor_list, list):
+        from ..tensor.manipulation import stack
+        x = stack(in_tensor_list, axis=0)
+    else:
+        x = in_tensor_list
+    v = unwrap(x)
+    if _is_traced(v):
+        out = apply(
+            lambda t: jax.lax.all_to_all(t, g.axis, split_axis=0,
+                                         concat_axis=0, tiled=False),
+            x, name="alltoall")
+        if out_tensor_list is not None:
+            from ..tensor.manipulation import unstack
+            parts = unstack(out, axis=0)
+            out_tensor_list.clear()
+            out_tensor_list.extend(parts)
+            return out_tensor_list
+        return out
+    if get_world_size() <= 1:
+        if out_tensor_list is not None:
+            out_tensor_list.clear()
+            out_tensor_list.extend(
+                in_tensor_list if isinstance(in_tensor_list, list) else [x])
+            return out_tensor_list
+        return x
+    raise NotImplementedError("eager multi-host alltoall")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """send_v2 parity — meaningful inside pipeline shard_map regions, where it
+    lowers to ppermute (see fleet.meta_parallel pipeline implementation)."""
+    g = group or _default_group()
+    v = unwrap(tensor)
+    if _is_traced(v):
+        n = g.nranks
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        out = apply(lambda x: jax.lax.ppermute(x, g.axis, perm), tensor,
+                    name="send_v2")
+        return out
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def barrier(group=None):
+    if get_world_size() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def split(x, num_or_sections, axis=0, group=None):
+    """paddle.distributed.split (megatron-style layer split helper,
+    collective.py:1233) — implemented at the fleet.meta_parallel layer."""
+    from ..tensor.manipulation import split as _split
+    return _split(x, num_or_sections, axis=axis)
+
+
+# -- TP helper collectives (mp_ops parity: _c_identity/_c_concat/_mp_allreduce)
+def _c_identity(x, group=None):
+    """Forward identity, backward all-reduce over the model axis."""
+    g = group or _default_group()
+    from ..autograd import PyLayer
+
+    class CIdentity(PyLayer):
+        @staticmethod
+        def forward(ctx, t):
+            return Tensor(unwrap(t))
+
+        @staticmethod
+        def backward(ctx, grad):
+            out = Tensor(unwrap(grad))
+            all_reduce(out, group=g)
+            return out
+
+    return CIdentity.apply(x)
+
+
+def _mp_allreduce(x, group=None):
+    """Forward all-reduce, backward identity (row-parallel output combine)."""
+    g = group or _default_group()
+    v = unwrap(x)
+    if _is_traced(v):
+        def prim(t):
+            summed = jax.lax.psum(t, g.axis)
+            return summed
+        # psum's transpose in jax is psum again; we want identity backward —
+        # emulate: out = psum(stop_grad(x)) + x - stop_grad(x)
+        def prim_id_bwd(t):
+            sg = jax.lax.stop_gradient(t)
+            return jax.lax.psum(sg, g.axis) + (t - sg)
+        return apply(prim_id_bwd, x, name="mp_allreduce")
+    return x

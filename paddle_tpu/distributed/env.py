@@ -1,0 +1,54 @@
+"""Distributed environment (distributed/parallel.py:69 init_parallel_env
+parity).
+
+TPU-native: one python process per HOST (not per device, unlike the
+reference's process-per-GPU launcher); jax.distributed handles multi-host
+coordination (≈ gen_comm_id_helper TCP bootstrap). rank = process_index,
+world = total hosts * local devices when used for data sharding.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_STATE = {"initialized": False}
+
+
+def init_parallel_env(strategy=None):
+    if _STATE["initialized"]:
+        return
+    # multi-host bootstrap via env (PADDLE_TRAINER_* parity names honored)
+    coord = os.environ.get("PADDLE_COORDINATOR_ADDR") or \
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("PADDLE_TRAINERS_NUM") or \
+        os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("PADDLE_TRAINER_ID") or \
+        os.environ.get("JAX_PROCESS_ID")
+    if coord and nproc and int(nproc) > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=int(nproc),
+                                   process_id=int(pid or 0))
+    from .mesh import build_mesh
+    build_mesh()
+    _STATE["initialized"] = True
+
+
+def is_initialized():
+    return _STATE["initialized"]
+
+
+def get_rank(group=None):
+    """Data-parallel rank of this process (process_index; per-device ranks
+    exist only inside shard_map'd code)."""
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None and hasattr(group, "nranks"):
+        return group.nranks
+    return jax.process_count()
+
+
+def parallel_device_count():
+    return jax.local_device_count()

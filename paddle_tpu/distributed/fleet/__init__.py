@@ -1,0 +1,26 @@
+"""paddle.distributed.fleet parity — entry points.
+
+Reference: fleet/base/fleet_base.py:103. Round-1 surface: init /
+distributed_model / distributed_optimizer / DistributedStrategy / worker env
+queries; hybrid meta_parallel layers land in .meta_parallel.
+"""
+from __future__ import annotations
+
+from .base import (  # noqa: F401
+    DistributedStrategy, Fleet, PaddleCloudRoleMaker, UserDefinedRoleMaker,
+)
+
+_fleet = Fleet()
+
+init = _fleet.init
+is_first_worker = _fleet.is_first_worker
+worker_index = _fleet.worker_index
+worker_num = _fleet.worker_num
+is_worker = _fleet.is_worker
+worker_endpoints = _fleet.worker_endpoints
+distributed_model = _fleet.distributed_model
+distributed_optimizer = _fleet.distributed_optimizer
+get_hybrid_communicate_group = _fleet.get_hybrid_communicate_group
+
+from . import meta_parallel  # noqa: F401,E402
+from .utils import recompute  # noqa: F401,E402

@@ -1,0 +1,219 @@
+"""Fleet base (fleet/base/fleet_base.py:103 + distributed_strategy.py +
+topology.py parity)."""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..env import get_rank, get_world_size, init_parallel_env
+from ..mesh import build_mesh, get_mesh
+
+__all__ = ["Fleet", "DistributedStrategy", "HybridCommunicateGroup",
+           "CommunicateTopology", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]
+
+
+class DistributedStrategy:
+    """fleet/base/distributed_strategy.py parity (the proto-backed strategy
+    object, framework/distributed_strategy.proto:238). Fields stored as plain
+    attributes; only TPU-meaningful ones are consumed, others accepted."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_batch_norm = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class CommunicateTopology:
+    """topology.py:36 parity: N-D cartesian rank mesh."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world = int(np.prod(dims))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return self._world
+
+
+class HybridCommunicateGroup:
+    """topology.py:117 parity over the jax mesh."""
+
+    def __init__(self, topology):
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        degrees = {n: topology.get_dim(n) for n in names}
+        # map reference names to mesh axes
+        axis_map = {"data": "data", "pipe": "pipe", "sharding": "sharding",
+                    "model": "model", "sep": "sep"}
+        mesh_axes = {axis_map.get(n, n): d for n, d in degrees.items()
+                     if d > 1}
+        ndev = len(jax.devices())
+        if not mesh_axes:
+            mesh_axes = {"data": ndev}
+        else:
+            have = int(np.prod(list(mesh_axes.values())))
+            if have < ndev and "data" not in mesh_axes:
+                mesh_axes = {"data": ndev // have, **mesh_axes}
+        self.mesh = build_mesh(mesh_axes)
+        self._dp_degree = degrees.get("data", 1)
+        self._mp_degree = degrees.get("model", 1)
+        self._pp_degree = degrees.get("pipe", 1)
+        self._sharding_degree = degrees.get("sharding", 1)
+
+    def get_parallel_mode(self):
+        from . import meta_parallel as mp
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding"
+        if self._mp_degree > 1:
+            return "tensor"
+        return "data"
+
+    # reference accessors
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        from ..collective import new_group
+        return new_group(axis="model")
+
+    def get_data_parallel_group(self):
+        from ..collective import new_group
+        return new_group(axis="data")
+
+    def get_pipe_parallel_group(self):
+        from ..collective import new_group
+        return new_group(axis="pipe")
+
+    def get_sharding_parallel_group(self):
+        from ..collective import new_group
+        return new_group(axis="sharding")
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    pass
+
+
+class Fleet:
+    """fleet_base.py:103 parity."""
+
+    def __init__(self):
+        self._role_maker = None
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        topo = CommunicateTopology(
+            ("data", "pipe", "sharding", "model"),
+            (hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+             hc.get("sharding_degree", 1), hc.get("mp_degree", 1)))
+        self._hcg = HybridCommunicateGroup(topo)
+        self._is_initialized = True
+        return self
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_worker(self):
+        return True
+
+    def worker_endpoints(self, to_string=False):
+        eps = ["127.0.0.1:0"]
+        return ",".join(eps) if to_string else eps
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def distributed_model(self, model):
+        from ..parallel import DataParallel
+        from .meta_parallel import (PipelineParallel, ShardingParallel,
+                                    TensorParallel)
+        if self._hcg is None:
+            self.init()
+        mode = self._hcg.get_parallel_mode()
+        if mode == "pipeline":
+            return PipelineParallel(model, self._hcg, self._strategy)
+        if mode == "tensor":
+            return TensorParallel(model, self._hcg, self._strategy)
+        if mode == "sharding":
+            return ShardingParallel(model, self._hcg, self._strategy)
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        from .meta_parallel import HybridParallelOptimizer
+        if self._hcg is not None and self._hcg.get_parallel_mode() != "data":
+            return HybridParallelOptimizer(optimizer, self._hcg,
+                                           self._strategy)
+        return optimizer

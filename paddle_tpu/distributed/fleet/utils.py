@@ -1,0 +1,38 @@
+"""fleet.utils (fleet/utils/recompute.py:182 parity).
+
+TPU-native: recompute = jax.checkpoint (rematerialization) applied to the
+layer function — XLA re-executes the forward inside backward, trading FLOPs
+for HBM exactly like the reference's PyLayer-based rerun, with RNG handled by
+functional keys (no state juggling needed).
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core.dispatch import apply, unwrap
+from ...core.tensor import Tensor
+
+__all__ = ["recompute"]
+
+
+def recompute(function, *args, **kwargs):
+    preserve = kwargs.pop("preserve_rng_state", True)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other = [(i, a) for i, a in enumerate(args) if not isinstance(a, Tensor)]
+
+    def pure(*vals):
+        rebuilt = []
+        vi = 0
+        oi = 0
+        for i in range(len(args)):
+            if oi < len(other) and other[oi][0] == i:
+                rebuilt.append(other[oi][1])
+                oi += 1
+            else:
+                rebuilt.append(Tensor(vals[vi], stop_gradient=False))
+                vi += 1
+        out = function(*rebuilt, **kwargs)
+        return unwrap(out)
+
+    ckpt = jax.checkpoint(pure)
+    return apply(ckpt, *tensor_args, name="recompute")

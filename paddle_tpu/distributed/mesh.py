@@ -1,0 +1,65 @@
+"""Global device-mesh registry.
+
+Reference parity: platform/collective_helper.h NCCLCommContext (ring registry)
++ fleet/base/topology.py CommunicateTopology. TPU-native: ONE logical N-D mesh
+over all devices; "rings" are named axes. Axis names follow the reference's
+hybrid order ["data", "pipe", "sharding", "model"] (topology.py:36) plus
+"sep"/"expert" for sequence/expert parallel.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_STATE = {"mesh": None, "axis_degrees": None}
+
+HYBRID_AXES = ("data", "pipe", "sharding", "sep", "model")
+
+
+def build_mesh(axis_degrees=None, devices=None):
+    """Create the global mesh. axis_degrees: dict axis->degree; product must
+    equal len(devices). Default: all devices on the 'data' axis."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if axis_degrees is None:
+        axis_degrees = {"data": n}
+    names = [a for a in HYBRID_AXES if a in axis_degrees] + \
+        [a for a in axis_degrees if a not in HYBRID_AXES]
+    degrees = [axis_degrees[a] for a in names]
+    total = int(np.prod(degrees))
+    if total != n:
+        # pad missing factor onto data axis
+        if "data" in axis_degrees:
+            raise ValueError(
+                f"axis degrees {axis_degrees} do not cover {n} devices")
+        names = ["data"] + names
+        degrees = [n // total] + degrees
+    arr = np.asarray(devices).reshape(degrees)
+    mesh = Mesh(arr, tuple(names))
+    _STATE["mesh"] = mesh
+    _STATE["axis_degrees"] = dict(zip(names, degrees))
+    return mesh
+
+
+def set_mesh(mesh):
+    _STATE["mesh"] = mesh
+    _STATE["axis_degrees"] = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return mesh
+
+
+def get_mesh():
+    if _STATE["mesh"] is None:
+        build_mesh()
+    return _STATE["mesh"]
+
+
+def global_mesh():
+    return get_mesh()
+
+
+def axis_degree(axis):
+    m = get_mesh()
+    if axis in m.axis_names:
+        return m.devices.shape[m.axis_names.index(axis)]
+    return 1

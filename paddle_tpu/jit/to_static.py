@@ -1,0 +1,369 @@
+"""`to_static`: whole-program capture → cached XLA computation.
+
+Reference parity: python/paddle/fluid/dygraph/dygraph_to_static/
+(StaticFunction/ConcreteProgram/PartialProgramLayer — jit.py:161,
+program_translator.py:234,590; partial_program.py:116). The reference
+AST-rewrites python into a ProgramDesc and runs it as one fused `run_program`
+op. TPU-native redesign: no AST surgery — the eager tape IS jax-traceable, so
+we functionalize instead:
+
+  phase A (discovery, first call per input signature): run the function
+    eagerly with read/write hooks on Tensor._value installed — every Tensor
+    read is a capture (parameters, optimizer moments, RNG key, lr, BN stats),
+    every captured Tensor written is mutated state.
+  phase B (compile): build pure_fn(mut_vals, ro_vals, arg_vals) ->
+    (out_vals, new_state), jit it (donating mutated-state buffers when no
+    gradient is recorded), cache by input signature.
+  steady state: one compiled XLA executable per signature; python only
+    shuttles buffers — the reference's per-op interpreter loop is gone (the
+    TPU throughput seam named in SURVEY.md §2.8).
+
+Gradient flows through a compiled forward like the reference's run_program
+grad: the jitted function is recorded on the tape as a single op whose VJP is
+jax's vjp of the whole program (also compiled).
+
+Python control flow is evaluated at trace time (same static-unrolling
+semantics as the reference's to_static for non-tensor conditions).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.autograd import GradNode
+from ..core.dtypes import is_inexact
+from ..core.tensor import Tensor, _TraceHooks
+
+__all__ = ["to_static", "not_to_static", "TracedLayer", "InputSpec"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+def _sig_of(value):
+    if isinstance(value, Tensor):
+        return ("T", tuple(value._val.shape), str(value._val.dtype))
+    if isinstance(value, (list, tuple)):
+        return (type(value).__name__, tuple(_sig_of(v) for v in value))
+    if isinstance(value, dict):
+        return ("dict", tuple(sorted((k, _sig_of(v)) for k, v in value.items())))
+    return ("py", value if isinstance(value, (int, float, str, bool, type(None)))
+            else str(type(value)))
+
+
+def _flatten_tensors(obj, out):
+    if isinstance(obj, Tensor):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _flatten_tensors(v, out)
+    elif isinstance(obj, dict):
+        for k in sorted(obj):
+            _flatten_tensors(obj[k], out)
+    return out
+
+
+_LEAF = object()
+
+
+def _build_tree(obj):
+    if isinstance(obj, Tensor):
+        return (_LEAF, obj.stop_gradient)
+    if isinstance(obj, (list, tuple)):
+        return (type(obj), [_build_tree(v) for v in obj])
+    if isinstance(obj, dict):
+        return (dict, [(k, _build_tree(obj[k])) for k in sorted(obj)])
+    return ("const", obj)
+
+
+def _unflatten(tree, leaves):
+    tag = tree[0]
+    if tag is _LEAF:
+        t = leaves.pop(0)
+        return t
+    if tag == "const":
+        return tree[1]
+    if tag is dict:
+        return {k: _unflatten(sub, leaves) for k, sub in tree[1]}
+    return tag(_unflatten(sub, leaves) for sub in tree[1])
+
+
+class _DiscoveryCtx:
+    """Installed during phase A: records reads (captures) and writes (state)."""
+
+    def __init__(self, explicit_ids):
+        self.explicit = set(explicit_ids)
+        self.created_ids = set()
+        self.captured = []
+        self.captured_ids = set()
+        self.mutated_ids = set()
+        self.mutated = []
+
+    def on_create(self, t):
+        # tensors born inside the traced region are intermediates, not state
+        self.created_ids.add(id(t))
+
+    def on_read(self, t):
+        i = id(t)
+        if i in self.explicit or i in self.created_ids or i in self.captured_ids:
+            return
+        self.captured_ids.add(i)
+        self.captured.append(t)
+
+    def on_write(self, t):
+        i = id(t)
+        if i in self.explicit or i in self.created_ids or i in self.mutated_ids:
+            return
+        self.mutated_ids.add(i)
+        self.mutated.append(t)
+        # write-only state (e.g. BN running stats updated via ._val reads)
+        # still needs an input slot + write-back: register as captured too
+        if i not in self.captured_ids:
+            self.captured_ids.add(i)
+            self.captured.append(t)
+
+
+class _Program:
+    __slots__ = ("captured", "mutated", "ro", "jitted", "jitted_donate",
+                 "out_tree", "n_outs", "stage")
+
+    def __init__(self):
+        self.captured = []
+        self.mutated = []
+        self.ro = []
+        self.jitted = None
+        self.jitted_donate = None
+        self.out_tree = None
+        self.n_outs = 0
+        self.stage = 0
+
+
+class StaticFunction:
+    """Callable wrapper (program_translator.py:234 StaticFunction parity)."""
+
+    def __init__(self, fn, input_spec=None, build_strategy=None):
+        functools.update_wrapper(self, fn)
+        self._fn = fn
+        self._input_spec = input_spec
+        self._programs = {}
+        self._enabled = True
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        # one bound wrapper (and program cache) PER INSTANCE — programs capture
+        # the instance's parameter tensors, so sharing across instances would
+        # run one model's compiled program with another model's weights.
+        cache_name = f"__static_fn_{id(self)}"
+        bound = instance.__dict__.get(cache_name)
+        if bound is None:
+            bound = StaticFunction.__new__(StaticFunction)
+            bound.__dict__ = self.__dict__.copy()
+            bound._fn = self._fn.__get__(instance, owner)
+            bound._programs = {}
+            instance.__dict__[cache_name] = bound
+        return bound
+
+    @property
+    def programs(self):
+        return self._programs
+
+    def __call__(self, *args, **kwargs):
+        if not self._enabled:
+            return self._fn(*args, **kwargs)
+        key = (_sig_of(args), _sig_of(kwargs), autograd.is_grad_enabled())
+        prog = self._programs.get(key)
+        # Two eager discovery calls: the first warms lazily-created state
+        # (optimizer accumulators, RNG splits); the second records the
+        # steady-state capture/mutation sets. Compile on the third call.
+        if prog is None or prog.stage < 2:
+            return self._discover(key, args, kwargs)
+        if prog.jitted is None:
+            self._build(prog, args, kwargs)
+        return self._run(prog, args, kwargs)
+
+    # -- phase A ---------------------------------------------------------------
+    def _discover(self, key, args, kwargs):
+        arg_tensors = _flatten_tensors((args, kwargs), [])
+        ctx = _DiscoveryCtx([id(t) for t in arg_tensors])
+        prev = (_TraceHooks.on_read, _TraceHooks.on_write,
+                _TraceHooks.on_create)
+        _TraceHooks.on_read = ctx.on_read
+        _TraceHooks.on_write = ctx.on_write
+        _TraceHooks.on_create = ctx.on_create
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            (_TraceHooks.on_read, _TraceHooks.on_write,
+             _TraceHooks.on_create) = prev
+        prog = self._programs.get(key) or _Program()
+        prog.stage += 1
+        prog.captured = ctx.captured
+        mutated_ids = ctx.mutated_ids & ctx.captured_ids
+        prog.mutated = [t for t in ctx.captured if id(t) in mutated_ids]
+        prog.ro = [t for t in ctx.captured if id(t) not in mutated_ids]
+        prog.out_tree = _build_tree(out)
+        prog.n_outs = len(_flatten_tensors(out, []))
+        self._programs[key] = prog
+        return out
+
+    # -- phase B ---------------------------------------------------------------
+    def _build(self, prog, args, kwargs):
+        fn = self._fn
+        mutated, ro = prog.mutated, prog.ro
+        arg_tensors = _flatten_tensors((args, kwargs), [])
+        n_outs = prog.n_outs
+
+        def pure_fn(mut_vals, ro_vals, arg_vals):
+            all_t = mutated + ro + arg_tensors
+            all_ids = {id(t) for t in all_t}
+            saved = [t._val for t in all_t]
+            # safety net: the trace may write tensors the discovery pass did
+            # not see (rare dynamic state); snapshot-before-write and restore,
+            # so no tracer ever leaks out of the trace.
+            stray = {}
+
+            def track_write(t):
+                i = id(t)
+                if i not in all_ids and i not in stray:
+                    stray[i] = (t, t._val)
+
+            prev_hooks = (_TraceHooks.on_read, _TraceHooks.on_write,
+                          _TraceHooks.on_create)
+            _TraceHooks.on_read = None
+            _TraceHooks.on_write = track_write
+            _TraceHooks.on_create = None
+            try:
+                for t, v in zip(mutated, mut_vals):
+                    t._val = v
+                for t, v in zip(ro, ro_vals):
+                    t._val = v
+                for t, v in zip(arg_tensors, arg_vals):
+                    t._val = v
+                out = fn(*args, **kwargs)
+                out_vals = tuple(t._val for t in _flatten_tensors(out, []))
+                new_state = tuple(t._val for t in mutated)
+                return out_vals + new_state
+            finally:
+                (_TraceHooks.on_read, _TraceHooks.on_write,
+                 _TraceHooks.on_create) = prev_hooks
+                for t, v in zip(all_t, saved):
+                    t._val = v
+                for t, v in stray.values():
+                    t._val = v
+
+        prog.jitted = jax.jit(pure_fn)
+        from ..framework.flags import get_flag
+        if get_flag("FLAGS_donate_state_buffers", True):
+            prog.jitted_donate = jax.jit(pure_fn, donate_argnums=(0,))
+        else:
+            prog.jitted_donate = prog.jitted
+
+    def _run(self, prog, args, kwargs):
+        arg_tensors = _flatten_tensors((args, kwargs), [])
+        mut_vals = tuple(t._val for t in prog.mutated)
+        ro_vals = tuple(t._val for t in prog.ro)
+        arg_vals = tuple(t._val for t in arg_tensors)
+        n_outs = prog.n_outs
+
+        # does gradient need to flow through this program?
+        diff_tensors = []
+        if autograd.is_grad_enabled():
+            for t in list(prog.mutated) + list(prog.ro) + arg_tensors:
+                if (not t.stop_gradient and is_inexact(t._val.dtype)
+                        and t._grad_node is None):
+                    diff_tensors.append(t)
+
+        if not diff_tensors:
+            flat = prog.jitted_donate(mut_vals, ro_vals, arg_vals)
+            out_vals, new_state = flat[:n_outs], flat[n_outs:]
+            for t, v in zip(prog.mutated, new_state):
+                t._val = v
+            leaves = [Tensor(v, stop_gradient=True) for v in out_vals]
+            return _unflatten(prog.out_tree, leaves)
+
+        # grad path: record the whole program as ONE tape op (run_program-grad
+        # parity). Donation is off (residuals alias inputs).
+        all_tensors = list(prog.mutated) + list(prog.ro) + arg_tensors
+        all_vals = list(mut_vals) + list(ro_vals) + list(arg_vals)
+        diff_idx = [i for i, t in enumerate(all_tensors)
+                    if not t.stop_gradient and is_inexact(t._val.dtype)
+                    and t._grad_node is None]
+        n_mut = len(prog.mutated)
+        n_ro = len(prog.ro)
+
+        def closed(*diff_vals):
+            vals = list(all_vals)
+            for i, dv in zip(diff_idx, diff_vals):
+                vals[i] = dv
+            return prog.jitted(tuple(vals[:n_mut]),
+                               tuple(vals[n_mut:n_mut + n_ro]),
+                               tuple(vals[n_mut + n_ro:]))
+
+        flat, vjp_fn = jax.vjp(closed, *[all_vals[i] for i in diff_idx])
+        out_vals, new_state = flat[:n_outs], flat[n_outs:]
+        for t, v in zip(prog.mutated, new_state):
+            t._val = v
+        node = GradNode(
+            vjp_fn=vjp_fn,
+            inputs=[all_tensors[i] for i in diff_idx],
+            out_meta=[(v.shape, v.dtype) for v in flat],
+            multi_output=True,
+            name="to_static_program",
+        )
+        leaves = []
+        for slot, v in enumerate(out_vals):
+            t = Tensor(v, stop_gradient=False)
+            t._grad_node = node
+            t._out_index = slot
+            leaves.append(t)
+        return _unflatten(prog.out_tree, leaves)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """paddle.jit.to_static parity (fluid/dygraph/jit.py:161 declarative)."""
+
+    def decorate(fn):
+        from ..nn import Layer
+        if isinstance(fn, Layer):
+            layer = fn
+            layer.forward = StaticFunction(type(layer).forward.__get__(layer),
+                                           input_spec)
+            return layer
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class TracedLayer:
+    """fluid.dygraph.TracedLayer shim over StaticFunction."""
+
+    def __init__(self, layer):
+        self._layer = layer
+        self._static = StaticFunction(layer.forward)
+
+    @staticmethod
+    def trace(layer, inputs):
+        tl = TracedLayer(layer)
+        out = tl._static(*inputs)
+        return out, tl
+
+    def __call__(self, *args, **kwargs):
+        return self._static(*args, **kwargs)

@@ -1,0 +1,65 @@
+"""paddle.nn.functional parity (python/paddle/nn/functional/__init__.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply, unwrap
+from ...core.tensor import Tensor
+
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    from ...core.dtypes import convert_dtype
+    lv = unwrap(lengths)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(lv))
+    mask = jnp.arange(m)[None, :] < lv[..., None]
+    return Tensor(mask.astype(convert_dtype(dtype)))
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Fused attention entry point (reference: operators/fused/fused_attention).
+
+    Shapes: (batch, seq, heads, head_dim) — paddle convention. Uses the Pallas
+    flash-attention kernel when available on TPU, else the XLA softmax path.
+    """
+    from ...ops.attention import scaled_dot_product_attention as sdpa
+    return sdpa(query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+                is_causal=is_causal, training=training)
+
+
+def embedding_renorm_(*args, **kwargs):
+    raise NotImplementedError
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):  # noqa: A002
+    def prim(v):
+        base = jnp.zeros(v.shape + (v.shape[-1],), dtype=v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        base = base.at[..., idx, idx].set(v)
+        if offset or dim1 != -2 or dim2 != -1:
+            base = jnp.moveaxis(base, (-2, -1), (dim1, dim2))
+        return base
+    return apply(prim, input, name="diag_embed")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def prim(a, p, lab):
+        batch = a.shape[0]
+        sim = a @ p.T
+        lab2 = lab.reshape(-1, 1)
+        same = (lab2 == lab2.T).astype(a.dtype)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        ce = jnp.mean(-jnp.sum(same * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) / 2
+        return ce + reg
+    return apply(prim, anchor, positive, labels, name="npair_loss")

@@ -1,0 +1,306 @@
+"""Common functionals: linear/dropout/embedding/interpolate/etc.
+(python/paddle/nn/functional/common.py, input.py parity)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply, unwrap
+from ...core.random import next_key
+from ...core.tensor import Tensor
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "label_smooth", "pad", "interpolate", "upsample",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
+    "cosine_similarity", "bilinear", "class_center_sample", "zeropad2d",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """weight shape (in, out) — reference layout (nn/layer/common.py Linear)."""
+    if bias is not None:
+        return apply(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias,
+                     name="linear")
+    return apply(lambda v, w: jnp.matmul(v, w), x, weight, name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda v: v * (1.0 - p), x, name="dropout_infer")
+        return x
+    if p == 1.0:
+        return apply(lambda v: jnp.zeros_like(v), x, name="dropout")
+    key = next_key()
+
+    def prim(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply(prim, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+
+    def prim(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2))).astype(np.float32)
+        b = -a * alpha_p * p
+        return (jnp.where(keep, v, alpha_p) * a + b).astype(v.dtype)
+
+    return apply(prim, x, name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Reference: operators/lookup_table_v2 — gather rows; positions equal to
+    padding_idx produce zero vectors (and contribute zero gradient)."""
+    if padding_idx is not None and padding_idx < 0:
+        padding_idx = weight.shape[0] + padding_idx
+
+    def prim(w, idx):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (idx != padding_idx)[..., None].astype(out.dtype)
+            out = out * mask
+        return out
+    return apply(prim, weight, unwrap(x), name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    v = unwrap(x)
+    return Tensor(jax.nn.one_hot(v.astype(jnp.int32), num_classes,
+                                 dtype=jnp.float32))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def prim(l, *rest):
+        k = l.shape[-1]
+        if rest:
+            return (1.0 - epsilon) * l + epsilon * rest[0]
+        return (1.0 - epsilon) * l + epsilon / k
+    if prior_dist is not None:
+        return apply(prim, label, prior_dist, name="label_smooth")
+    return apply(prim, label, name="label_smooth")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    from ...tensor.manipulation import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    xv = unwrap(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    nd = xv.ndim
+    nsp = nd - 2
+    if channel_last:
+        in_spatial = xv.shape[1:-1]
+    else:
+        in_spatial = xv.shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in np.asarray(size._value)]
+        out_spatial = tuple(int(s.item() if isinstance(s, Tensor) else s) for s in
+                            (size if isinstance(size, (list, tuple)) else [size]))
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * nsp
+        out_spatial = tuple(int(np.floor(i * s)) for i, s in
+                            zip(in_spatial, scale_factor))
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def prim(v):
+        if channel_last:
+            out_shape = (v.shape[0],) + out_spatial + (v.shape[-1],)
+        else:
+            out_shape = v.shape[:2] + out_spatial
+        if jmode == "nearest":
+            return jax.image.resize(v, out_shape, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate with manual coords
+            return _resize_align_corners(v, out_shape, jmode, channel_last)
+        return jax.image.resize(v, out_shape, method=jmode)
+
+    return apply(prim, x, name="interpolate")
+
+
+def _resize_align_corners(v, out_shape, method, channel_last):
+    """align_corners resize: output o samples input o*(in-1)/(out-1). Uses
+    jax.image.scale_and_translate so linear AND cubic kernels are honored."""
+    nd = v.ndim
+    sp_axes = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+    scales = []
+    for ax in sp_axes:
+        in_s, out_s = v.shape[ax], out_shape[ax]
+        scales.append(1.0 if out_s <= 1 or in_s <= 1
+                      else (out_s - 1.0) / (in_s - 1.0))
+    kernel = {"linear": "linear", "cubic": "cubic"}.get(method, "linear")
+    # scale_and_translate samples input at (o + 0.5 - t)/s - 0.5; choosing
+    # t = 0.5 - 0.5*s makes that o/s — the align_corners mapping.
+    translations = [0.5 - 0.5 * s for s in scales]
+    return jax.image.scale_and_translate(
+        v, out_shape, tuple(sp_axes),
+        jnp.asarray(scales, dtype=jnp.float32),
+        jnp.asarray(translations, dtype=jnp.float32),
+        method=kernel)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def prim(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            out = v.reshape(n, c // (r * r), r, r, h, w)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        out = v.reshape(n, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply(prim, x, name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def prim(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            out = v.reshape(n, c, h // r, r, w // r, r)
+            out = out.transpose(0, 1, 3, 5, 2, 4)
+            return out.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        out = v.reshape(n, h // r, r, w // r, r, c)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h // r, w // r, c * r * r)
+
+    return apply(prim, x, name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def prim(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return v.reshape(n, groups, c // groups, h, w) \
+                    .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, groups, c // groups) \
+                .transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply(prim, x, name="channel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/unfold_op.cc)."""
+    from .conv import _norm_tuple
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    if isinstance(paddings, int):
+        p = [(paddings, paddings), (paddings, paddings)]
+    elif len(paddings) == 2:
+        p = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    else:
+        p = [(paddings[0], paddings[2]), (paddings[1], paddings[3])]
+
+    def prim(v):
+        n, c, h, w = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=k, window_strides=s,
+            padding=p, rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: (N, C*kh*kw, oh, ow) -> (N, C*kh*kw, oh*ow)
+        return patches.reshape(n, c * k[0] * k[1], -1)
+
+    return apply(prim, x, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    from .conv import _norm_tuple
+    out_hw = _norm_tuple(output_sizes, 2)
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    p = _norm_tuple(paddings, 2) if not isinstance(paddings, int) else (paddings, paddings)
+
+    def prim(v):
+        n, ckk, L = v.shape
+        c = ckk // (k[0] * k[1])
+        oh = (out_hw[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (out_hw[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        vv = v.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, out_hw[0] + 2 * p[0], out_hw[1] + 2 * p[1]),
+                        dtype=v.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi:hi + oh * s[0]:s[0],
+                             wj:wj + ow * s[1]:s[1]].add(vv[:, :, i, j])
+        return out[:, :, p[0]:out.shape[2] - p[0], p[1]:out.shape[3] - p[1]]
+
+    return apply(prim, x, name="fold")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def prim(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis)) * jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+    return apply(prim, x1, x2, name="cosine_similarity")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def prim(a, b, w, *mb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if mb:
+            out = out + mb[0]
+        return out
+    if bias is not None:
+        return apply(prim, x1, x2, weight, bias, name="bilinear")
+    return apply(prim, x1, x2, weight, name="bilinear")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: PS-oriented; out of scope")

@@ -1,0 +1,180 @@
+"""Convolutions (python/paddle/nn/functional/conv.py parity).
+
+TPU-native: a single jax.lax.conv_general_dilated per op — XLA maps it onto the
+MXU (the reference dispatches to cuDNN, operators/conv_op.cc). Weight layout is
+the reference's OIHW; data format NCHW by default, NHWC supported (NHWC is the
+TPU-friendly layout — models may pass data_format="NHWC").
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n, stride, dilation, kernel):
+    """Returns lax padding: string 'SAME'/'VALID' or [(lo,hi)]*n."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # nested [[lo,hi],...] possibly including batch/channel dims
+    pairs = [tuple(int(x) for x in p) for p in padding]
+    if len(pairs) == n + 2:
+        pairs = pairs[2:]
+    return pairs
+
+
+def _conv(ndim, x, weight, bias, stride, padding, dilation, groups, data_format):
+    n = ndim
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "DHW"[-n:] if n > 1 else "W"
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    pad = _norm_padding(padding, n, stride, dilation, None)
+
+    def prim(xv, wv, *maybe_bias):
+        out = jax.lax.conv_general_dilated(
+            xv, wv,
+            window_strides=stride,
+            padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+            feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            shape[out_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(prim, x, weight, bias, name=f"conv{n}d")
+    return apply(prim, x, weight, name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NLC" if data_format == "NLC" else "NCL"
+    # express conv1d via the generic path with 1 spatial dim
+    channel_last = fmt == "NLC"
+    return _conv(1, x, weight, bias, stride, padding, dilation, groups,
+                 "NLC" if channel_last else "NCW")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(2, x, weight, bias, stride, padding, dilation, groups, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(3, x, weight, bias, stride, padding, dilation, groups, data_format)
+
+
+def _conv_transpose(ndim, x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, data_format, output_size):
+    n = ndim
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "DHW"[-n:] if n > 1 else "W"
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # reference stores transpose weights as (in, out/groups, *k) = IOHW
+    rhs_spec = "IO" + spatial
+    out_spec = lhs_spec
+
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pad = _norm_padding(padding, n, stride, dilation, None)
+    opad = _norm_tuple(output_padding, n) if output_padding else (0,) * n
+
+    def prim(xv, wv, *maybe_bias):
+        if isinstance(pad, str):
+            lax_pad = pad
+        else:
+            # conv_transpose pad semantics: effective padding on the dilated input
+            k = list(wv.shape[2:])
+            lax_pad = []
+            for i in range(n):
+                eff_k = (k[i] - 1) * dilation[i] + 1
+                lo = eff_k - 1 - pad[i][0]
+                hi = eff_k - 1 - pad[i][1] + opad[i]
+                lax_pad.append((lo, hi))
+        if groups > 1:
+            # lax.conv_transpose has no feature_group_count on all versions:
+            # do grouped transpose by splitting channels.
+            xs = jnp.split(xv, groups, axis=lhs_spec.index("C"))
+            ws = jnp.split(wv, groups, axis=0)
+            outs = [
+                jax.lax.conv_transpose(
+                    xg, wg, strides=stride, padding=lax_pad,
+                    rhs_dilation=dilation,
+                    dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+                    transpose_kernel=False)
+                for xg, wg in zip(xs, ws)
+            ]
+            out = jnp.concatenate(outs, axis=out_spec.index("C"))
+        else:
+            out = jax.lax.conv_transpose(
+                xv, wv, strides=stride, padding=lax_pad,
+                rhs_dilation=dilation,
+                dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+                transpose_kernel=False)
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            shape[out_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(prim, x, weight, bias, name=f"conv{n}d_transpose")
+    return apply(prim, x, weight, name=f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    fmt = "NLC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(1, x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, fmt, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(2, x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(3, x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, output_size)

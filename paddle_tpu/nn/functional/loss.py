@@ -1,0 +1,311 @@
+"""Loss functionals (python/paddle/nn/functional/loss.py parity).
+
+cross_entropy matches the reference semantics (softmax_with_cross_entropy op,
+operators/softmax_with_cross_entropy_op.*): hard or soft labels, ignore_index,
+class weights, reductions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply, unwrap
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "ctc_loss",
+    "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
+    "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    wv = unwrap(weight) if weight is not None else None
+
+    def prim(logits, lab, *maybe_w):
+        w = maybe_w[0] if maybe_w else None
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            per = -jnp.sum(lab * logp, axis=axis)
+            if reduction == "mean":
+                return jnp.mean(per)
+            return _reduce(per, reduction)
+        li = lab.astype(jnp.int32)
+        li_exp = jnp.expand_dims(li, axis) if li.ndim == logp.ndim - 1 else li
+        picked = jnp.take_along_axis(logp, jnp.maximum(li_exp, 0), axis=axis)
+        per = -jnp.squeeze(picked, axis)
+        valid = (jnp.squeeze(li_exp, axis) != ignore_index)
+        per = jnp.where(valid, per, 0.0)
+        if w is not None:
+            wsel = jnp.take(w, jnp.maximum(jnp.squeeze(li_exp, axis), 0), axis=0)
+            wsel = jnp.where(valid, wsel, 0.0)
+            per = per * wsel
+            if reduction == "mean":
+                return jnp.sum(per) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+            return jnp.sum(per) / denom
+        return _reduce(per, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(prim, *args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    def prim(lg, lab):
+        sm = jax.nn.softmax(lg, axis=axis)
+        logp = jax.nn.log_softmax(lg, axis=axis)
+        if soft_label:
+            loss = -jnp.sum(lab * logp, axis=axis, keepdims=True)
+        else:
+            li = lab.astype(jnp.int32)
+            li_exp = li if li.ndim == logp.ndim else jnp.expand_dims(li, axis)
+            picked = jnp.take_along_axis(logp, jnp.maximum(li_exp, 0), axis=axis)
+            loss = -picked
+            valid = (li_exp != ignore_index)
+            loss = jnp.where(valid, loss, 0.0)
+        if return_softmax:
+            return loss, sm
+        return loss
+    return apply(prim, logits, label, name="softmax_with_cross_entropy")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",  # noqa: A002
+                         name=None):
+    def prim(p, y, *mw):
+        eps = 1e-12
+        per = -(y * jnp.log(jnp.maximum(p, eps))
+                + (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if mw:
+            per = per * mw[0]
+        return _reduce(per, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(prim, *args, name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def prim(x, y, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+        max_val = jnp.maximum(-x, 0)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            per = (1 - y) * x + log_w * (jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val)
+        else:
+            per = (1 - y) * x + jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val
+        if w is not None:
+            per = per * w
+        return _reduce(per, reduction)
+    args = [logit, label] + [a for a in (weight, pos_weight) if a is not None]
+    return apply(prim, *args, name="bce_with_logits")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100,  # noqa: A002
+             reduction="mean", name=None):
+    def prim(logp, lab, *mw):
+        li = lab.astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, jnp.maximum(li[:, None], 0), axis=1)[:, 0]
+        per = -picked
+        valid = li != ignore_index
+        per = jnp.where(valid, per, 0.0)
+        if mw:
+            wsel = jnp.take(mw[0], jnp.maximum(li, 0))
+            wsel = jnp.where(valid, wsel, 0.0)
+            per = per * wsel
+            if reduction == "mean":
+                return jnp.sum(per) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+        return _reduce(per, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(prim, *args, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 input, label, name="mse_loss")
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return apply(lambda a, b: jnp.square(a - b), input, label,
+                 name="square_error_cost")
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 input, label, name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def prim(a, b):
+        diff = jnp.abs(a - b)
+        per = jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                        diff - 0.5 * delta)
+        return _reduce(per, reduction)
+    return apply(prim, input, label, name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    def prim(logp, y):
+        per = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(per) / logp.shape[0]
+        return _reduce(per, reduction)
+    return apply(prim, input, label, name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    def prim(a, b, y):
+        per = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(per, reduction)
+    return apply(prim, input, other, label, name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    def prim(x, y):
+        per = jnp.where(y == 1, x, jnp.maximum(margin - x, 0.0))
+        return _reduce(per, reduction)
+    return apply(prim, input, label, name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def prim(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(per, reduction)
+    return apply(prim, input1, input2, label, name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def prim(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        per = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce(per, reduction)
+    return apply(prim, input, positive, negative, name="triplet_margin_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    def prim(p, y):
+        return -(y * jnp.log(p + epsilon) + (1 - y) * jnp.log(1 - p + epsilon))
+    return apply(prim, input, label, name="log_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def prim(x, y, *mn):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        per = a_t * ((1 - p_t) ** gamma) * ce
+        if mn:
+            per = per / mn[0]
+        return _reduce(per, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply(prim, *args, name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    def prim(p, y):
+        y1 = jax.nn.one_hot(y.astype(jnp.int32).squeeze(-1), p.shape[-1],
+                            dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply(prim, input, label, name="dice_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard alpha-recursion in log space (lax.scan over time).
+
+    Reference: operators/warpctc_op.* (wraps warp-ctc); here it is a pure XLA
+    computation.
+    """
+    def prim(lp, lab, in_len, lab_len):
+        # lp: (T, N, C) log-probs (paddle convention time-major)
+        T, N, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        lab = lab.astype(jnp.int32)
+        # extended label sequence with blanks: [b, l1, b, l2, ..., b]
+        ext = jnp.full((N, S), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = -1e30
+        # init alpha at t=0
+        alpha0 = jnp.full((N, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0][jnp.arange(N), ext[:, 0]])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, lp[0][jnp.arange(N), ext[:, 1]], neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), dtype=bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate(
+                [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            m = jnp.maximum(jnp.maximum(a_prev, a_shift1), a_shift2)
+            m_safe = jnp.maximum(m, neg_inf)
+            summed = (jnp.exp(a_prev - m_safe) + jnp.exp(a_shift1 - m_safe)
+                      + jnp.exp(a_shift2 - m_safe))
+            new_alpha = m_safe + jnp.log(jnp.maximum(summed, 1e-30))
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return new_alpha + emit, new_alpha
+
+        def step2(alpha, lp_t):
+            out, _ = step(alpha, lp_t)
+            return out, out
+        _, all_alpha = jax.lax.scan(step2, alpha0, lp[1:])
+        all_alpha = jnp.concatenate([alpha0[None], all_alpha], axis=0)  # (T,N,S)
+        t_idx = jnp.maximum(in_len.astype(jnp.int32) - 1, 0)
+        final = all_alpha[t_idx, jnp.arange(N)]  # (N, S)
+        s_last = 2 * lab_len.astype(jnp.int32)      # blank after last label
+        s_last2 = jnp.maximum(s_last - 1, 0)        # last label
+        a1 = jnp.take_along_axis(final, s_last[:, None], axis=1)[:, 0]
+        a2 = jnp.take_along_axis(final, s_last2[:, None], axis=1)[:, 0]
+        m = jnp.maximum(a1, a2)
+        ll = m + jnp.log(jnp.exp(a1 - m) + jnp.exp(a2 - m))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply(prim, log_probs, unwrap(labels), unwrap(input_lengths),
+                 unwrap(label_lengths), name="ctc_loss")

@@ -1,0 +1,152 @@
+"""Pooling (python/paddle/nn/functional/pooling.py parity) via lax.reduce_window."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from .conv import _norm_padding, _norm_tuple
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _pool(x, n, kernel, stride, padding, mode, ceil_mode, exclusive,
+          data_format):
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride, n) if stride is not None else kernel
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    pad = _norm_padding(padding, n, stride, (1,) * n, kernel)
+    if isinstance(pad, str):
+        pad_pairs = None if pad == "VALID" else "SAME"
+    else:
+        pad_pairs = pad
+
+    def prim(v):
+        nd = v.ndim
+        if channel_last:
+            window = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = [(0, 0)] + (pad_pairs if isinstance(pad_pairs, list) else [(0, 0)] * n) + [(0, 0)]
+        else:
+            window = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = [(0, 0), (0, 0)] + (pad_pairs if isinstance(pad_pairs, list) else [(0, 0)] * n)
+        if pad_pairs == "SAME":
+            pads = "SAME"
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, init, jax.lax.max, window, strides,
+                                         pads)
+        # avg
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add,
+                                       window, strides, pads)
+        if exclusive and pads != "SAME" and any(p != (0, 0) for p in (pads if isinstance(pads, list) else [])):
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                           strides, pads)
+            return summed / counts
+        return summed / float(np.prod(kernel))
+
+    return apply(prim, x, name=f"{mode}_pool{n}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, 1, kernel_size, stride, padding, "max", ceil_mode, True,
+                 "NLC" if data_format == "NLC" else "NCW")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, 2, kernel_size, stride, padding, "max", ceil_mode, True,
+                 data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, 3, kernel_size, stride, padding, "max", ceil_mode, True,
+                 data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, 1, kernel_size, stride, padding, "avg", ceil_mode,
+                 exclusive, "NLC" if data_format == "NLC" else "NCW")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, 2, kernel_size, stride, padding, "avg", ceil_mode,
+                 exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, 3, kernel_size, stride, padding, "avg", ceil_mode,
+                 exclusive, data_format)
+
+
+def _adaptive_pool(x, n, output_size, mode, data_format):
+    out = _norm_tuple(output_size, n) if output_size is not None else None
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def prim(v):
+        nd = v.ndim
+        sp_start = 1 if channel_last else 2
+        res = v
+        for i in range(n):
+            axis = sp_start + i
+            in_size = res.shape[axis]
+            o = out[i]
+            if in_size % o == 0:
+                # uniform windows: reshape + reduce (fast path, XLA-friendly)
+                k = in_size // o
+                newshape = res.shape[:axis] + (o, k) + res.shape[axis + 1:]
+                r = res.reshape(newshape)
+                res = jnp.max(r, axis=axis + 1) if mode == "max" else jnp.mean(r, axis=axis + 1)
+            else:
+                # general adaptive: per-output-slot start/end (numpy-computed, static)
+                starts = [int(np.floor(j * in_size / o)) for j in range(o)]
+                ends = [int(np.ceil((j + 1) * in_size / o)) for j in range(o)]
+                slabs = []
+                for s, e in zip(starts, ends):
+                    sl = jax.lax.slice_in_dim(res, s, e, axis=axis)
+                    red = jnp.max(sl, axis=axis, keepdims=True) if mode == "max" \
+                        else jnp.mean(sl, axis=axis, keepdims=True)
+                    slabs.append(red)
+                res = jnp.concatenate(slabs, axis=axis)
+        return res
+
+    return apply(prim, x, name=f"adaptive_{mode}_pool{n}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, 1, output_size, "avg", "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, 2, output_size, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, 3, output_size, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, 1, output_size, "max", "NCW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, 2, output_size, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, 3, output_size, "max", "NCDHW")

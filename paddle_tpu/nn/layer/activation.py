@@ -1,0 +1,80 @@
+"""Activation layers (python/paddle/nn/layer/activation.py parity)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Sigmoid",
+           "LogSigmoid", "Tanh", "Tanhshrink", "Hardtanh", "Hardshrink",
+           "Hardsigmoid", "Hardswish", "LeakyReLU", "PReLU", "Softmax",
+           "LogSoftmax", "Softplus", "Softshrink", "Softsign", "Swish",
+           "SiLU", "Mish", "Maxout", "ThresholdedReLU", "GLU"]
+
+
+def _simple(name, fname, **defaults):
+    fn = getattr(F, fname)
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._args = args
+            self._kwargs = {**defaults, **kwargs}
+            self._kwargs.pop("name", None)
+
+        def forward(self, x):
+            return fn(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+ELU = _simple("ELU", "elu")
+SELU = _simple("SELU", "selu")
+CELU = _simple("CELU", "celu")
+GELU = _simple("GELU", "gelu")
+Sigmoid = _simple("Sigmoid", "sigmoid")
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+Tanh = _simple("Tanh", "tanh")
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+Hardtanh = _simple("Hardtanh", "hardtanh")
+Hardshrink = _simple("Hardshrink", "hardshrink")
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Hardswish = _simple("Hardswish", "hardswish")
+LeakyReLU = _simple("LeakyReLU", "leaky_relu")
+Softmax = _simple("Softmax", "softmax")
+LogSoftmax = _simple("LogSoftmax", "log_softmax")
+Softplus = _simple("Softplus", "softplus")
+Softshrink = _simple("Softshrink", "softshrink")
+Softsign = _simple("Softsign", "softsign")
+Swish = _simple("Swish", "swish")
+SiLU = _simple("SiLU", "silu")
+Mish = _simple("Mish", "mish")
+ThresholdedReLU = _simple("ThresholdedReLU", "thresholded_relu")
+GLU = _simple("GLU", "glu")
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)

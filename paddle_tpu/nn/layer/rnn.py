@@ -1,0 +1,372 @@
+"""RNN layers (python/paddle/nn/layer/rnn.py parity: SimpleRNN/LSTM/GRU + cells).
+
+TPU-native: the time loop is a single `lax.scan` per layer/direction — one XLA
+while-loop with fused cell body (the reference's operators/rnn_op.cu dispatches
+to cuDNN). Gate order matches the reference (LSTM: i,f,g,o; GRU: r,z,n).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply, unwrap
+from ...core.tensor import Tensor
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM",
+           "GRU", "BiRNN"]
+
+
+def _lstm_step(carry, x_t, wi, wh, bi, bh):
+    h, c = carry
+    gates = x_t @ wi.T + h @ wh.T + bi + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
+def _gru_step(carry, x_t, wi, wh, bi, bh):
+    h = carry
+    xg = x_t @ wi.T + bi
+    hg = h @ wh.T + bh
+    xr, xz, xn = jnp.split(xg, 3, axis=-1)
+    hr, hz, hn = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    h_new = (1 - z) * n + z * h
+    return h_new, h_new
+
+
+def _rnn_step(carry, x_t, wi, wh, bi, bh, act):
+    h = carry
+    h_new = act(x_t @ wi.T + h @ wh.T + bi + bh)
+    return h_new, h_new
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...tensor.creation import full
+        b = batch_ref.shape[batch_dim_idx]
+        state_shape = shape or self.state_shape
+        if isinstance(state_shape[0], (list, tuple)):
+            return tuple(full([b] + list(s), init_value,
+                              dtype or batch_ref.dtype) for s in state_shape)
+        return full([b] + list(state_shape), init_value,
+                    dtype or batch_ref.dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        def prim(x, h, wi, wh, bi, bh):
+            h_new, _ = _rnn_step(h, x, wi, wh, bi, bh, act)
+            return h_new
+        h = apply(prim, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+        def prim(x, h, c, wi, wh, bi, bh):
+            (h_new, c_new), _ = _lstm_step((h, c), x, wi, wh, bi, bh)
+            return h_new, c_new
+        h, c = apply(prim, inputs, h0, c0, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, name="lstm_cell")
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def prim(x, h, wi, wh, bi, bh):
+            h_new, _ = _gru_step(h, x, wi, wh, bi, bh)
+            return h_new
+        h = apply(prim, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Generic cell-driven RNN wrapper (rnn.py RNN parity) — python loop over
+    time (use SimpleRNN/LSTM/GRU for the scan-fused fast path)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import stack, unstack
+        time_axis = 0 if self.time_major else 1
+        steps = unstack(inputs, axis=time_axis)
+        if self.is_reverse:
+            steps = steps[::-1]
+        states = initial_states
+        outs = []
+        for x_t in steps:
+            if states is None:
+                out, states = self.cell(x_t)
+            else:
+                out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=time_axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        return concat([out_fw, out_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional scan-based RNN (LSTM/GRU/SimpleRNN)."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN": 1}[mode]
+        std = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_sz = input_size if layer == 0 else hidden_size * self.bidirect
+                suffix = "_reverse" if d == 1 else ""
+                wi = self.create_parameter([gate_mult * hidden_size, in_sz],
+                                           weight_ih_attr,
+                                           default_initializer=init)
+                wh = self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size], weight_hh_attr,
+                    default_initializer=init)
+                bi = self.create_parameter([gate_mult * hidden_size],
+                                           bias_ih_attr, is_bias=True,
+                                           default_initializer=init)
+                bh = self.create_parameter([gate_mult * hidden_size],
+                                           bias_hh_attr, is_bias=True,
+                                           default_initializer=init)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wi)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", wh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bi)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.mode == "LSTM"
+        nl, nd = self.num_layers, self.bidirect
+        xv = unwrap(inputs)
+        batch_axis = 1 if self.time_major else 0
+        b = xv.shape[batch_axis]
+        dtype = xv.dtype
+
+        if initial_states is None:
+            from ...tensor.creation import zeros
+            h0 = zeros([nl * nd, b, self.hidden_size], dtype=dtype)
+            initial_states = (h0, zeros([nl * nd, b, self.hidden_size],
+                                        dtype=dtype)) if is_lstm else h0
+
+        flat_weights = [w for group in self._all_weights for w in group]
+        mode = self.mode
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        time_major = self.time_major
+        dropout_p = self.dropout
+        training = self.training
+        drop_keys = None
+        if dropout_p > 0 and training and nl > 1:
+            from ...core.random import next_key
+            drop_keys = [next_key() for _ in range(nl - 1)]
+
+        def prim(x, *args):
+            if is_lstm:
+                h0v, c0v = args[0], args[1]
+                ws = args[2:]
+            else:
+                h0v = args[0]
+                c0v = None
+                ws = args[1:]
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # -> (T, B, C)
+            layer_in = x
+            h_finals, c_finals = [], []
+            for layer in range(nl):
+                outs_dir = []
+                for d in range(nd):
+                    wi, wh, bi, bh = ws[4 * (layer * nd + d):4 * (layer * nd + d) + 4]
+                    idx = layer * nd + d
+                    h_init = h0v[idx]
+                    c_init = c0v[idx] if is_lstm else None
+                    seq = layer_in if d == 0 else jnp.flip(layer_in, axis=0)
+                    if mode == "LSTM":
+                        def step(carry, x_t, wi=wi, wh=wh, bi=bi, bh=bh):
+                            return _lstm_step(carry, x_t, wi, wh, bi, bh)
+                        (h_f, c_f), out = jax.lax.scan(step, (h_init, c_init), seq)
+                        c_finals.append(c_f)
+                    elif mode == "GRU":
+                        def step(carry, x_t, wi=wi, wh=wh, bi=bi, bh=bh):
+                            return _gru_step(carry, x_t, wi, wh, bi, bh)
+                        h_f, out = jax.lax.scan(step, h_init, seq)
+                    else:
+                        def step(carry, x_t, wi=wi, wh=wh, bi=bi, bh=bh):
+                            return _rnn_step(carry, x_t, wi, wh, bi, bh, act)
+                        h_f, out = jax.lax.scan(step, h_init, seq)
+                    h_finals.append(h_f)
+                    if d == 1:
+                        out = jnp.flip(out, axis=0)
+                    outs_dir.append(out)
+                layer_in = outs_dir[0] if nd == 1 else jnp.concatenate(outs_dir,
+                                                                       axis=-1)
+                if drop_keys is not None and layer < nl - 1:
+                    keep = jax.random.bernoulli(drop_keys[layer], 1 - dropout_p,
+                                                layer_in.shape)
+                    layer_in = jnp.where(keep, layer_in / (1 - dropout_p), 0.0) \
+                        .astype(layer_in.dtype)
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(h_finals, axis=0)
+            if is_lstm:
+                return out, h_stack, jnp.stack(c_finals, axis=0)
+            return out, h_stack
+
+        if is_lstm:
+            h0, c0 = initial_states
+            res = apply(prim, inputs, h0, c0, *flat_weights, name=f"{mode}")
+            out, h_f, c_f = res
+            return out, (h_f, c_f)
+        res = apply(prim, inputs, initial_states, *flat_weights, name=f"{mode}")
+        out, h_f = res
+        return out, h_f
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)

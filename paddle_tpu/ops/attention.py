@@ -1,0 +1,78 @@
+"""Attention kernels.
+
+Reference parity: operators/fused/fused_attention_op.cu + fmha_ref.h. TPU-native
+design: one XLA attention path (softmax fused by XLA) + a Pallas
+flash-attention kernel (ops/pallas/flash_attention.py) selected for TPU when
+shapes allow; both behind one functional entry point.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+
+
+def _xla_attention(q, k, v, mask, scale, is_causal, dropout_p, dropout_key):
+    # q,k,v: (B, S, H, D) paddle layout -> compute in (B, H, S, D)
+    q = jnp.swapaxes(q, 1, 2)
+    k = jnp.swapaxes(k, 1, 2)
+    v = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if is_causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(causal, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(probs.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, use_pallas=None):
+    qv = unwrap(query)
+    head_dim = qv.shape[-1]
+    scale = 1.0 / (head_dim ** 0.5)
+    dropout_key = None
+    if dropout_p > 0.0 and training:
+        from ..core.random import next_key
+        dropout_key = next_key()
+    if not training:
+        dropout_p = 0.0
+
+    if use_pallas is None:
+        use_pallas = _pallas_available() and attn_mask is None and dropout_p == 0.0
+    if use_pallas:
+        from .pallas.flash_attention import flash_attention
+        def prim(q, k, v):
+            return flash_attention(q, k, v, causal=is_causal, scale=scale)
+        return apply(prim, query, key, value, name="flash_attention")
+
+    def prim(q, k, v, *maybe_mask):
+        m = maybe_mask[0] if maybe_mask else None
+        return _xla_attention(q, k, v, m, scale, is_causal, dropout_p, dropout_key)
+
+    if attn_mask is not None:
+        return apply(prim, query, key, value, attn_mask, name="sdpa")
+    return apply(prim, query, key, value, name="sdpa")
+
+
+@functools.lru_cache(maxsize=1)
+def _pallas_available():
+    try:
+        from .pallas import flash_attention  # noqa: F401
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    return dev.platform in ("tpu", "axon")

@@ -1,0 +1,240 @@
+"""paddle.optimizer parity: SGD/Momentum/Adam/AdamW/Adagrad/Adadelta/Adamax/
+RMSProp/Lamb (+ lr schedulers in .lr).
+
+Update rules match the reference kernels (operators/optimizers/*_op.h) —
+notably Adam's epsilon placement: denom = sqrt(v_hat) + eps.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import lr  # noqa: F401
+from .optimizer import Optimizer
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "Adadelta", "Adamax", "RMSProp", "Lamb", "lr"]
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _apply_update(self, p, g):
+        lr_ = self._lr.astype(p._val.dtype)
+        p._value = p._value - lr_ * g.astype(p._val.dtype)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _apply_update(self, p, g):
+        vel = self._get_accumulator("velocity", p)
+        lr_ = self._lr.astype(p._val.dtype)
+        g = g.astype(p._val.dtype)
+        v_new = self._momentum * vel._value + g
+        vel._value = v_new
+        if self._use_nesterov:
+            p._value = p._value - lr_ * (g + self._momentum * v_new)
+        else:
+            p._value = p._value - lr_ * v_new
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _apply_update(self, p, g):
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, shape=())
+        b2p = self._get_accumulator("beta2_pow", p, init=1.0, shape=())
+        dtype = p._val.dtype
+        g = g.astype(dtype)
+        lr_ = self._lr.astype(jnp.float32)
+        b1 = self._beta1
+        b2 = self._beta2
+        b1p_new = b1p._value * b1
+        b2p_new = b2p._value * b2
+        b1p._value = b1p_new
+        b2p._value = b2p_new
+        m_new = b1 * m._value + (1 - b1) * g
+        v_new = b2 * v._value + (1 - b2) * g * g
+        m._value = m_new
+        v._value = v_new
+        # reference adam_op.h: lr_t = lr * sqrt(1-beta2^t)/(1-beta1^t);
+        # update = lr_t * m / (sqrt(v) + eps*sqrt(1-beta2^t))
+        lr_t = (lr_ * jnp.sqrt(1 - b2p_new) / (1 - b1p_new)).astype(dtype)
+        denom = jnp.sqrt(v_new) + self._epsilon * jnp.sqrt(1 - b2p_new).astype(dtype)
+        p._value = p._value - lr_t * (m_new / denom)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: adamw semantics in adam_op with
+    coeff applied to the param before the adam update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._coeff = float(weight_decay) if weight_decay is not None else 0.0
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_update(self, p, g):
+        if self._coeff and (self._apply_decay_param_fun is None
+                            or self._apply_decay_param_fun(p.name)):
+            lr_ = self._lr.astype(p._val.dtype)
+            p._value = p._value * (1.0 - lr_ * self._coeff)
+        super()._apply_update(p, g)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _apply_update(self, p, g):
+        acc = self._get_accumulator("moment", p, init=self._init_acc)
+        dtype = p._val.dtype
+        g = g.astype(dtype)
+        lr_ = self._lr.astype(dtype)
+        acc_new = acc._value + g * g
+        acc._value = acc_new
+        p._value = p._value - lr_ * g / (jnp.sqrt(acc_new) + self._epsilon)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _apply_update(self, p, g):
+        avg_sq = self._get_accumulator("avg_squared_grad", p)
+        avg_upd = self._get_accumulator("avg_squared_update", p)
+        dtype = p._val.dtype
+        g = g.astype(dtype)
+        rho = self._rho
+        eps = self._epsilon
+        new_sq = rho * avg_sq._value + (1 - rho) * g * g
+        update = -jnp.sqrt((avg_upd._value + eps) / (new_sq + eps)) * g
+        new_upd = rho * avg_upd._value + (1 - rho) * update * update
+        avg_sq._value = new_sq
+        avg_upd._value = new_upd
+        lr_ = self._lr.astype(dtype)
+        p._value = p._value + lr_ * update
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _apply_update(self, p, g):
+        m = self._get_accumulator("moment", p)
+        inf_norm = self._get_accumulator("inf_norm", p)
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, shape=())
+        dtype = p._val.dtype
+        g = g.astype(dtype)
+        b1, b2 = self._beta1, self._beta2
+        b1p_new = b1p._value * b1
+        b1p._value = b1p_new
+        m_new = b1 * m._value + (1 - b1) * g
+        n_new = jnp.maximum(b2 * inf_norm._value, jnp.abs(g) + self._epsilon)
+        m._value = m_new
+        inf_norm._value = n_new
+        lr_ = self._lr.astype(dtype)
+        p._value = p._value - (lr_ / (1 - b1p_new)).astype(dtype) * m_new / n_new
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _apply_update(self, p, g):
+        ms = self._get_accumulator("mean_square", p)
+        mom = self._get_accumulator("momentum", p)
+        dtype = p._val.dtype
+        g = g.astype(dtype)
+        rho, eps = self._rho, self._epsilon
+        ms_new = rho * ms._value + (1 - rho) * g * g
+        ms._value = ms_new
+        lr_ = self._lr.astype(dtype)
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", p)
+            mg_new = rho * mg._value + (1 - rho) * g
+            mg._value = mg_new
+            denom = jnp.sqrt(ms_new - mg_new * mg_new + eps)
+        else:
+            denom = jnp.sqrt(ms_new + eps)
+        mom_new = self._momentum * mom._value + lr_ * g / denom
+        mom._value = mom_new
+        p._value = p._value - mom_new
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply_update(self, p, g):
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, shape=())
+        b2p = self._get_accumulator("beta2_pow", p, init=1.0, shape=())
+        dtype = p._val.dtype
+        g = g.astype(jnp.float32)
+        pv = p._value.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        b1p_new = b1p._value * b1
+        b2p_new = b2p._value * b2
+        b1p._value = b1p_new
+        b2p._value = b2p_new
+        m_new = b1 * m._value + (1 - b1) * g
+        v_new = b2 * v._value + (1 - b2) * g * g
+        m._value = m_new
+        v._value = v_new
+        m_hat = m_new / (1 - b1p_new)
+        v_hat = v_new / (1 - b2p_new)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) \
+            else self._lamb_wd
+        update = r + wd * pv
+        w_norm = jnp.sqrt(jnp.sum(pv * pv))
+        u_norm = jnp.sqrt(jnp.sum(update * update))
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        lr_ = self._lr
+        p._value = (pv - lr_ * trust * update).astype(dtype)

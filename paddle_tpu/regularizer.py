@@ -1,0 +1,33 @@
+"""paddle.regularizer parity (python/paddle/regularizer.py: L1Decay/L2Decay).
+
+Applied by the optimizer per-parameter (param_attr regularizer wins over the
+optimizer-level weight_decay, matching fluid/regularizer.py append_regularization_ops
+precedence).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def grad_term(self, param_value):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def grad_term(self, param_value):
+        return self._coeff * param_value
+
+
+class L1Decay(WeightDecayRegularizer):
+    def grad_term(self, param_value):
+        return self._coeff * jnp.sign(param_value)

@@ -1,0 +1,92 @@
+"""paddle.static shim.
+
+Reference: python/paddle/static — the full ProgramDesc/Executor machinery
+(fluid/framework.py, executor.py). TPU-native position (SURVEY.md §7): the
+static-graph mode's value is whole-graph compilation, which `jit.to_static`
+already delivers via XLA; so `paddle.static` here is a thin compatibility
+facade: `InputSpec`, `data`, `Program` objects that collect a traced callable,
+and an `Executor` that runs compiled programs. Scripts written dygraph-first
+need no change; legacy fully-static scripts need the documented 5-line port to
+to_static.
+"""
+from __future__ import annotations
+
+from ..jit.to_static import InputSpec  # noqa: F401
+
+_static_mode = [False]
+
+
+def _enable():
+    _static_mode[0] = True
+
+
+def _disable():
+    _static_mode[0] = False
+
+
+class Program:
+    """Placeholder program object (framework.py Program parity at the API
+    level; holds no op graph — graphs live in XLA)."""
+
+    def __init__(self):
+        self._callables = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Static feed placeholder → returns an InputSpec (used with to_static)."""
+    return InputSpec(shape=[s if s and s > 0 else 1 for s in shape],
+                     dtype=dtype, name=name)
+
+
+class Executor:
+    """paddle.static.Executor facade: runs python callables registered as
+    'programs' (full static ProgramDesc execution is intentionally replaced by
+    to_static + XLA; see module docstring)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        raise NotImplementedError(
+            "paddle.static.Executor.run: the TPU build executes whole "
+            "programs via jit.to_static-compiled callables; port static "
+            "scripts with paddle_tpu.jit.to_static (see static/__init__.py "
+            "docstring)")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    raise NotImplementedError("use paddle_tpu.jit.save")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError("use paddle_tpu.jit.load")

@@ -1,0 +1,159 @@
+"""Functional tensor API + Tensor method monkey-patching.
+
+Reference parity: python/paddle/tensor/__init__.py and
+python/paddle/fluid/dygraph/math_op_patch.py — the reference patches methods
+onto VarBase exactly like this.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+from . import attribute, creation, einsum as _einsum_mod, linalg, logic  # noqa: F401
+from . import manipulation, math, random, search, stat  # noqa: F401
+
+from .attribute import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import var, std, median, quantile, numel  # noqa: F401
+
+
+# ---- dunder / method patching ------------------------------------------------
+
+def _binop(fn, reverse=False):
+    def method(self, other):
+        if reverse:
+            return fn(other if isinstance(other, Tensor) else Tensor(other, dtype=self.dtype if isinstance(other, (int, float)) else None), self)
+        return fn(self, other)
+    return method
+
+
+def _patch():
+    T = Tensor
+    # arithmetic
+    T.__add__ = lambda s, o: math.add(s, o)
+    T.__radd__ = lambda s, o: math.add(s, o)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = lambda s, o: apply(lambda a, b: b - a, s, o if isinstance(o, Tensor) else o, name="rsub")
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = lambda s, o: math.multiply(s, o)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: apply(lambda a, b: b / a, s, o, name="rdiv")
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__mod__ = lambda s, o: math.mod(s, o)
+    T.__pow__ = lambda s, o: math.pow(s, o)
+    T.__rpow__ = lambda s, o: apply(lambda a, b: b ** a, s, o, name="rpow")
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: linalg.matmul(Tensor(o), s)
+    # comparisons
+    T.__eq__ = lambda s, o: logic.equal(s, o)
+    T.__ne__ = lambda s, o: logic.not_equal(s, o)
+    T.__lt__ = lambda s, o: logic.less_than(s, o)
+    T.__le__ = lambda s, o: logic.less_equal(s, o)
+    T.__gt__ = lambda s, o: logic.greater_than(s, o)
+    T.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    T.__hash__ = lambda s: id(s)
+    T.__invert__ = lambda s: logic.logical_not(s)
+    T.__and__ = lambda s, o: logic.bitwise_and(s, o)
+    T.__or__ = lambda s, o: logic.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: logic.bitwise_xor(s, o)
+
+    # indexing
+    def _getitem(self, idx):
+        idx2 = _convert_index(idx)
+        return apply(lambda v: v[idx2], self, name="getitem")
+
+    def _setitem(self, idx, value):
+        idx2 = _convert_index(idx)
+        val = unwrap(value) if isinstance(value, Tensor) else value
+        self._value = self._val.at[idx2].set(val)
+
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    # attach the functional namespace as methods (reference math_op_patch style)
+    method_sources = [math, manipulation, linalg, logic, search, stat, creation,
+                      attribute]
+    skip = {"to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace",
+            "eye", "meshgrid", "rand", "randn", "randint", "randperm", "normal",
+            "uniform", "where", "einsum", "jax_complex"}
+    for mod in method_sources:
+        for fname in dir(mod):
+            if fname.startswith("_") or fname in skip:
+                continue
+            fn = getattr(mod, fname)
+            if not callable(fn) or getattr(fn, "__module__", None) != mod.__name__:
+                continue
+            if not hasattr(T, fname):
+                setattr(T, fname, fn)
+    T.matmul = linalg.matmul
+    T.mm = linalg.mm
+    T.dot = linalg.dot
+    T.where = lambda s, x, y: logic.where(s, x, y)
+    T.add_ = lambda s, o: _inplace(s, math.add(s, o))
+    T.subtract_ = lambda s, o: _inplace(s, math.subtract(s, o))
+    T.multiply_ = lambda s, o: _inplace(s, math.multiply(s, o))
+    T.clip_ = lambda s, lo=None, hi=None: _inplace(s, math.clip(s, lo, hi))
+    T.exp_ = lambda s: _inplace(s, math.exp(s))
+    T.sqrt_ = lambda s: _inplace(s, math.sqrt(s))
+    T.rsqrt_ = lambda s: _inplace(s, math.rsqrt(s))
+    T.reciprocal_ = lambda s: _inplace(s, math.reciprocal(s))
+    T.round_ = lambda s: _inplace(s, math.round(s))
+    T.ceil_ = lambda s: _inplace(s, math.ceil(s))
+    T.floor_ = lambda s: _inplace(s, math.floor(s))
+    T.uniform_ = _uniform_
+    T.normal_ = _normal_
+
+
+def _inplace(t, result):
+    t._value = result._val
+    return t
+
+
+def _uniform_(self, min=-1.0, max=1.0, seed=0):  # noqa: A002
+    import jax
+    from ..core.random import next_key
+    self._value = jax.random.uniform(next_key(), tuple(self._val.shape),
+                                     dtype=self._val.dtype, minval=min, maxval=max)
+    return self
+
+
+def _normal_(self, mean=0.0, std=1.0):
+    import jax
+    from ..core.random import next_key
+    z = jax.random.normal(next_key(), tuple(self._val.shape), dtype=self._val.dtype)
+    self._value = mean + std * z
+    return self
+
+
+def _convert_index(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            v = i._value
+            return v.astype(jnp.int32) if jnp.issubdtype(v.dtype, jnp.integer) else v
+        if isinstance(i, builtins.slice):
+            return builtins.slice(
+                conv(i.start) if isinstance(i.start, Tensor) else i.start,
+                conv(i.stop) if isinstance(i.stop, Tensor) else i.stop,
+                conv(i.step) if isinstance(i.step, Tensor) else i.step)
+        if isinstance(i, (list, tuple)):
+            return type(i)(conv(x) for x in i)
+        return i
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+_patch()

@@ -1,0 +1,28 @@
+"""Attribute ops (python/paddle/tensor/attribute.py parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import unwrap
+from ..core.dtypes import is_complex, is_floating, is_integer
+from ..core.tensor import Tensor
+
+
+def shape(input, name=None):  # noqa: A002
+    return Tensor(jnp.asarray(unwrap(input).shape, dtype=jnp.int32))
+
+
+def rank(input, name=None):  # noqa: A002
+    return Tensor(jnp.asarray(unwrap(input).ndim, dtype=jnp.int32))
+
+
+def is_floating_point(x):
+    return is_floating(x.dtype)
+
+
+def is_integer_tensor(x):
+    return is_integer(x.dtype)
+
+
+def is_complex_tensor(x):
+    return is_complex(x.dtype)

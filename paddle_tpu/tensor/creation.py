@@ -1,0 +1,144 @@
+"""Tensor creation ops (python/paddle/tensor/creation.py parity)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "eye", "diag", "diagflat",
+    "tril", "triu", "meshgrid", "assign", "clone", "complex", "tril_indices",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.zeros(_shape(shape), dtype=dtype))
+
+
+def ones(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.ones(_shape(shape), dtype=dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    dtype = convert_dtype(dtype)
+    if dtype is None:
+        dtype = get_default_dtype() if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype=dtype))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(unwrap(x), dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(unwrap(x), dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(unwrap(x), fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = (v.item() if isinstance(v, Tensor) else v
+                        for v in (start, end, step))
+    if end is None:
+        start, end = 0, start
+    dtype = convert_dtype(dtype)
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = get_default_dtype()
+        else:
+            dtype = np.dtype(np.int64)
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.linspace(start, stop, num, dtype=dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=dtype))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    v = unwrap(x)
+    if v.ndim == 1 and padding_value != 0:
+        d = jnp.diag(v, k=offset)
+        mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+        return apply(lambda dv: jnp.where(mask, dv, padding_value), Tensor(d))
+    return apply(lambda xv: jnp.diag(xv, k=offset), x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda xv: jnp.diagflat(xv, k=offset), x, name="diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda xv: jnp.tril(xv, k=diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda xv: jnp.triu(xv, k=diagonal), x, name="triu")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    if col is None:
+        col = row
+    r, c = np.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(convert_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    arrs = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[unwrap(a) for a in arrs], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    v = unwrap(x) if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is not None:
+        output._value = v.astype(output._value.dtype) if hasattr(v, "astype") else v
+        return output
+    return Tensor(v)
+
+
+def clone(x, name=None):
+    return apply(lambda v: v + 0 if jnp.issubdtype(v.dtype, jnp.number) else v,
+                 x, name="clone")
+
+
+def complex(real, imag, name=None):
+    return apply(lambda r, i: jax_complex(r, i), real, imag, name="complex")
+
+
+def jax_complex(r, i):
+    return r + 1j * i

@@ -1,0 +1,12 @@
+"""einsum (python/paddle/tensor/einsum.py parity) — direct jnp.einsum (MXU path)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *operands, name="einsum")

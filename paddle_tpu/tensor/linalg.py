@@ -1,0 +1,208 @@
+"""Linear algebra ops (python/paddle/tensor/linalg.py parity).
+
+matmul is THE MXU op — keep it a single jnp.matmul so XLA tiles it onto the
+systolic array (reference: operators/matmul_v2_op.* dispatches to cuBLAS).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def prim(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return apply(prim, x, y, name="matmul")
+
+
+def dot(x, y, name=None):
+    def prim(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply(prim, x, y, name="dot")
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, x, y, name="bmm")
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    return matmul(input, mat2)
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, x, vec, name="mv")
+
+
+def t(input, name=None):  # noqa: A002
+    def prim(v):
+        return v.T if v.ndim >= 2 else v
+    return apply(prim, input, name="t")
+
+
+def transpose(x, perm, name=None):
+    from .manipulation import transpose as _tr
+    return _tr(x, perm)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def prim(v):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(v)))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=ax, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply(prim, x, name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    return norm(apply(jnp.subtract, x, y), p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    def prim(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply(prim, x, y, name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    def prim(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply(prim, x, name="cholesky")
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, x, name="inverse")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x)
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def prim(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(prim, x, y, name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def prim(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+    return apply(prim, x, y, name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    xv, yv = unwrap(x), unwrap(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(xv, yv, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv))
+
+
+def qr(x, mode="reduced", name=None):
+    def prim(v):
+        q, r = jnp.linalg.qr(v, mode=mode)
+        return q, r
+    if mode == "r":
+        return apply(lambda v: jnp.linalg.qr(v, mode="r"), x)
+    return apply(prim, x, name="qr")
+
+
+def svd(x, full_matrices=False, name=None):
+    def prim(v):
+        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
+        return u, s, vh
+    return apply(prim, x, name="svd")
+
+
+def eig(x, name=None):
+    v = unwrap(x)
+    import numpy as np
+    w, vec = np.linalg.eig(np.asarray(v))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def eigh(x, UPLO="L", name=None):
+    def prim(v):
+        w, vec = jnp.linalg.eigh(v, UPLO=UPLO)
+        return w, vec
+    return apply(prim, x, name="eigh")
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    w = np.linalg.eigvals(np.asarray(unwrap(x)))
+    return Tensor(jnp.asarray(w))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x)
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    r = jnp.linalg.matrix_rank(unwrap(x), rtol=tol)
+    return Tensor(r.astype(jnp.int64))
+
+
+def slogdet(x, name=None):
+    def prim(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+    return apply(prim, x, name="slogdet")
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, name="det")
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *x, name="multi_dot")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002,A001
+    v = unwrap(input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (float(jnp.min(v)), float(jnp.max(v)))
+    h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+    return Tensor(h.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    r = jnp.bincount(unwrap(x).astype(jnp.int32),
+                     weights=unwrap(weights) if weights is not None else None,
+                     minlength=minlength)
+    return Tensor(r)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                                   fweights=unwrap(fweights) if fweights is not None else None,
+                                   aweights=unwrap(aweights) if aweights is not None else None), x)

@@ -1,0 +1,71 @@
+"""Comparison/logical ops (python/paddle/tensor/logic.py parity).
+
+Outputs are bool tensors with stop_gradient=True (non-differentiable), matching
+the reference's compare ops (operators/controlflow/compare_op.cc).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+
+def _defcmp(name, fn):
+    def op(x, y, name=None):
+        return Tensor(fn(unwrap(x), unwrap(y)))
+    op.__name__ = name
+    return op
+
+
+equal = _defcmp("equal", jnp.equal)
+not_equal = _defcmp("not_equal", jnp.not_equal)
+greater_than = _defcmp("greater_than", jnp.greater)
+greater_equal = _defcmp("greater_equal", jnp.greater_equal)
+less_than = _defcmp("less_than", jnp.less)
+less_equal = _defcmp("less_equal", jnp.less_equal)
+logical_and = _defcmp("logical_and", jnp.logical_and)
+logical_or = _defcmp("logical_or", jnp.logical_or)
+logical_xor = _defcmp("logical_xor", jnp.logical_xor)
+bitwise_and = _defcmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _defcmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _defcmp("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return Tensor(jnp.logical_not(unwrap(x)))
+
+
+def bitwise_not(x, name=None):
+    return Tensor(jnp.bitwise_not(unwrap(x)))
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+        return nonzero(condition, as_tuple=False)
+    def prim(xv, yv):
+        return jnp.where(unwrap(condition).astype(bool), xv, yv)
+    return apply(prim, x, y, name="where")

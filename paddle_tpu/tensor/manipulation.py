@@ -1,0 +1,353 @@
+"""Shape/layout manipulation ops (python/paddle/tensor/manipulation.py parity)."""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        return tuple(int(v) for v in np.asarray(seq._value).reshape(-1))
+    if isinstance(seq, (int, np.integer)):
+        return (int(seq),)
+    return tuple(int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in seq)
+
+
+def cast(x, dtype):
+    d = convert_dtype(dtype)
+    src = unwrap(x)
+    if jnp.issubdtype(d, jnp.inexact) and jnp.issubdtype(src.dtype, jnp.inexact):
+        return apply(lambda v: v.astype(d), x, name="cast")
+    return Tensor(src.astype(d), stop_gradient=x.stop_gradient if isinstance(x, Tensor) else True)
+
+
+def reshape(x, shape, name=None):
+    return apply(lambda v: jnp.reshape(v, _ints(shape)), x, name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    x._value = jnp.reshape(x._val, _ints(shape))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def prim(v):
+        nd = v.ndim
+        if nd == 0:
+            return v.reshape(1)
+        s = start_axis % nd if start_axis >= 0 else start_axis + nd
+        e = stop_axis % nd if stop_axis >= 0 else stop_axis + nd
+        newshape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return v.reshape(newshape)
+    return apply(prim, x, name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    def prim(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = _ints(axis)
+        axes = tuple(a for a in axes if v.shape[a] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return apply(prim, x, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis)
+    return apply(lambda v: jnp.expand_dims(v, axes), x, name="unsqueeze")
+
+
+def transpose(x, perm, name=None):
+    return apply(lambda v: jnp.transpose(v, _ints(perm)), x, name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply(lambda v: jnp.swapaxes(v, axis1, axis2), x)
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else axis
+    return apply(lambda *vs: jnp.concatenate(vs, axis=axis), *x, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return apply(lambda *vs: jnp.stack(vs, axis=axis), *x, name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+    def prim(v):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(v, n, axis=axis))
+    return list(apply(prim, x, name="unstack"))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dim {dim} along axis {axis} is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(unwrap(s)) if isinstance(s, Tensor) else int(s)
+                    for s in num_or_sections]
+        total = sum(s for s in sections if s >= 0)
+        sections = [s if s >= 0 else dim - total for s in sections]
+    offsets = np.cumsum([0] + sections)
+
+    def prim(v):
+        return tuple(jnp.take(v, jnp.arange(offsets[i], offsets[i + 1]), axis=axis)
+                     for i in range(len(sections)))
+    return list(apply(prim, x, name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def tile(x, repeat_times, name=None):
+    return apply(lambda v: jnp.tile(v, _ints(repeat_times)), x, name="tile")
+
+
+def expand(x, shape, name=None):
+    tgt = _ints(shape)
+    def prim(v):
+        full = list(tgt)
+        src = list(v.shape)
+        # paddle semantics: -1 keeps the original dim
+        src = [1] * (len(full) - len(src)) + src
+        for i, s in enumerate(full):
+            if s == -1:
+                full[i] = src[i]
+        return jnp.broadcast_to(v.reshape(src), full)
+    return apply(prim, x, name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(i.shape) for i in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [expand(i, out_shape) for i in inputs]
+
+
+def flip(x, axis, name=None):
+    return apply(lambda v: jnp.flip(v, axis=_ints(axis)), x, name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda v: jnp.roll(v, shifts, axis=axis), x, name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis),
+                 x, index, name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def prim(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., i] for i in range(k))
+        return v[flat_idx]
+    return apply(prim, x, index, name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis),
+                 arr, indices, name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    def prim(v, i, val):
+        i = i.astype(jnp.int32)
+        val = jnp.broadcast_to(val, i.shape).astype(v.dtype)
+        dims = list(range(v.ndim))
+        idxs = jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij")
+        idxs[axis] = i
+        if reduce == "assign":
+            return v.at[tuple(idxs)].set(val)
+        if reduce == "add":
+            return v.at[tuple(idxs)].add(val)
+        if reduce == "multiply" or reduce == "mul":
+            return v.at[tuple(idxs)].multiply(val)
+        raise ValueError(reduce)
+    return apply(prim, arr, indices, values, name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def prim(v, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return v.at[i].set(u.astype(v.dtype))
+        zeroed = v.at[i].set(jnp.zeros_like(u, dtype=v.dtype))
+        return zeroed.at[i].add(u.astype(v.dtype))
+    return apply(prim, x, index, updates, name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def prim(v, i, u):
+        i = i.astype(jnp.int32)
+        k = i.shape[-1]
+        flat = tuple(i[..., d] for d in range(k))
+        return v.at[flat].add(u.astype(v.dtype))
+    return apply(prim, x, index, updates, name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis=axis)
+
+
+def index_sample(x, index, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=1),
+                 x, index, name="index_sample")
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+    def prim(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            dim = v.shape[a]
+            s2 = np.clip(s if s >= 0 else s + dim, 0, dim)
+            e2 = np.clip(e if e >= 0 else e + dim, 0, dim)
+            idx[a] = builtins.slice(int(s2), int(e2))
+        return v[tuple(idx)]
+    return apply(prim, x, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = map(_ints, (axes, starts, ends, strides))
+    def prim(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins.slice(s, e, st)
+        return v[tuple(idx)]
+    return apply(prim, x, name="strided_slice")
+
+
+def unbind(input, axis=0):  # noqa: A002
+    return unstack(input, axis=axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = np.asarray(unwrap(x))
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    v = np.asarray(unwrap(x)).reshape(-1) if axis is None else np.asarray(unwrap(x))
+    keep = np.concatenate([[True], v[1:] != v[:-1]]) if v.ndim == 1 else None
+    out = v[keep]
+    rets = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        rets.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, len(v)))
+        rets.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def masked_select(x, mask, name=None):
+    v = unwrap(x)
+    m = np.asarray(unwrap(mask)).astype(bool)
+    return Tensor(jnp.asarray(np.asarray(v)[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    val = unwrap(value) if isinstance(value, Tensor) else value
+    return apply(lambda v, m: jnp.where(m, jnp.asarray(val, dtype=v.dtype), v),
+                 x, mask, name="masked_fill")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    pads = _ints(pad)
+    def prim(v):
+        nd = v.ndim
+        if len(pads) == 2 * nd:
+            width = [(pads[2 * i], pads[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle nn.functional.pad convention: the flat pad list applies
+            # LAST-dim-first — [left,right,top,bottom] pads W then H (same as
+            # torch). Channel-last formats keep that W-then-H meaning over
+            # their spatial axes.
+            k = len(pads) // 2
+            width = [(0, 0)] * nd
+            if data_format.endswith("HWC") or data_format in ("NHWC", "NDHWC", "NLC"):
+                spatial = list(range(1, 1 + k))
+            else:
+                spatial = list(range(nd - k, nd))
+            for j in range(k):
+                a = spatial[len(spatial) - 1 - j]
+                width[a] = (pads[2 * j], pads[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, width, mode="constant", constant_values=value)
+        return jnp.pad(v, width, mode=jmode)
+    return apply(prim, x, name="pad")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _ints(shape)
+    offs = _ints(offsets) if offsets is not None else (0,) * len(shp)
+    def prim(v):
+        idx = tuple(builtins.slice(o, o + s) for o, s in zip(offs, shp))
+        return v[idx]
+    return apply(prim, x, name="crop")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = unwrap(repeats) if isinstance(repeats, Tensor) else repeats
+    return apply(lambda v: jnp.repeat(v, r, axis=axis), x, name="repeat_interleave")
+
+
+def as_complex(x, name=None):
+    return apply(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+

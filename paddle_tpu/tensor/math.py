@@ -1,0 +1,295 @@
+"""Elementwise math + reductions (python/paddle/tensor/math.py parity).
+
+All ops are thin jax-traceable primitives routed through dispatch.apply so the
+tape records VJPs; broadcasting/type-promotion semantics are JAX's (match the
+reference's elementwise broadcast machinery, operators/elementwise/).
+"""
+from __future__ import annotations
+
+import operator
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor
+
+
+def _defun(name, fn):
+    def op(x, name=None):
+        return apply(fn, x, name=name or op.__name__)
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+def _defbin(name, fn):
+    def op(x, y, name=None):
+        return apply(fn, x, y, name=name or op.__name__)
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+# ---- unary -------------------------------------------------------------------
+exp = _defun("exp", jnp.exp)
+expm1 = _defun("expm1", jnp.expm1)
+log = _defun("log", jnp.log)
+log2 = _defun("log2", jnp.log2)
+log10 = _defun("log10", jnp.log10)
+log1p = _defun("log1p", jnp.log1p)
+sqrt = _defun("sqrt", jnp.sqrt)
+rsqrt = _defun("rsqrt", jax.lax.rsqrt)
+square = _defun("square", jnp.square)
+reciprocal = _defun("reciprocal", lambda x: 1.0 / x)
+abs = _defun("abs", jnp.abs)  # noqa: A001
+sign = _defun("sign", jnp.sign)
+neg = _defun("neg", operator.neg)
+floor = _defun("floor", jnp.floor)
+ceil = _defun("ceil", jnp.ceil)
+round = _defun("round", jnp.round)  # noqa: A001
+trunc = _defun("trunc", jnp.trunc)
+frac = _defun("frac", lambda x: x - jnp.trunc(x))
+sin = _defun("sin", jnp.sin)
+cos = _defun("cos", jnp.cos)
+tan = _defun("tan", jnp.tan)
+asin = _defun("asin", jnp.arcsin)
+acos = _defun("acos", jnp.arccos)
+atan = _defun("atan", jnp.arctan)
+sinh = _defun("sinh", jnp.sinh)
+cosh = _defun("cosh", jnp.cosh)
+tanh = _defun("tanh", jnp.tanh)
+asinh = _defun("asinh", jnp.arcsinh)
+acosh = _defun("acosh", jnp.arccosh)
+atanh = _defun("atanh", jnp.arctanh)
+erf = _defun("erf", jax.lax.erf)
+erfinv = _defun("erfinv", jax.lax.erf_inv)
+sigmoid = _defun("sigmoid", jax.nn.sigmoid)
+digamma = _defun("digamma", jax.lax.digamma)
+lgamma = _defun("lgamma", jax.lax.lgamma)
+angle = _defun("angle", jnp.angle)
+conj = _defun("conj", jnp.conj)
+real = _defun("real", jnp.real)
+imag = _defun("imag", jnp.imag)
+
+# ---- binary ------------------------------------------------------------------
+add = _defbin("add", jnp.add)
+subtract = _defbin("subtract", jnp.subtract)
+multiply = _defbin("multiply", jnp.multiply)
+divide = _defbin("divide", jnp.true_divide)
+floor_divide = _defbin("floor_divide", jnp.floor_divide)
+mod = _defbin("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _defbin("pow", jnp.power)  # noqa: A001
+maximum = _defbin("maximum", jnp.maximum)
+minimum = _defbin("minimum", jnp.minimum)
+fmax = _defbin("fmax", jnp.fmax)
+fmin = _defbin("fmin", jnp.fmin)
+atan2 = _defbin("atan2", jnp.arctan2)
+logaddexp = _defbin("logaddexp", jnp.logaddexp)
+hypot = _defbin("hypot", jnp.hypot)
+inner = _defbin("inner", jnp.inner)
+outer = _defbin("outer", jnp.outer)
+kron = _defbin("kron", jnp.kron)
+gcd = _defbin("gcd", jnp.gcd)
+lcm = _defbin("lcm", jnp.lcm)
+heaviside = _defbin("heaviside", jnp.heaviside)
+nextafter = _defbin("nextafter", jnp.nextafter)
+copysign = _defbin("copysign", jnp.copysign)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    scale = unwrap(scale)
+    def prim(v, s):
+        r = v * s + bias if bias_after_scale else (v + bias) * s
+        return r
+    out = apply(prim, x, scale, name="scale")
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    def prim(idx, *ins):
+        stacked = jnp.stack(ins, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))).astype(jnp.int32),
+            axis=0)[0]
+    return apply(prim, index, *inputs, name="multiplex")
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    lo = unwrap(min) if min is not None else None
+    hi = unwrap(max) if max is not None else None
+    return apply(lambda v: jnp.clip(v, lo, hi), x, name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return apply(lambda a, b: a + weight * (b - a), x, y, name="lerp")
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), x, name="stanh")
+
+
+def rad2deg(x, name=None):
+    return apply(jnp.rad2deg, x)
+
+
+def deg2rad(x, name=None):
+    return apply(jnp.deg2rad, x)
+
+
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(unwrap(x)))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(unwrap(x)))
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(unwrap(x)))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+# ---- reductions --------------------------------------------------------------
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis._value)
+        return tuple(int(v) for v in a.reshape(-1))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _defreduce(name, fn, int_promote=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _norm_axis(axis)
+        def prim(v):
+            r = fn(v, axis=ax, keepdims=keepdim)
+            if dtype is not None:
+                r = r.astype(convert_dtype(dtype))
+            return r
+        return apply(prim, x, name=op.__name__)
+    op.__name__ = name
+    return op
+
+
+sum = _defreduce("sum", jnp.sum)  # noqa: A001
+mean = _defreduce("mean", jnp.mean)
+prod = _defreduce("prod", jnp.prod)
+nansum = _defreduce("nansum", jnp.nansum)
+nanmean = _defreduce("nanmean", jnp.nanmean)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.max(v, axis=_norm_axis(axis), keepdims=keepdim), x,
+                 name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.min(v, axis=_norm_axis(axis), keepdims=keepdim), x,
+                 name="min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jax.nn.logsumexp(v, axis=_norm_axis(axis), keepdims=keepdim),
+                 x, name="logsumexp")
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return Tensor(jnp.all(unwrap(x), axis=_norm_axis(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return Tensor(jnp.any(unwrap(x), axis=_norm_axis(axis), keepdims=keepdim))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.count_nonzero(unwrap(x), axis=_norm_axis(axis),
+                                    keepdims=keepdim).astype(jnp.int64))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def prim(v):
+        if axis is None:
+            r = jnp.cumsum(v.reshape(-1))
+        else:
+            r = jnp.cumsum(v, axis=axis)
+        return r.astype(convert_dtype(dtype)) if dtype else r
+    return apply(prim, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def prim(v):
+        r = jnp.cumprod(v, axis=dim)
+        return r.astype(convert_dtype(dtype)) if dtype else r
+    return apply(prim, x, name="cumprod")
+
+
+def _cummaxmin(x, axis, dtype, is_max):
+    v = unwrap(x)
+    ax = 0 if axis is None else axis
+    vv = v.reshape(-1) if axis is None else v
+    shape = [1] * vv.ndim
+    shape[ax] = vv.shape[ax]
+    pos = jnp.broadcast_to(
+        jnp.arange(vv.shape[ax]).reshape(shape), vv.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = (bv > av) if is_max else (bv < av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    vals, idxs = jax.lax.associative_scan(combine, (vv, pos), axis=ax)
+    return Tensor(vals), Tensor(idxs.astype(convert_dtype(dtype)))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cummaxmin(x, axis, dtype, True)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cummaxmin(x, axis, dtype, False)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return apply(lambda v: jnp.diff(v, n=n, axis=axis,
+                                    prepend=unwrap(prepend) if prepend is not None else None,
+                                    append=unwrap(append) if append is not None else None),
+                 x, name="diff")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+                 x, name="trace")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, name="addmm")
+
+
+def increment(x, value=1.0, name=None):
+    x._value = x._val + value
+    return x

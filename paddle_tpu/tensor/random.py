@@ -1,0 +1,97 @@
+"""Random sampling ops (python/paddle/tensor/random.py parity).
+
+All sampling consumes keys from the global Generator (core/random.py) whose
+state is a Tensor — so under `to_static` the key is captured/advanced as traced
+state and randomness is correct inside compiled steps.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import unwrap
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..core.random import next_key
+from ..core.tensor import Tensor
+from .creation import _shape
+
+
+def rand(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dtype=dtype))
+
+
+def randn(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.normal(next_key(), _shape(shape), dtype=dtype))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype=dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = unwrap(mean) if isinstance(mean, Tensor) else mean
+        s = unwrap(std) if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        z = jax.random.normal(next_key(), shp, dtype=get_default_dtype())
+        return Tensor(m + s * z)
+    shp = _shape(shape) if shape is not None else ()
+    z = jax.random.normal(next_key(), shp, dtype=get_default_dtype())
+    return Tensor(mean + std * z)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dtype=dtype,
+                                     minval=float(unwrap(min) if isinstance(min, Tensor) else min),
+                                     maxval=float(unwrap(max) if isinstance(max, Tensor) else max)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     dtype=convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, shape=x.shape, dtype=dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = unwrap(x)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + v.shape[:-1])
+        if v.ndim == 1:
+            return Tensor(out.astype(jnp.int64))
+        return Tensor(jnp.moveaxis(out, 0, -1).astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(next_key(), v.shape, dtype=logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    v = unwrap(x)
+    u = jax.random.uniform(next_key(), v.shape, dtype=v.dtype)
+    return Tensor((u < v).astype(v.dtype))
+
+
+def poisson(x, name=None):
+    v = unwrap(x)
+    return Tensor(jax.random.poisson(next_key(), v).astype(v.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    v = unwrap(x)
+    u = jax.random.exponential(next_key(), v.shape, dtype=v.dtype) / lam
+    x._value = u
+    return x

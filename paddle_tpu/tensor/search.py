@@ -1,0 +1,116 @@
+"""Search/sort ops (python/paddle/tensor/search.py parity)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    v = unwrap(x)
+    r = jnp.argmax(v.reshape(-1) if axis is None else v,
+                   axis=None if axis is None else axis,
+                   keepdims=keepdim if axis is not None else False)
+    return Tensor(r.astype(convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    v = unwrap(x)
+    r = jnp.argmin(v.reshape(-1) if axis is None else v,
+                   axis=None if axis is None else axis,
+                   keepdims=keepdim if axis is not None else False)
+    return Tensor(r.astype(convert_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    v = unwrap(x)
+    idx = jnp.argsort(-v if descending else v, axis=axis, kind="stable")
+    return Tensor(idx.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def prim(v):
+        s = jnp.sort(v, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply(prim, x, name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    k = int(unwrap(k)) if isinstance(k, Tensor) else int(k)
+    def prim(v):
+        vv = jnp.moveaxis(v, axis, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, k)
+        else:
+            vals, idx = jax.lax.top_k(-vv, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+    vals, idx = apply(prim, x, name="topk")
+    return vals, Tensor(idx._value.astype(jnp.int64))
+
+
+def nonzero(x, as_tuple=False):
+    v = np.asarray(unwrap(x))
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n.astype(np.int64))[:, None]) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def index_of_max(x):
+    return argmax(x)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    r = jnp.searchsorted(unwrap(sorted_sequence), unwrap(values), side=side)
+    return Tensor(r.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def prim(v):
+        s = jnp.sort(v, axis=axis)
+        i = jnp.argsort(v, axis=axis, kind="stable")
+        vals = jnp.take(s, k - 1, axis=axis)
+        idxs = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idxs = jnp.expand_dims(idxs, axis)
+        return vals, idxs
+    vals, idx = apply(prim, x, name="kthvalue")
+    return vals, Tensor(idx._value.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = np.asarray(unwrap(x))
+    mv = np.moveaxis(v, axis, -1)
+    flat = mv.reshape(-1, mv.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=v.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        # paddle returns the last index of the mode value
+        where = np.nonzero(row == best)[0]
+        vals[i] = best
+        idxs[i] = where[-1]
+    out_shape = mv.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)

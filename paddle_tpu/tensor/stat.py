@@ -1,0 +1,49 @@
+"""Statistics ops (python/paddle/tensor/stat.py parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+from .math import _norm_axis
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    from .math import mean as _mean
+    return _mean(x, axis=axis, keepdim=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return apply(lambda v: jnp.var(v, axis=_norm_axis(axis), ddof=ddof,
+                                   keepdims=keepdim), x, name="var")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return apply(lambda v: jnp.std(v, axis=_norm_axis(axis), ddof=ddof,
+                                   keepdims=keepdim), x, name="std")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.median(v, axis=_norm_axis(axis), keepdims=keepdim),
+                 x, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nanmedian(v, axis=_norm_axis(axis), keepdims=keepdim),
+                 x, name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.quantile(v, jnp.asarray(q), axis=_norm_axis(axis),
+                                        keepdims=keepdim), x, name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nanquantile(v, jnp.asarray(q), axis=_norm_axis(axis),
+                                           keepdims=keepdim), x, name="nanquantile")
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))

@@ -1,0 +1,5 @@
+from .bert import BertModel, BertForSequenceClassification  # noqa: F401
+from .gpt import GPTForCausalLM, GPTModel  # noqa: F401
+
+__all__ = ["BertModel", "BertForSequenceClassification", "GPTModel",
+           "GPTForCausalLM"]

@@ -1,0 +1,167 @@
+"""GPT decoder (BASELINE config 5: "PaddleNLP GPT-3 1.3B hybrid-parallel").
+
+The reference ships the building blocks (fleet mp_layers, fused attention);
+PaddleNLP assembles them. Here the model is in-tree: decoder-only transformer
+with optional tensor parallelism — when `tensor_parallel=True` the qkv/ffn
+projections are Column/RowParallelLinear and the embedding is vocab-sharded,
+so under a mesh with a 'model' axis GSPMD partitions the matmuls over ICI.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...tensor import manipulation as M
+
+__all__ = ["GPTModel", "GPTForCausalLM", "GPTConfig"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, max_position_embeddings=1024,
+                 intermediate_size=None, dropout=0.1, tensor_parallel=False,
+                 use_flash_attention=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.dropout = dropout
+        self.tensor_parallel = tensor_parallel
+        self.use_flash_attention = use_flash_attention
+
+    @classmethod
+    def gpt3_1p3b(cls, **kw):
+        return cls(vocab_size=50304, hidden_size=2048, num_layers=24,
+                   num_heads=16, **kw)
+
+
+def _linear_cls(cfg, kind):
+    if not cfg.tensor_parallel:
+        return None
+    from ...distributed.fleet.meta_parallel import (ColumnParallelLinear,
+                                                    RowParallelLinear)
+    return ColumnParallelLinear if kind == "col" else RowParallelLinear
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.hidden = cfg.hidden_size
+        self.dropout = cfg.dropout
+        self.use_flash = cfg.use_flash_attention
+        Col = _linear_cls(cfg, "col")
+        Row = _linear_cls(cfg, "row")
+        if Col is not None:
+            self.qkv = Col(cfg.hidden_size, 3 * cfg.hidden_size,
+                           gather_output=False)
+            self.out_proj = Row(cfg.hidden_size, cfg.hidden_size,
+                                input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+            self.out_proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, x, cache=None):
+        b, s, _ = x.shape
+        qkv = self.qkv(x)
+        qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        parts = M.unstack(qkv, axis=2)
+        q, k, v = parts[0], parts[1], parts[2]
+        if cache is not None:
+            k = M.concat([cache[0], k], axis=1)
+            v = M.concat([cache[1], v], axis=1)
+            cache = (k, v)
+        from ...ops.attention import scaled_dot_product_attention
+        out = scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout,
+            training=self.training)
+        out = M.reshape(out, [b, s, self.hidden])
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, cache
+        return out
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        Col = _linear_cls(cfg, "col")
+        Row = _linear_cls(cfg, "row")
+        if Col is not None:
+            self.fc1 = Col(cfg.hidden_size, cfg.intermediate_size,
+                           gather_output=False)
+            self.fc2 = Row(cfg.intermediate_size, cfg.hidden_size,
+                           input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+            self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        cfg = config or GPTConfig(**kwargs)
+        self.config = cfg
+        if cfg.tensor_parallel:
+            from ...distributed.fleet.meta_parallel import \
+                VocabParallelEmbedding
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        else:
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            import jax.numpy as jnp
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int64)[None, :])
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        self.gpt = GPTModel(config, **kwargs)
+        # weight tying with the token embedding (standard GPT head)
+        self.config = self.gpt.config
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        logits = F.linear(h, self.gpt.wte.weight.t())
+        if labels is not None:
+            loss = F.cross_entropy(
+                M.reshape(logits, [-1, self.config.vocab_size]),
+                M.reshape(labels, [-1]))
+            return loss
+        return logits

@@ -1,0 +1,56 @@
+"""Vision ops (reference: operators/detection/* — nms, roi_align, yolo_box).
+Core subset implemented; detection-specific ops land with the detection
+models."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "box_iou", "deform_conv2d"]
+
+
+def box_iou(boxes1, boxes2):
+    def prim(b1, b2):
+        area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0, None)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+    return apply(prim, boxes1, boxes2, name="box_iou")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    b = np.asarray(unwrap(boxes))
+    s = np.asarray(unwrap(scores)) if scores is not None else np.arange(
+        len(b), 0, -1, dtype=np.float32)
+    order = np.argsort(-s)
+    keep = []
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    while order.size > 0:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(b[i, 0], b[order[1:], 0])
+        yy1 = np.maximum(b[i, 1], b[order[1:], 1])
+        xx2 = np.minimum(b[i, 2], b[order[1:], 2])
+        yy2 = np.minimum(b[i, 3], b[order[1:], 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        iou = inter / (area[i] + area[order[1:]] - inter)
+        order = order[1:][iou <= iou_threshold]
+    keep = np.asarray(keep, dtype=np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError("deform_conv2d: planned with detection models")

@@ -1,0 +1,157 @@
+"""Vision transforms (python/paddle/vision/transforms parity) — numpy CHW."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "normalize", "to_tensor", "resize", "hflip"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.ndim == 2:
+            arr = arr[None]
+        elif arr.ndim == 3 and arr.shape[-1] in (1, 3, 4) and self.data_format == "CHW":
+            if arr.shape[0] not in (1, 3, 4):
+                arr = arr.transpose(2, 0, 1)
+        if arr.max() > 2.0:
+            arr = arr / 255.0
+        return arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+def _chw(arr):
+    return arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        import jax
+        import jax.numpy as jnp
+        chw = _chw(arr)
+        if chw:
+            target = (arr.shape[0],) + self.size
+        else:
+            target = self.size + (arr.shape[-1],)
+        return np.asarray(jax.image.resize(jnp.asarray(arr), target,
+                                           method="linear"))
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h_axis, w_axis = (1, 2) if _chw(arr) else (0, 1)
+        h, w = arr.shape[h_axis], arr.shape[w_axis]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        sl = [slice(None)] * arr.ndim
+        sl[h_axis] = slice(i, i + th)
+        sl[w_axis] = slice(j, j + tw)
+        return arr[tuple(sl)]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h_axis, w_axis = (1, 2) if _chw(arr) else (0, 1)
+        if self.padding:
+            pads = [(0, 0)] * arr.ndim
+            pads[h_axis] = (self.padding, self.padding)
+            pads[w_axis] = (self.padding, self.padding)
+            arr = np.pad(arr, pads)
+        h, w = arr.shape[h_axis], arr.shape[w_axis]
+        th, tw = self.size
+        i = np.random.randint(0, max(h - th, 0) + 1)
+        j = np.random.randint(0, max(w - tw, 0) + 1)
+        sl = [slice(None)] * arr.ndim
+        sl[h_axis] = slice(i, i + th)
+        sl[w_axis] = slice(j, j + tw)
+        return arr[tuple(sl)]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() < self.prob:
+            axis = 2 if _chw(arr) else 1
+            return np.flip(arr, axis=axis).copy()
+        return arr
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() < self.prob:
+            axis = 1 if _chw(arr) else 0
+            return np.flip(arr, axis=axis).copy()
+        return arr
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    arr = np.asarray(img)
+    axis = 2 if _chw(arr) else 1
+    return np.flip(arr, axis=axis).copy()

@@ -1,0 +1,31 @@
+"""Test configuration.
+
+Mirrors the reference's CPU-everywhere testability (SURVEY.md §4): tests run on
+a virtual 8-device CPU mesh so sharding/collective paths compile and execute
+without TPU hardware. (The axon sitecustomize is bypassed via JAX_PLATFORMS.)
+"""
+import os
+
+# force CPU (the ambient env pins JAX_PLATFORMS=axon for the TPU tunnel);
+# set PADDLE_TPU_TEST_DEVICE=tpu to run the suite on the real chip.
+if os.environ.get("PADDLE_TPU_TEST_DEVICE", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+# keep compile times sane on the 1-core CI box
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def paddle():
+    import paddle_tpu
+    return paddle_tpu
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+    paddle_tpu.seed(1234)
+    yield

@@ -1,0 +1,66 @@
+"""Numpy-oracle op test harness.
+
+Reference parity: python/paddle/fluid/tests/unittests/op_test.py:277 — "op
+semantics are defined by numpy reference implementations" (SURVEY.md §4.1).
+TPU-native adaptation: `check_output` compares eager AND jit (to_static)
+execution against the numpy oracle; `check_grad` compares the tape's analytic
+gradient against numeric finite differences.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.dispatch import unwrap
+
+
+def check_output(fn, np_fn, inputs, atol=1e-5, rtol=1e-5, jit=True):
+    """fn: callable over Tensors; np_fn: numpy oracle over ndarrays."""
+    tensors = [paddle.to_tensor(i) for i in inputs]
+    expected = np_fn(*[np.asarray(i) for i in inputs])
+    out = fn(*tensors)
+    got = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+    np.testing.assert_allclose(got, expected, atol=atol, rtol=rtol,
+                               err_msg="eager mismatch")
+    if jit:
+        jfn = paddle.jit.to_static(fn)
+        for _ in range(3):  # discovery x2 + compiled
+            jout = jfn(*tensors)
+        jgot = jout.numpy() if hasattr(jout, "numpy") else np.asarray(jout)
+        np.testing.assert_allclose(jgot, expected, atol=atol, rtol=rtol,
+                                   err_msg="jit mismatch")
+
+
+def check_grad(fn, inputs, atol=5e-3, rtol=5e-3, eps=1e-3, loss_reduce=True):
+    """Finite-difference gradient check (op_test.py check_grad parity)."""
+    tensors = [paddle.to_tensor(np.asarray(i, dtype=np.float64).astype("float32"),
+                                stop_gradient=False) for i in inputs]
+
+    def scalar_loss(*ts):
+        out = fn(*ts)
+        return out.sum() if loss_reduce else out
+
+    loss = scalar_loss(*tensors)
+    loss.backward()
+    analytic = [t.grad.numpy() if t.grad is not None else
+                np.zeros(t.shape, dtype=np.float32) for t in tensors]
+
+    for ti, t in enumerate(tensors):
+        base = np.asarray(unwrap(t)).astype(np.float64)
+        num = np.zeros_like(base)
+        flat = base.reshape(-1)
+        nflat = num.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            lp = float(scalar_loss(*[paddle.to_tensor(
+                base.astype("float32")) if k == ti else tensors[k]
+                for k in range(len(tensors))]).item())
+            flat[j] = orig - eps
+            lm = float(scalar_loss(*[paddle.to_tensor(
+                base.astype("float32")) if k == ti else tensors[k]
+                for k in range(len(tensors))]).item())
+            flat[j] = orig
+            nflat[j] = (lp - lm) / (2 * eps)
+        np.testing.assert_allclose(analytic[ti], num, atol=atol, rtol=rtol,
+                                   err_msg=f"grad mismatch for input {ti}")

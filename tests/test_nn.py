@@ -1,0 +1,220 @@
+"""nn layer/functional tests (reference pattern: test_nn_* dual-mode tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(1)
+
+
+class TestFunctional:
+    def test_relu_gelu_softmax(self):
+        a = RNG.randn(3, 4).astype("float32")
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(a, 0))
+        sm = F.softmax(t, axis=-1).numpy()
+        np.testing.assert_allclose(sm.sum(-1), np.ones(3), atol=1e-6)
+        g = F.gelu(t).numpy()
+        assert g.shape == a.shape
+
+    def test_linear(self):
+        x = RNG.randn(2, 3).astype("float32")
+        w = RNG.randn(3, 4).astype("float32")
+        b = RNG.randn(4).astype("float32")
+        got = F.linear(paddle.to_tensor(x), paddle.to_tensor(w),
+                       paddle.to_tensor(b))
+        np.testing.assert_allclose(got.numpy(), x @ w + b, atol=1e-5)
+
+    def test_conv2d_vs_naive(self):
+        x = RNG.randn(1, 2, 5, 5).astype("float32")
+        w = RNG.randn(3, 2, 3, 3).astype("float32")
+        got = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                       padding=1).numpy()
+        # naive conv
+        xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        exp = np.zeros((1, 3, 5, 5), dtype=np.float32)
+        for o in range(3):
+            for i in range(5):
+                for j in range(5):
+                    exp[0, o, i, j] = np.sum(xp[0, :, i:i + 3, j:j + 3] * w[o])
+        np.testing.assert_allclose(got, exp, atol=1e-4)
+
+    def test_max_avg_pool(self):
+        x = RNG.randn(1, 1, 4, 4).astype("float32")
+        got = F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+        exp = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(got, exp)
+        got = F.avg_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+        exp = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(got, exp, atol=1e-6)
+
+    def test_cross_entropy(self):
+        logits = RNG.randn(4, 5).astype("float32")
+        labels = np.array([0, 2, 4, 1], dtype=np.int64)
+        got = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels)).item()
+        m = logits - logits.max(-1, keepdims=True)
+        logp = m - np.log(np.exp(m).sum(-1, keepdims=True))
+        exp = -logp[np.arange(4), labels].mean()
+        assert abs(got - exp) < 1e-5
+
+    def test_cross_entropy_soft_and_ignore(self):
+        logits = RNG.randn(4, 5).astype("float32")
+        soft = np.abs(RNG.randn(4, 5).astype("float32"))
+        soft /= soft.sum(-1, keepdims=True)
+        got = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft),
+                              soft_label=True).item()
+        m = logits - logits.max(-1, keepdims=True)
+        logp = m - np.log(np.exp(m).sum(-1, keepdims=True))
+        assert abs(got - (-(soft * logp).sum(-1).mean())) < 1e-5
+        labels = np.array([0, -100, 4, 1], dtype=np.int64)
+        got = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels),
+                              ignore_index=-100).item()
+        valid = labels != -100
+        exp = -logp[np.arange(4), np.maximum(labels, 0)][valid].mean()
+        assert abs(got - exp) < 1e-5
+
+    def test_mse_l1(self):
+        a = RNG.randn(3, 3).astype("float32")
+        b = RNG.randn(3, 3).astype("float32")
+        assert abs(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item()
+                   - ((a - b) ** 2).mean()) < 1e-6
+        assert abs(F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item()
+                   - np.abs(a - b).mean()) < 1e-6
+
+    def test_dropout_modes(self):
+        x = paddle.ones([1000])
+        out = F.dropout(x, p=0.5, training=True)
+        kept = (out.numpy() != 0).mean()
+        assert 0.35 < kept < 0.65
+        np.testing.assert_allclose(out.numpy()[out.numpy() != 0], 2.0)
+        out_eval = F.dropout(x, p=0.5, training=False)
+        np.testing.assert_allclose(out_eval.numpy(), np.ones(1000))
+
+    def test_embedding(self):
+        w = RNG.randn(10, 4).astype("float32")
+        idx = np.array([[1, 3], [5, 9]], dtype=np.int64)
+        got = F.embedding(paddle.to_tensor(idx), paddle.to_tensor(w)).numpy()
+        np.testing.assert_allclose(got, w[idx])
+
+    def test_one_hot_label_smooth(self):
+        oh = F.one_hot(paddle.to_tensor(np.array([1, 2])), 4).numpy()
+        np.testing.assert_allclose(oh, np.eye(4)[[1, 2]])
+
+    def test_layer_norm_fn(self):
+        x = RNG.randn(2, 3, 8).astype("float32")
+        got = F.layer_norm(paddle.to_tensor(x), 8).numpy()
+        mu = x.mean(-1, keepdims=True)
+        sd = x.std(-1, keepdims=True)
+        np.testing.assert_allclose(got, (x - mu) / np.sqrt(sd ** 2 + 1e-5),
+                                   atol=1e-4)
+
+
+class TestLayers:
+    def test_linear_layer(self):
+        layer = nn.Linear(4, 3)
+        x = paddle.to_tensor(RNG.randn(2, 4).astype("float32"))
+        out = layer(x)
+        assert out.shape == [2, 3]
+        exp = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), exp, atol=1e-5)
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(m1.state_dict())
+        x = paddle.to_tensor(RNG.randn(3, 4).astype("float32"))
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), atol=1e-6)
+
+    def test_named_parameters(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+        names = [n for n, _ in m.named_parameters()]
+        assert names == ["0.weight", "0.bias", "1.weight", "1.bias"]
+
+    def test_batch_norm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.to_tensor(RNG.randn(4, 3, 5, 5).astype("float32") * 2 + 1)
+        bn.train()
+        out = bn(x)
+        # normalized output: ~zero mean, unit var per channel
+        o = out.numpy()
+        assert abs(o.mean()) < 1e-5
+        assert abs(o.std() - 1.0) < 1e-2
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_conv_layer_shapes(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = paddle.to_tensor(RNG.randn(2, 3, 8, 8).astype("float32"))
+        assert conv(x).shape == [2, 8, 4, 4]
+
+    def test_embedding_layer(self):
+        emb = nn.Embedding(20, 6, padding_idx=0)
+        np.testing.assert_allclose(emb.weight.numpy()[0], np.zeros(6))
+        out = emb(paddle.to_tensor(np.array([[1, 0, 3]], dtype=np.int64)))
+        assert out.shape == [1, 3, 6]
+
+    def test_sublayer_train_eval_propagation(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_lstm(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = paddle.to_tensor(RNG.randn(2, 5, 4).astype("float32"))
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [2, 2, 8]
+        assert c.shape == [2, 2, 8]
+
+    def test_bilstm(self):
+        lstm = nn.LSTM(4, 8, direction="bidirect")
+        x = paddle.to_tensor(RNG.randn(2, 5, 4).astype("float32"))
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_gru_simplernn(self):
+        x = paddle.to_tensor(RNG.randn(2, 5, 4).astype("float32"))
+        out, h = nn.GRU(4, 6)(x)
+        assert out.shape == [2, 5, 6]
+        out, h = nn.SimpleRNN(4, 6)(x)
+        assert out.shape == [2, 5, 6]
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        q = paddle.to_tensor(RNG.randn(2, 5, 16).astype("float32"))
+        out = mha(q, q, q)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        enc_layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        src = paddle.to_tensor(RNG.randn(2, 6, 16).astype("float32"))
+        out = enc(src)
+        assert out.shape == [2, 6, 16]
+
+    def test_transformer_full(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        src = paddle.to_tensor(RNG.randn(2, 5, 16).astype("float32"))
+        tgt = paddle.to_tensor(RNG.randn(2, 4, 16).astype("float32"))
+        out = model(src, tgt)
+        assert out.shape == [2, 4, 16]
+
+    def test_layer_grad_flow(self):
+        layer = nn.Linear(3, 2)
+        x = paddle.to_tensor(RNG.randn(4, 3).astype("float32"))
+        loss = layer(x).sum()
+        loss.backward()
+        assert layer.weight.grad is not None
+        assert layer.weight.grad.shape == [3, 2]
+        assert layer.bias.grad is not None

@@ -1,0 +1,182 @@
+"""Regression tests for review findings (engine/API edge cases)."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_pylayer_none_grad_does_not_stall_upstream():
+    from paddle_tpu.autograd import PyLayer
+
+    class Partial(PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a, b)
+            return a * b
+
+        @staticmethod
+        def backward(ctx, g):
+            a, b = ctx.saved_tensor
+            return g * paddle.to_tensor(b.numpy()), None
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    w = x * 3
+    y = paddle.to_tensor([4.0], stop_gradient=False)
+    out = Partial.apply(w, y * 2)
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [24.0])
+
+
+def test_paddle_grad_does_not_touch_other_leaves():
+    lin = nn.Linear(2, 2)
+    x = paddle.to_tensor(np.ones((1, 2), "float32"), stop_gradient=False)
+    (gx,) = paddle.grad([lin(x).sum()], [x])
+    assert lin.weight.grad is None
+    assert gx is not None
+
+
+def test_scaler_no_double_unscale():
+    layer = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=1.0,
+                               parameters=layer.parameters())
+    sc = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    loss = layer(paddle.to_tensor(np.ones((1, 2), "float32"))).sum()
+    sc.scale(loss).backward()
+    sc.unscale_(opt)
+    g1 = layer.weight.grad.numpy().copy()
+    sc.step(opt)
+    np.testing.assert_allclose(layer.weight.grad.numpy(), g1)
+
+
+def test_scaler_inf_skips_params_and_state():
+    layer = nn.Linear(2, 2)
+    opt = paddle.optimizer.Adam(parameters=layer.parameters())
+    sc = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    before = layer.weight.numpy().copy()
+    x = paddle.to_tensor(np.full((2, 2), np.inf, "float32"))
+    sc.scale(layer(x).mean()).backward()
+    sc.step(opt)
+    sc.update()
+    np.testing.assert_allclose(layer.weight.numpy(), before)
+    assert float(sc._scale._val) == 4.0
+
+
+def test_cummax_cummin_shapes_and_values():
+    v = paddle.to_tensor(np.array([1.0, 3.0, 2.0]))
+    vals, idx = paddle.cummax(v)
+    np.testing.assert_allclose(vals.numpy(), [1, 3, 3])
+    np.testing.assert_array_equal(idx.numpy(), [0, 1, 1])
+    vals, idx = paddle.cummin(v)
+    np.testing.assert_allclose(vals.numpy(), [1, 1, 1])
+
+
+def test_sublayer_nonpersistable_buffer_excluded():
+    class Sub(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("tmp", paddle.to_tensor([1.0]),
+                                 persistable=False)
+            self.register_buffer("keep", paddle.to_tensor([2.0]))
+
+    class Root(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.sub = Sub()
+
+    sd = Root().state_dict()
+    assert "sub.tmp" not in sd and "sub.keep" in sd
+
+
+def test_param_attr_regularizer_applied():
+    from paddle_tpu.regularizer import L2Decay
+    l2 = nn.Linear(2, 2,
+                   weight_attr=paddle.nn.ParamAttr(regularizer=L2Decay(0.5)))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=l2.parameters())
+    x = paddle.to_tensor(np.zeros((1, 2), "float32"))
+    (l2(x).sum() * 0).backward()
+    before = l2.weight.numpy().copy()
+    opt.step()
+    np.testing.assert_allclose(l2.weight.numpy(), before * 0.5, atol=1e-6)
+
+
+def test_dataloader_early_break_no_thread_leak():
+    from paddle_tpu.io import DataLoader, TensorDataset
+    ds = TensorDataset([np.arange(1000, dtype=np.float32)])
+    before = threading.active_count()
+    for _ in range(5):
+        for _b in DataLoader(ds, batch_size=2, num_workers=2):
+            break
+    import time
+    time.sleep(0.5)
+    assert threading.active_count() <= before + 1
+
+
+def test_dataloader_propagates_worker_error():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Bad(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            if i == 5:
+                raise RuntimeError("corrupt sample")
+            return np.zeros(2, "float32")
+
+    with pytest.raises(RuntimeError, match="corrupt"):
+        for _ in DataLoader(Bad(), batch_size=2, num_workers=2):
+            pass
+
+
+def test_to_static_per_instance_programs():
+    class M(nn.Layer):
+        def __init__(self, scale):
+            super().__init__()
+            self.lin = nn.Linear(2, 2)
+            self.lin.weight._value = self.lin.weight._val * 0 + scale
+            self.lin.bias._value = self.lin.bias._val * 0
+
+        @paddle.jit.to_static
+        def forward(self, x):
+            return self.lin(x)
+
+    m1, m2 = M(1.0), M(2.0)
+    x = paddle.to_tensor(np.ones((1, 2), "float32"))
+    with paddle.no_grad():
+        for _ in range(4):
+            o1, o2 = m1(x), m2(x)
+    np.testing.assert_allclose(o1.numpy(), np.full((1, 2), 2.0))
+    np.testing.assert_allclose(o2.numpy(), np.full((1, 2), 4.0))
+
+
+def test_pad_last_dim_first_ordering():
+    x = paddle.to_tensor(np.zeros((1, 1, 2, 2), "float32"))
+    assert F.pad(x, [1, 1, 0, 0]).shape == [1, 1, 2, 4]  # W padded
+    assert F.pad(x, [0, 0, 2, 2]).shape == [1, 1, 6, 2]  # H padded
+
+
+def test_embedding_padding_idx_zeroes_output():
+    w = paddle.to_tensor(np.ones((5, 3), "float32"))
+    e = F.embedding(paddle.to_tensor(np.array([0, 1], "int64")), w,
+                    padding_idx=0)
+    np.testing.assert_allclose(e.numpy()[0], 0.0)
+    np.testing.assert_allclose(e.numpy()[1], 1.0)
+    e2 = F.embedding(paddle.to_tensor(np.array([4], "int64")), w,
+                     padding_idx=-1)
+    np.testing.assert_allclose(e2.numpy()[0], 0.0)
+
+
+def test_split_non_divisible_raises():
+    with pytest.raises(ValueError, match="divisible"):
+        paddle.split(paddle.to_tensor(np.zeros((7, 2), "float32")), 3)
+
+
+def test_align_corners_resize_values():
+    v = paddle.to_tensor(np.arange(3, dtype="float32").reshape(1, 1, 1, 3))
+    out = F.interpolate(v, size=[1, 5], mode="bilinear", align_corners=True)
+    np.testing.assert_allclose(out.numpy().ravel(), [0, 0.5, 1, 1.5, 2],
+                               atol=1e-5)

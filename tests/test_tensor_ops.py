@@ -1,0 +1,270 @@
+"""Op semantics vs numpy oracle (reference test pattern: test_*_op.py files)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+RNG = np.random.RandomState(0)
+
+
+class TestElementwise:
+    def test_add(self):
+        a = RNG.randn(3, 4).astype("float32")
+        b = RNG.randn(3, 4).astype("float32")
+        check_output(lambda x, y: paddle.add(x, y), np.add, [a, b])
+
+    def test_broadcast_add(self):
+        a = RNG.randn(3, 4).astype("float32")
+        b = RNG.randn(4).astype("float32")
+        check_output(lambda x, y: x + y, np.add, [a, b])
+
+    def test_mul_div_sub(self):
+        a = RNG.randn(2, 3).astype("float32")
+        b = RNG.rand(2, 3).astype("float32") + 0.5
+        check_output(lambda x, y: x * y, np.multiply, [a, b])
+        check_output(lambda x, y: x / y, np.divide, [a, b])
+        check_output(lambda x, y: x - y, np.subtract, [a, b])
+
+    def test_unary(self):
+        a = RNG.rand(3, 4).astype("float32") + 0.1
+        check_output(paddle.exp, np.exp, [a])
+        check_output(paddle.log, np.log, [a])
+        check_output(paddle.sqrt, np.sqrt, [a])
+        check_output(paddle.tanh, np.tanh, [a])
+        check_output(paddle.abs, np.abs, [a - 0.5])
+        check_output(paddle.floor, np.floor, [a * 10])
+        check_output(paddle.square, np.square, [a])
+
+    def test_pow_maximum(self):
+        a = RNG.rand(3).astype("float32") + 0.5
+        b = RNG.rand(3).astype("float32") + 0.5
+        check_output(lambda x, y: paddle.pow(x, y), np.power, [a, b])
+        check_output(paddle.maximum, np.maximum, [a, b])
+        check_output(paddle.minimum, np.minimum, [a, b])
+
+    def test_clip(self):
+        a = RNG.randn(4, 4).astype("float32")
+        check_output(lambda x: paddle.clip(x, -0.5, 0.5),
+                     lambda x: np.clip(x, -0.5, 0.5), [a])
+
+
+class TestReduce:
+    def test_sum_mean(self):
+        a = RNG.randn(3, 4, 5).astype("float32")
+        check_output(lambda x: paddle.sum(x), np.sum, [a])
+        check_output(lambda x: paddle.sum(x, axis=1),
+                     lambda x: np.sum(x, axis=1), [a])
+        check_output(lambda x: paddle.mean(x, axis=[0, 2], keepdim=True),
+                     lambda x: np.mean(x, axis=(0, 2), keepdims=True), [a])
+
+    def test_max_min_prod(self):
+        a = RNG.randn(3, 4).astype("float32")
+        check_output(lambda x: paddle.max(x, axis=0),
+                     lambda x: np.max(x, axis=0), [a])
+        check_output(lambda x: paddle.min(x, axis=1),
+                     lambda x: np.min(x, axis=1), [a])
+        check_output(lambda x: paddle.prod(x, axis=1),
+                     lambda x: np.prod(x, axis=1), [a])
+
+    def test_cumsum_logsumexp(self):
+        a = RNG.randn(3, 4).astype("float32")
+        check_output(lambda x: paddle.cumsum(x, axis=1),
+                     lambda x: np.cumsum(x, axis=1), [a])
+        from scipy_free_logsumexp import np_logsumexp
+        check_output(lambda x: paddle.logsumexp(x, axis=1),
+                     lambda x: np_logsumexp(x, 1), [a], atol=1e-4)
+
+
+class TestMatmul:
+    def test_matmul(self):
+        a = RNG.randn(3, 4).astype("float32")
+        b = RNG.randn(4, 5).astype("float32")
+        check_output(paddle.matmul, np.matmul, [a, b], atol=1e-4)
+
+    def test_matmul_transpose(self):
+        a = RNG.randn(4, 3).astype("float32")
+        b = RNG.randn(4, 5).astype("float32")
+        check_output(lambda x, y: paddle.matmul(x, y, transpose_x=True),
+                     lambda x, y: x.T @ y, [a, b], atol=1e-4)
+
+    def test_batched(self):
+        a = RNG.randn(2, 3, 4).astype("float32")
+        b = RNG.randn(2, 4, 5).astype("float32")
+        check_output(paddle.bmm, np.matmul, [a, b], atol=1e-4)
+
+    def test_einsum(self):
+        a = RNG.randn(2, 3, 4).astype("float32")
+        b = RNG.randn(2, 4, 5).astype("float32")
+        check_output(lambda x, y: paddle.einsum("bij,bjk->bik", x, y),
+                     lambda x, y: np.einsum("bij,bjk->bik", x, y), [a, b],
+                     atol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = RNG.randn(2, 3, 4).astype("float32")
+        check_output(lambda x: paddle.reshape(x, [6, 4]),
+                     lambda x: x.reshape(6, 4), [a])
+        check_output(lambda x: paddle.transpose(x, [2, 0, 1]),
+                     lambda x: x.transpose(2, 0, 1), [a])
+
+    def test_concat_stack_split(self):
+        a = RNG.randn(2, 3).astype("float32")
+        b = RNG.randn(2, 3).astype("float32")
+        got = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(got.numpy(), np.concatenate([a, b], 0))
+        got = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+        np.testing.assert_allclose(got.numpy(), np.stack([a, b], 1))
+        parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+        assert len(parts) == 3
+        np.testing.assert_allclose(parts[1].numpy(), a[:, 1:2])
+
+    def test_gather_scatter(self):
+        a = RNG.randn(5, 3).astype("float32")
+        idx = np.array([0, 2, 4])
+        got = paddle.gather(paddle.to_tensor(a), paddle.to_tensor(idx))
+        np.testing.assert_allclose(got.numpy(), a[idx])
+        upd = RNG.randn(2, 3).astype("float32")
+        got = paddle.scatter(paddle.to_tensor(a),
+                             paddle.to_tensor(np.array([1, 3])),
+                             paddle.to_tensor(upd))
+        exp = a.copy()
+        exp[[1, 3]] = upd
+        np.testing.assert_allclose(got.numpy(), exp)
+
+    def test_squeeze_unsqueeze_tile(self):
+        a = RNG.randn(1, 3, 1).astype("float32")
+        check_output(lambda x: paddle.squeeze(x),
+                     lambda x: np.squeeze(x), [a])
+        check_output(lambda x: paddle.unsqueeze(x, 0),
+                     lambda x: x[None], [a])
+        b = RNG.randn(2, 3).astype("float32")
+        check_output(lambda x: paddle.tile(x, [2, 1]),
+                     lambda x: np.tile(x, (2, 1)), [b])
+
+    def test_pad_flip(self):
+        a = RNG.randn(2, 3).astype("float32")
+        check_output(lambda x: paddle.flip(x, [0]),
+                     lambda x: np.flip(x, 0), [a])
+
+    def test_getitem(self):
+        a = RNG.randn(4, 5).astype("float32")
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(t[1:3, ::2].numpy(), a[1:3, ::2])
+        np.testing.assert_allclose(t[-1].numpy(), a[-1])
+
+    def test_where(self):
+        c = RNG.rand(3, 3) > 0.5
+        a = RNG.randn(3, 3).astype("float32")
+        b = RNG.randn(3, 3).astype("float32")
+        got = paddle.where(paddle.to_tensor(c), paddle.to_tensor(a),
+                           paddle.to_tensor(b))
+        np.testing.assert_allclose(got.numpy(), np.where(c, a, b))
+
+    def test_cast(self):
+        a = RNG.randn(3).astype("float32")
+        assert paddle.to_tensor(a).astype("int32").dtype == np.int32
+        assert paddle.to_tensor(a).astype("bfloat16").dtype.name == "bfloat16"
+
+
+class TestSearchSort:
+    def test_argmax_topk_sort(self):
+        a = RNG.randn(3, 5).astype("float32")
+        t = paddle.to_tensor(a)
+        np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(),
+                                      np.argmax(a, 1))
+        vals, idx = paddle.topk(t, 2, axis=1)
+        exp_idx = np.argsort(-a, axis=1)[:, :2]
+        np.testing.assert_allclose(vals.numpy(),
+                                   np.take_along_axis(a, exp_idx, 1))
+        s = paddle.sort(t, axis=1, descending=True)
+        np.testing.assert_allclose(s.numpy(), -np.sort(-a, axis=1))
+
+    def test_unique_nonzero(self):
+        a = np.array([3, 1, 2, 1, 3], dtype=np.int64)
+        u = paddle.unique(paddle.to_tensor(a))
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+        nz = paddle.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+        np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+class TestGrad:
+    def test_elementwise_grads(self):
+        a = RNG.rand(3, 3).astype("float32") + 0.2
+        b = RNG.rand(3, 3).astype("float32") + 0.2
+        check_grad(lambda x, y: x * y + x, [a, b])
+        check_grad(lambda x: paddle.exp(x), [a])
+        check_grad(lambda x: paddle.tanh(x), [a])
+
+    def test_matmul_grad(self):
+        a = RNG.randn(3, 4).astype("float32")
+        b = RNG.randn(4, 2).astype("float32")
+        check_grad(paddle.matmul, [a, b])
+
+    def test_broadcast_grad(self):
+        a = RNG.randn(3, 4).astype("float32")
+        b = RNG.randn(4).astype("float32")
+        check_grad(lambda x, y: x * y, [a, b])
+
+    def test_reduce_grad(self):
+        a = RNG.randn(3, 4).astype("float32")
+        check_grad(lambda x: paddle.mean(x, axis=1), [a])
+
+    def test_getitem_grad(self):
+        a = RNG.randn(4, 4).astype("float32")
+        check_grad(lambda x: x[1:3].sum(), [a], loss_reduce=False)
+
+
+class TestAutogradEngine:
+    def test_backward_accumulate(self, paddle):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        z = y + x  # two paths into x
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+    def test_retain_graph(self, paddle):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_released_graph_raises(self, paddle):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_no_grad(self, paddle):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+    def test_paddle_grad(self, paddle):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x
+        (g,) = paddle.grad([y], [x])
+        np.testing.assert_allclose(g.numpy(), [6.0])
+        assert x.grad is None  # functional: doesn't touch .grad
+
+    def test_stop_gradient_propagation(self, paddle):
+        x = paddle.to_tensor([1.0], stop_gradient=True)
+        y = x * 2
+        assert y.stop_gradient
+
+    def test_detach(self, paddle):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+
+    def test_double_grad_functional(self, paddle):
+        # second-order via functional hessian
+        from paddle_tpu.autograd import hessian
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        h = hessian(lambda t: (t * t * t).sum(), x)
+        np.testing.assert_allclose(np.diag(h.numpy()), [6.0, 12.0], atol=1e-4)
