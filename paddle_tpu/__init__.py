@@ -11,12 +11,26 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# Host-staging for remote TPU (axon relay): eager ops execute on the host CPU
+# (local, fast); only compiled whole-program executables run on the TPU (the
+# relay's per-op dispatch+compile latency makes eager-on-device pathological).
+# Requires the cpu platform to be registered alongside axon BEFORE jax's
+# backend init.
+if _os.environ.get("JAX_PLATFORMS") == "axon":
+    _os.environ["JAX_PLATFORMS"] = "axon,cpu"
+    _os.environ.setdefault("PADDLE_TPU_HOST_STAGING", "1")
+
 from .core import autograd as _autograd_mod  # noqa: F401
 from .core.autograd import enable_grad, no_grad, set_grad_enabled  # noqa: F401
 from .core.device import (  # noqa: F401
     CPUPlace, CUDAPlace, Place, TPUPlace, get_device, set_device,
     is_compiled_with_cuda, is_compiled_with_tpu,
 )
+from .core.device import setup_host_staging as _setup_host_staging  # noqa: E402
+
+_setup_host_staging()
 from .core.dtypes import (  # noqa: F401
     bfloat16, complex64, complex128, float16, float32, float64,
     get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
@@ -40,6 +54,12 @@ from . import static  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import device  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import fft  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
 from .framework import io_utils as _io_utils  # noqa: F401,E402
 from .framework.io_utils import load, save  # noqa: F401,E402
 
@@ -91,6 +111,12 @@ def get_flags(flags=None):
 def set_flags(flags):
     from .framework.flags import set_flags as _sf
     return _sf(flags)
+
+
+def Model(network, inputs=None, labels=None):
+    """paddle.Model parity (hapi/model.py:906)."""
+    from .hapi.model import Model as _Model
+    return _Model(network, inputs, labels)
 
 
 def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002

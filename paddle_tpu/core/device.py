@@ -141,5 +141,31 @@ def is_compiled_with_tpu() -> bool:
     return device_count("tpu") > 0
 
 
+def host_staging_enabled() -> bool:
+    """True when eager ops run on host CPU and only compiled programs run on
+    the (remote) TPU. Default on under the axon relay."""
+    import os
+    return os.environ.get("PADDLE_TPU_HOST_STAGING", "0") == "1"
+
+
+def accelerator_device():
+    """First TPU/axon device, or None (pure-CPU environment)."""
+    devs = [d for d in jax.devices() if d.platform in _accel_platforms()]
+    return devs[0] if devs else None
+
+
+def setup_host_staging():
+    """Point jax's default device at the host CPU so eager dispatch stays
+    local; jit/to_static device_puts compiled-program inputs to the TPU."""
+    if not host_staging_enabled():
+        return
+    try:
+        cpu = jax.devices("cpu")
+        if cpu:
+            jax.config.update("jax_default_device", cpu[0])
+    except RuntimeError:
+        pass
+
+
 def is_compiled_with_cuda() -> bool:  # reference-API shim; the accelerator is TPU
     return is_compiled_with_tpu()

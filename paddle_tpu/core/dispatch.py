@@ -28,6 +28,31 @@ def _is_diff_value(v):
     return hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact)
 
 
+_DEBUG = {"check_nan_inf": False, "record_ops": False}
+
+
+def set_debug(check_nan_inf=None, record_ops=None):
+    """Wire FLAGS_check_nan_inf (nan_inf_utils_detail.cc parity: scan outputs
+    after every op) and per-op RecordEvent spans (tracer.cc:150 parity)."""
+    if check_nan_inf is not None:
+        _DEBUG["check_nan_inf"] = bool(check_nan_inf)
+    if record_ops is not None:
+        _DEBUG["record_ops"] = bool(record_ops)
+
+
+def _check_finite(out, name):
+    import jax.core as jax_core
+    vals = out if isinstance(out, (tuple, list)) else (out,)
+    for v in vals:
+        if isinstance(v, jax_core.Tracer):
+            continue
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact):
+            if not bool(jnp.all(jnp.isfinite(v))):
+                raise FloatingPointError(
+                    f"Operator '{name}' output contains NaN/Inf "
+                    f"(FLAGS_check_nan_inf is enabled)")
+
+
 def apply(prim, *args, name=None, **kwargs):
     """Run `prim(*raw_args, **kwargs)` with autograd recording.
 
@@ -37,6 +62,14 @@ def apply(prim, *args, name=None, **kwargs):
     - differentiable inputs = Tensor args with inexact dtype and
       stop_gradient=False (while grad mode enabled).
     """
+    if _DEBUG["record_ops"]:
+        from ..profiler import RecordEvent
+        with RecordEvent(name or getattr(prim, "__name__", "op")):
+            return _apply_impl(prim, args, kwargs, name)
+    return _apply_impl(prim, args, kwargs, name)
+
+
+def _apply_impl(prim, args, kwargs, name):
     raw = [unwrap(a) for a in args]
     record = autograd.is_grad_enabled()
     diff_idx = []
@@ -51,6 +84,8 @@ def apply(prim, *args, name=None, **kwargs):
 
     if not diff_idx:
         out = prim(*raw, **kwargs)
+        if _DEBUG["check_nan_inf"]:
+            _check_finite(out, name or getattr(prim, "__name__", "op"))
         return _wrap_outputs(out, stop_gradient=True)
 
     def closed(*diff_vals):
@@ -62,6 +97,8 @@ def apply(prim, *args, name=None, **kwargs):
         return tuple(r) if isinstance(r, list) else r
 
     out, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+    if _DEBUG["check_nan_inf"]:
+        _check_finite(out, name or getattr(prim, "__name__", "op"))
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
     out_meta = [(o.shape, o.dtype) for o in outs]

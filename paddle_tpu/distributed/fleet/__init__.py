@@ -23,4 +23,7 @@ distributed_optimizer = _fleet.distributed_optimizer
 get_hybrid_communicate_group = _fleet.get_hybrid_communicate_group
 
 from . import meta_parallel  # noqa: F401,E402
+from . import sequence_parallel  # noqa: F401,E402
+from . import sharding_optimizer  # noqa: F401,E402
+from . import spmd_pipeline  # noqa: F401,E402
 from .utils import recompute  # noqa: F401,E402

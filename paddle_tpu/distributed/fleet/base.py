@@ -212,6 +212,10 @@ class Fleet:
     def distributed_optimizer(self, optimizer, strategy=None):
         if strategy is not None:
             self._strategy = strategy
+        if (self._hcg is not None
+                and self._hcg.get_sharding_parallel_world_size() > 1):
+            from .sharding_optimizer import ShardingOptimizerWrapper
+            optimizer = ShardingOptimizerWrapper(optimizer)
         from .meta_parallel import HybridParallelOptimizer
         if self._hcg is not None and self._hcg.get_parallel_mode() != "data":
             return HybridParallelOptimizer(optimizer, self._hcg,

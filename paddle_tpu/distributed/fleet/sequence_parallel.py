@@ -1,0 +1,111 @@
+"""Sequence/context parallelism — ring attention over ICI.
+
+The reference has NO long-context parallelism (SURVEY.md §5: zero hits for
+ring/sequence/context parallel) — this is the TPU-native stretch capability:
+sequence sharded over the mesh 'sep' axis; each step computes blockwise
+attention against the currently-held K/V shard with online-softmax merging,
+then rotates K/V around the ring with collective-permute (compute overlaps the
+permute under XLA's scheduler). Backward = jax autodiff through ppermute.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...core.dispatch import apply, unwrap
+from ..mesh import axis_degree, get_mesh
+
+__all__ = ["ring_attention", "split_sequence", "gather_sequence"]
+
+
+def _blockwise_update(q, k_blk, v_blk, m, l, acc, scale, causal, q_start,
+                      k_start, s_local):
+    # q: (B, Sq, H, D); k_blk/v_blk: (B, Sk, H, D) — compute in (B,H,S,D)
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kt = jnp.swapaxes(k_blk, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v_blk, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(q_pos >= k_pos, s, -1e30)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    q_start = idx * s_local
+
+    m0 = jnp.full((b, h, s_local), -1e30, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, h, s_local, d), dtype=jnp.float32)
+    # mark the (replicated-initialized) carry as device-varying so the scan
+    # carry type stays consistent across iterations under shard_map
+    m0, l0, acc0 = jax.lax.pvary((m0, l0, acc0), axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        # the shard we hold at step i originated at rank (idx - i) mod n
+        k_start = ((idx - i) % n) * s_local
+        m, l, acc = _blockwise_update(q, k_cur, v_cur, m, l, acc, scale,
+                                      causal, q_start, k_start, s_local)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, acc), None
+
+    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ring_attention(query, key, value, is_causal=True, axis="sep", scale=None):
+    """(B, S_local, H, D) shards in, same out. Falls back to plain SDPA when
+    the mesh has no (>1) `axis` dimension."""
+    mesh = get_mesh()
+    degree = axis_degree(axis)
+    if degree <= 1:
+        from ...ops.attention import scaled_dot_product_attention
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=is_causal)
+    d = query.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    spec = P(None, axis, None, None)
+    inner = functools.partial(_ring_attention_local, axis_name=axis,
+                              causal=is_causal, scale=scale)
+    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return apply(fn, query, key, value, name="ring_attention")
+
+
+def split_sequence(x, axis="sep", seq_dim=1):
+    """Shard a full-sequence tensor over the ring (device_put with a
+    sequence-sharded NamedSharding)."""
+    import jax as _jax
+    from jax.sharding import NamedSharding
+    mesh = get_mesh()
+    spec = [None] * unwrap(x).ndim
+    spec[seq_dim] = axis
+    from ...core.tensor import Tensor
+    return Tensor(_jax.device_put(unwrap(x), NamedSharding(mesh, P(*spec))),
+                  stop_gradient=x.stop_gradient)
+
+
+def gather_sequence(x, axis="sep", seq_dim=1):
+    from jax.sharding import NamedSharding
+    mesh = get_mesh()
+    from ...core.tensor import Tensor
+    return Tensor(jax.device_put(unwrap(x), NamedSharding(mesh, P())),
+                  stop_gradient=x.stop_gradient)

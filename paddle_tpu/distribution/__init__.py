@@ -1,0 +1,213 @@
+"""paddle.distribution parity (python/paddle/distribution.py, 967 LoC:
+Distribution/Normal/Uniform/Categorical; + the v2.3 additions Beta/Dirichlet/
+Exponential-family helpers kept minimal)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, unwrap
+from ..core.random import next_key
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Multinomial", "kl_divergence"]
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(np.asarray(x, dtype=np.float32))
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        from ..tensor.math import exp
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc,
+                                       jnp.broadcast_shapes(self.loc.shape,
+                                                            self.scale.shape)))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2,
+                                       jnp.broadcast_shapes(self.loc.shape,
+                                                            self.scale.shape)))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        base = jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
+        z = jax.random.normal(next_key(), shape + base, dtype=jnp.float32)
+        return Tensor(self.loc + self.scale * z)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def prim(v):
+            var = self.scale ** 2
+            return (-((v - self.loc) ** 2) / (2 * var)
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        return apply(prim, value, name="normal_log_prob")
+
+    def entropy(self):
+        base = jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale), base))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        base = jnp.broadcast_shapes(jnp.shape(self.low), jnp.shape(self.high))
+        u = jax.random.uniform(next_key(), shape + base, dtype=jnp.float32)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def prim(v):
+            inside = (v >= self.low) & (v < self.high)
+            lp = -jnp.log(self.high - self.low)
+            return jnp.where(inside, lp, -jnp.inf)
+        return apply(prim, value, name="uniform_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        # paddle semantics: the input is UNNORMALIZED PROBABILITIES
+        # (distribution.py Categorical docstring)
+        v = _t(logits)
+        self.logits = v
+        self._log_p = jnp.log(jnp.maximum(v / jnp.sum(v, axis=-1,
+                                                      keepdims=True), 1e-30))
+
+    def sample(self, shape=()):
+        shape = tuple(shape)
+        out = jax.random.categorical(next_key(), self._log_p,
+                                     shape=shape + self._log_p.shape[:-1])
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        idx = unwrap(value).astype(jnp.int32)
+        if self._log_p.ndim == 1:
+            return Tensor(jnp.take(self._log_p, idx))
+        return Tensor(jnp.take_along_axis(
+            self._log_p, idx[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        idx = unwrap(value).astype(jnp.int32)
+        p = jnp.exp(self._log_p)
+        if p.ndim == 1:
+            return Tensor(jnp.take(p, idx))
+        return Tensor(jnp.take_along_axis(p, idx[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        p = jnp.exp(self._log_p)
+        return Tensor(-jnp.sum(p * self._log_p, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.p = _t(probs)
+
+    def sample(self, shape=()):
+        shape = tuple(shape)
+        u = jax.random.uniform(next_key(), shape + jnp.shape(self.p))
+        return Tensor((u < self.p).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def prim(v):
+            return v * jnp.log(jnp.maximum(self.p, 1e-30)) + \
+                (1 - v) * jnp.log(jnp.maximum(1 - self.p, 1e-30))
+        return apply(prim, value, name="bernoulli_log_prob")
+
+    def entropy(self):
+        p = self.p
+        return Tensor(-(p * jnp.log(jnp.maximum(p, 1e-30))
+                        + (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-30))))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+
+    def sample(self, shape=()):
+        shape = tuple(shape)
+        out = jax.random.beta(next_key(), self.alpha, self.beta,
+                              shape=shape + jnp.broadcast_shapes(
+                                  jnp.shape(self.alpha),
+                                  jnp.shape(self.beta)))
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def prim(v):
+            a, b = self.alpha, self.beta
+            lbeta = (jax.lax.lgamma(a) + jax.lax.lgamma(b)
+                     - jax.lax.lgamma(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+        return apply(prim, value, name="beta_log_prob")
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.n = int(total_count)
+        self.p = _t(probs)
+
+    def sample(self, shape=()):
+        logp = jnp.log(jnp.maximum(
+            self.p / jnp.sum(self.p, -1, keepdims=True), 1e-30))
+        draws = jax.random.categorical(
+            next_key(), logp, shape=tuple(shape) + (self.n,)
+            + self.p.shape[:-1])
+        k = self.p.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return Tensor(jnp.sum(onehot, axis=len(tuple(shape))))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        pp = jnp.exp(p._log_p)
+        return Tensor(jnp.sum(pp * (p._log_p - q._log_p), axis=-1))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,91 @@
+"""paddle.fft parity (python/paddle/fft.py, 1,624 LoC; backed by
+operators/spectral_op — pocketfft/cuFFT). TPU-native: jnp.fft (XLA FFT HLO)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply, unwrap
+from .core.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+    "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "hfft2", "ihfft2",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    return norm if norm in ("forward", "ortho") else "backward"
+
+
+def _def1(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda v: fn(v, n=n, axis=axis, norm=_norm(norm)), x,
+                     name=op.__name__)
+    op.__name__ = name
+    return op
+
+
+fft = _def1("fft", jnp.fft.fft)
+ifft = _def1("ifft", jnp.fft.ifft)
+rfft = _def1("rfft", jnp.fft.rfft)
+irfft = _def1("irfft", jnp.fft.irfft)
+hfft = _def1("hfft", jnp.fft.hfft)
+ihfft = _def1("ihfft", jnp.fft.ihfft)
+
+
+def _def2(name, fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply(lambda v: fn(v, s=s, axes=tuple(axes), norm=_norm(norm)),
+                     x, name=op.__name__)
+    op.__name__ = name
+    return op
+
+
+fft2 = _def2("fft2", jnp.fft.fft2)
+ifft2 = _def2("ifft2", jnp.fft.ifft2)
+rfft2 = _def2("rfft2", jnp.fft.rfft2)
+irfft2 = _def2("irfft2", jnp.fft.irfft2)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda v: jnp.fft.hfft(jnp.fft.ifft(
+        v, axis=axes[0], norm=_norm(norm)), n=None if s is None else s[-1],
+        axis=axes[1], norm=_norm(norm)), x, name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda v: jnp.fft.ihfft(
+        jnp.fft.fft(v, axis=axes[0], norm=_norm(norm)), axis=axes[1],
+        norm=_norm(norm)), x, name="ihfft2")
+
+
+def _defn(name, fn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply(lambda v: fn(v, s=s, axes=axes, norm=_norm(norm)), x,
+                     name=op.__name__)
+    op.__name__ = name
+    return op
+
+
+fftn = _defn("fftn", jnp.fft.fftn)
+ifftn = _defn("ifftn", jnp.fft.ifftn)
+rfftn = _defn("rfftn", jnp.fft.rfftn)
+irfftn = _defn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.fftshift(v, axes=axes), x, name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), x,
+                 name="ifftshift")

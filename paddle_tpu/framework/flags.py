@@ -54,6 +54,9 @@ def set_flags(flags: dict):
             _FLAGS[k] = _coerce(_FLAGS[k], v)
         else:
             _FLAGS[k] = v
+    if "FLAGS_check_nan_inf" in flags:
+        from ..core.dispatch import set_debug
+        set_debug(check_nan_inf=_FLAGS["FLAGS_check_nan_inf"])
 
 
 def get_flags(flags=None):

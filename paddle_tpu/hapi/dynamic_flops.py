@@ -1,0 +1,89 @@
+"""paddle.flops (hapi/dynamic_flops.py parity) — per-layer FLOP counting via
+forward hooks."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtypes import get_default_dtype
+from ..core.tensor import Tensor
+from ..nn.layer import common as C
+from ..nn.layer import conv as CONV
+from ..nn.layer import norm as NORM
+from ..nn.layer import pooling as POOL
+
+__all__ = ["flops"]
+
+
+def _conv_flops(layer, ins, outs):
+    out = outs if isinstance(outs, Tensor) else outs[0]
+    out_elems = int(np.prod(out.shape))
+    kernel = int(np.prod(layer._kernel_size))
+    cin = layer._in_channels // layer._groups
+    f = out_elems * (kernel * cin * 2)
+    if layer.bias is not None:
+        f += out_elems
+    return f
+
+
+def _linear_flops(layer, ins, outs):
+    out = outs if isinstance(outs, Tensor) else outs[0]
+    return int(np.prod(out.shape)) * layer._in_features * 2
+
+
+def _norm_flops(layer, ins, outs):
+    x = ins[0]
+    return int(np.prod(x.shape)) * 2
+
+
+def _pool_flops(layer, ins, outs):
+    out = outs if isinstance(outs, Tensor) else outs[0]
+    return int(np.prod(out.shape))
+
+
+_RULES = [
+    (CONV._ConvNd, _conv_flops),
+    (C.Linear, _linear_flops),
+    (NORM._BatchNormBase, _norm_flops),
+    (NORM.LayerNorm, _norm_flops),
+    (POOL._PoolNd, _pool_flops),
+]
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    total = [0]
+    hooks = []
+    custom_ops = custom_ops or {}
+
+    def make_hook(layer):
+        def hook(l, ins, outs):
+            fn = custom_ops.get(type(l))
+            if fn is None:
+                for klass, f in _RULES:
+                    if isinstance(l, klass):
+                        fn = f
+                        break
+            if fn is not None:
+                n = fn(l, ins, outs)
+                total[0] += n
+                if print_detail:
+                    print(f"{type(l).__name__}: {n:,} FLOPs")
+        return hook
+
+    for _, layer in net.named_sublayers():
+        if not layer._sub_layers:
+            hooks.append(layer.register_forward_post_hook(make_hook(layer)))
+
+    import jax.numpy as jnp
+    x = Tensor(jnp.zeros(tuple(input_size), dtype=get_default_dtype()))
+    was_training = net.training
+    net.eval()
+    try:
+        from ..core import autograd
+        with autograd.no_grad():
+            net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    return total[0]

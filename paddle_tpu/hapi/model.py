@@ -1,0 +1,224 @@
+"""Keras-like Model (python/paddle/hapi/model.py:906 parity).
+
+The reference keeps two adapters (StaticGraphAdapter:247 / DynamicGraphAdapter
+:666); here there is ONE path — eager semantics with the train step
+`to_static`-compiled, which IS the static-graph performance mode on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import optimizer as opt_mod
+from ..core import autograd
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from ..jit.to_static import StaticFunction
+from ..metric import Metric
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._compiled_train_step = None
+        self._compiled_eval_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric)
+        return self
+
+    # -- single-batch entry points (hapi parity) -------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        if self._compiled_train_step is None:
+            def _step(ins, labs):
+                outs = self.network(*ins)
+                losses = _to_list(self._loss(*(_to_list(outs) + labs)))
+                total = losses[0]
+                for l in losses[1:]:
+                    total = total + l
+                total.backward()
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+                return total
+            self._compiled_train_step = StaticFunction(_step)
+        ins = [i if isinstance(i, Tensor) else Tensor(np.asarray(i))
+               for i in _to_list(inputs)]
+        labs = [l if isinstance(l, Tensor) else Tensor(np.asarray(l))
+                for l in _to_list(labels)]
+        loss = self._compiled_train_step(ins, labs)
+        return [float(loss.item())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = [i if isinstance(i, Tensor) else Tensor(np.asarray(i))
+               for i in _to_list(inputs)]
+        labs = [l if isinstance(l, Tensor) else Tensor(np.asarray(l))
+                for l in _to_list(labels)]
+        with autograd.no_grad():
+            outs = _to_list(self.network(*ins))
+            loss_vals = []
+            if self._loss is not None:
+                losses = _to_list(self._loss(*(outs + labs)))
+                loss_vals = [float(l.item()) for l in losses]
+            metric_results = []
+            for m in self._metrics:
+                res = m.compute(*(outs + labs))
+                m.update(*_to_list(res))
+                metric_results.append(m.accumulate())
+        return loss_vals, metric_results
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = [i if isinstance(i, Tensor) else Tensor(np.asarray(i))
+               for i in _to_list(inputs)]
+        with autograd.no_grad():
+            outs = self.network(*ins)
+        return [o.numpy() for o in _to_list(outs)]
+
+    # -- loops ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from .callbacks import CallbackList, ProgBarLogger
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        cbs = CallbackList(_to_list(callbacks) or [ProgBarLogger(log_freq,
+                                                                 verbose)])
+        cbs.set_model(self)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbs.on_train_begin({"epochs": epochs, "steps": steps,
+                            "metrics": self._metric_names()})
+        self.stop_training = False
+        it = 0
+        for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                loss = self.train_batch(ins, labs)
+                logs = {"loss": loss, "step": step}
+                cbs.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            cbs.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=0, num_workers=num_workers)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if self.stop_training or (num_iters is not None and it >= num_iters):
+                break
+        cbs.on_train_end(logs)
+        if save_dir is not None:
+            self.save(f"{save_dir}/final")
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            ins, labs = self._split_batch(batch)
+            loss_vals, _ = self.eval_batch(ins, labs)
+            if loss_vals:
+                losses.append(loss_vals[0])
+        result = {}
+        if losses:
+            result["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            for n, v in zip(names, vals):
+                result[n] = v
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        import inspect
+        try:
+            sig = inspect.signature(type(self.network).forward)
+            max_ins = sum(1 for p in sig.parameters.values()
+                          if p.kind in (p.POSITIONAL_ONLY,
+                                        p.POSITIONAL_OR_KEYWORD)
+                          and p.name != "self")
+        except (TypeError, ValueError):
+            max_ins = None
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_labels=False)
+            if max_ins is not None:
+                ins = ins[:max_ins]
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence ------------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io_utils import save as _save
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+        from ..framework.io_utils import load as _load
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size, dtypes=dtype)
+
+    # -- helpers ----------------------------------------------------------------
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names += n if isinstance(n, list) else [n]
+        return names
+
+    @staticmethod
+    def _split_batch(batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            if has_labels and len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return list(batch), []
+        return [batch], []

@@ -1,0 +1,68 @@
+"""paddle.summary (hapi/model_summary.py parity)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtypes import get_default_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    entries = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(l, ins, outs):
+            params = sum(int(np.prod(p._val.shape))
+                         for p in l._parameters.values() if p is not None)
+            out0 = outs[0] if isinstance(outs, (list, tuple)) else outs
+            shape = list(out0.shape) if isinstance(out0, Tensor) else None
+            entries.append((name, type(l).__name__, shape, params))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        if not layer._sub_layers:  # leaves only
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+
+    if input is not None:
+        x = input if isinstance(input, (list, tuple)) else [input]
+    else:
+        if isinstance(input_size, tuple) and input_size and \
+                isinstance(input_size[0], (tuple, list)):
+            sizes = input_size
+        else:
+            sizes = [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes] * len(sizes)
+        import jax.numpy as jnp
+        x = [Tensor(jnp.zeros(tuple(s),
+                              dtype=dt or get_default_dtype()))
+             for s, dt in zip(sizes, dts)]
+    was_training = net.training
+    net.eval()
+    try:
+        from ..core import autograd
+        with autograd.no_grad():
+            net(*x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p._val.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p._val.shape)) for p in net.parameters()
+                    if p.trainable)
+    header = f"{'Layer':<40}{'Type':<22}{'Output Shape':<22}{'Params':>12}"
+    lines = [header, "-" * len(header)]
+    for name, tname, shape, params in entries:
+        lines.append(f"{name:<40}{tname:<22}{str(shape):<22}{params:>12,}")
+    lines.append("-" * len(header))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total - trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

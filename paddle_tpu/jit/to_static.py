@@ -275,6 +275,21 @@ class StaticFunction:
         arg_vals = tuple(t._val for t in arg_tensors)
         n_outs = prog.n_outs
 
+        # host-staging: compiled programs execute on the accelerator; move
+        # host-resident inputs there (no-op once state lives on-device).
+        from ..core.device import accelerator_device, host_staging_enabled
+        if host_staging_enabled():
+            accel = accelerator_device()
+            if accel is not None:
+                def put(vals):
+                    return tuple(
+                        v if getattr(v, "sharding", None) is not None
+                        and accel in v.sharding.device_set
+                        else jax.device_put(v, accel) for v in vals)
+                mut_vals = put(mut_vals)
+                ro_vals = put(ro_vals)
+                arg_vals = put(arg_vals)
+
         # does gradient need to flow through this program?
         diff_tensors = []
         if autograd.is_grad_enabled():

@@ -52,7 +52,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         dropout_p = 0.0
 
     if use_pallas is None:
-        use_pallas = _pallas_available() and attn_mask is None and dropout_p == 0.0
+        from ..core.autograd import is_grad_enabled
+        no_grad_needed = not is_grad_enabled() or (
+            query.stop_gradient and key.stop_gradient and value.stop_gradient)
+        use_pallas = (_pallas_available() and attn_mask is None
+                      and dropout_p == 0.0 and no_grad_needed
+                      and _pallas_supports(query, key))
     if use_pallas:
         from .pallas.flash_attention import flash_attention
         def prim(q, k, v):
@@ -66,6 +71,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if attn_mask is not None:
         return apply(prim, query, key, value, attn_mask, name="sdpa")
     return apply(prim, query, key, value, name="sdpa")
+
+
+def _pallas_supports(query, key):
+    try:
+        from .pallas.flash_attention import supports
+        return supports(tuple(query.shape), tuple(key.shape))
+    except Exception:
+        return False
 
 
 @functools.lru_cache(maxsize=1)

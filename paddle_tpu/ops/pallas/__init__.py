@@ -1,0 +1,2 @@
+"""Pallas TPU kernels (the fused-kernel tier — reference analog:
+paddle/fluid/operators/fused/)."""

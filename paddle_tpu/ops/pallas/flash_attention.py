@@ -1,0 +1,104 @@
+"""Flash attention (Pallas/TPU).
+
+Reference analog: operators/fused/fused_attention_op.cu + fmha_ref.h (cuDNN
+FMHA). TPU-native: online-softmax tiled attention in VMEM — O(S) memory
+instead of the O(S^2) probability matrix; the MXU does the q@k^T and p@v
+matmuls per tile. Causal masking skips fully-masked k-tiles via the grid.
+
+Layout: inputs (B, S, H, D) paddle convention; kernel works on (B*H, S, D).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
+                 seq_k):
+    # q_ref: (block_q, d); k_ref/v_ref: (seq_k, d); o_ref: (block_q, d)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q_idx = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    m0 = jnp.full((block_q,), -1e30, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
+    acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+
+    num_k_blocks = seq_k // block_k
+
+    def body(kb, carry):
+        m_prev, l_prev, acc = carry
+        k_tile = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_tile.T  # (block_q, block_k) on the MXU
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=1)
+        acc = acc * correction[:, None] + p @ v_tile
+        return m_new, l_new, acc
+
+    if causal:
+        # skip k-blocks strictly above the diagonal for this q-block
+        last_kb = jnp.minimum(
+            ((q_idx + 1) * block_q + block_k - 1) // block_k, num_k_blocks)
+        m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m0, l0, acc0))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k"))
+def _flash_bh(q, k, v, causal, scale, block_q, block_k):
+    # q,k,v: (BH, S, D)
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    grid = (bh, seq_q // block_q)
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_k=seq_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+    )(q, k, v)
+    return out
+
+
+def supports(q_shape, k_shape):
+    b, s_q, h, d = q_shape
+    s_k = k_shape[1]
+    return (s_q % DEFAULT_BLOCK_Q == 0 and s_k % DEFAULT_BLOCK_K == 0
+            and d % 128 == 0 and s_q == s_k)
+
+
+def flash_attention(q, k, v, causal=False, scale=1.0,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """q,k,v: (B, S, H, D) -> (B, S, H, D). Forward only (jax.custom_vjp with
+    the standard recompute backward is wired in attention.py when selected)."""
+    b, s, h, d = q.shape
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, k.shape[1], d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, v.shape[1], d)
+    out = _flash_bh(qt, kt, vt, causal, scale, block_q, block_k)
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

@@ -1,0 +1,222 @@
+"""Profiler (reference: platform/profiler.h RecordEvent/EnableProfiler +
+python/paddle/utils/profiler, paddle.profiler v2 API).
+
+TPU-native: host spans recorded by a lightweight in-process recorder (chrome
+trace JSON export, ≈ profiler.proto timeline); device timeline comes from
+jax.profiler (XPlane/TensorBoard trace) — start_trace/stop_trace wrap it.
+RecordEvent also emits jax.profiler.TraceAnnotation so host spans align with
+device activity in the XPlane view.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+import jax
+
+__all__ = [
+    "Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
+    "start_profiler", "stop_profiler", "reset_profiler", "profiler",
+    "export_chrome_tracing", "summary",
+]
+
+
+class _HostEventRecorder:
+    def __init__(self):
+        self._events = []
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def record(self, name, start_us, dur_us, tid):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append((name, start_us, dur_us, tid))
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def chrome_trace(self):
+        evs = [{
+            "name": name, "ph": "X", "ts": start, "dur": dur,
+            "pid": os.getpid(), "tid": tid, "cat": "host",
+        } for name, start, dur, tid in self._events]
+        return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+    def aggregate(self):
+        agg = {}
+        for name, _start, dur, _tid in self._events:
+            tot, cnt, mx = agg.get(name, (0.0, 0, 0.0))
+            agg[name] = (tot + dur, cnt + 1, max(mx, dur))
+        return agg
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """platform/profiler.h:216 RecordEvent parity (RAII span). Usable as a
+    context manager or decorator; nests into the jax XPlane via
+    TraceAnnotation."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._start = None
+        self._jax_ann = None
+
+    def begin(self):
+        self._start = time.perf_counter_ns()
+        if _recorder.enabled:
+            self._jax_ann = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ann.__enter__()
+
+    def end(self):
+        if self._start is None:
+            return
+        dur_us = (time.perf_counter_ns() - self._start) / 1000.0
+        _recorder.record(self.name, self._start / 1000.0, dur_us,
+                         threading.get_ident())
+        if self._jax_ann is not None:
+            self._jax_ann.__exit__(None, None, None)
+            self._jax_ann = None
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+        return wrapped
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class Profiler:
+    """paddle.profiler.Profiler (v2 API) parity."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._tmpdir = None
+        self._device_trace = not timer_only
+
+    def start(self):
+        _recorder.enabled = True
+        _recorder.clear()
+        if self._device_trace:
+            import tempfile
+            self._tmpdir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+            try:
+                jax.profiler.start_trace(self._tmpdir)
+            except Exception:
+                self._tmpdir = None
+
+    def stop(self):
+        _recorder.enabled = False
+        if self._tmpdir is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def export(self, path, format="json"):  # noqa: A002
+        export_chrome_tracing(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return summary()
+
+    @property
+    def xplane_dir(self):
+        """Directory with the jax/XLA device trace (TensorBoard-loadable)."""
+        return self._tmpdir
+
+
+def export_chrome_tracing(path, dir_name=None):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(_recorder.chrome_trace(), f)
+    return path
+
+
+def summary(sorted_by="total"):
+    agg = _recorder.aggregate()
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
+    header = f"{'Event':<48}{'Calls':>8}{'Total(us)':>14}{'Avg(us)':>12}{'Max(us)':>12}"
+    lines = [header, "-" * len(header)]
+    for name, (tot, cnt, mx) in rows:
+        lines.append(f"{name:<48}{cnt:>8}{tot:>14.1f}{tot / cnt:>12.1f}{mx:>12.1f}")
+    out = "\n".join(lines)
+    print(out)
+    return agg
+
+
+# -- classic API (fluid/profiler.py parity) -----------------------------------
+_classic = {"profiler": None}
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    _recorder.enabled = True
+    _recorder.clear()
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    _recorder.enabled = False
+    summary()
+
+
+def reset_profiler():
+    _recorder.clear()
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default"):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,107 @@
+"""paddle.signal parity (python/paddle/signal.py: stft/istft, 574 LoC)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import apply, unwrap
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def prim(v):
+        n = v.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        starts = np.arange(num) * hop_length
+        idx = starts[:, None] + np.arange(frame_length)[None, :]
+        out = jnp.take(v, jnp.asarray(idx), axis=axis)
+        # paddle layout: frames on axis, frame_length last when axis=-1:
+        # result shape (..., frame_length, num_frames)
+        if axis in (-1, v.ndim - 1):
+            return jnp.swapaxes(out, -1, -2)
+        return out
+    return apply(prim, x, name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def prim(v):
+        # v: (..., frame_length, num_frames) when axis=-1
+        fl = v.shape[-2]
+        num = v.shape[-1]
+        out_len = (num - 1) * hop_length + fl
+        out = jnp.zeros(v.shape[:-2] + (out_len,), dtype=v.dtype)
+        for i in range(num):
+            sl = (Ellipsis, slice(i * hop_length, i * hop_length + fl))
+            out = out.at[sl].add(v[..., :, i])
+        return out
+    return apply(prim, x, name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = unwrap(window) if window is not None else jnp.ones(win_length)
+
+    def prim(v, w):
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        if center:
+            pads = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pads, mode=pad_mode)
+        n = v.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        starts = np.arange(num) * hop_length
+        idx = starts[:, None] + np.arange(n_fft)[None, :]
+        frames = v[..., idx] * w  # (..., num, n_fft)
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, dtype=spec.real.dtype))
+        # paddle layout: (..., n_fft//2+1, num_frames)
+        return jnp.swapaxes(spec, -1, -2)
+
+    if window is not None:
+        return apply(prim, x, window, name="stft")
+    return apply(lambda v: prim(v, wv), x, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = unwrap(window) if window is not None else jnp.ones(win_length)
+
+    def prim(v, w):
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        spec = jnp.swapaxes(v, -1, -2)  # (..., num, bins)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, dtype=jnp.float32))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided \
+            else jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * w
+        num = frames.shape[-2]
+        out_len = (num - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), dtype=frames.dtype)
+        norm = jnp.zeros(out_len, dtype=frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            norm = norm.at[sl].add(w * w)
+        out = out / jnp.maximum(norm, 1e-10)
+        if center:
+            out = out[..., n_fft // 2:out.shape[-1] - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    if window is not None:
+        return apply(prim, x, window, name="istft")
+    return apply(lambda v: prim(v, wv), x, name="istft")

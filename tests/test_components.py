@@ -1,0 +1,198 @@
+"""Tests for hapi/distribution/fft/signal/flash-attention/text models."""
+import functools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(3)
+
+
+class TestHapi:
+    def _dataset(self, n=64):
+        from paddle_tpu.io import TensorDataset
+        x = RNG.randn(n, 4).astype("float32")
+        w = RNG.randn(4, 3).astype("float32")
+        y = np.argmax(x @ w + 0.05 * RNG.randn(n, 3), axis=1).astype("int64")
+        return TensorDataset([x, y])
+
+    def test_fit_evaluate_predict(self, tmp_path):
+        from paddle_tpu.hapi import Model
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 3))
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=0.01,
+                                            parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=paddle.metric.Accuracy())
+        ds = self._dataset()
+        model.fit(ds, batch_size=16, epochs=3, verbose=0)
+        result = model.evaluate(ds, batch_size=16, verbose=0)
+        assert result["acc"] > 0.5, result
+        preds = model.predict(ds, batch_size=16, stack_outputs=True)
+        assert preds[0].shape == (64, 3)
+        model.save(str(tmp_path / "m"))
+        model.load(str(tmp_path / "m"))
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        net = nn.Linear(4, 3)
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(learning_rate=0.0,
+                                           parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        es = EarlyStopping(monitor="loss", patience=1, mode="min")
+        model.fit(self._dataset(32), batch_size=16, epochs=10, verbose=0,
+                  callbacks=[es])
+        assert model.stop_training
+
+    def test_summary_and_flops(self, capsys):
+        net = paddle.vision.models.LeNet()
+        info = paddle.summary(net, (1, 1, 28, 28))
+        assert info["total_params"] == 61610  # LeNet param count (reference)
+        f = paddle.flops(net, (1, 1, 28, 28))
+        assert f > 1e5
+
+
+class TestDistribution:
+    def test_normal(self):
+        d = paddle.distribution.Normal(0.0, 1.0)
+        s = d.sample([2000])
+        assert abs(float(s.numpy().mean())) < 0.1
+        lp = d.log_prob(paddle.to_tensor([0.0]))
+        np.testing.assert_allclose(lp.numpy(), [-0.9189385], atol=1e-5)
+        assert abs(float(d.entropy().item()) - 1.4189385) < 1e-4
+
+    def test_uniform(self):
+        d = paddle.distribution.Uniform(1.0, 3.0)
+        s = d.sample([1000]).numpy()
+        assert s.min() >= 1.0 and s.max() < 3.0
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor([2.0])).numpy(), [-np.log(2.0)],
+            atol=1e-6)
+
+    def test_categorical(self):
+        d = paddle.distribution.Categorical(paddle.to_tensor([1.0, 1.0, 2.0]))
+        s = d.sample([4000]).numpy()
+        freq = np.bincount(s, minlength=3) / 4000
+        np.testing.assert_allclose(freq, [0.25, 0.25, 0.5], atol=0.05)
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor([2])).numpy(), [np.log(0.5)],
+            atol=1e-5)
+
+    def test_kl(self):
+        p = paddle.distribution.Normal(0.0, 1.0)
+        q = paddle.distribution.Normal(1.0, 1.0)
+        np.testing.assert_allclose(
+            paddle.distribution.kl_divergence(p, q).numpy(), 0.5, atol=1e-6)
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = RNG.randn(8).astype("float32")
+        X = paddle.fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(X.numpy(), np.fft.fft(x), atol=1e-4)
+        back = paddle.fft.ifft(X)
+        np.testing.assert_allclose(back.numpy().real, x, atol=1e-5)
+
+    def test_rfft(self):
+        x = RNG.randn(3, 16).astype("float32")
+        X = paddle.fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(X.numpy(), np.fft.rfft(x), atol=1e-4)
+
+    def test_fft2_shift(self):
+        x = RNG.randn(4, 4).astype("float32")
+        X = paddle.fft.fft2(paddle.to_tensor(x))
+        np.testing.assert_allclose(X.numpy(), np.fft.fft2(x), atol=1e-4)
+        sh = paddle.fft.fftshift(paddle.to_tensor(x))
+        np.testing.assert_allclose(sh.numpy(), np.fft.fftshift(x))
+
+    def test_fft_grad(self):
+        x = paddle.to_tensor(RNG.randn(8).astype("float32"),
+                             stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        loss = (y.abs() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None and x.grad.shape == [8]
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        x = RNG.randn(1, 512).astype("float32")
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64,
+                                  hop_length=16)
+        assert spec.shape[1] == 33  # onesided bins
+        rec = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                                  length=512)
+        np.testing.assert_allclose(rec.numpy(), x, atol=1e-3)
+
+    def test_frame_overlap_add(self):
+        x = paddle.to_tensor(np.arange(16, dtype="float32"))
+        fr = paddle.signal.frame(x, frame_length=4, hop_length=4)
+        assert fr.shape == [4, 4]
+
+
+class TestFlashAttention:
+    def test_interpret_matches_xla(self):
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        orig = pl.pallas_call
+        pl.pallas_call = functools.partial(orig, interpret=True)
+        try:
+            B, S, H, D = 1, 256, 2, 128
+            q = jnp.asarray(RNG.randn(B, S, H, D).astype("float32"))
+            k = jnp.asarray(RNG.randn(B, S, H, D).astype("float32"))
+            v = jnp.asarray(RNG.randn(B, S, H, D).astype("float32"))
+            scale = 1.0 / np.sqrt(D)
+            out = fa.flash_attention(q, k, v, causal=True, scale=scale)
+            import jax
+            qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+            mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+            ref = jnp.einsum("bhqk,bhkd->bhqd",
+                             jax.nn.softmax(jnp.where(mask, logits, -1e30),
+                                            axis=-1), vt)
+            ref = jnp.swapaxes(ref, 1, 2)
+            assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+        finally:
+            pl.pallas_call = orig
+
+
+class TestTextModels:
+    def test_bert_forward_and_train(self):
+        from paddle_tpu.text.models import BertForSequenceClassification
+        from paddle_tpu.text.models.bert import BertConfig
+        cfg = BertConfig(vocab_size=100, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64, max_position=64)
+        model = BertForSequenceClassification(cfg, num_classes=2)
+        ids = paddle.to_tensor(RNG.randint(0, 100, (2, 16)).astype("int64"))
+        labels = paddle.to_tensor(np.array([0, 1], dtype="int64"))
+        mask = paddle.to_tensor(np.ones((2, 16), dtype="int64"))
+        loss = model(ids, attention_mask=mask, labels=labels)
+        loss.backward()
+        assert np.isfinite(loss.item())
+
+    def test_gpt_forward_loss_decreases(self):
+        from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+        paddle.seed(5)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position_embeddings=32, dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        ids = paddle.to_tensor(RNG.randint(0, 64, (2, 17)).astype("int64"))
+        x, y = ids[:, :-1], ids[:, 1:]
+        losses = []
+        for _ in range(5):
+            loss = model(x, labels=y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(loss.item())
+        assert losses[-1] < losses[0]
