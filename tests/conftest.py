@@ -8,12 +8,18 @@ import os
 
 # force CPU (the ambient env pins JAX_PLATFORMS=axon for the TPU tunnel);
 # set PADDLE_TPU_TEST_DEVICE=tpu to run the suite on the real chip.
-if os.environ.get("PADDLE_TPU_TEST_DEVICE", "cpu") == "cpu":
-    os.environ["JAX_PLATFORMS"] = "cpu"
+# NOTE: the site customization pre-imports jax before conftest runs, so env
+# vars alone are too late — use jax.config.update, which works as long as no
+# backend has been initialized yet.
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
 # keep compile times sane on the 1-core CI box
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+if os.environ.get("PADDLE_TPU_TEST_DEVICE", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
