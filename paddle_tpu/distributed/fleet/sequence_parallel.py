@@ -79,7 +79,7 @@ def ring_attention(query, key, value, is_causal=True, axis="sep", scale=None):
     if degree <= 1:
         from ...ops.attention import scaled_dot_product_attention
         return scaled_dot_product_attention(query, key, value,
-                                            is_causal=is_causal)
+                                            is_causal=is_causal, scale=scale)
     d = query.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     spec = P(None, axis, None, None)

@@ -21,12 +21,12 @@ from ..mesh import axis_degree, get_mesh
 __all__ = ["ShardingOptimizerWrapper", "shard_optimizer_states"]
 
 
-def _shard_spec_for(shape, degree):
+def _shard_spec_for(shape, degree, axis="sharding"):
     """First dim divisible by the sharding degree gets sharded."""
     for i, s in enumerate(shape):
         if s % degree == 0 and s >= degree:
             spec = [None] * len(shape)
-            spec[i] = "sharding"
+            spec[i] = axis
             return P(*spec)
     return None
 
@@ -40,7 +40,7 @@ def shard_optimizer_states(optimizer, axis="sharding"):
     mesh = get_mesh()
     for by_param in optimizer._accumulators.values():
         for acc in by_param.values():
-            spec = _shard_spec_for(tuple(acc._val.shape), degree)
+            spec = _shard_spec_for(tuple(acc._val.shape), degree, axis)
             if spec is not None:
                 acc._value = jax.device_put(acc._val,
                                             NamedSharding(mesh, spec))
@@ -64,7 +64,7 @@ class ShardingOptimizerWrapper:
                 existed = id(param) in optimizer._accumulators[name]
                 acc = orig(name, param, init=init, dtype=dtype, shape=shape)
                 if not existed:
-                    spec = _shard_spec_for(tuple(acc._val.shape), degree)
+                    spec = _shard_spec_for(tuple(acc._val.shape), degree, axis)
                     if spec is not None:
                         acc._value = jax.device_put(
                             acc._val, NamedSharding(mesh, spec))
@@ -73,7 +73,7 @@ class ShardingOptimizerWrapper:
             optimizer._get_accumulator = sharded_get
             if shard_params and optimizer._parameter_list:
                 for p in optimizer._parameter_list:
-                    spec = _shard_spec_for(tuple(p._val.shape), degree)
+                    spec = _shard_spec_for(tuple(p._val.shape), degree, axis)
                     if spec is not None:
                         p.sharding_spec = spec
                         p._value = jax.device_put(p._val,

@@ -38,6 +38,12 @@ class PipelineStageStack(Layer):
     def __init__(self, layer_factory, num_stages, num_microbatches,
                  axis="pipe"):
         super().__init__()
+        deg = axis_degree(axis)
+        if deg > 1 and num_stages != deg:
+            raise ValueError(
+                f"num_stages ({num_stages}) must equal the '{axis}' mesh axis "
+                f"degree ({deg}): each device holds and executes exactly one "
+                f"stage in the circular schedule")
         self.num_stages = num_stages
         self.num_microbatches = num_microbatches
         self.axis = axis

@@ -1,6 +1,12 @@
 """paddle.distribution parity (python/paddle/distribution.py, 967 LoC:
 Distribution/Normal/Uniform/Categorical; + the v2.3 additions Beta/Dirichlet/
-Exponential-family helpers kept minimal)."""
+Exponential-family helpers kept minimal).
+
+Gradients flow to distribution parameters: log_prob/entropy route the
+parameters through `core.dispatch.apply` as differentiable inputs (matching
+the reference, where e.g. Normal.log_prob builds ops over the loc/scale
+variables), so `Normal(net_out, s).log_prob(a).backward()` reaches net_out.
+"""
 from __future__ import annotations
 
 import math
@@ -17,10 +23,15 @@ __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Beta", "Multinomial", "kl_divergence"]
 
 
-def _t(x):
+def _keep(x):
+    """Preserve Tensor identity (for autograd); coerce python/numpy to jnp."""
     if isinstance(x, Tensor):
-        return x._value
+        return x
     return jnp.asarray(np.asarray(x, dtype=np.float32))
+
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else x
 
 
 class Distribution:
@@ -46,168 +57,218 @@ class Distribution:
 
 class Normal(Distribution):
     def __init__(self, loc, scale, name=None):
-        self.loc = _t(loc)
-        self.scale = _t(scale)
+        self.loc = _keep(loc)
+        self.scale = _keep(scale)
 
     @property
     def mean(self):
-        return Tensor(jnp.broadcast_to(self.loc,
-                                       jnp.broadcast_shapes(self.loc.shape,
-                                                            self.scale.shape)))
+        base = jnp.broadcast_shapes(jnp.shape(_raw(self.loc)),
+                                    jnp.shape(_raw(self.scale)))
+
+        def prim(loc):
+            return jnp.broadcast_to(loc, base)
+        return apply(prim, self.loc, name="normal_mean")
 
     @property
     def variance(self):
-        return Tensor(jnp.broadcast_to(self.scale ** 2,
-                                       jnp.broadcast_shapes(self.loc.shape,
-                                                            self.scale.shape)))
+        base = jnp.broadcast_shapes(jnp.shape(_raw(self.loc)),
+                                    jnp.shape(_raw(self.scale)))
+
+        def prim(scale):
+            return jnp.broadcast_to(scale ** 2, base)
+        return apply(prim, self.scale, name="normal_variance")
 
     def sample(self, shape=(), seed=0):
         shape = tuple(shape)
-        base = jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
+        loc, scale = _raw(self.loc), _raw(self.scale)
+        base = jnp.broadcast_shapes(jnp.shape(loc), jnp.shape(scale))
         z = jax.random.normal(next_key(), shape + base, dtype=jnp.float32)
-        return Tensor(self.loc + self.scale * z)
+
+        def prim(l, s):
+            return l + s * z
+        return apply(prim, self.loc, self.scale, name="normal_sample")
 
     rsample = sample
 
     def log_prob(self, value):
-        def prim(v):
-            var = self.scale ** 2
-            return (-((v - self.loc) ** 2) / (2 * var)
-                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
-        return apply(prim, value, name="normal_log_prob")
+        def prim(v, loc, scale):
+            var = scale ** 2
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+        return apply(prim, value, self.loc, self.scale,
+                     name="normal_log_prob")
 
     def entropy(self):
-        base = jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
-        return Tensor(jnp.broadcast_to(
-            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale), base))
+        base = jnp.broadcast_shapes(jnp.shape(_raw(self.loc)),
+                                    jnp.shape(_raw(self.scale)))
+
+        def prim(scale):
+            return jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale), base)
+        return apply(prim, self.scale, name="normal_entropy")
 
 
 class Uniform(Distribution):
     def __init__(self, low, high, name=None):
-        self.low = _t(low)
-        self.high = _t(high)
+        self.low = _keep(low)
+        self.high = _keep(high)
 
     def sample(self, shape=(), seed=0):
         shape = tuple(shape)
-        base = jnp.broadcast_shapes(jnp.shape(self.low), jnp.shape(self.high))
+        low, high = _raw(self.low), _raw(self.high)
+        base = jnp.broadcast_shapes(jnp.shape(low), jnp.shape(high))
         u = jax.random.uniform(next_key(), shape + base, dtype=jnp.float32)
-        return Tensor(self.low + (self.high - self.low) * u)
+
+        def prim(lo, hi):
+            return lo + (hi - lo) * u
+        return apply(prim, self.low, self.high, name="uniform_sample")
 
     rsample = sample
 
     def log_prob(self, value):
-        def prim(v):
-            inside = (v >= self.low) & (v < self.high)
-            lp = -jnp.log(self.high - self.low)
+        def prim(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            lp = -jnp.log(hi - lo)
             return jnp.where(inside, lp, -jnp.inf)
-        return apply(prim, value, name="uniform_log_prob")
+        return apply(prim, value, self.low, self.high,
+                     name="uniform_log_prob")
 
     def entropy(self):
-        return Tensor(jnp.log(self.high - self.low))
+        def prim(lo, hi):
+            return jnp.log(hi - lo)
+        return apply(prim, self.low, self.high, name="uniform_entropy")
+
+
+def _norm_log_p(logits):
+    """paddle semantics: input is UNNORMALIZED PROBABILITIES
+    (distribution.py Categorical docstring)."""
+    return jnp.log(jnp.maximum(
+        logits / jnp.sum(logits, axis=-1, keepdims=True), 1e-30))
 
 
 class Categorical(Distribution):
     def __init__(self, logits, name=None):
-        # paddle semantics: the input is UNNORMALIZED PROBABILITIES
-        # (distribution.py Categorical docstring)
-        v = _t(logits)
-        self.logits = v
-        self._log_p = jnp.log(jnp.maximum(v / jnp.sum(v, axis=-1,
-                                                      keepdims=True), 1e-30))
+        self.logits = _keep(logits)
+        self._log_p_cache = None
+
+    @property
+    def _log_p(self):
+        # cache the normalized log-probs per raw logits value (sampling loops
+        # call this every draw; autograd doesn't go through here — log_prob/
+        # entropy renormalize inside their prim)
+        raw = _raw(self.logits)
+        if self._log_p_cache is None or self._log_p_cache[0] is not raw:
+            self._log_p_cache = (raw, _norm_log_p(raw))
+        return self._log_p_cache[1]
 
     def sample(self, shape=()):
         shape = tuple(shape)
-        out = jax.random.categorical(next_key(), self._log_p,
-                                     shape=shape + self._log_p.shape[:-1])
+        log_p = self._log_p
+        out = jax.random.categorical(next_key(), log_p,
+                                     shape=shape + log_p.shape[:-1])
         return Tensor(out.astype(jnp.int64))
 
     def log_prob(self, value):
         idx = unwrap(value).astype(jnp.int32)
-        if self._log_p.ndim == 1:
-            return Tensor(jnp.take(self._log_p, idx))
-        return Tensor(jnp.take_along_axis(
-            self._log_p, idx[..., None], axis=-1)[..., 0])
+
+        def prim(logits):
+            log_p = _norm_log_p(logits)
+            if log_p.ndim == 1:
+                return jnp.take(log_p, idx)
+            return jnp.take_along_axis(log_p, idx[..., None], axis=-1)[..., 0]
+        return apply(prim, self.logits, name="categorical_log_prob")
 
     def probs(self, value):
         idx = unwrap(value).astype(jnp.int32)
-        p = jnp.exp(self._log_p)
-        if p.ndim == 1:
-            return Tensor(jnp.take(p, idx))
-        return Tensor(jnp.take_along_axis(p, idx[..., None], axis=-1)[..., 0])
+
+        def prim(logits):
+            p = jnp.exp(_norm_log_p(logits))
+            if p.ndim == 1:
+                return jnp.take(p, idx)
+            return jnp.take_along_axis(p, idx[..., None], axis=-1)[..., 0]
+        return apply(prim, self.logits, name="categorical_probs")
 
     def entropy(self):
-        p = jnp.exp(self._log_p)
-        return Tensor(-jnp.sum(p * self._log_p, axis=-1))
+        def prim(logits):
+            log_p = _norm_log_p(logits)
+            return -jnp.sum(jnp.exp(log_p) * log_p, axis=-1)
+        return apply(prim, self.logits, name="categorical_entropy")
 
 
 class Bernoulli(Distribution):
     def __init__(self, probs, name=None):
-        self.p = _t(probs)
+        self.p = _keep(probs)
 
     def sample(self, shape=()):
         shape = tuple(shape)
-        u = jax.random.uniform(next_key(), shape + jnp.shape(self.p))
-        return Tensor((u < self.p).astype(jnp.float32))
+        p = _raw(self.p)
+        u = jax.random.uniform(next_key(), shape + jnp.shape(p))
+        return Tensor((u < p).astype(jnp.float32))
 
     def log_prob(self, value):
-        def prim(v):
-            return v * jnp.log(jnp.maximum(self.p, 1e-30)) + \
-                (1 - v) * jnp.log(jnp.maximum(1 - self.p, 1e-30))
-        return apply(prim, value, name="bernoulli_log_prob")
+        def prim(v, p):
+            return v * jnp.log(jnp.maximum(p, 1e-30)) + \
+                (1 - v) * jnp.log(jnp.maximum(1 - p, 1e-30))
+        return apply(prim, value, self.p, name="bernoulli_log_prob")
 
     def entropy(self):
-        p = self.p
-        return Tensor(-(p * jnp.log(jnp.maximum(p, 1e-30))
-                        + (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-30))))
+        def prim(p):
+            return -(p * jnp.log(jnp.maximum(p, 1e-30))
+                     + (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-30)))
+        return apply(prim, self.p, name="bernoulli_entropy")
 
 
 class Beta(Distribution):
     def __init__(self, alpha, beta, name=None):
-        self.alpha = _t(alpha)
-        self.beta = _t(beta)
+        self.alpha = _keep(alpha)
+        self.beta = _keep(beta)
 
     def sample(self, shape=()):
         shape = tuple(shape)
-        out = jax.random.beta(next_key(), self.alpha, self.beta,
+        a, b = _raw(self.alpha), _raw(self.beta)
+        out = jax.random.beta(next_key(), a, b,
                               shape=shape + jnp.broadcast_shapes(
-                                  jnp.shape(self.alpha),
-                                  jnp.shape(self.beta)))
+                                  jnp.shape(a), jnp.shape(b)))
         return Tensor(out)
 
     def log_prob(self, value):
-        def prim(v):
-            a, b = self.alpha, self.beta
+        def prim(v, a, b):
             lbeta = (jax.lax.lgamma(a) + jax.lax.lgamma(b)
                      - jax.lax.lgamma(a + b))
             return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
-        return apply(prim, value, name="beta_log_prob")
+        return apply(prim, value, self.alpha, self.beta, name="beta_log_prob")
 
 
 class Multinomial(Distribution):
     def __init__(self, total_count, probs, name=None):
         self.n = int(total_count)
-        self.p = _t(probs)
+        self.p = _keep(probs)
 
     def sample(self, shape=()):
-        logp = jnp.log(jnp.maximum(
-            self.p / jnp.sum(self.p, -1, keepdims=True), 1e-30))
+        p = _raw(self.p)
+        logp = jnp.log(jnp.maximum(p / jnp.sum(p, -1, keepdims=True), 1e-30))
         draws = jax.random.categorical(
-            next_key(), logp, shape=tuple(shape) + (self.n,)
-            + self.p.shape[:-1])
-        k = self.p.shape[-1]
+            next_key(), logp, shape=tuple(shape) + (self.n,) + p.shape[:-1])
+        k = p.shape[-1]
         onehot = jax.nn.one_hot(draws, k)
         return Tensor(jnp.sum(onehot, axis=len(tuple(shape))))
 
 
 def kl_divergence(p, q):
     if isinstance(p, Normal) and isinstance(q, Normal):
-        var_ratio = (p.scale / q.scale) ** 2
-        t1 = ((p.loc - q.loc) / q.scale) ** 2
-        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+        def prim(pl, ps, ql, qs):
+            var_ratio = (ps / qs) ** 2
+            t1 = ((pl - ql) / qs) ** 2
+            return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+        return apply(prim, p.loc, p.scale, q.loc, q.scale, name="kl_normal")
     if isinstance(p, Categorical) and isinstance(q, Categorical):
-        pp = jnp.exp(p._log_p)
-        return Tensor(jnp.sum(pp * (p._log_p - q._log_p), axis=-1))
+        def prim(pl, ql):
+            plog, qlog = _norm_log_p(pl), _norm_log_p(ql)
+            return jnp.sum(jnp.exp(plog) * (plog - qlog), axis=-1)
+        return apply(prim, p.logits, q.logits, name="kl_categorical")
     if isinstance(p, Uniform) and isinstance(q, Uniform):
-        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+        def prim(pl, ph, ql, qh):
+            return jnp.log((qh - ql) / (ph - pl))
+        return apply(prim, p.low, p.high, q.low, q.high, name="kl_uniform")
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -44,6 +44,12 @@ class Callback:
     def on_eval_end(self, logs=None):
         pass
 
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
 
 class CallbackList:
     def __init__(self, callbacks):
@@ -56,11 +62,13 @@ class CallbackList:
     def __getattr__(self, name):
         if name.startswith("on_"):
             def call(*args, **kwargs):
-                for c in self.callbacks:
-                    getattr(c, name)(*args, **kwargs)
+                # params must be visible from inside on_train_begin itself
+                # (reference: ProgBarLogger reads self.params there)
                 if name == "on_train_begin" and args:
                     for c in self.callbacks:
                         c.set_params(args[0])
+                for c in self.callbacks:
+                    getattr(c, name)(*args, **kwargs)
             return call
         raise AttributeError(name)
 

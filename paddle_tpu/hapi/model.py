@@ -125,10 +125,20 @@ class Model:
                 it += 1
                 if num_iters is not None and it >= num_iters:
                     break
-            cbs.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=0, num_workers=num_workers)
+                eval_result = self.evaluate(eval_data, batch_size=batch_size,
+                                            verbose=0, num_workers=num_workers,
+                                            callbacks=callbacks)
+                # flatten eval metrics into the epoch logs so monitoring
+                # callbacks (EarlyStopping/ModelCheckpoint) can see them
+                for k, v in eval_result.items():
+                    logs[k] = v[0] if isinstance(v, list) and len(v) == 1 else v
+            # epoch logs carry scalars (batch logs carry lists): keep the
+            # monitored 'loss' the same type whether or not this was an
+            # eval epoch
+            if isinstance(logs.get("loss"), list) and len(logs["loss"]) == 1:
+                logs["loss"] = logs["loss"][0]
+            cbs.on_epoch_end(epoch, logs)
             if save_dir is not None and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/{epoch}")
             if self.stop_training or (num_iters is not None and it >= num_iters):
@@ -139,16 +149,22 @@ class Model:
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):
+        from .callbacks import CallbackList
         loader = eval_data if isinstance(eval_data, DataLoader) else \
             DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        cbs = CallbackList(_to_list(callbacks))
+        cbs.set_model(self)
+        cbs.on_eval_begin({})
         for m in self._metrics:
             m.reset()
         losses = []
-        for batch in loader:
+        for step, batch in enumerate(loader):
+            cbs.on_eval_batch_begin(step)
             ins, labs = self._split_batch(batch)
             loss_vals, _ = self.eval_batch(ins, labs)
             if loss_vals:
                 losses.append(loss_vals[0])
+            cbs.on_eval_batch_end(step, {"loss": loss_vals, "step": step})
         result = {}
         if losses:
             result["loss"] = [float(np.mean(losses))]
@@ -158,6 +174,7 @@ class Model:
             vals = res if isinstance(res, list) else [res]
             for n, v in zip(names, vals):
                 result[n] = v
+        cbs.on_eval_end(result)
         return result
 
     def predict(self, test_data, batch_size=1, num_workers=0,

@@ -30,9 +30,9 @@ def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
     if input is not None:
         x = input if isinstance(input, (list, tuple)) else [input]
     else:
-        if isinstance(input_size, tuple) and input_size and \
+        if isinstance(input_size, (tuple, list)) and input_size and \
                 isinstance(input_size[0], (tuple, list)):
-            sizes = input_size
+            sizes = list(input_size)
         else:
             sizes = [input_size]
         dts = dtypes if isinstance(dtypes, (list, tuple)) else \

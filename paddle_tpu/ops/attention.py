@@ -40,10 +40,11 @@ def _xla_attention(q, k, v, mask, scale, is_causal, dropout_p, dropout_key):
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, use_pallas=None):
+                                 training=True, use_pallas=None, scale=None):
     qv = unwrap(query)
     head_dim = qv.shape[-1]
-    scale = 1.0 / (head_dim ** 0.5)
+    if scale is None:
+        scale = 1.0 / (head_dim ** 0.5)
     dropout_key = None
     if dropout_p > 0.0 and training:
         from ..core.random import next_key
@@ -52,16 +53,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         dropout_p = 0.0
 
     if use_pallas is None:
-        from ..core.autograd import is_grad_enabled
-        no_grad_needed = not is_grad_enabled() or (
-            query.stop_gradient and key.stop_gradient and value.stop_gradient)
         use_pallas = (_pallas_available() and attn_mask is None
-                      and dropout_p == 0.0 and no_grad_needed
+                      and dropout_p == 0.0
                       and _pallas_supports(query, key))
+    elif use_pallas and (attn_mask is not None or dropout_p > 0.0):
+        raise ValueError(
+            "use_pallas=True is incompatible with attn_mask/dropout_p: the "
+            "flash kernel computes plain (optionally causal) attention")
     if use_pallas:
-        from .pallas.flash_attention import flash_attention
         def prim(q, k, v):
-            return flash_attention(q, k, v, causal=is_causal, scale=scale)
+            return _flash_attention_diff(q, k, v, is_causal, scale)
         return apply(prim, query, key, value, name="flash_attention")
 
     def prim(q, k, v, *maybe_mask):
@@ -71,6 +72,34 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if attn_mask is not None:
         return apply(prim, query, key, value, attn_mask, name="sdpa")
     return apply(prim, query, key, value, name="sdpa")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_diff(q, k, v, is_causal, scale):
+    """Pallas flash-attention forward with an XLA-autodiff backward.
+
+    pallas_call has no autodiff rule, so the VJP recomputes attention with the
+    XLA path and differentiates that — mathematically identical (same scale /
+    causal masking), memory profile of the backward matches the plain XLA
+    path. A fused Pallas backward kernel can replace _bwd later without
+    touching callers."""
+    from .pallas.flash_attention import flash_attention
+    return flash_attention(q, k, v, causal=is_causal, scale=scale)
+
+
+def _flash_fwd(q, k, v, is_causal, scale):
+    return _flash_attention_diff(q, k, v, is_causal, scale), (q, k, v)
+
+
+def _flash_bwd(is_causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_attention(q_, k_, v_, None, scale, is_causal,
+                                          0.0, None), q, k, v)
+    return vjp(g)
+
+
+_flash_attention_diff.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _pallas_supports(query, key):

@@ -180,3 +180,110 @@ def test_align_corners_resize_values():
     out = F.interpolate(v, size=[1, 5], mode="bilinear", align_corners=True)
     np.testing.assert_allclose(out.numpy().ravel(), [0, 0.5, 1, 1.5, 2],
                                atol=1e-5)
+
+
+def test_distribution_param_gradients_flow():
+    # log_prob must propagate gradients to distribution parameters
+    # (reference Normal.log_prob builds ops over the loc/scale variables)
+    from paddle_tpu.distribution import Categorical, Normal
+    loc = paddle.to_tensor(np.array([0.5], "float32"), stop_gradient=False)
+    scale = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    lp = Normal(loc, scale).log_prob(paddle.to_tensor(
+        np.array([1.0], "float32")))
+    lp.backward()
+    assert loc.grad is not None and scale.grad is not None
+    # d/dloc log N(v;loc,scale) = (v-loc)/scale^2 = 0.5/4
+    np.testing.assert_allclose(loc.grad.numpy(), [0.125], atol=1e-6)
+
+    logits = paddle.to_tensor(np.array([1.0, 3.0], "float32"),
+                              stop_gradient=False)
+    lp = Categorical(logits).log_prob(paddle.to_tensor(
+        np.array([1], "int64")))
+    lp.backward()
+    assert logits.grad is not None
+    assert abs(float(logits.grad.numpy().sum())) > 0
+
+
+def test_flash_attention_differentiable():
+    # explicit use_pallas=True with grad-requiring inputs must not crash:
+    # custom_vjp (pallas forward, XLA backward)
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+    rng = np.random.RandomState(0)
+    q = paddle.to_tensor(rng.randn(1, 128, 2, 128).astype("float32"),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.randn(1, 128, 2, 128).astype("float32"),
+                         stop_gradient=False)
+    v = paddle.to_tensor(rng.randn(1, 128, 2, 128).astype("float32"),
+                         stop_gradient=False)
+    out = scaled_dot_product_attention(q, k, v, is_causal=True,
+                                       use_pallas=True)
+    ref = scaled_dot_product_attention(
+        paddle.to_tensor(q.numpy()), paddle.to_tensor(k.numpy()),
+        paddle.to_tensor(v.numpy()), is_causal=True, use_pallas=False)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=2e-3)
+    out.backward(paddle.to_tensor(np.ones_like(out.numpy())))
+    assert q.grad is not None and k.grad is not None and v.grad is not None
+
+
+def test_sdpa_custom_scale():
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+    rng = np.random.RandomState(0)
+    q = paddle.to_tensor(rng.randn(1, 4, 2, 8).astype("float32"))
+    k = paddle.to_tensor(rng.randn(1, 4, 2, 8).astype("float32"))
+    v = paddle.to_tensor(rng.randn(1, 4, 2, 8).astype("float32"))
+    a = scaled_dot_product_attention(q, k, v, scale=0.125)
+    b = scaled_dot_product_attention(q, k, v)  # default 1/sqrt(8)=0.3535
+    assert not np.allclose(a.numpy(), b.numpy())
+
+
+def test_hapi_eval_metrics_reach_callbacks():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import Callback
+    import paddle_tpu.nn as nn
+
+    seen = {}
+
+    class Spy(Callback):
+        def on_train_begin(self, logs=None):
+            # params must already be set when this hook runs
+            seen["params"] = dict(self.params)
+
+        def on_epoch_end(self, epoch, logs=None):
+            seen["epoch_logs"] = dict(logs or {})
+
+        def on_eval_end(self, logs=None):
+            seen["eval_logs"] = dict(logs or {})
+
+    class DS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return (np.ones(4, "float32") * i, np.array([i % 2], "int64"))
+
+    net = nn.Linear(4, 2)
+    m = Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    m.fit(DS(), eval_data=DS(), batch_size=4, epochs=1, verbose=0,
+          callbacks=[Spy()])
+    assert seen["params"].get("epochs") == 1
+    assert "loss" in seen["eval_logs"]
+    assert "loss" in seen["epoch_logs"]
+
+
+def test_summary_accepts_list_of_shapes():
+    import paddle_tpu.nn as nn
+
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 2)
+            self.b = nn.Linear(8, 2)
+
+        def forward(self, x, y):
+            return self.a(x) + self.b(y)
+
+    res = paddle.summary(TwoIn(), [(1, 4), (1, 8)])
+    assert res["total_params"] == (4 * 2 + 2) + (8 * 2 + 2)
