@@ -1,0 +1,110 @@
+// Common native-runtime utilities: error enforcement + status plumbing.
+//
+// TPU-native analog of the reference's platform/enforce.h error system
+// (PADDLE_ENFORCE_* macros with typed error codes): errors raised in the
+// native runtime are recorded per-thread and surfaced to Python as
+// RuntimeError via the ctypes layer (paddle_tpu/core/native.py).
+#pragma once
+
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <stdexcept>
+#include <string>
+
+namespace paddle_tpu {
+
+// Typed error codes mirroring the reference's platform/errors.h taxonomy.
+enum class ErrorCode : int32_t {
+  kOk = 0,
+  kInvalidArgument = 1,
+  kNotFound = 2,
+  kOutOfRange = 3,
+  kAlreadyExists = 4,
+  kResourceExhausted = 5,
+  kPreconditionNotMet = 6,
+  kPermissionDenied = 7,
+  kExecutionTimeout = 8,
+  kUnimplemented = 9,
+  kUnavailable = 10,
+  kFatal = 11,
+  kExternal = 12,
+};
+
+class EnforceError : public std::runtime_error {
+ public:
+  EnforceError(ErrorCode code, const std::string& msg)
+      : std::runtime_error(msg), code_(code) {}
+  ErrorCode code() const { return code_; }
+
+ private:
+  ErrorCode code_;
+};
+
+inline std::string FormatV(const char* fmt, va_list ap) {
+  va_list ap2;
+  va_copy(ap2, ap);
+  int n = std::vsnprintf(nullptr, 0, fmt, ap);
+  std::string out;
+  if (n > 0) {
+    out.resize(static_cast<size_t>(n));
+    std::vsnprintf(&out[0], out.size() + 1, fmt, ap2);
+  }
+  va_end(ap2);
+  return out;
+}
+
+[[noreturn]] inline void ThrowEnforce(ErrorCode code, const char* file,
+                                      int line, const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  std::string msg = FormatV(fmt, ap);
+  va_end(ap);
+  msg += " (at ";
+  msg += file;
+  msg += ":";
+  msg += std::to_string(line);
+  msg += ")";
+  throw EnforceError(code, msg);
+}
+
+#define PT_ENFORCE(cond, code, ...)                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      ::paddle_tpu::ThrowEnforce(::paddle_tpu::ErrorCode::code, __FILE__, \
+                                 __LINE__, __VA_ARGS__);                  \
+    }                                                                     \
+  } while (0)
+
+#define PT_THROW(code, ...)                                             \
+  ::paddle_tpu::ThrowEnforce(::paddle_tpu::ErrorCode::code, __FILE__, \
+                             __LINE__, __VA_ARGS__)
+
+// ---- C-boundary error capture ------------------------------------------
+// Every extern "C" entry wraps its body in PT_CAPI_BEGIN/END; a raised
+// EnforceError lands in thread-local state readable via pt_last_error().
+struct LastError {
+  int32_t code = 0;
+  std::string message;
+};
+
+LastError* TlsLastError();
+
+#define PT_CAPI_BEGIN try {
+#define PT_CAPI_END(failval)                                  \
+  }                                                           \
+  catch (const ::paddle_tpu::EnforceError& e) {               \
+    auto* le = ::paddle_tpu::TlsLastError();                  \
+    le->code = static_cast<int32_t>(e.code());                \
+    le->message = e.what();                                   \
+    return (failval);                                         \
+  }                                                           \
+  catch (const std::exception& e) {                           \
+    auto* le = ::paddle_tpu::TlsLastError();                  \
+    le->code = static_cast<int32_t>(                          \
+        ::paddle_tpu::ErrorCode::kFatal);                     \
+    le->message = e.what();                                   \
+    return (failval);                                         \
+  }
+
+}  // namespace paddle_tpu

@@ -1,0 +1,195 @@
+// Global flags registry — TPU-native analog of the reference's gflags-based
+// PADDLE_DEFINE_EXPORTED_* registry (platform/flags.cc) surfaced to Python as
+// paddle.set_flags / paddle.get_flags.
+//
+// Flags are typed (bool/int64/double/string), carry a help string, and take
+// their default from the environment (PADDLE_TPU_<NAME> or FLAGS_<name>) at
+// registration time, mirroring the reference's env override behavior.
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace paddle_tpu {
+
+LastError* TlsLastError() {
+  static thread_local LastError le;
+  return &le;
+}
+
+namespace {
+
+enum class FlagType : int32_t { kBool = 0, kInt64 = 1, kDouble = 2,
+                                kString = 3 };
+
+struct Flag {
+  FlagType type;
+  std::string help;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string s;
+};
+
+class FlagRegistry {
+ public:
+  static FlagRegistry& Instance() {
+    static FlagRegistry r;
+    return r;
+  }
+
+  void Define(const std::string& name, FlagType type,
+              const std::string& defval, const std::string& help) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = flags_.find(name);
+    if (it != flags_.end()) return;  // idempotent re-registration
+    Flag f;
+    f.type = type;
+    f.help = help;
+    std::string v = defval;
+    // env override: FLAGS_<name> first (reference convention), then
+    // PADDLE_TPU_<NAME>
+    if (const char* env = std::getenv(("FLAGS_" + name).c_str())) {
+      v = env;
+    } else {
+      std::string upper = name;
+      for (auto& c : upper) c = toupper(c);
+      if (const char* env2 = std::getenv(("PADDLE_TPU_" + upper).c_str()))
+        v = env2;
+    }
+    Assign(&f, v);
+    flags_[name] = std::move(f);
+  }
+
+  void Set(const std::string& name, const std::string& value) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = flags_.find(name);
+    PT_ENFORCE(it != flags_.end(), kNotFound, "unknown flag '%s'",
+               name.c_str());
+    Assign(&it->second, value);
+  }
+
+  std::string Get(const std::string& name) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = flags_.find(name);
+    PT_ENFORCE(it != flags_.end(), kNotFound, "unknown flag '%s'",
+               name.c_str());
+    return ToString(it->second);
+  }
+
+  int32_t Type(const std::string& name) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = flags_.find(name);
+    PT_ENFORCE(it != flags_.end(), kNotFound, "unknown flag '%s'",
+               name.c_str());
+    return static_cast<int32_t>(it->second.type);
+  }
+
+  std::string List() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string out;
+    for (auto& kv : flags_) {
+      if (!out.empty()) out += "\n";
+      out += kv.first + "=" + ToString(kv.second);
+    }
+    return out;
+  }
+
+ private:
+  static void Assign(Flag* f, const std::string& v) {
+    switch (f->type) {
+      case FlagType::kBool:
+        f->b = (v == "1" || v == "true" || v == "True" || v == "TRUE");
+        break;
+      case FlagType::kInt64:
+        f->i = v.empty() ? 0 : std::stoll(v);
+        break;
+      case FlagType::kDouble:
+        f->d = v.empty() ? 0.0 : std::stod(v);
+        break;
+      case FlagType::kString:
+        f->s = v;
+        break;
+    }
+  }
+
+  static std::string ToString(const Flag& f) {
+    switch (f.type) {
+      case FlagType::kBool:
+        return f.b ? "true" : "false";
+      case FlagType::kInt64:
+        return std::to_string(f.i);
+      case FlagType::kDouble: {
+        char buf[64];
+        std::snprintf(buf, sizeof(buf), "%g", f.d);
+        return buf;
+      }
+      case FlagType::kString:
+        return f.s;
+    }
+    return "";
+  }
+
+  std::mutex mu_;
+  std::map<std::string, Flag> flags_;
+};
+
+}  // namespace
+}  // namespace paddle_tpu
+
+using paddle_tpu::FlagRegistry;
+
+extern "C" {
+
+const char* pt_last_error() {
+  return paddle_tpu::TlsLastError()->message.c_str();
+}
+
+int32_t pt_last_error_code() { return paddle_tpu::TlsLastError()->code; }
+
+// type: 0=bool 1=int64 2=double 3=string
+int32_t pt_flag_define(const char* name, int32_t type, const char* defval,
+                       const char* help) {
+  PT_CAPI_BEGIN
+  FlagRegistry::Instance().Define(
+      name, static_cast<paddle_tpu::FlagType>(type), defval ? defval : "",
+      help ? help : "");
+  return 0;
+  PT_CAPI_END(-1)
+}
+
+int32_t pt_flag_set(const char* name, const char* value) {
+  PT_CAPI_BEGIN
+  FlagRegistry::Instance().Set(name, value ? value : "");
+  return 0;
+  PT_CAPI_END(-1)
+}
+
+// Caller copies out of the returned thread-local buffer before next call.
+const char* pt_flag_get(const char* name) {
+  PT_CAPI_BEGIN
+  static thread_local std::string out;
+  out = FlagRegistry::Instance().Get(name);
+  return out.c_str();
+  PT_CAPI_END(nullptr)
+}
+
+int32_t pt_flag_type(const char* name) {
+  PT_CAPI_BEGIN
+  return FlagRegistry::Instance().Type(name);
+  PT_CAPI_END(-1)
+}
+
+const char* pt_flag_list() {
+  PT_CAPI_BEGIN
+  static thread_local std::string out;
+  out = FlagRegistry::Instance().List();
+  return out.c_str();
+  PT_CAPI_END(nullptr)
+}
+
+}  // extern "C"

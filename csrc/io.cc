@@ -1,0 +1,244 @@
+// Native data-loading runtime — TPU-native analog of the reference
+// DataLoader's native machinery (fluid/reader.py:146 queue-backed readers,
+// operators/reader/ buffered_reader, framework/data_feed.cc thread pools).
+//
+// Components:
+//  - BlockingQueue: bounded MPMC queue of opaque item handles with close
+//    semantics, backing DataLoader prefetch (≈ LoDTensorBlockingQueue).
+//  - ThreadPool: shared worker pool (≈ framework/new_executor workqueue).
+//  - CollateStack: parallel memcpy of N same-shaped sample buffers into one
+//    batch buffer (the hot loop of default_collate_fn, done outside the
+//    GIL).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+namespace paddle_tpu {
+namespace {
+
+struct QueueItem {
+  void* data;
+  int64_t a, b;  // user metadata (e.g. nbytes, index)
+};
+
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(size_t capacity) : cap_(capacity ? capacity : 1) {}
+
+  // returns 0 ok, 1 timeout, 2 closed
+  int Push(QueueItem item, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [&] { return closed_ || q_.size() < cap_; };
+    if (!WaitFor(lk, not_full_, timeout_ms, pred)) return 1;
+    if (closed_) return 2;
+    q_.push_back(item);
+    not_empty_.notify_one();
+    return 0;
+  }
+
+  int Pop(QueueItem* out, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [&] { return !q_.empty() || closed_; };
+    if (!WaitFor(lk, not_empty_, timeout_ms, pred)) return 1;
+    if (q_.empty()) return 2;  // closed and drained
+    *out = q_.front();
+    q_.pop_front();
+    not_full_.notify_one();
+    return 0;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> g(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  int64_t Size() {
+    std::lock_guard<std::mutex> g(mu_);
+    return static_cast<int64_t>(q_.size());
+  }
+
+  bool Closed() {
+    std::lock_guard<std::mutex> g(mu_);
+    return closed_;
+  }
+
+ private:
+  template <typename Pred>
+  static bool WaitFor(std::unique_lock<std::mutex>& lk,
+                      std::condition_variable& cv, int64_t timeout_ms,
+                      Pred pred) {
+    if (timeout_ms < 0) {
+      cv.wait(lk, pred);
+      return true;
+    }
+    return cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+  }
+
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<QueueItem> q_;
+  size_t cap_;
+  bool closed_ = false;
+};
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int nthreads) {
+    if (nthreads <= 0) nthreads = 1;
+    for (int i = 0; i < nthreads; ++i)
+      workers_.emplace_back([this] { Loop(); });
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  void Submit(std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      tasks_.push_back(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+  void Wait() {
+    std::unique_lock<std::mutex> lk(mu_);
+    idle_cv_.wait(lk, [&] { return tasks_.empty() && active_ == 0; });
+  }
+
+  int Size() const { return static_cast<int>(workers_.size()); }
+
+ private:
+  void Loop() {
+    for (;;) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return stop_ || !tasks_.empty(); });
+        if (stop_ && tasks_.empty()) return;
+        fn = std::move(tasks_.front());
+        tasks_.pop_front();
+        ++active_;
+      }
+      fn();
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        --active_;
+        if (tasks_.empty() && active_ == 0) idle_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, idle_cv_;
+  std::deque<std::function<void()>> tasks_;
+  std::vector<std::thread> workers_;
+  int active_ = 0;
+  bool stop_ = false;
+};
+
+ThreadPool* GlobalPool() {
+  static ThreadPool pool(static_cast<int>(
+      std::max(2u, std::thread::hardware_concurrency())));
+  return &pool;
+}
+
+}  // namespace
+}  // namespace paddle_tpu
+
+using paddle_tpu::BlockingQueue;
+using paddle_tpu::GlobalPool;
+using paddle_tpu::QueueItem;
+
+extern "C" {
+
+void* pt_queue_create(int64_t capacity) {
+  PT_CAPI_BEGIN
+  return new BlockingQueue(static_cast<size_t>(capacity));
+  PT_CAPI_END(nullptr)
+}
+
+void pt_queue_destroy(void* q) { delete static_cast<BlockingQueue*>(q); }
+
+int32_t pt_queue_push(void* q, void* data, int64_t a, int64_t b,
+                      int64_t timeout_ms) {
+  PT_CAPI_BEGIN
+  return static_cast<BlockingQueue*>(q)->Push(QueueItem{data, a, b},
+                                              timeout_ms);
+  PT_CAPI_END(-1)
+}
+
+int32_t pt_queue_pop(void* q, void** data, int64_t* a, int64_t* b,
+                     int64_t timeout_ms) {
+  PT_CAPI_BEGIN
+  QueueItem item;
+  int rc = static_cast<BlockingQueue*>(q)->Pop(&item, timeout_ms);
+  if (rc == 0) {
+    *data = item.data;
+    *a = item.a;
+    *b = item.b;
+  }
+  return rc;
+  PT_CAPI_END(-1)
+}
+
+void pt_queue_close(void* q) { static_cast<BlockingQueue*>(q)->Close(); }
+int64_t pt_queue_size(void* q) {
+  return static_cast<BlockingQueue*>(q)->Size();
+}
+
+// Parallel collate: stack n sample buffers (each item_bytes) into dst.
+// Chunked across the global pool; caller releases the GIL (ctypes does).
+int32_t pt_collate_stack(void* dst, void** srcs, int64_t n,
+                         int64_t item_bytes) {
+  PT_CAPI_BEGIN
+  char* out = static_cast<char*>(dst);
+  // small batches: single memcpy loop beats task overhead
+  if (n * item_bytes < (1 << 20) || n < 4) {
+    for (int64_t i = 0; i < n; ++i)
+      std::memcpy(out + i * item_bytes, srcs[i],
+                  static_cast<size_t>(item_bytes));
+    return 0;
+  }
+  auto* pool = GlobalPool();
+  int nw = std::min<int64_t>(pool->Size(), n);
+  int64_t per = (n + nw - 1) / nw;
+  // per-call completion latch so concurrent collates don't interfere
+  std::mutex done_mu;
+  std::condition_variable done_cv;
+  int pending = 0;
+  for (int w = 0; w < nw; ++w)
+    if (w * per < std::min<int64_t>(n, w * per + per)) ++pending;
+  for (int w = 0; w < nw; ++w) {
+    int64_t lo = w * per, hi = std::min<int64_t>(n, lo + per);
+    if (lo >= hi) break;
+    pool->Submit([&, lo, hi] {
+      for (int64_t i = lo; i < hi; ++i)
+        std::memcpy(out + i * item_bytes, srcs[i],
+                    static_cast<size_t>(item_bytes));
+      std::lock_guard<std::mutex> g(done_mu);
+      if (--pending == 0) done_cv.notify_one();
+    });
+  }
+  std::unique_lock<std::mutex> lk(done_mu);
+  done_cv.wait(lk, [&] { return pending == 0; });
+  return 0;
+  PT_CAPI_END(-1)
+}
+
+}  // extern "C"

@@ -1,0 +1,187 @@
+// Host memory arena — TPU-native analog of the reference's
+// auto_growth_best_fit allocator (memory/allocation/
+// auto_growth_best_fit_allocator.cc, the default strategy behind
+// AllocatorFacade).
+//
+// On TPU the device heap is owned by PJRT/XLA, so the framework-owned
+// allocator manages *host staging* memory: DataLoader batch assembly and
+// host→device transfer buffers. Strategy matches the reference: carve
+// allocations out of large slabs ("chunks") with a size-ordered free map
+// (best fit), split on alloc, coalesce neighbors on free, grow by
+// max(request, slab_size) when no block fits.
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace paddle_tpu {
+namespace {
+
+constexpr size_t kAlign = 64;  // cacheline; also good for dma staging
+
+inline size_t AlignUp(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+class Arena {
+ public:
+  explicit Arena(size_t slab_bytes)
+      : slab_bytes_(std::max<size_t>(slab_bytes, 1 << 20)) {}
+
+  ~Arena() {
+    for (void* s : slabs_) std::free(s);
+  }
+
+  void* Alloc(size_t nbytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    nbytes = AlignUp(std::max<size_t>(nbytes, kAlign));
+    auto it = free_by_size_.lower_bound(nbytes);
+    if (it == free_by_size_.end()) {
+      Grow(nbytes);
+      it = free_by_size_.lower_bound(nbytes);
+      PT_ENFORCE(it != free_by_size_.end(), kResourceExhausted,
+                 "arena grow failed for %zu bytes", nbytes);
+    }
+    char* base = it->second;
+    size_t block = it->first;
+    EraseFree(it);
+    if (block - nbytes >= 2 * kAlign) {
+      InsertFree(base + nbytes, block - nbytes);
+      block = nbytes;
+    }
+    allocated_[base] = block;
+    in_use_ += block;
+    peak_ = std::max(peak_, in_use_);
+    return base;
+  }
+
+  void Free(void* p) {
+    if (p == nullptr) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = allocated_.find(static_cast<char*>(p));
+    PT_ENFORCE(it != allocated_.end(), kInvalidArgument,
+               "free of pointer not owned by arena");
+    char* base = it->first;
+    size_t block = it->second;
+    allocated_.erase(it);
+    in_use_ -= block;
+    // coalesce with right neighbor
+    auto right = free_by_addr_.find(base + block);
+    if (right != free_by_addr_.end() &&
+        SameSlab(base, right->first)) {
+      size_t rsize = right->second;
+      EraseFreeByAddr(right);
+      block += rsize;
+    }
+    // coalesce with left neighbor
+    auto left = free_by_addr_.lower_bound(base);
+    if (left != free_by_addr_.begin()) {
+      --left;
+      if (left->first + left->second == base && SameSlab(left->first, base)) {
+        base = left->first;
+        block += left->second;
+        EraseFreeByAddr(left);
+      }
+    }
+    InsertFree(base, block);
+  }
+
+  void Stats(int64_t* in_use, int64_t* peak, int64_t* reserved) {
+    std::lock_guard<std::mutex> g(mu_);
+    *in_use = static_cast<int64_t>(in_use_);
+    *peak = static_cast<int64_t>(peak_);
+    *reserved = static_cast<int64_t>(reserved_);
+  }
+
+ private:
+  void Grow(size_t at_least) {
+    size_t n = std::max(slab_bytes_, AlignUp(at_least));
+    void* s = nullptr;
+    // aligned slab so AlignUp'd offsets stay aligned
+    if (posix_memalign(&s, kAlign, n) != 0 || s == nullptr)
+      PT_THROW(kResourceExhausted, "host oom allocating %zu byte slab", n);
+    slabs_.push_back(s);
+    slab_ranges_.emplace_back(static_cast<char*>(s),
+                              static_cast<char*>(s) + n);
+    reserved_ += n;
+    InsertFree(static_cast<char*>(s), n);
+  }
+
+  bool SameSlab(char* a, char* b) {
+    for (auto& r : slab_ranges_)
+      if (a >= r.first && a < r.second) return b >= r.first && b < r.second;
+    return false;
+  }
+
+  void InsertFree(char* base, size_t n) {
+    free_by_size_.emplace(n, base);
+    free_by_addr_[base] = n;
+  }
+
+  void EraseFree(std::multimap<size_t, char*>::iterator it) {
+    free_by_addr_.erase(it->second);
+    free_by_size_.erase(it);
+  }
+
+  void EraseFreeByAddr(std::map<char*, size_t>::iterator it) {
+    auto range = free_by_size_.equal_range(it->second);
+    for (auto i = range.first; i != range.second; ++i) {
+      if (i->second == it->first) {
+        free_by_size_.erase(i);
+        break;
+      }
+    }
+    free_by_addr_.erase(it);
+  }
+
+  std::mutex mu_;
+  size_t slab_bytes_;
+  std::vector<void*> slabs_;
+  std::vector<std::pair<char*, char*>> slab_ranges_;
+  std::multimap<size_t, char*> free_by_size_;   // size → base (best fit)
+  std::map<char*, size_t> free_by_addr_;        // base → size (coalescing)
+  std::unordered_map<char*, size_t> allocated_;
+  size_t in_use_ = 0, peak_ = 0, reserved_ = 0;
+};
+
+}  // namespace
+}  // namespace paddle_tpu
+
+using paddle_tpu::Arena;
+
+extern "C" {
+
+void* pt_arena_create(int64_t slab_bytes) {
+  PT_CAPI_BEGIN
+  return new Arena(static_cast<size_t>(slab_bytes));
+  PT_CAPI_END(nullptr)
+}
+
+void pt_arena_destroy(void* arena) { delete static_cast<Arena*>(arena); }
+
+void* pt_arena_alloc(void* arena, int64_t nbytes) {
+  PT_CAPI_BEGIN
+  return static_cast<Arena*>(arena)->Alloc(static_cast<size_t>(nbytes));
+  PT_CAPI_END(nullptr)
+}
+
+int32_t pt_arena_free(void* arena, void* p) {
+  PT_CAPI_BEGIN
+  static_cast<Arena*>(arena)->Free(p);
+  return 0;
+  PT_CAPI_END(-1)
+}
+
+int32_t pt_arena_stats(void* arena, int64_t* in_use, int64_t* peak,
+                       int64_t* reserved) {
+  PT_CAPI_BEGIN
+  static_cast<Arena*>(arena)->Stats(in_use, peak, reserved);
+  return 0;
+  PT_CAPI_END(-1)
+}
+
+}  // extern "C"

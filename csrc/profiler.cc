@@ -1,0 +1,220 @@
+// Host-side tracing profiler — TPU-native analog of the reference's
+// RecordEvent / EnableProfiler machinery (platform/profiler.h:216,
+// platform/device_tracer.cc) with chrome-trace output.
+//
+// Design: per-thread lock-free event buffers (vector append; the global
+// registry is only touched on thread-first-use), steady-clock nanosecond
+// timestamps, paired push/pop spans plus instant counter events. Device-side
+// activity comes from XLA/jax.profiler (XPlane) — this covers the host spans
+// the reference records around every op/executor run, and merges with the
+// Python-level profiler (paddle_tpu/profiler) which reads these buffers out
+// through the C API.
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+namespace paddle_tpu {
+namespace {
+
+inline int64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Event {
+  // kind: 0 = span begin, 1 = span end, 2 = instant, 3 = counter
+  int32_t kind;
+  int64_t ts_ns;
+  double value;  // counters
+  std::string name;
+};
+
+struct ThreadBuffer {
+  uint64_t tid;
+  std::vector<Event> events;
+  std::mutex mu;  // only contended during Dump
+};
+
+class Profiler {
+ public:
+  static Profiler& Instance() {
+    static Profiler p;
+    return p;
+  }
+
+  void Enable() { enabled_.store(true, std::memory_order_relaxed); }
+  void Disable() { enabled_.store(false, std::memory_order_relaxed); }
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+
+  ThreadBuffer* Local() {
+    static thread_local ThreadBuffer* buf = [this] {
+      auto* b = new ThreadBuffer();
+      b->tid = std::hash<std::thread::id>()(std::this_thread::get_id());
+      std::lock_guard<std::mutex> g(mu_);
+      buffers_.push_back(b);
+      return b;
+    }();
+    return buf;
+  }
+
+  void Record(int32_t kind, const char* name, double value) {
+    // span-ends (kind 1) bypass the enabled check: a span that began while
+    // profiling was on must close even if profiling stopped mid-span, so
+    // B/E events stay balanced (the Python RecordEvent only issues a pop
+    // when its begin pushed)
+    if (!enabled() && kind != 1) return;
+    auto* b = Local();
+    std::lock_guard<std::mutex> g(b->mu);
+    b->events.push_back(Event{kind, NowNs(), value, name ? name : ""});
+  }
+
+  // Chrome trace event format (the reference emits the same via its
+  // profiler.proto → timeline tool); loadable in chrome://tracing /
+  // perfetto alongside jax.profiler XPlane dumps.
+  std::string DumpChromeTrace(bool clear) {
+    std::string out = "{\"traceEvents\":[";
+    bool first = true;
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto* b : buffers_) {
+      std::lock_guard<std::mutex> gb(b->mu);
+      for (auto& e : b->events) {
+        if (!first) out += ",";
+        first = false;
+        char head[160];
+        const char* ph = e.kind == 0   ? "B"
+                         : e.kind == 1 ? "E"
+                         : e.kind == 2 ? "i"
+                                       : "C";
+        std::snprintf(head, sizeof(head),
+                      "{\"ph\":\"%s\",\"pid\":0,\"tid\":%llu,\"ts\":%.3f",
+                      ph, static_cast<unsigned long long>(b->tid % 100000),
+                      e.ts_ns / 1000.0);
+        out += head;
+        if (e.kind != 1) {
+          out += ",\"name\":\"";
+          for (char c : e.name) {
+            if (c == '"' || c == '\\') out += '\\';
+            out += c;
+          }
+          out += "\"";
+        }
+        if (e.kind == 3) {
+          char v[64];
+          std::snprintf(v, sizeof(v), ",\"args\":{\"value\":%g}", e.value);
+          out += v;
+        }
+        out += ",\"cat\":\"host\"}";
+      }
+      if (clear) b->events.clear();
+    }
+    out += "]}";
+    return out;
+  }
+
+  int64_t EventCount() {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t n = 0;
+    for (auto* b : buffers_) {
+      std::lock_guard<std::mutex> gb(b->mu);
+      n += static_cast<int64_t>(b->events.size());
+    }
+    return n;
+  }
+
+ private:
+  std::atomic<bool> enabled_{false};
+  std::mutex mu_;
+  std::vector<ThreadBuffer*> buffers_;
+};
+
+// ---- stat monitor (reference platform/monitor.h StatRegistry) ----------
+class StatRegistry {
+ public:
+  static StatRegistry& Instance() {
+    static StatRegistry r;
+    return r;
+  }
+  void Add(const std::string& name, int64_t v) {
+    std::lock_guard<std::mutex> g(mu_);
+    stats_[name] += v;
+  }
+  int64_t Get(const std::string& name) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = stats_.find(name);
+    return it == stats_.end() ? 0 : it->second;
+  }
+  std::string List() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string out;
+    for (auto& kv : stats_) {
+      if (!out.empty()) out += "\n";
+      out += kv.first + "=" + std::to_string(kv.second);
+    }
+    return out;
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<std::string, int64_t> stats_;
+};
+
+}  // namespace
+}  // namespace paddle_tpu
+
+using paddle_tpu::Profiler;
+using paddle_tpu::StatRegistry;
+
+extern "C" {
+
+void pt_prof_enable() { Profiler::Instance().Enable(); }
+void pt_prof_disable() { Profiler::Instance().Disable(); }
+int32_t pt_prof_enabled() { return Profiler::Instance().enabled() ? 1 : 0; }
+
+void pt_prof_push(const char* name) {
+  Profiler::Instance().Record(0, name, 0.0);
+}
+void pt_prof_pop() { Profiler::Instance().Record(1, nullptr, 0.0); }
+void pt_prof_instant(const char* name) {
+  Profiler::Instance().Record(2, name, 0.0);
+}
+void pt_prof_counter(const char* name, double value) {
+  Profiler::Instance().Record(3, name, value);
+}
+int64_t pt_prof_event_count() { return Profiler::Instance().EventCount(); }
+
+// Returns number of bytes written (including NUL) or required size if buf
+// too small; clear=1 drains buffers.
+int64_t pt_prof_dump_chrome(char* buf, int64_t buflen, int32_t clear) {
+  PT_CAPI_BEGIN
+  std::string s = Profiler::Instance().DumpChromeTrace(clear != 0);
+  int64_t need = static_cast<int64_t>(s.size()) + 1;
+  if (buf == nullptr || buflen < need) return need;
+  std::copy(s.begin(), s.end(), buf);
+  buf[s.size()] = '\0';
+  return need;
+  PT_CAPI_END(-1)
+}
+
+void pt_stat_add(const char* name, int64_t v) {
+  StatRegistry::Instance().Add(name, v);
+}
+int64_t pt_stat_get(const char* name) {
+  return StatRegistry::Instance().Get(name);
+}
+const char* pt_stat_list() {
+  static thread_local std::string out;
+  out = StatRegistry::Instance().List();
+  return out.c_str();
+}
+
+}  // extern "C"

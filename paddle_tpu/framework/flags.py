@@ -48,12 +48,35 @@ for _k in list(_FLAGS):
         _FLAGS[_k] = _coerce(_FLAGS[_k], os.environ[_k])
 
 
+def _native_lib():
+    """The C++ registry (csrc/flags.cc) is the authoritative store when the
+    native runtime is available; this dict then acts as a typed mirror."""
+    from ..core import native
+    lib = native.try_load()
+    if lib is None:
+        return None
+    if not getattr(_native_lib, "_registered", False):
+        for k, v in _FLAGS.items():
+            ty = (0 if isinstance(v, bool) else 1 if isinstance(v, int)
+                  else 2 if isinstance(v, float) else 3)
+            lib.pt_flag_define(k.encode(), ty, str(v).encode(), b"")
+        _native_lib._registered = True
+    return lib
+
+
 def set_flags(flags: dict):
+    lib = _native_lib()
     for k, v in flags.items():
         if k in _FLAGS:
             _FLAGS[k] = _coerce(_FLAGS[k], v)
         else:
             _FLAGS[k] = v
+        if lib is not None:
+            ty = (0 if isinstance(_FLAGS[k], bool)
+                  else 1 if isinstance(_FLAGS[k], int)
+                  else 2 if isinstance(_FLAGS[k], float) else 3)
+            lib.pt_flag_define(k.encode(), ty, str(_FLAGS[k]).encode(), b"")
+            lib.pt_flag_set(k.encode(), str(_FLAGS[k]).encode())
     if "FLAGS_check_nan_inf" in flags:
         from ..core.dispatch import set_debug
         set_debug(check_nan_inf=_FLAGS["FLAGS_check_nan_inf"])

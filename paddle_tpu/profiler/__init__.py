@@ -58,6 +58,26 @@ class _HostEventRecorder:
 _recorder = _HostEventRecorder()
 
 
+# Native span recorder (csrc/profiler.cc) — the C++-side analog of the
+# reference's RecordEvent ring; spans recorded there too so native-runtime
+# internals (DataLoader workers, executors) share one timeline. Resolved
+# once in Profiler.start() (may compile csrc/ on first use); RecordEvent
+# only consults the cached value so the span hot path never blocks.
+_native_lib = None
+
+
+def _native():
+    return _native_lib
+
+
+def _resolve_native():
+    global _native_lib
+    if _native_lib is None:
+        from ..core import native
+        _native_lib = native.try_load()
+    return _native_lib
+
+
 class RecordEvent:
     """platform/profiler.h:216 RecordEvent parity (RAII span). Usable as a
     context manager or decorator; nests into the jax XPlane via
@@ -67,12 +87,17 @@ class RecordEvent:
         self.name = name
         self._start = None
         self._jax_ann = None
+        self._native_pushed = False
 
     def begin(self):
         self._start = time.perf_counter_ns()
         if _recorder.enabled:
             self._jax_ann = jax.profiler.TraceAnnotation(self.name)
             self._jax_ann.__enter__()
+            lib = _native()
+            if lib is not None:
+                lib.pt_prof_push(self.name.encode())
+                self._native_pushed = True
 
     def end(self):
         if self._start is None:
@@ -83,6 +108,14 @@ class RecordEvent:
         if self._jax_ann is not None:
             self._jax_ann.__exit__(None, None, None)
             self._jax_ann = None
+        if self._native_pushed:
+            # pop is honored even if profiling was disabled mid-span
+            # (csrc/profiler.cc records span-ends unconditionally) so B/E
+            # stay balanced in the chrome trace
+            self._native_pushed = False
+            lib = _native()
+            if lib is not None:
+                lib.pt_prof_pop()
         self._start = None
 
     def __enter__(self):
@@ -131,6 +164,10 @@ class Profiler:
     def start(self):
         _recorder.enabled = True
         _recorder.clear()
+        lib = _resolve_native()  # may compile csrc/ once, before any spans
+        if lib is not None:
+            _drain_native(lib)  # discard stale events from prior sessions
+            lib.pt_prof_enable()
         if self._device_trace:
             import tempfile
             self._tmpdir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
@@ -141,6 +178,9 @@ class Profiler:
 
     def stop(self):
         _recorder.enabled = False
+        lib = _native()
+        if lib is not None:
+            lib.pt_prof_disable()
         if self._tmpdir is not None:
             try:
                 jax.profiler.stop_trace()
@@ -173,12 +213,30 @@ class Profiler:
         return self._tmpdir
 
 
+def _drain_native(lib):
+    """Dump-and-clear the native per-thread buffers; returns the native
+    chrome-trace events (possibly empty)."""
+    import ctypes
+    n = lib.pt_prof_dump_chrome(None, 0, 0)
+    buf = ctypes.create_string_buffer(int(n))
+    lib.pt_prof_dump_chrome(buf, n, 1)
+    try:
+        return json.loads(buf.value.decode())["traceEvents"]
+    except Exception:
+        return []
+
+
 def export_chrome_tracing(path, dir_name=None):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    trace = _recorder.chrome_trace()
+    lib = _native()
+    if lib is not None:
+        # merge native-runtime spans (csrc recorder) into the same timeline
+        trace["traceEvents"].extend(_drain_native(lib))
     with open(path, "w") as f:
-        json.dump(_recorder.chrome_trace(), f)
+        json.dump(trace, f)
     return path
 
 
