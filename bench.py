@@ -59,9 +59,12 @@ def bench_bert():
         opt.clear_grad()
         return loss
 
-    # discovery x2 + compile
-    for _ in range(3):
-        step(x, y)
+    # warmup: 2 discovery runs, then compiled calls until the executable
+    # cache settles (the donate variant recompiles once when state buffers
+    # adopt the executable's output layouts)
+    for _ in range(5):
+        loss = step(x, y)
+    loss.item()
     # timed
     t0 = time.time()
     for _ in range(steps):
@@ -99,8 +102,9 @@ def bench_lenet():
         opt.clear_grad()
         return loss
 
-    for _ in range(3):
-        step(x, y)
+    for _ in range(5):
+        loss = step(x, y)
+    loss.item()
     t0 = time.time()
     for _ in range(steps):
         loss = step(x, y)

@@ -72,13 +72,17 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     gouts = grad_outputs if grad_outputs is None or isinstance(grad_outputs, (list, tuple)) else [grad_outputs]
-    retain = bool(retain_graph) if retain_graph is not None else create_graph
+    # NB: builtin bool is shadowed at module level by the dtype export
+    retain = (True if retain_graph else False) if retain_graph is not None \
+        else create_graph
     return grad_for_tensors(outs, ins, gouts, retain_graph=retain,
                             allow_unused=allow_unused)
 
 
 def disable_static(place=None):
-    """Dygraph is the default and only eager mode; kept for API parity."""
+    """Return to dygraph (the default mode)."""
+    from . import static as static_mod
+    static_mod._disable()
     return None
 
 

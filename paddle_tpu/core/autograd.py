@@ -100,6 +100,14 @@ def _reachable_nodes(root_nodes):
     return order
 
 
+# Incremented on every LEAF-ACCUMULATING engine run (Tensor.backward) — not
+# paddle.grad, whose gradient computation is part of a forward (WGAN-GP
+# pattern). to_static discovery reads it to learn whether a traced function
+# performs its own optimizer-style backward (train-step pattern), in which
+# case outer gradient flow through the compiled program is skipped.
+backward_run_counter = [0]
+
+
 def backward(tensors, grad_tensors=None, retain_graph=False,
              accumulate_leaves=True):
     """Run reverse accumulation from `tensors`, writing into leaf `.grad`.
@@ -110,6 +118,9 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
     _grad_capture hook, leaving other leaves' .grad untouched.
     """
     from .tensor import Tensor  # local import to avoid cycle
+
+    if accumulate_leaves:
+        backward_run_counter[0] += 1
 
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)

@@ -30,6 +30,19 @@ def _is_diff_value(v):
 
 _DEBUG = {"check_nan_inf": False, "record_ops": False}
 
+# Static-graph builder (paddle_tpu/static/graph.py). When set, apply() records
+# ops into the current Program instead of executing (framework.py append_op
+# parity); Tensor.backward and Optimizer.minimize also consult it.
+_STATIC_BUILDER = [None]
+
+
+def set_static_builder(builder):
+    _STATIC_BUILDER[0] = builder
+
+
+def get_static_builder():
+    return _STATIC_BUILDER[0]
+
 
 def set_debug(check_nan_inf=None, record_ops=None):
     """Wire FLAGS_check_nan_inf (nan_inf_utils_detail.cc parity: scan outputs
@@ -62,6 +75,8 @@ def apply(prim, *args, name=None, **kwargs):
     - differentiable inputs = Tensor args with inexact dtype and
       stop_gradient=False (while grad mode enabled).
     """
+    if _STATIC_BUILDER[0] is not None:
+        return _STATIC_BUILDER[0].record(prim, args, kwargs, name)
     if _DEBUG["record_ops"]:
         from ..profiler import RecordEvent
         with RecordEvent(name or getattr(prim, "__name__", "op")):
@@ -101,6 +116,10 @@ def _apply_impl(prim, args, kwargs, name):
         _check_finite(out, name or getattr(prim, "__name__", "op"))
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
+    # integer/bool outputs terminate gradient flow (comparisons, argmax...):
+    # no node to record
+    if not any(_is_diff_value(o) for o in outs):
+        return _wrap_outputs(out, stop_gradient=True)
     out_meta = [(o.shape, o.dtype) for o in outs]
     node = GradNode(
         vjp_fn=vjp_fn,

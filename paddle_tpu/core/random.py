@@ -34,6 +34,18 @@ class Generator:
         self._key._value = jax.random.key_data(new_key)
         return sub
 
+    def next_key_data(self):
+        """Split the state; returns the subkey as raw key DATA (uint32
+        array) suitable to pass as an op input — prims re-wrap it with
+        jax.random.wrap_key_data. Under static-graph build this records a
+        generator-split node instead, so each Executor replay draws a fresh
+        key (reference: dropout's seed/generator var in static programs)."""
+        from .dispatch import get_static_builder
+        b = get_static_builder()
+        if b is not None:
+            return b.record_rng(self)
+        return jax.random.key_data(self.next_key())
+
     def get_state(self):
         return Tensor(self._key._value, stop_gradient=True)
 
@@ -52,6 +64,10 @@ def seed(s: int):
 
 def next_key():
     return default_generator.next_key()
+
+
+def next_key_data():
+    return default_generator.next_key_data()
 
 
 def get_state():

@@ -23,11 +23,16 @@ class _TraceHooks:
     """Module-level hooks installed by the jit/to_static functionalizer."""
 
     on_read = None    # fn(tensor) — called when ._value is read
-    on_write = None   # fn(tensor) — called when ._value is assigned
+    on_write = None   # fn(tensor, new_value) — called BEFORE ._value assign
     on_create = None  # fn(tensor) — called from Tensor.__init__
 
 
 class Tensor:
+    # True on static-graph Variables: they are always written inside a traced
+    # region before being read, so to_static discovery must NOT treat them as
+    # captured state (their placeholder value is not a valid jit input)
+    _trace_transparent = False
+
     __slots__ = (
         "_val",
         "grad",
@@ -78,9 +83,11 @@ class Tensor:
 
     @_value.setter
     def _value(self, v):
-        # hook fires BEFORE the write so tracers can snapshot the old value
+        # hook fires BEFORE the write so tracers can snapshot the old value;
+        # the new value is passed so the static builder can record the
+        # assignment as a replayable node
         if _TraceHooks.on_write is not None:
-            _TraceHooks.on_write(self)
+            _TraceHooks.on_write(self, v)
         self._val = v
 
     @property
@@ -161,6 +168,11 @@ class Tensor:
 
     # -- autograd ---------------------------------------------------------------
     def backward(self, grad_tensor=None, retain_graph=False):
+        from .dispatch import get_static_builder
+        b = get_static_builder()
+        if b is not None:  # static-graph build: schedule, don't run
+            b.record_backward(self, retain_graph=retain_graph)
+            return
         autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
 
     def clear_grad(self):

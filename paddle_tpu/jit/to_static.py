@@ -113,13 +113,17 @@ class _DiscoveryCtx:
         self.created_ids.add(id(t))
 
     def on_read(self, t):
+        if t._trace_transparent:
+            return
         i = id(t)
         if i in self.explicit or i in self.created_ids or i in self.captured_ids:
             return
         self.captured_ids.add(i)
         self.captured.append(t)
 
-    def on_write(self, t):
+    def on_write(self, t, new_value=None):
+        if t._trace_transparent:
+            return
         i = id(t)
         if i in self.explicit or i in self.created_ids or i in self.mutated_ids:
             return
@@ -134,7 +138,7 @@ class _DiscoveryCtx:
 
 class _Program:
     __slots__ = ("captured", "mutated", "ro", "jitted", "jitted_donate",
-                 "out_tree", "n_outs", "stage")
+                 "out_tree", "n_outs", "stage", "internal_backward")
 
     def __init__(self):
         self.captured = []
@@ -145,6 +149,10 @@ class _Program:
         self.out_tree = None
         self.n_outs = 0
         self.stage = 0
+        # the traced fn ran its own backward (train-step pattern): outputs
+        # are post-update losses — outer grad flow would re-trace the whole
+        # program per call for a gradient nobody consumes, so skip it
+        self.internal_backward = False
 
 
 class StaticFunction:
@@ -200,6 +208,7 @@ class StaticFunction:
         _TraceHooks.on_read = ctx.on_read
         _TraceHooks.on_write = ctx.on_write
         _TraceHooks.on_create = ctx.on_create
+        bwd_before = autograd.backward_run_counter[0]
         try:
             out = self._fn(*args, **kwargs)
         finally:
@@ -207,6 +216,7 @@ class StaticFunction:
              _TraceHooks.on_create) = prev
         prog = self._programs.get(key) or _Program()
         prog.stage += 1
+        prog.internal_backward = autograd.backward_run_counter[0] > bwd_before
         prog.captured = ctx.captured
         mutated_ids = ctx.mutated_ids & ctx.captured_ids
         prog.mutated = [t for t in ctx.captured if id(t) in mutated_ids]
@@ -232,7 +242,7 @@ class StaticFunction:
             # so no tracer ever leaks out of the trace.
             stray = {}
 
-            def track_write(t):
+            def track_write(t, new_value=None):
                 i = id(t)
                 if i not in all_ids and i not in stray:
                     stray[i] = (t, t._val)
@@ -292,7 +302,7 @@ class StaticFunction:
 
         # does gradient need to flow through this program?
         diff_tensors = []
-        if autograd.is_grad_enabled():
+        if autograd.is_grad_enabled() and not prog.internal_backward:
             for t in list(prog.mutated) + list(prog.ro) + arg_tensors:
                 if (not t.stop_gradient and is_inexact(t._val.dtype)
                         and t._grad_node is None):
@@ -304,6 +314,30 @@ class StaticFunction:
             for t, v in zip(prog.mutated, new_state):
                 t._val = v
             leaves = [Tensor(v, stop_gradient=True) for v in out_vals]
+            if prog.internal_backward and autograd.is_grad_enabled():
+                # the fast path skips outer grad flow; if the caller later
+                # tries to differentiate these outputs, fail loudly instead
+                # of silently yielding zero gradients (GAN-style programs
+                # that both update internally AND return differentiable
+                # outputs should split the function in two)
+                def _raise(*a, **k):
+                    raise RuntimeError(
+                        "cannot differentiate through the output of a "
+                        "to_static function that runs its own backward(): "
+                        "outer gradient flow is disabled for compiled "
+                        "train-step programs. Split the function so the "
+                        "internally-optimized part and the externally-"
+                        "differentiated part are separate to_static "
+                        "functions.")
+                node = GradNode(vjp_fn=_raise, inputs=[],
+                                out_meta=[(v.shape, v.dtype)
+                                          for v in out_vals],
+                                multi_output=True,
+                                name="to_static_internal_backward")
+                for slot, t in enumerate(leaves):
+                    t.stop_gradient = False
+                    t._grad_node = node
+                    t._out_index = slot
             return _unflatten(prog.out_tree, leaves)
 
         # grad path: record the whole program as ONE tape op (run_program-grad

@@ -99,12 +99,15 @@ def prelu(x, weight, data_format="NCHW", name=None):
 
 def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
     if training:
-        from ...core.random import next_key
-        def prim(v):
-            a = jax.random.uniform(next_key(), v.shape, dtype=v.dtype,
+        from ...core.random import next_key_data
+        kd = next_key_data()
+
+        def prim(v, key_data):
+            a = jax.random.uniform(jax.random.wrap_key_data(key_data),
+                                   v.shape, dtype=v.dtype,
                                    minval=lower, maxval=upper)
             return jnp.where(v >= 0, v, a * v)
-        return apply(prim, x, name="rrelu")
+        return apply(prim, x, kd, name="rrelu")
     mid = (lower + upper) / 2.0
     return leaky_relu(x, mid)
 
@@ -175,13 +178,16 @@ def thresholded_relu(x, threshold=1.0, name=None):
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
-    from ...core.random import next_key
-    def prim(v):
-        g = jax.random.gumbel(next_key(), v.shape, dtype=v.dtype)
+    from ...core.random import next_key_data
+    kd = next_key_data()
+
+    def prim(v, key_data):
+        g = jax.random.gumbel(jax.random.wrap_key_data(key_data),
+                              v.shape, dtype=v.dtype)
         y = jax.nn.softmax((v + g) / temperature, axis=axis)
         if hard:
             mx = jnp.max(y, axis=axis, keepdims=True)
             onehot = (y == mx).astype(y.dtype)
             y = jax.lax.stop_gradient(onehot - y) + y
         return y
-    return apply(prim, x, name="gumbel_softmax")
+    return apply(prim, x, kd, name="gumbel_softmax")

@@ -7,7 +7,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core.dispatch import apply, unwrap
-from ...core.random import next_key
+from ...core.random import next_key_data
 from ...core.tensor import Tensor
 
 __all__ = [
@@ -34,9 +34,10 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
         return x
     if p == 1.0:
         return apply(lambda v: jnp.zeros_like(v), x, name="dropout")
-    key = next_key()
+    kd = next_key_data()
 
-    def prim(v):
+    def prim(v, key_data):
+        key = jax.random.wrap_key_data(key_data)
         shape = list(v.shape)
         if axis is not None:
             axes = [axis] if isinstance(axis, int) else list(axis)
@@ -46,7 +47,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
         return jnp.where(keep, v, 0.0).astype(v.dtype)
 
-    return apply(prim, x, name="dropout")
+    return apply(prim, x, kd, name="dropout")
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -62,18 +63,19 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x
-    key = next_key()
+    kd = next_key_data()
     alpha = 1.6732632423543772848170429916717
     scale = 1.0507009873554804934193349852946
     alpha_p = -alpha * scale
 
-    def prim(v):
-        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+    def prim(v, key_data):
+        keep = jax.random.bernoulli(jax.random.wrap_key_data(key_data),
+                                    1.0 - p, v.shape)
         a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2))).astype(np.float32)
         b = -a * alpha_p * p
         return (jnp.where(keep, v, alpha_p) * a + b).astype(v.dtype)
 
-    return apply(prim, x, name="alpha_dropout")
+    return apply(prim, x, kd, name="alpha_dropout")
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):

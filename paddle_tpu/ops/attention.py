@@ -45,10 +45,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     head_dim = qv.shape[-1]
     if scale is None:
         scale = 1.0 / (head_dim ** 0.5)
-    dropout_key = None
+    dropout_kd = None
     if dropout_p > 0.0 and training:
-        from ..core.random import next_key
-        dropout_key = next_key()
+        from ..core.random import next_key_data
+        dropout_kd = next_key_data()
     if not training:
         dropout_p = 0.0
 
@@ -65,13 +65,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             return _flash_attention_diff(q, k, v, is_causal, scale)
         return apply(prim, query, key, value, name="flash_attention")
 
-    def prim(q, k, v, *maybe_mask):
-        m = maybe_mask[0] if maybe_mask else None
-        return _xla_attention(q, k, v, m, scale, is_causal, dropout_p, dropout_key)
+    def prim(q, k, v, *rest):
+        rest = list(rest)
+        kd = rest.pop() if dropout_kd is not None else None
+        m = rest[0] if rest else None
+        dk = jax.random.wrap_key_data(kd) if kd is not None else None
+        return _xla_attention(q, k, v, m, scale, is_causal, dropout_p, dk)
 
-    if attn_mask is not None:
-        return apply(prim, query, key, value, attn_mask, name="sdpa")
-    return apply(prim, query, key, value, name="sdpa")
+    extra = [attn_mask] if attn_mask is not None else []
+    if dropout_kd is not None:
+        extra.append(dropout_kd)
+    return apply(prim, query, key, value, *extra, name="sdpa")
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

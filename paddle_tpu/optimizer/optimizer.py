@@ -126,6 +126,11 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..core.dispatch import get_static_builder
+        b = get_static_builder()
+        if b is not None:  # static-graph build (optimizer.py minimize:1036)
+            b.record_minimize(self, loss)
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -1,92 +1,110 @@
-"""paddle.static shim.
+"""paddle.static: real static-graph mode.
 
-Reference: python/paddle/static — the full ProgramDesc/Executor machinery
-(fluid/framework.py, executor.py). TPU-native position (SURVEY.md §7): the
-static-graph mode's value is whole-graph compilation, which `jit.to_static`
-already delivers via XLA; so `paddle.static` here is a thin compatibility
-facade: `InputSpec`, `data`, `Program` objects that collect a traced callable,
-and an `Executor` that runs compiled programs. Scripts written dygraph-first
-need no change; legacy fully-static scripts need the documented 5-line port to
-to_static.
+Reference: python/paddle/static (fluid/framework.py Program IR,
+fluid/executor.py Executor.run:1078, backward.py append_backward:1406).
+TPU-native: `enable_static()` routes every op into a recorded Program
+(paddle_tpu/static/graph.py); `Executor.run` replays the program as a pure
+function compiled to one cached XLA computation. The op graph is mirrored
+into the native C++ ProgramDesc IR (csrc/graph.cc) for validation, fetch
+pruning (DCE) and serialization.
 """
 from __future__ import annotations
 
 from ..jit.to_static import InputSpec  # noqa: F401
+from .graph import (  # noqa: F401
+    Executor, Program, Variable, append_backward, data, default_main_program,
+    default_startup_program, disable_static_build, enable_static_build,
+    global_scope, in_static_build, program_guard, scope_guard,
+)
+from . import nn  # noqa: F401,E402
 
 _static_mode = [False]
 
 
 def _enable():
     _static_mode[0] = True
+    enable_static_build()
 
 
 def _disable():
     _static_mode[0] = False
+    disable_static_build()
 
 
-class Program:
-    """Placeholder program object (framework.py Program parity at the API
-    level; holds no op graph — graphs live in XLA)."""
-
-    def __init__(self):
-        self._callables = []
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return self
+# paddle.static.amp is an alias of the dygraph amp module in spirit
+# (static/amp/__init__.py:15-21 aliases fluid.contrib.mixed_precision)
+from .. import amp  # noqa: F401,E402
 
 
-_default_main = Program()
-_default_startup = Program()
-
-
-def default_main_program():
-    return _default_main
-
-
-def default_startup_program():
-    return _default_startup
-
-
-class program_guard:
-    def __init__(self, main_program=None, startup_program=None):
-        pass
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        return False
-
-
-def data(name, shape, dtype="float32", lod_level=0):
-    """Static feed placeholder → returns an InputSpec (used with to_static)."""
-    return InputSpec(shape=[s if s and s > 0 else 1 for s in shape],
-                     dtype=dtype, name=name)
-
-
-class Executor:
-    """paddle.static.Executor facade: runs python callables registered as
-    'programs' (full static ProgramDesc execution is intentionally replaced by
-    to_static + XLA; see module docstring)."""
-
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        raise NotImplementedError(
-            "paddle.static.Executor.run: the TPU build executes whole "
-            "programs via jit.to_static-compiled callables; port static "
-            "scripts with paddle_tpu.jit.to_static (see static/__init__.py "
-            "docstring)")
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients parity: schedules backward for the targets and
+    returns fetchable gradient Variables for the inputs."""
+    from .graph import get_builder
+    b = get_builder()
+    if b is None:
+        raise RuntimeError("static.gradients requires paddle.enable_static()")
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    for t in ts:
+        b.record_backward(t)
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return [b.record_grad_read(i) for i in ins]
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
-                         **kwargs):
-    raise NotImplementedError("use paddle_tpu.jit.save")
+                         program=None, **kwargs):
+    """static/io.py save_inference_model parity: persists the native-IR
+    program (binary ProgramDesc) + all persistable tensors it references."""
+    import os
+    import numpy as np
+    from ..framework.io_utils import save as _save_obj
+    prog = program
+    if prog is None:
+        prog = default_main_program()
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(prog.serialize_to_string())
+    # persistables = every concrete tensor the program's ops reference
+    params = {}
+    from .graph import OpNode
+    from ..core.tensor import Tensor
+    from .graph import Variable as _Var
+    for node in prog.nodes:
+        if isinstance(node, OpNode):
+            for a in node.args:
+                if isinstance(a, Tensor) and not isinstance(a, _Var):
+                    params[prog.name_of(a)] = np.asarray(a._val)
+    _save_obj(params, path_prefix + ".pdiparams")
+    meta = {
+        "feed": [getattr(v, "name", None) for v in feed_vars or []],
+        "fetch": [prog.name_of(v) for v in fetch_vars or []],
+    }
+    import json
+    with open(path_prefix + ".pdmodel.meta", "w") as f:
+        json.dump(meta, f)
 
 
 def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError("use paddle_tpu.jit.load")
+    """Returns (program_desc_json, feed_names, fetch_names, params). Full
+    re-execution of a deserialized program requires the original python prims
+    (the reference reloads C++ kernels by op type); the saved artifact here
+    serves the inference Predictor (paddle_tpu.inference) which re-binds
+    prims from the registry where possible."""
+    import json
+    from ..core import native
+    from ..framework.io_utils import load as _load_obj
+    import ctypes
+    lib = native.load()
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        blob = f.read()
+    prog = native.check(lib.pt_prog_deserialize(blob, len(blob)), lib)
+    try:
+        n = native.check(lib.pt_prog_to_json(prog, None, 0), lib)
+        buf = ctypes.create_string_buffer(int(n))
+        native.check(lib.pt_prog_to_json(prog, buf, n), lib)
+        desc = json.loads(buf.value.decode())
+    finally:
+        lib.pt_prog_destroy(prog)
+    params = _load_obj(path_prefix + ".pdiparams")
+    with open(path_prefix + ".pdmodel.meta") as f:
+        meta = json.load(f)
+    return desc, meta["feed"], meta["fetch"], params

@@ -1,0 +1,760 @@
+"""Static-graph mode: record → replay → compile.
+
+Reference parity: python/paddle/fluid/framework.py (Program/Block/Operator/
+Variable python IR builders), executor.py (Executor.run:1078), backward.py
+(append_backward:1406). TPU-native redesign (SURVEY.md §7): the reference
+interprets a protobuf ProgramDesc op-by-op; here `enable_static()` turns every
+`apply()` call into a *recorded node* (no execution), and `Executor.run`
+replays the node list as a pure function that is jit-compiled per feed
+signature — so a static Program executes as exactly one cached XLA
+computation, and backward/optimizer nodes replay through the same tape
+machinery the dygraph mode uses.
+
+The op graph is mirrored into the native C++ ProgramDesc IR (csrc/graph.cc)
+which provides topology validation, dead-op elimination for fetch pruning
+(≈ framework/prune.cc), and the serialized program format.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor, _TraceHooks
+
+__all__ = [
+    "Variable", "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "append_backward",
+    "enable_static_build", "disable_static_build", "in_static_build",
+    "scope_guard", "global_scope",
+]
+
+
+class _AbstractVal:
+    """Placeholder value carried by a not-yet-executed Variable (the static
+    analog of an uninitialized LoDTensor in a Scope)."""
+
+    __slots__ = ("shape", "dtype", "owner")
+
+    def __init__(self, shape, dtype, owner=None):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self.owner = owner
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def __repr__(self):
+        return f"AbstractVal(shape={self.shape}, dtype={self.dtype})"
+
+
+def _aval_of(t):
+    # works for both _AbstractVal placeholders and concrete jax arrays
+    return jax.ShapeDtypeStruct(t._val.shape, t._val.dtype)
+
+
+class Variable(Tensor):
+    """Static-graph variable (framework.py Variable parity): a Tensor whose
+    value is bound during Executor replay."""
+
+    _trace_transparent = True
+
+    __slots__ = ("is_data", "declared_shape", "_feed_name")
+
+    def __init__(self, shape, dtype, name=None, is_data=False):
+        # bypass Tensor.__init__ (no concrete value yet); initialize slots
+        self._val = _AbstractVal([1 if s in (None, -1) else s for s in shape],
+                                 convert_dtype(dtype) or "float32", self)
+        self.grad = None
+        self.stop_gradient = True
+        self._grad_node = None
+        self._out_index = 0
+        self._grad_capture = None
+        self.name = name
+        self.persistable = False
+        self.trainable = False
+        self._hooks = None
+        self.is_data = is_data
+        self.declared_shape = [(-1 if s in (None, -1) else s) for s in shape]
+        self._feed_name = name
+
+    @property
+    def shape(self):
+        return list(self.declared_shape)
+
+    def bind(self, value):
+        self._val = value
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.declared_shape}, "
+                f"dtype={self._val.dtype})")
+
+
+# ---------------------------------------------------------------------------
+# Nodes
+
+class OpNode:
+    __slots__ = ("prim", "args", "kwargs", "outs", "multi", "op_type")
+
+    def __init__(self, prim, args, kwargs, outs, multi, op_type):
+        self.prim = prim
+        self.args = args
+        self.kwargs = kwargs
+        self.outs = outs
+        self.multi = multi
+        self.op_type = op_type
+
+    def execute(self):
+        from ..core.dispatch import apply
+        res = apply(self.prim, *self.args, name=self.op_type, **self.kwargs)
+        rs = res if isinstance(res, (tuple, list)) else (res,)
+        for ov, rt in zip(self.outs, rs):
+            ov._val = rt._val
+            ov._grad_node = rt._grad_node
+            ov._out_index = rt._out_index
+            ov.stop_gradient = rt.stop_gradient
+
+    def var_names(self, namer):
+        ins = [namer(a) for a in self.args if isinstance(a, Tensor)]
+        outs = [namer(o) for o in self.outs]
+        return ins, outs
+
+
+class AssignNode:
+    """Records `target._value = <recorded Variable's value>` writes made by
+    layer code at build time (BN running stats etc.) so replay performs the
+    real update (the static analog of in-place outputs like MeanOut)."""
+
+    __slots__ = ("target", "source")
+
+    def __init__(self, target, source):
+        self.target = target
+        self.source = source
+
+    @property
+    def op_type(self):
+        return "share_data"
+
+    def execute(self):
+        self.target._val = (self.source._val
+                            if isinstance(self.source, Tensor)
+                            else self.source)
+
+    def var_names(self, namer):
+        return [namer(self.source)], [namer(self.target)]
+
+
+class RngNode:
+    """A recorded generator split: replay draws a fresh subkey from the global
+    generator (captured as mutable state by the jit wrapper, so compiled
+    programs still advance the RNG per run)."""
+
+    __slots__ = ("out", "generator")
+
+    def __init__(self, out, generator):
+        self.out = out
+        self.generator = generator
+
+    @property
+    def op_type(self):
+        return "seed_generator"
+
+    def execute(self):
+        sub = self.generator.next_key()
+        self.out._val = jax.random.key_data(sub)
+        self.out._grad_node = None
+        self.out.stop_gradient = True
+
+    def var_names(self, namer):
+        return [], [namer(self.out)]
+
+
+class GradReadNode:
+    """Binds a Variable to `source.grad` after a BackwardNode ran — makes
+    gradients fetchable (reference: append_backward returns grad Variables)."""
+
+    __slots__ = ("out", "source")
+
+    def __init__(self, out, source):
+        self.out = out
+        self.source = source
+
+    @property
+    def op_type(self):
+        return "read_grad"
+
+    def execute(self):
+        g = self.source.grad
+        self.out._val = (g._val if g is not None
+                         else jnp.zeros(self.source._val.shape,
+                                        self.source._val.dtype))
+        self.out._grad_node = None
+        self.out.stop_gradient = True
+
+    def var_names(self, namer):
+        return [namer(self.source) + "@GRAD"], [namer(self.out)]
+
+
+class BackwardNode:
+    __slots__ = ("loss", "retain_graph")
+
+    def __init__(self, loss, retain_graph=False):
+        self.loss = loss
+        self.retain_graph = retain_graph
+
+    @property
+    def op_type(self):
+        return "backward"
+
+    def execute(self):
+        autograd.backward([self.loss], [None],
+                          retain_graph=self.retain_graph)
+
+    def var_names(self, namer):
+        return [namer(self.loss)], [namer(self.loss) + "@BWD"]
+
+
+class MinimizeNode:
+    """opt.minimize(loss) recorded whole (backward + update + grad reset),
+    matching static-graph semantics where gradients are per-run temporaries."""
+
+    __slots__ = ("optimizer", "loss")
+
+    def __init__(self, optimizer, loss):
+        self.optimizer = optimizer
+        self.loss = loss
+
+    @property
+    def op_type(self):
+        return "minimize"
+
+    def execute(self):
+        autograd.backward([self.loss], [None])
+        self.optimizer.step()
+        self.optimizer.clear_grad()
+
+    def var_names(self, namer):
+        return [namer(self.loss)], [namer(self.loss) + "@OPT"]
+
+
+# ---------------------------------------------------------------------------
+# Program
+
+class Program:
+    """framework.py Program parity: an ordered op-node list + var registry."""
+
+    def __init__(self):
+        self.nodes = []
+        self.feed_vars = {}
+        self._name_of = {}       # id(tensor) -> name
+        self._used_names = set()
+        self._name_ct = 0
+        self._exec_cache = {}
+        self._version = 0
+        self.random_seed = None
+
+    # -- build ----------------------------------------------------------------
+    def add_node(self, node):
+        self.nodes.append(node)
+        self._version += 1
+        self._exec_cache.clear()
+
+    def add_feed(self, var):
+        self.feed_vars[var.name] = var
+
+    def name_of(self, t):
+        n = self._name_of.get(id(t))
+        if n is None:
+            if getattr(t, "name", None):
+                n = t.name
+                if n in self._used_names:
+                    n = f"{n}_{self._name_ct}"
+                    self._name_ct += 1
+            else:
+                n = f"tmp_{self._name_ct}"
+                self._name_ct += 1
+            self._name_of[id(t)] = n
+            self._used_names.add(n)
+        return n
+
+    # -- introspection ---------------------------------------------------------
+    def global_block(self):
+        return self
+
+    @property
+    def ops(self):
+        return self.nodes
+
+    def clone(self, for_test=False):
+        """for_test=True strips backward/optimizer nodes (reference
+        Program.clone semantics for eval programs)."""
+        p = Program()
+        p.feed_vars = dict(self.feed_vars)
+        p._name_of = dict(self._name_of)
+        p._used_names = set(self._used_names)
+        p._name_ct = self._name_ct
+        for n in self.nodes:
+            if for_test and isinstance(n, (BackwardNode, MinimizeNode)):
+                continue
+            p.nodes.append(n)
+        return p
+
+    def to_native(self):
+        """Mirror into the C++ ProgramDesc (csrc/graph.cc) — serialization,
+        topology validation and DCE live there."""
+        from ..core import native
+        lib = native.load()
+        import ctypes
+        prog = lib.pt_prog_create()
+        seen_vars = set()
+
+        def ensure_var(name, t=None):
+            if name in seen_vars:
+                return
+            seen_vars.add(name)
+            shape = []
+            dt = -1
+            if t is not None and hasattr(t, "_val"):
+                shape = list(getattr(t._val, "shape", ()) or ())
+                try:
+                    dt = _DTYPE_CODES.get(np.dtype(t._val.dtype).name, -1)
+                except Exception:
+                    dt = -1
+            arr = (ctypes.c_int64 * len(shape))(*[int(s) for s in shape])
+            persistable = 1 if (t is not None and getattr(t, "persistable", False)) else 0
+            native.check(lib.pt_block_add_var(prog, 0, name.encode(), dt, arr,
+                                              len(shape), persistable), lib)
+
+        for idx, node in enumerate(self.nodes):
+            ins, outs = node.var_names(self.name_of)
+            op = native.check(lib.pt_block_add_op(prog, 0,
+                                                  node.op_type.encode()), lib)
+            tensors = {}
+            if isinstance(node, OpNode):
+                tensors = {self.name_of(a): a for a in node.args
+                           if isinstance(a, Tensor)}
+                tensors.update({self.name_of(o): o for o in node.outs})
+            for i, name in enumerate(ins):
+                ensure_var(name, tensors.get(name))
+                native.check(lib.pt_op_add_input(prog, 0, op, b"X%d" % i,
+                                                 name.encode()), lib)
+            for i, name in enumerate(outs):
+                ensure_var(name, tensors.get(name))
+                native.check(lib.pt_op_add_output(prog, 0, op, b"Out%d" % i,
+                                                  name.encode()), lib)
+            # node index attr keys replay order after native-side passes
+            native.check(lib.pt_op_set_attr_int(prog, 0, op, b"idx", idx), lib)
+            if isinstance(node, OpNode):
+                for k, v in node.kwargs.items():
+                    try:
+                        if isinstance(v, bool):
+                            lib.pt_op_set_attr_bool(prog, 0, op, k.encode(),
+                                                    int(v))
+                        elif isinstance(v, int):
+                            lib.pt_op_set_attr_int(prog, 0, op, k.encode(), v)
+                        elif isinstance(v, float):
+                            lib.pt_op_set_attr_float(prog, 0, op, k.encode(), v)
+                        elif isinstance(v, str):
+                            lib.pt_op_set_attr_str(prog, 0, op, k.encode(),
+                                                   v.encode())
+                        elif (isinstance(v, (list, tuple)) and v
+                              and all(isinstance(x, int) for x in v)):
+                            arr = (ctypes.c_int64 * len(v))(*v)
+                            lib.pt_op_set_attr_ints(prog, 0, op, k.encode(),
+                                                    arr, len(v))
+                    except Exception:
+                        pass
+        return prog
+
+    def desc_json(self):
+        from ..core import native
+        import ctypes
+        lib = native.load()
+        prog = self.to_native()
+        try:
+            n = native.check(lib.pt_prog_to_json(prog, None, 0), lib)
+            buf = ctypes.create_string_buffer(int(n))
+            native.check(lib.pt_prog_to_json(prog, buf, n), lib)
+            import json
+            return json.loads(buf.value.decode())
+        finally:
+            lib.pt_prog_destroy(prog)
+
+    def serialize_to_string(self):
+        from ..core import native
+        import ctypes
+        lib = native.load()
+        prog = self.to_native()
+        try:
+            n = native.check(lib.pt_prog_serialize(prog, None, 0), lib)
+            buf = ctypes.create_string_buffer(int(n))
+            native.check(lib.pt_prog_serialize(prog, buf, n), lib)
+            return buf.raw[:n]
+        finally:
+            lib.pt_prog_destroy(prog)
+
+    def live_node_indices(self, fetch_names):
+        """Native DCE: which nodes are needed for these fetches."""
+        from ..core import native
+        import ctypes
+        lib = native.load()
+        prog = self.to_native()
+        try:
+            csv = ",".join(fetch_names).encode()
+            native.check(lib.pt_prog_dce(prog, 0, csv), lib)
+            n = native.check(lib.pt_prog_to_json(prog, None, 0), lib)
+            buf = ctypes.create_string_buffer(int(n))
+            native.check(lib.pt_prog_to_json(prog, buf, n), lib)
+            import json
+            desc = json.loads(buf.value.decode())
+            return sorted(op["attrs"]["idx"] for op in desc["blocks"][0]["ops"])
+        finally:
+            lib.pt_prog_destroy(prog)
+
+    def __str__(self):
+        lines = [f"Program(nodes={len(self.nodes)})"]
+        for i, n in enumerate(self.nodes):
+            ins, outs = n.var_names(self.name_of)
+            lines.append(f"  {i}: {n.op_type}({', '.join(ins)}) -> "
+                         f"{', '.join(outs)}")
+        return "\n".join(lines)
+
+
+_DTYPE_CODES = {
+    "bool": 0, "int16": 1, "int32": 2, "int64": 3, "float16": 4,
+    "float32": 5, "float64": 6, "uint8": 8, "int8": 9, "bfloat16": 10,
+    "complex64": 11, "complex128": 12, "uint32": 13,
+}
+
+
+# ---------------------------------------------------------------------------
+# Builder state
+
+class _Builder:
+    """Active while static mode is on: routes apply() into the current
+    program, sandboxes build-time writes so concrete state (params, BN stats,
+    RNG keys) survives graph construction untouched."""
+
+    def __init__(self):
+        self.main = Program()
+        self.startup = Program()
+        self.guard_stack = []
+        self._snapshots = {}   # id(tensor) -> (tensor, old_val)
+        self._aval_owner = {}  # id(_AbstractVal) -> Variable
+
+    @property
+    def current(self):
+        return self.guard_stack[-1][0] if self.guard_stack else self.main
+
+    # -- sandbox ---------------------------------------------------------------
+    def on_write(self, t, new_value=None):
+        i = id(t)
+        if i not in self._snapshots and not isinstance(t, Variable):
+            self._snapshots[i] = (t, t._val)
+        # record concrete-state updates whose new value came from a recorded
+        # Variable (e.g. BN running-mean write) as replayable assignments
+        if isinstance(new_value, _AbstractVal) and not isinstance(t, Variable):
+            src = new_value.owner
+            if src is not None:
+                self.current.add_node(AssignNode(t, src))
+
+    def flush_sandbox(self):
+        for t, old in self._snapshots.values():
+            t._val = old
+        self._snapshots.clear()
+
+    # -- recording -------------------------------------------------------------
+    def record(self, prim, args, kwargs, name):
+        prog = self.current
+        # shape/dtype inference via abstract evaluation (the infer_shape pass)
+        def shaped(a):
+            if isinstance(a, Tensor):
+                return _aval_of(a)
+            return a
+        try:
+            out_shape = jax.eval_shape(
+                lambda *ts: prim(*ts, **kwargs), *[shaped(a) for a in args])
+        except Exception:
+            # fallback: run on zeros (build-time only, never at steady state)
+            zeros = [jnp.zeros(_aval_of(a).shape, _aval_of(a).dtype)
+                     if isinstance(a, Tensor) else a for a in args]
+            out_shape = jax.eval_shape(lambda *ts: prim(*ts, **kwargs), *zeros)
+        multi = isinstance(out_shape, (tuple, list))
+        outs_aval = list(out_shape) if multi else [out_shape]
+        any_diff = any(isinstance(a, Tensor) and not a.stop_gradient
+                       and jnp.issubdtype(_aval_of(a).dtype, jnp.inexact)
+                       for a in args)
+        out_vars = []
+        for av in outs_aval:
+            v = Variable(av.shape, av.dtype)
+            v.name = prog.name_of(v)
+            v.stop_gradient = not any_diff
+            self._aval_owner[id(v._val)] = v
+            out_vars.append(v)
+        node = OpNode(prim, list(args), dict(kwargs), out_vars, multi,
+                      name or getattr(prim, "__name__", "op"))
+        prog.add_node(node)
+        return tuple(out_vars) if multi else out_vars[0]
+
+    def record_rng(self, generator):
+        # key-data shape/dtype must match what the generator actually stores
+        kd = generator._key._val
+        out = Variable(tuple(kd.shape), np.dtype(kd.dtype))
+        out.name = self.current.name_of(out)
+        self.current.add_node(RngNode(out, generator))
+        return out
+
+    def record_backward(self, loss, retain_graph=False):
+        self.current.add_node(BackwardNode(loss, retain_graph))
+
+    def record_grad_read(self, source):
+        v = Variable(tuple(_aval_of(source).shape), _aval_of(source).dtype)
+        v.name = self.current.name_of(v)
+        self.current.add_node(GradReadNode(v, source))
+        return v
+
+    def record_minimize(self, optimizer, loss):
+        self.current.add_node(MinimizeNode(optimizer, loss))
+
+
+_builder: list[_Builder | None] = [None]
+
+
+def enable_static_build():
+    if _builder[0] is None:
+        _builder[0] = _Builder()
+        from ..core import dispatch
+        dispatch.set_static_builder(_builder[0])
+        _TraceHooks.on_write = _builder[0].on_write
+
+
+def disable_static_build():
+    if _builder[0] is not None:
+        _builder[0].flush_sandbox()
+        _builder[0] = None
+        from ..core import dispatch
+        dispatch.set_static_builder(None)
+        _TraceHooks.on_write = None
+
+
+def in_static_build():
+    return _builder[0] is not None
+
+
+def get_builder():
+    return _builder[0]
+
+
+def default_main_program():
+    if _builder[0] is not None:
+        return _builder[0].main
+    return _FALLBACK_MAIN
+
+
+def default_startup_program():
+    if _builder[0] is not None:
+        return _builder[0].startup
+    return _FALLBACK_STARTUP
+
+
+_FALLBACK_MAIN = Program()
+_FALLBACK_STARTUP = Program()
+
+
+class program_guard:
+    """fluid.program_guard parity: redirect recording to given programs."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self.main = main_program if main_program is not None else Program()
+        self.startup = (startup_program if startup_program is not None
+                        else Program())
+
+    def __enter__(self):
+        if _builder[0] is None:
+            enable_static_build()
+        _builder[0].guard_stack.append((self.main, self.startup))
+        return self
+
+    def __exit__(self, *exc):
+        b = _builder[0]
+        if b is not None and b.guard_stack:
+            b.guard_stack.pop()
+            b.flush_sandbox()
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data parity: declare a feed Variable."""
+    v = Variable(shape, dtype, name=name, is_data=True)
+    if _builder[0] is not None:
+        _builder[0].current.add_feed(v)
+        _builder[0]._aval_owner[id(v._val)] = v
+    return v
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """backward.py append_backward parity: schedule gradient computation for
+    `loss` in the current program; param.grad is populated during replay."""
+    b = _builder[0]
+    if b is None:
+        raise RuntimeError("append_backward requires static mode "
+                           "(paddle.enable_static())")
+    b.record_backward(loss, retain_graph=False)
+    return []
+
+
+# ---------------------------------------------------------------------------
+# Scope shims (framework/scope.h parity at the API level)
+
+class _Scope:
+    def var(self, name):
+        return None
+
+    def find_var(self, name):
+        return None
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Executor
+
+class Executor:
+    """Executor.run parity (fluid/executor.py:1078 → §3.3): replays the
+    program's live nodes (native DCE against the fetch list) as a pure
+    function and executes the cached compiled form."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        if program is None:
+            program = default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if not isinstance(fetch_list, (list, tuple)):
+            fetch_list = [fetch_list]
+        if not program.nodes:  # startup program: params already initialized
+            return []
+        b = _builder[0]
+        if b is not None:
+            b.flush_sandbox()
+
+        # feed validation (the reference Executor raises on missing feeds)
+        unknown = [k for k in feed if k not in program.feed_vars]
+        if unknown:
+            raise KeyError(
+                f"feed contains undeclared variables {unknown}; declared "
+                f"feed targets: {sorted(program.feed_vars)}")
+        missing = [k for k in program.feed_vars if k not in feed]
+        if missing:
+            raise KeyError(f"missing feed values for {missing}")
+
+        fetch_names = [program.name_of(f) for f in fetch_list]
+        sig = (program._version, tuple(fetch_names), tuple(sorted(feed)),
+               tuple((np.asarray(v).shape, str(np.asarray(v).dtype))
+                     for _, v in sorted(feed.items())))
+        entry = program._exec_cache.get(sig)
+        if entry is None:
+            if fetch_names:
+                # side-effect nodes (optimizer/backward/assign/rng) always
+                # replay; their data inputs (e.g. the loss) must survive DCE
+                # even when not fetched, so add them to the root set
+                roots = list(fetch_names)
+                for n in program.nodes:
+                    if isinstance(n, (BackwardNode, MinimizeNode, AssignNode,
+                                      RngNode, GradReadNode)):
+                        ins, _ = n.var_names(program.name_of)
+                        roots.extend(ins)
+                try:
+                    live = set(program.live_node_indices(roots))
+                except Exception:
+                    live = set(range(len(program.nodes)))
+                for i, n in enumerate(program.nodes):
+                    if isinstance(n, (BackwardNode, MinimizeNode, AssignNode,
+                                      RngNode, GradReadNode)):
+                        live.add(i)
+            else:
+                live = set(range(len(program.nodes)))
+            nodes = [n for i, n in enumerate(program.nodes) if i in live]
+            feed_vars = [program.feed_vars[k] for k in sorted(feed)
+                         if k in program.feed_vars]
+
+            # every Variable a node writes: restored after each replay so no
+            # jax tracer from the compile trace can leak into eager state
+            written_vars = list(feed_vars)
+            for n in nodes:
+                if isinstance(n, OpNode):
+                    written_vars.extend(n.outs)
+                elif isinstance(n, (RngNode, GradReadNode)):
+                    written_vars.append(n.out)
+
+            def replay(*feed_vals):
+                # silence static recording so nodes execute eagerly; trace
+                # hooks are left alone — they belong to the enclosing
+                # StaticFunction discovery/compile phases, which need to see
+                # reads (captures) and writes (mutated state) during replay
+                from ..core import dispatch
+                was = dispatch.get_static_builder()
+                dispatch.set_static_builder(None)
+                saved = [(v, v._val, v._grad_node) for v in written_vars]
+                try:
+                    for var, val in zip(feed_vars, feed_vals):
+                        var._val = val._val
+                        var._grad_node = None
+                        var.stop_gradient = True
+                    for node in nodes:
+                        node.execute()
+                    return tuple(Tensor(f._val) for f in fetch_list)
+                finally:
+                    dispatch.set_static_builder(was)
+                    for v, old_val, old_node in saved:
+                        v._val = old_val
+                        v._grad_node = old_node
+
+            from ..jit.to_static import StaticFunction
+            entry = (StaticFunction(replay), feed_vars)
+            program._exec_cache[sig] = entry
+
+        static_fn, feed_vars = entry
+        vals = []
+        for k in sorted(feed):
+            if k in program.feed_vars:
+                v = feed[k]
+                vals.append(Tensor(v._val if isinstance(v, Tensor)
+                                   else jnp.asarray(np.asarray(v))))
+        outs = static_fn(*vals)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        if return_numpy:
+            return [np.asarray(o._val) for o in outs]
+        return list(outs)
+
+    def close(self):
+        pass

@@ -1,0 +1,161 @@
+"""paddle.static.nn: static-graph layer helpers.
+
+Reference: python/paddle/static/nn (fc, batch_norm, embedding, conv2d — thin
+wrappers that append ops with fresh parameters). Here each helper creates the
+corresponding nn.Layer (parameters initialize eagerly = the startup program)
+and calls it, recording its ops into the current Program.
+
+Control flow (fluid/layers/control_flow.py cond:2302 / while_loop:1116) maps
+to lax.cond / lax.while_loop — compiler-friendly data-dependent control flow
+instead of the reference's conditional_block/while sub-block ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["fc", "batch_norm", "embedding", "conv2d", "cond", "while_loop",
+           "case", "switch_case"]
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+       weight_attr=None, bias_attr=None):
+    from .. import nn
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= int(s) if s and s > 0 else 1
+    layer = nn.Linear(in_features, size, weight_attr=weight_attr,
+                      bias_attr=bias_attr)
+    xin = x
+    if len(x.shape) > num_flatten_dims + 1:
+        from ..tensor.manipulation import reshape
+        lead = list(x.shape[:num_flatten_dims])
+        xin = reshape(x, lead + [in_features])
+    out = layer(xin)
+    if activation:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def batch_norm(x, is_test=False, momentum=0.9, epsilon=1e-5,
+               data_layout="NCHW", name=None, **kwargs):
+    from .. import nn
+    ch = int(x.shape[1] if data_layout == "NCHW" else x.shape[-1])
+    layer = nn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                           data_format=data_layout)
+    if is_test:
+        layer.eval()
+    return layer(x)
+
+
+def embedding(x, size, is_sparse=False, padding_idx=None, name=None,
+              param_attr=None):
+    from .. import nn
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=param_attr)
+    return layer(x)
+
+
+def conv2d(x, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, name=None, **kwargs):
+    from .. import nn
+    layer = nn.Conv2D(int(x.shape[1]), num_filters, filter_size,
+                      stride=stride, padding=padding, dilation=dilation,
+                      groups=groups)
+    return layer(x)
+
+
+# ---------------------------------------------------------------------------
+# Control flow (data-dependent, lowered to XLA control-flow ops)
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """fluid/layers/control_flow.py:2302 `cond` parity over lax.cond.
+
+    true_fn/false_fn must return structurally identical outputs (same
+    constraint as the reference)."""
+    t_out = true_fn() if true_fn is not None else None
+    f_out = false_fn() if false_fn is not None else None
+    if t_out is None and f_out is None:
+        return None
+
+    def norm(o):
+        return o if isinstance(o, (tuple, list)) else (o,)
+
+    t_flat, f_flat = norm(t_out), norm(f_out)
+    multi = isinstance(t_out, (tuple, list))
+
+    def prim(p, *branches):
+        n = len(branches) // 2
+        tv, fv = branches[:n], branches[n:]
+        res = jax.lax.cond(jnp.asarray(p).reshape(()).astype(bool),
+                           lambda: tuple(tv), lambda: tuple(fv))
+        return res if len(res) > 1 else res[0]
+
+    out = apply(prim, pred, *t_flat, *f_flat, name="cond")
+    return out if multi or not isinstance(out, tuple) else out[0]
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """fluid/layers/control_flow.py:1116 `while_loop` parity over
+    lax.while_loop. cond_fn/body_fn are traced once (pure functions of the
+    loop vars)."""
+    flat_in = [unwrap(v) if isinstance(v, Tensor) else v for v in loop_vars]
+
+    def prim(*vals):
+        def c(state):
+            r = cond_fn(*[Tensor(s) for s in state])
+            return jnp.asarray(unwrap(r)).reshape(()).astype(bool)
+
+        def b(state):
+            r = body_fn(*[Tensor(s) for s in state])
+            r = r if isinstance(r, (tuple, list)) else (r,)
+            return tuple(unwrap(x).astype(v.dtype).reshape(v.shape)
+                         for x, v in zip(r, state))
+
+        return jax.lax.while_loop(c, b, tuple(vals))
+
+    from ..core import autograd
+    if autograd.is_grad_enabled() and any(
+            isinstance(v, Tensor) and not v.stop_gradient for v in loop_vars):
+        # lax.while_loop has no reverse-mode rule; the reference's While
+        # grad op has no XLA analog. Fail-soft with a loud warning rather
+        # than silently severing gradients.
+        import warnings
+        warnings.warn(
+            "while_loop is not reverse-differentiable on the XLA backend "
+            "(lax.while_loop has no VJP); gradients will not flow through "
+            "the loop. Use a bounded python loop or lax.scan-style "
+            "unrolling for differentiable iteration.", stacklevel=2)
+    with autograd.no_grad():
+        out = apply(prim, *loop_vars, name="while_loop")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """fluid/layers/control_flow.py:2486 parity: first matching predicate."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return fn()
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """switch_case parity over lax.switch-style nesting."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    pairs = []
+    from ..tensor.logic import equal
+    for idx, fn in items:
+        pairs.append((equal(branch_index, idx), fn))
+    return case(pairs, default)

@@ -11,10 +11,15 @@ from ..core.dispatch import apply, unwrap
 from ..core.tensor import Tensor
 
 
-def _defcmp(name, fn):
+def _defcmp(name_, fn):
     def op(x, y, name=None):
+        from ..core.dispatch import get_static_builder
+        if get_static_builder() is not None:  # static mode: record the op
+            from ..core.dispatch import apply
+            return apply(lambda a, b: fn(a, b), x, y, name=name_)
+        # eager fast path: comparisons never carry gradient — skip dispatch
         return Tensor(fn(unwrap(x), unwrap(y)))
-    op.__name__ = name
+    op.__name__ = name_
     return op
 
 
