@@ -4,23 +4,30 @@ TPU-native: recompute = jax.checkpoint (rematerialization) applied to the
 layer function — XLA re-executes the forward inside backward, trading FLOPs
 for HBM exactly like the reference's PyLayer-based rerun, with RNG handled by
 functional keys (no state juggling needed).
+
+Closure parameters (layer weights referenced inside `function`) are
+discovered with an abstract trace (jax.eval_shape + read hooks — no FLOPs)
+and passed to the checkpointed region as explicit differentiable inputs, so
+their gradients flow exactly as in the plain forward.
 """
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 from ...core.dispatch import apply, unwrap
-from ...core.tensor import Tensor
+from ...core.tensor import Tensor, _TraceHooks
 
 __all__ = ["recompute"]
 
 
 def recompute(function, *args, **kwargs):
-    preserve = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("preserve_rng_state", True)
     tensor_args = [a for a in args if isinstance(a, Tensor)]
     other = [(i, a) for i, a in enumerate(args) if not isinstance(a, Tensor)]
+    seen = {id(t) for t in tensor_args}
 
-    def pure(*vals):
+    def rebuild(vals):
         rebuilt = []
         vi = 0
         oi = 0
@@ -29,10 +36,62 @@ def recompute(function, *args, **kwargs):
                 rebuilt.append(other[oi][1])
                 oi += 1
             else:
-                rebuilt.append(Tensor(vals[vi], stop_gradient=False))
+                t = Tensor(vals[vi], stop_gradient=False)
                 vi += 1
-        out = function(*rebuilt, **kwargs)
-        return unwrap(out)
+                # rebuilt arg tensors are per-call wrappers, not closure
+                # state — never admit them into closure_reads (they hold
+                # trace-local tracers)
+                seen.add(id(t))
+                rebuilt.append(t)
+        return rebuilt
+
+    # -- discovery: which closure tensors does `function` read? -------------
+    closure_reads = []
+
+    def on_read(t):
+        if id(t) in seen or t._trace_transparent:
+            return
+        seen.add(id(t))
+        if not t.stop_gradient and jnp.issubdtype(t._val.dtype, jnp.inexact):
+            closure_reads.append(t)
+
+    # abstract-trace writes (RNG splits, BN stats) must not leak tracers
+    # into real state: snapshot old values and restore after discovery
+    written = {}
+
+    def on_write(t, new_value=None):
+        if id(t) not in written:
+            written[id(t)] = (t, t._val)
+
+    from ...core import autograd as _autograd
+    prev = (_TraceHooks.on_read, _TraceHooks.on_write, _TraceHooks.on_create)
+    _TraceHooks.on_read = on_read
+    _TraceHooks.on_write = on_write
+    _TraceHooks.on_create = None
+    try:
+        with _autograd.no_grad():
+            jax.eval_shape(
+                lambda *vals: unwrap(function(*rebuild(vals), **kwargs)),
+                *[jax.ShapeDtypeStruct(t._val.shape, t._val.dtype)
+                  for t in tensor_args])
+    finally:
+        (_TraceHooks.on_read, _TraceHooks.on_write,
+         _TraceHooks.on_create) = prev
+        for t, old in written.values():
+            t._val = old
+
+    n_args = len(tensor_args)
+
+    def pure(*vals):
+        saved = [(t, t._val) for t in closure_reads]
+        try:
+            for t, v in zip(closure_reads, vals[n_args:]):
+                t._val = v
+            out = function(*rebuild(vals[:n_args]), **kwargs)
+            return unwrap(out)
+        finally:
+            for t, v in saved:
+                t._val = v
 
     ckpt = jax.checkpoint(pure)
-    return apply(ckpt, *tensor_args, name="recompute")
+    return apply(ckpt, *tensor_args, *closure_reads, name="recompute")

@@ -1,0 +1,94 @@
+"""Expert-parallel token exchange (python/paddle/distributed/utils.py:57
+global_scatter / global_gather over operators/collective/global_scatter_op.cc).
+
+Reference semantics: tokens are routed to experts living on different ranks —
+`global_scatter(x, local_count, global_count)` sends each rank's tokens for
+expert e to the rank owning e (variable counts over NCCL); `global_gather`
+is the inverse.
+
+TPU-native redesign: XLA requires static shapes, so variable-count sends
+become fixed-capacity buffers (the GShard/Switch formulation): tokens are
+dispatched into a (num_experts, capacity, d) buffer with a one-hot combine
+matrix; inside a jit+shard_map region the expert dimension is sharded over a
+mesh axis and XLA lowers the dispatch einsum into an all-to-all over ICI.
+The functions below implement the capacity-based exchange; MoELayer
+(paddle_tpu.incubate.moe) packages gating + dispatch + expert MLP + combine.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["global_scatter", "global_gather", "dispatch_tokens",
+           "combine_tokens"]
+
+
+def dispatch_tokens(x, expert_idx, num_experts, capacity):
+    """Scatter tokens into a fixed-capacity per-expert buffer.
+
+    x: (N, d) tokens; expert_idx: (N,) int assignment.
+    Returns (buffer (num_experts, capacity, d), combine (N, num_experts,
+    capacity) one-hot weights, overflow mask (N,)). Tokens beyond an
+    expert's capacity are dropped (Switch-Transformer semantics).
+    """
+    def prim(xv, idx):
+        n, d = xv.shape
+        onehot = jax.nn.one_hot(idx, num_experts, dtype=xv.dtype)  # (N, E)
+        # position of each token within its expert's queue
+        pos = jnp.cumsum(onehot, axis=0) * onehot  # (N, E), 1-based
+        pos_in_expert = jnp.sum(pos, axis=1) - 1.0  # (N,)
+        keep = pos_in_expert < capacity
+        pos_clipped = jnp.clip(pos_in_expert, 0, capacity - 1).astype(jnp.int32)
+        combine = (onehot[:, :, None] *
+                   jax.nn.one_hot(pos_clipped, capacity, dtype=xv.dtype)[:, None, :])
+        combine = combine * keep[:, None, None].astype(xv.dtype)
+        buffer = jnp.einsum("nec,nd->ecd", combine, xv)
+        return buffer, combine, keep
+    return apply(prim, x, expert_idx, name="moe_dispatch")
+
+
+def combine_tokens(expert_out, combine):
+    """Gather expert outputs back to token order: (E, C, d), (N, E, C) → (N, d)."""
+    return apply(lambda eo, cb: jnp.einsum("ecd,nec->nd", eo, cb),
+                 expert_out, combine, name="moe_combine")
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Reference-parity entry (distributed/utils.py:57): rearrange local
+    tokens so tokens destined for the same expert are contiguous, returning
+    the receive buffer for this rank's experts.
+
+    Eager semantics (single host): tokens sorted by expert. Inside a
+    jit/shard_map region, the fixed-capacity path (dispatch_tokens) should be
+    used instead; this entry keeps script compatibility.
+    """
+    xv = unwrap(x)
+    lc = jnp.asarray(unwrap(local_count)).astype(jnp.int32)
+
+    def prim(xx, counts):
+        n_chunks = counts.shape[0]
+        # expert id per token from counts via repeat → sort key
+        ids = jnp.repeat(jnp.arange(n_chunks), repeats=counts,
+                         total_repeat_length=xx.shape[0])
+        order = jnp.argsort(ids, stable=True)
+        return jnp.take(xx, order, axis=0)
+
+    return apply(prim, x, lc, name="global_scatter")
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse of global_scatter (reference global_gather_op.cc)."""
+    lc = jnp.asarray(unwrap(local_count)).astype(jnp.int32)
+
+    def prim(xx, counts):
+        n_chunks = counts.shape[0]
+        ids = jnp.repeat(jnp.arange(n_chunks), repeats=counts,
+                         total_repeat_length=xx.shape[0])
+        order = jnp.argsort(ids, stable=True)
+        inv = jnp.argsort(order)
+        return jnp.take(xx, inv, axis=0)
+
+    return apply(prim, x, lc, name="global_gather")

@@ -1,0 +1,7 @@
+"""paddle.incubate parity (python/paddle/incubate: lookahead/modelaverage
+optimizers, fused transformer layers) + TPU-native MoE layer."""
+from . import nn  # noqa: F401
+from .moe import MoELayer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = ["LookAhead", "ModelAverage", "MoELayer", "nn"]

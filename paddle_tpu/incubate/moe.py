@@ -1,0 +1,122 @@
+"""Mixture-of-Experts layer (TPU-native; GShard/Switch formulation).
+
+The reference snapshot ships only the expert-parallel exchange ops
+(global_scatter/global_gather, operators/collective/global_scatter_op.cc) with
+no full MoE layer; this provides the layer the way a TPU framework should:
+top-k gating → fixed-capacity einsum dispatch → per-expert MLP (batched over
+the expert dim) → weighted combine. Under SPMD the expert dimension is
+annotated to shard over the 'expert' (or 'model') mesh axis and XLA lowers
+the dispatch/combine einsums into all-to-alls over ICI.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import apply
+from ..distributed.utils import combine_tokens, dispatch_tokens
+
+__all__ = ["MoELayer"]
+
+
+class MoELayer(nn.Layer):
+    """Top-k gated MoE over d_model → d_hidden → d_model expert MLPs.
+
+    capacity_factor bounds tokens per expert per batch: capacity =
+    ceil(k * N / E * capacity_factor); overflowing tokens pass through
+    (residual) with zero expert contribution (Switch semantics).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2,
+                 capacity_factor=1.25, gate_noise=0.0, expert_axis=None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.top_k = min(top_k, num_experts)
+        self.capacity_factor = capacity_factor
+        self.gate_noise = gate_noise
+        self.expert_axis = expert_axis  # mesh axis name for expert sharding
+        self.gate = nn.Linear(d_model, num_experts, bias_attr=False)
+        # batched expert parameters: (E, d_model, d_hidden) / (E, d_hidden, d_model)
+        import numpy as np
+        from ..core.tensor import Parameter
+        rng = np.random.RandomState(0)
+        scale1 = (2.0 / d_model) ** 0.5
+        scale2 = (2.0 / d_hidden) ** 0.5
+        self.w1 = Parameter(
+            (rng.randn(num_experts, d_model, d_hidden) * scale1)
+            .astype("float32"))
+        self.b1 = Parameter(np.zeros((num_experts, 1, d_hidden), "float32"))
+        self.w2 = Parameter(
+            (rng.randn(num_experts, d_hidden, d_model) * scale2)
+            .astype("float32"))
+        self.b2 = Parameter(np.zeros((num_experts, 1, d_model), "float32"))
+        self.aux_loss = None
+
+    def forward(self, x):
+        # x: (..., d_model) → flatten tokens
+        orig_shape = list(x.shape)
+        n_tokens = 1
+        for s in orig_shape[:-1]:
+            n_tokens *= int(s)
+        xf = x.reshape([n_tokens, self.d_model])
+        E = self.num_experts
+        capacity = max(1, int(self.top_k * n_tokens / E
+                              * self.capacity_factor))
+
+        logits = self.gate(xf)                       # (N, E)
+        probs = nn.functional.softmax(logits, axis=-1)
+
+        # load-balancing auxiliary loss (GShard eq.4): E * sum_e f_e * p_e
+        def aux(pr):
+            me = jnp.mean(pr, axis=0)
+            # fraction of tokens whose argmax is e
+            ce = jnp.mean(jax.nn.one_hot(jnp.argmax(pr, axis=1), E,
+                                         dtype=pr.dtype), axis=0)
+            return jnp.sum(me * ce) * E
+        self.aux_loss = apply(aux, probs, name="moe_aux_loss")
+
+        combined = None
+        residual_w = None
+        for k in range(self.top_k):
+            def topk_idx(pr, kk=k):
+                # k-th choice per token (mask out previous choices)
+                top = jax.lax.top_k(pr, kk + 1)[1]
+                return top[:, kk]
+            idx_k = apply(topk_idx, probs, name=f"moe_top{k}")
+            buf, combine, keep = dispatch_tokens(xf, idx_k, E, capacity)
+            expert_out = self._experts(buf)          # (E, C, d_model)
+            out_k = combine_tokens(expert_out, combine)  # (N, d_model)
+
+            def gate_w(pr, ik, kp):
+                w = jnp.take_along_axis(pr, ik[:, None].astype(jnp.int32),
+                                        axis=1)[:, 0]
+                return (w * kp.astype(pr.dtype))[:, None]
+            w_k = apply(gate_w, probs, idx_k, keep, name="moe_gate_w")
+            term = out_k * w_k
+            combined = term if combined is None else combined + term
+            residual_w = w_k if residual_w is None else residual_w + w_k
+
+        out = combined.reshape(orig_shape)
+        return out
+
+    def _experts(self, buf):
+        """Per-expert MLP batched over E; annotated for expert-axis SPMD."""
+        axis = self.expert_axis
+
+        def prim(b, w1, b1, w2, b2):
+            if axis is not None:
+                try:
+                    from jax.sharding import PartitionSpec as P
+                    b = jax.lax.with_sharding_constraint(
+                        b, P(axis, None, None))
+                except Exception:
+                    pass
+            h = jnp.einsum("ecd,edh->ech", b, w1) + b1
+            h = jax.nn.gelu(h)
+            return jnp.einsum("ech,ehd->ecd", h, w2) + b2
+
+        return apply(prim, buf, self.w1, self.b1, self.w2, self.b2,
+                     name="moe_experts")

@@ -1,0 +1,104 @@
+"""incubate optimizers (python/paddle/incubate/optimizer: lookahead.py,
+modelaverage.py parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """Lookahead wrapper (incubate/optimizer/lookahead.py): every k inner
+    steps, slow weights move alpha of the way toward fast weights and the
+    fast weights are reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    @autograd.no_grad()
+    def step(self):
+        params = self.inner_optimizer._parameter_list or []
+        if not self._slow:
+            for p in params:
+                self._slow[id(p)] = jnp.asarray(p._value)
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._value - slow)
+                self._slow[id(p)] = slow
+                p._value = slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        return {"slow": dict(self._slow), "step": self._step_count}
+
+    def set_state_dict(self, sd):
+        self._slow = dict(sd.get("slow", {}))
+        self._step_count = sd.get("step", 0)
+
+
+class ModelAverage:
+    """Weight averaging (incubate/optimizer/modelaverage.py): maintains a
+    running average of parameters; apply()/restore() swap it in and out for
+    evaluation."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._parameter_list = list(parameters or [])
+        self.rate = average_window_rate
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+        self._sum = {id(p): jnp.zeros_like(p._value)
+                     for p in self._parameter_list}
+        self._count = 0
+        self._backup = None
+
+    @autograd.no_grad()
+    def step(self):
+        for p in self._parameter_list:
+            self._sum[id(p)] = self._sum[id(p)] + p._value
+        self._count += 1
+
+    def minimize(self, loss, **kwargs):
+        self.step()
+        return None, None
+
+    @autograd.no_grad()
+    def apply(self, executor=None, need_restore=True):
+        if self._count == 0:
+            return
+        self._backup = {id(p): jnp.asarray(p._value)
+                        for p in self._parameter_list}
+        for p in self._parameter_list:
+            p._value = (self._sum[id(p)] / self._count).astype(p._value.dtype)
+
+    @autograd.no_grad()
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._parameter_list:
+            p._value = self._backup[id(p)]
+        self._backup = None

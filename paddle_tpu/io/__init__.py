@@ -244,12 +244,42 @@ def get_worker_info():
     return _worker_info
 
 
+def _native_stack(arrays):
+    """Stack same-shaped contiguous arrays via the C++ parallel collate
+    (csrc/io.cc pt_collate_stack); ctypes releases the GIL so large batches
+    copy on all cores. Returns None when the native path doesn't apply."""
+    try:
+        from ..core import native
+        lib = native.try_load()
+    except Exception:
+        return None
+    if lib is None or len(arrays) < 2:
+        return None
+    first = arrays[0]
+    if not all(a.shape == first.shape and a.dtype == first.dtype
+               for a in arrays[1:]):
+        return None
+    if first.nbytes * len(arrays) < (1 << 16):  # small: numpy is fine
+        return None
+    import ctypes
+    arrs = [np.ascontiguousarray(a) for a in arrays]
+    out = np.empty((len(arrs),) + first.shape, dtype=first.dtype)
+    srcs = (ctypes.c_void_p * len(arrs))(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrs])
+    rc = lib.pt_collate_stack(out.ctypes.data_as(ctypes.c_void_p), srcs,
+                              len(arrs), first.nbytes)
+    return out if rc == 0 else None
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (np.ndarray, np.generic)):
-        return Tensor(np.stack(batch))
+        stacked = _native_stack([np.asarray(b) for b in batch])
+        return Tensor(stacked if stacked is not None else np.stack(batch))
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(b._value) for b in batch]))
+        arrs = [np.asarray(b._value) for b in batch]
+        stacked = _native_stack(arrs)
+        return Tensor(stacked if stacked is not None else np.stack(arrs))
     if isinstance(sample, (int, np.integer)):
         return Tensor(np.asarray(batch, dtype=np.int64))
     if isinstance(sample, float):
@@ -318,6 +348,38 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._iter_batches()
             return
+        if self._iterable_ds:
+            yield from self._iter_single_producer()
+            return
+        yield from self._iter_worker_pool()
+
+    def _iter_worker_pool(self):
+        """num_workers fetch+collate batches concurrently with a bounded
+        in-order window (reference: dataloader_iter.py's index-queue worker
+        pool with _order preservation; threads instead of processes — numpy,
+        decode and the native collate all release the GIL)."""
+        from concurrent.futures import ThreadPoolExecutor
+        window = self.prefetch_factor * self.num_workers
+
+        def fetch(indices):
+            samples = [self.dataset[i] for i in indices]
+            return self.collate_fn(samples)
+
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            pending = []
+            it = iter(self.batch_sampler)
+            try:
+                for indices in it:
+                    pending.append(pool.submit(fetch, indices))
+                    if len(pending) >= window:
+                        yield pending.pop(0).result()
+                while pending:
+                    yield pending.pop(0).result()
+            finally:
+                for f in pending:
+                    f.cancel()
+
+    def _iter_single_producer(self):
         q = _queue.Queue(maxsize=self.prefetch_factor * max(self.num_workers, 1))
         stop = object()
         error = []

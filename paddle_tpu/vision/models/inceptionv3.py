@@ -1,0 +1,182 @@
+"""Inception v3 (python/paddle/vision/models/inceptionv3.py parity)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+
+from ... import nn
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, in_ch, pool_features):
+        super().__init__()
+        self.b1x1 = ConvBNLayer(in_ch, 64, 1)
+        self.b5x5_1 = ConvBNLayer(in_ch, 48, 1)
+        self.b5x5_2 = ConvBNLayer(48, 64, 5, padding=2)
+        self.b3x3_1 = ConvBNLayer(in_ch, 64, 1)
+        self.b3x3_2 = ConvBNLayer(64, 96, 3, padding=1)
+        self.b3x3_3 = ConvBNLayer(96, 96, 3, padding=1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bpool = ConvBNLayer(in_ch, pool_features, 1)
+
+    def forward(self, x):
+        return paddle.concat([
+            self.b1x1(x),
+            self.b5x5_2(self.b5x5_1(x)),
+            self.b3x3_3(self.b3x3_2(self.b3x3_1(x))),
+            self.bpool(self.pool(x)),
+        ], axis=1)
+
+
+class InceptionB(nn.Layer):
+    """Grid reduction 35→17."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3x3 = ConvBNLayer(in_ch, 384, 3, stride=2)
+        self.bd_1 = ConvBNLayer(in_ch, 64, 1)
+        self.bd_2 = ConvBNLayer(64, 96, 3, padding=1)
+        self.bd_3 = ConvBNLayer(96, 96, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([
+            self.b3x3(x),
+            self.bd_3(self.bd_2(self.bd_1(x))),
+            self.pool(x),
+        ], axis=1)
+
+
+class InceptionC(nn.Layer):
+    def __init__(self, in_ch, channels_7x7):
+        super().__init__()
+        c7 = channels_7x7
+        self.b1x1 = ConvBNLayer(in_ch, 192, 1)
+        self.b7_1 = ConvBNLayer(in_ch, c7, 1)
+        self.b7_2 = ConvBNLayer(c7, c7, (1, 7), padding=(0, 3))
+        self.b7_3 = ConvBNLayer(c7, 192, (7, 1), padding=(3, 0))
+        self.b7d_1 = ConvBNLayer(in_ch, c7, 1)
+        self.b7d_2 = ConvBNLayer(c7, c7, (7, 1), padding=(3, 0))
+        self.b7d_3 = ConvBNLayer(c7, c7, (1, 7), padding=(0, 3))
+        self.b7d_4 = ConvBNLayer(c7, c7, (7, 1), padding=(3, 0))
+        self.b7d_5 = ConvBNLayer(c7, 192, (1, 7), padding=(0, 3))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bpool = ConvBNLayer(in_ch, 192, 1)
+
+    def forward(self, x):
+        return paddle.concat([
+            self.b1x1(x),
+            self.b7_3(self.b7_2(self.b7_1(x))),
+            self.b7d_5(self.b7d_4(self.b7d_3(self.b7d_2(self.b7d_1(x))))),
+            self.bpool(self.pool(x)),
+        ], axis=1)
+
+
+class InceptionD(nn.Layer):
+    """Grid reduction 17→8."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3_1 = ConvBNLayer(in_ch, 192, 1)
+        self.b3_2 = ConvBNLayer(192, 320, 3, stride=2)
+        self.b7_1 = ConvBNLayer(in_ch, 192, 1)
+        self.b7_2 = ConvBNLayer(192, 192, (1, 7), padding=(0, 3))
+        self.b7_3 = ConvBNLayer(192, 192, (7, 1), padding=(3, 0))
+        self.b7_4 = ConvBNLayer(192, 192, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([
+            self.b3_2(self.b3_1(x)),
+            self.b7_4(self.b7_3(self.b7_2(self.b7_1(x)))),
+            self.pool(x),
+        ], axis=1)
+
+
+class InceptionE(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1x1 = ConvBNLayer(in_ch, 320, 1)
+        self.b3_1 = ConvBNLayer(in_ch, 384, 1)
+        self.b3_2a = ConvBNLayer(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = ConvBNLayer(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_1 = ConvBNLayer(in_ch, 448, 1)
+        self.b3d_2 = ConvBNLayer(448, 384, 3, padding=1)
+        self.b3d_3a = ConvBNLayer(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_3b = ConvBNLayer(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bpool = ConvBNLayer(in_ch, 192, 1)
+
+    def forward(self, x):
+        b3 = self.b3_1(x)
+        b3d = self.b3d_2(self.b3d_1(x))
+        return paddle.concat([
+            self.b1x1(x),
+            paddle.concat([self.b3_2a(b3), self.b3_2b(b3)], axis=1),
+            paddle.concat([self.b3d_3a(b3d), self.b3d_3b(b3d)], axis=1),
+            self.bpool(self.pool(x)),
+        ], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            ConvBNLayer(3, 32, 3, stride=2),
+            ConvBNLayer(32, 32, 3),
+            ConvBNLayer(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            ConvBNLayer(64, 80, 1),
+            ConvBNLayer(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            InceptionA(192, pool_features=32),
+            InceptionA(256, pool_features=64),
+            InceptionA(288, pool_features=64),
+            InceptionB(288),
+            InceptionC(768, channels_7x7=128),
+            InceptionC(768, channels_7x7=160),
+            InceptionC(768, channels_7x7=160),
+            InceptionC(768, channels_7x7=192),
+            InceptionD(768),
+            InceptionE(1280),
+            InceptionE(2048),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.blocks(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.dropout(x.flatten(1))
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled (no network egress)")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,56 @@
+"""ResNeXt (python/paddle/vision/models/resnext.py parity) — expressed over
+the grouped-convolution ResNet backbone (resnet.py BottleneckBlock supports
+groups/base_width)."""
+from __future__ import annotations
+
+from ... import nn
+from .resnet import BottleneckBlock, ResNet
+
+__all__ = ["ResNeXt", "resnext50_32x4d", "resnext50_64x4d",
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+           "resnext152_64x4d"]
+
+_DEPTH_LAYERS = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+
+
+class ResNeXt(ResNet):
+    def __init__(self, depth=50, cardinality=32, width=4, num_classes=1000,
+                 with_pool=True):
+        self.cardinality = cardinality
+        # BottleneckBlock computes group width as planes*(base_width/64)*groups
+        # → base_width=width gives the canonical cardinality×width channels
+        super().__init__(BottleneckBlock, depth=depth, width=width,
+                         num_classes=num_classes, with_pool=with_pool,
+                         groups=cardinality)
+
+
+def _resnext(depth, cardinality, width, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled (no network egress)")
+    return ResNeXt(depth=depth, cardinality=cardinality, width=width,
+                   **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnext(50, 32, 4, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext(50, 64, 4, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext(101, 32, 4, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext(101, 64, 4, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext(152, 32, 4, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext(152, 64, 4, pretrained, **kwargs)

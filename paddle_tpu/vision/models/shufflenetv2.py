@@ -1,0 +1,152 @@
+"""ShuffleNetV2 (python/paddle/vision/models/shufflenetv2.py parity)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+
+from ... import nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = x.reshape([b if b and b > 0 else -1, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([b if b and b > 0 else -1, c, h, w])
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_features = oup // 2
+        if self.stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch_features, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_features),
+                _act(act),
+            )
+            branch2_in = inp
+        else:
+            self.branch1 = None
+            branch2_in = inp // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(branch2_in, branch_features, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_features),
+            _act(act),
+            nn.Conv2D(branch_features, branch_features, 3, stride=stride,
+                      padding=1, groups=branch_features, bias_attr=False),
+            nn.BatchNorm2D(branch_features),
+            nn.Conv2D(branch_features, branch_features, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_features),
+            _act(act),
+        )
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_out = _STAGE_OUT[scale]
+        stage_repeats = [4, 8, 4]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, stage_out[0], 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(stage_out[0]),
+            _act(act),
+        )
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        in_ch = stage_out[0]
+        for stage, repeats in enumerate(stage_repeats):
+            out_ch = stage_out[stage + 1]
+            for i in range(repeats):
+                blocks.append(InvertedResidual(in_ch, out_ch,
+                                               stride=2 if i == 0 else 1,
+                                               act=act))
+                in_ch = out_ch
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_ch, stage_out[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(stage_out[-1]),
+            _act(act),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.blocks(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled (no network egress)")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

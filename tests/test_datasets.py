@@ -1,0 +1,112 @@
+"""Dataset + DataLoader tests (vision + text, native collate, worker pool)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader
+
+
+class TestVisionDatasets:
+    def test_mnist_shapes(self):
+        ds = paddle.vision.datasets.MNIST(mode="train")
+        img, label = ds[0]
+        assert img.shape == (1, 28, 28)
+        assert label.dtype == np.int64
+
+    def test_flowers_and_voc(self):
+        f = paddle.vision.datasets.Flowers(mode="test")
+        img, y = f[3]
+        assert img.shape == (3, 96, 96)
+        voc = paddle.vision.datasets.VOC2012()
+        img, mask = voc[0]
+        assert img.shape == (3, 64, 64)
+        assert mask.shape == (64, 64)
+
+    def test_deterministic(self):
+        a = paddle.vision.datasets.Cifar10(mode="train")
+        b = paddle.vision.datasets.Cifar10(mode="train")
+        ia, _ = a[7]
+        ib, _ = b[7]
+        np.testing.assert_array_equal(ia, ib)
+
+
+class TestTextDatasets:
+    def test_imdb(self):
+        ds = paddle.text.Imdb(mode="train")
+        ids, label = ds[0]
+        assert ids.dtype == np.int64 and label in (0, 1)
+        assert len(ds) > 100
+
+    def test_imikolov_windows(self):
+        ds = paddle.text.Imikolov(window_size=5)
+        sample = ds[0]
+        assert len(sample) == 5
+
+    def test_uci_housing_learnable(self):
+        tr = paddle.text.UCIHousing(mode="train")
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_movielens_and_conll(self):
+        ml = paddle.text.Movielens()
+        s = ml[0]
+        assert len(s) == 8
+        c = paddle.text.Conll05st()
+        words, preds, marks, labels = c[0]
+        assert words.shape == labels.shape
+
+    def test_wmt(self):
+        ds = paddle.text.WMT16(mode="train")
+        src, trg_in, trg_out = ds[0]
+        assert src.shape == trg_in.shape == trg_out.shape
+        assert trg_in[0] == 1  # BOS
+
+
+class TestViterbi:
+    def test_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        B, S, T = 2, 5, 4
+        pot = rng.randn(B, S, T).astype("float32")
+        trans = rng.randn(T, T).astype("float32")
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans))
+        # brute force over all T^S paths
+        import itertools
+        for b in range(B):
+            best, best_path = -1e30, None
+            for p in itertools.product(range(T), repeat=S):
+                s = pot[b, 0, p[0]]
+                for t in range(1, S):
+                    s += trans[p[t - 1], p[t]] + pot[b, t, p[t]]
+                if s > best:
+                    best, best_path = s, p
+            assert float(scores.numpy()[b]) == pytest.approx(best, rel=1e-4)
+            assert list(paths.numpy()[b]) == list(best_path)
+
+
+class TestDataLoaderWorkers:
+    def test_worker_pool_order_and_content(self):
+        ds = paddle.vision.datasets.MNIST(mode="train")
+        dl0 = DataLoader(ds, batch_size=32, shuffle=False, num_workers=0)
+        dl4 = DataLoader(ds, batch_size=32, shuffle=False, num_workers=4)
+        b0 = [np.asarray(x._value) for x, _ in list(dl0)[:5]]
+        b4 = [np.asarray(x._value) for x, _ in list(dl4)[:5]]
+        for a, b in zip(b0, b4):
+            np.testing.assert_array_equal(a, b)
+
+    def test_native_collate_matches_numpy(self):
+        from paddle_tpu.io import _native_stack
+        rng = np.random.RandomState(0)
+        arrays = [rng.randn(64, 64).astype("float32") for _ in range(32)]
+        out = _native_stack(arrays)
+        if out is None:
+            pytest.skip("native runtime unavailable")
+        np.testing.assert_array_equal(out, np.stack(arrays))
+
+    def test_early_break_no_hang(self):
+        ds = paddle.vision.datasets.MNIST(mode="train")
+        dl = DataLoader(ds, batch_size=16, num_workers=2)
+        for i, batch in enumerate(dl):
+            if i == 2:
+                break
+        assert True

@@ -1,0 +1,146 @@
+"""incubate tests: MoE layer, LookAhead/ModelAverage, fused transformer,
+recompute, global_scatter/gather."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestMoE:
+    def test_forward_shape_and_trains(self):
+        paddle.seed(0)
+        moe = paddle.incubate.MoELayer(d_model=16, d_hidden=32,
+                                       num_experts=4, top_k=2,
+                                       capacity_factor=2.0)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=moe.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 10, 16).astype("float32"))
+        tgt = paddle.to_tensor(rng.randn(8, 10, 16).astype("float32"))
+        losses = []
+        for _ in range(5):
+            out = moe(x)
+            assert list(out.shape) == [8, 10, 16]
+            loss = F.mse_loss(out, tgt) + 0.01 * moe.aux_loss
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        assert losses[-1] < losses[0]
+
+    def test_aux_loss_scalar(self):
+        moe = paddle.incubate.MoELayer(16, 32, 4)
+        x = paddle.to_tensor(np.random.randn(4, 16).astype("float32"))
+        moe(x)
+        assert moe.aux_loss is not None
+        assert float(moe.aux_loss.item()) > 0
+
+    def test_under_to_static(self):
+        paddle.seed(0)
+        moe = paddle.incubate.MoELayer(8, 16, 2, top_k=1)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+
+        @paddle.jit.to_static
+        def fwd(xx):
+            with paddle.no_grad():
+                return moe(xx)
+        outs = [np.asarray(fwd(x)._val) for _ in range(4)]
+        np.testing.assert_allclose(outs[2], outs[3], rtol=1e-5)
+
+
+class TestGlobalScatter:
+    def test_scatter_gather_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(10, 4).astype("float32"))
+        counts = paddle.to_tensor(np.array([3, 2, 5], dtype="int64"))
+        from paddle_tpu.distributed.utils import global_gather, global_scatter
+        s = global_scatter(x, counts, counts)
+        g = global_gather(s, counts, counts)
+        np.testing.assert_allclose(np.asarray(g._value),
+                                   np.asarray(x._value), rtol=1e-6)
+
+
+class TestIncubateOptimizers:
+    def _quad_problem(self):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.ones(4, "float32"))
+        w.stop_gradient = False
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.ones(4, "float32"))
+        return p
+
+    def test_lookahead_converges(self):
+        p = self._quad_problem()
+        inner = paddle.optimizer.SGD(learning_rate=0.3, parameters=[p])
+        opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=3)
+        for _ in range(20):
+            loss = (p * p).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(np.abs(np.asarray(p._value)).max()) < 0.2
+
+    def test_model_average_apply_restore(self):
+        p = self._quad_problem()
+        sgd = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        avg = paddle.incubate.ModelAverage(parameters=[p])
+        vals = []
+        for _ in range(5):
+            loss = (p * p).sum()
+            loss.backward()
+            sgd.step()
+            sgd.clear_grad()
+            avg.step()
+            vals.append(np.asarray(p._value).copy())
+        current = np.asarray(p._value).copy()
+        avg.apply()
+        np.testing.assert_allclose(np.asarray(p._value),
+                                   np.mean(vals, axis=0), rtol=1e-5)
+        avg.restore()
+        np.testing.assert_allclose(np.asarray(p._value), current)
+
+
+class TestFusedTransformer:
+    def test_encoder_layer_matches_shapes_and_trains(self):
+        paddle.seed(0)
+        layer = paddle.incubate.nn.FusedTransformerEncoderLayer(
+            d_model=32, nhead=4, dim_feedforward=64, dropout_rate=0.0)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=layer.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 10, 32).astype("float32"))
+        tgt = paddle.to_tensor(rng.randn(2, 10, 32).astype("float32"))
+        losses = []
+        for _ in range(4):
+            out = layer(x)
+            assert list(out.shape) == [2, 10, 32]
+            loss = F.mse_loss(out, tgt)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        assert losses[-1] < losses[0]
+
+
+class TestRecompute:
+    def test_gradient_matches_plain(self):
+        paddle.seed(0)
+        from paddle_tpu.distributed.fleet.utils import recompute
+        lin = paddle.nn.Linear(8, 8)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 8).astype("float32"))
+
+        def block(t):
+            return F.relu(lin(t)).sum()
+
+        loss1 = block(x)
+        loss1.backward()
+        g_plain = np.asarray(lin.weight.grad._value).copy()
+        lin.weight.clear_gradient()
+        lin.bias.clear_gradient()
+
+        loss2 = recompute(block, x)
+        loss2.backward()
+        g_ckpt = np.asarray(lin.weight.grad._value)
+        np.testing.assert_allclose(g_plain, g_ckpt, rtol=1e-5)

@@ -1,0 +1,72 @@
+"""Vision model family tests: forward shapes + one train step per family.
+
+Reference test model: tests/unittests/test_vision_models.py style — build
+each model, run a small input through, check the logit shape.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+# (builder, input hw, kwargs) — small inputs keep CPU CI fast
+CASES = [
+    ("alexnet", 224, {}),
+    ("vgg11", 64, {}),
+    ("mobilenet_v1", 64, {"scale": 0.25}),
+    ("mobilenet_v2", 64, {"scale": 0.25}),
+    ("densenet121", 64, {}),
+    ("inception_v3", 128, {}),
+    ("resnext50_32x4d", 64, {}),
+    ("shufflenet_v2_x0_25", 64, {}),
+    ("squeezenet1_1", 64, {}),
+]
+
+
+@pytest.mark.parametrize("name,hw,kwargs", CASES,
+                         ids=[c[0] for c in CASES])
+def test_forward_shape(name, hw, kwargs):
+    paddle.seed(0)
+    model = getattr(paddle.vision.models, name)(num_classes=10, **kwargs)
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, hw, hw).astype("float32"))
+    with paddle.no_grad():
+        out = model(x)
+    assert list(out.shape) == [2, 10]
+
+
+def test_googlenet_aux_outputs():
+    paddle.seed(0)
+    model = paddle.vision.models.googlenet(num_classes=10)
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 128, 128).astype("float32"))
+    with paddle.no_grad():
+        out, aux1, aux2 = model(x)
+    assert list(out.shape) == [2, 10]
+    assert list(aux1.shape) == [2, 10]
+    assert list(aux2.shape) == [2, 10]
+
+
+def test_small_model_trains():
+    paddle.seed(0)
+    model = paddle.vision.models.shufflenet_v2_x0_25(num_classes=4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, (4,)).astype("int64"))
+    losses = []
+    for _ in range(4):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
+
+
+def test_pretrained_raises():
+    with pytest.raises(NotImplementedError):
+        paddle.vision.models.alexnet(pretrained=True)
