@@ -84,12 +84,30 @@ def recompute(function, *args, **kwargs):
 
     def pure(*vals):
         saved = [(t, t._val) for t in closure_reads]
+        # writes during the traced run (BN running stats, RNG keys) would
+        # store tracers into real state — snapshot and restore them, same as
+        # the discovery pass. State updates inside a recompute block are
+        # therefore dropped (functional purity; the checkpointed region may
+        # re-execute in backward, so double-updates would be wrong anyway).
+        written = {}
+        prev_write = _TraceHooks.on_write
+
+        def on_write(t, new_value=None):
+            if id(t) not in written:
+                written[id(t)] = (t, t._val)
+            if prev_write is not None:
+                prev_write(t, new_value)
+
+        _TraceHooks.on_write = on_write
         try:
             for t, v in zip(closure_reads, vals[n_args:]):
                 t._val = v
             out = function(*rebuild(vals[:n_args]), **kwargs)
             return unwrap(out)
         finally:
+            _TraceHooks.on_write = prev_write
+            for t, old in written.values():
+                t._val = old
             for t, v in saved:
                 t._val = v
 

@@ -36,12 +36,14 @@ def dispatch_tokens(x, expert_idx, num_experts, capacity):
     """
     def prim(xv, idx):
         n, d = xv.shape
-        onehot = jax.nn.one_hot(idx, num_experts, dtype=xv.dtype)  # (N, E)
-        # position of each token within its expert's queue
-        pos = jnp.cumsum(onehot, axis=0) * onehot  # (N, E), 1-based
-        pos_in_expert = jnp.sum(pos, axis=1) - 1.0  # (N,)
+        # queue positions in int32: cumsum in the activation dtype (bf16)
+        # loses integer exactness past 256 tokens per expert
+        onehot_i = jax.nn.one_hot(idx, num_experts, dtype=jnp.int32)  # (N, E)
+        pos = jnp.cumsum(onehot_i, axis=0) * onehot_i  # (N, E), 1-based
+        pos_in_expert = jnp.sum(pos, axis=1) - 1  # (N,) int32
         keep = pos_in_expert < capacity
-        pos_clipped = jnp.clip(pos_in_expert, 0, capacity - 1).astype(jnp.int32)
+        pos_clipped = jnp.clip(pos_in_expert, 0, capacity - 1)
+        onehot = onehot_i.astype(xv.dtype)
         combine = (onehot[:, :, None] *
                    jax.nn.one_hot(pos_clipped, capacity, dtype=xv.dtype)[:, None, :])
         combine = combine * keep[:, None, None].astype(xv.dtype)
@@ -56,39 +58,35 @@ def combine_tokens(expert_out, combine):
                  expert_out, combine, name="moe_combine")
 
 
+def _check_counts(x, local_count):
+    n = unwrap(x).shape[0]
+    total = int(jnp.sum(jnp.asarray(unwrap(local_count))))
+    if total != n:
+        raise ValueError(
+            f"global_scatter/gather: sum(local_count)={total} must equal the "
+            f"token count {n}")
+
+
 def global_scatter(x, local_count, global_count, group=None):
-    """Reference-parity entry (distributed/utils.py:57): rearrange local
-    tokens so tokens destined for the same expert are contiguous, returning
-    the receive buffer for this rank's experts.
+    """Reference-parity entry (distributed/utils.py:57).
 
-    Eager semantics (single host): tokens sorted by expert. Inside a
-    jit/shard_map region, the fixed-capacity path (dispatch_tokens) should be
-    used instead; this entry keeps script compatibility.
+    Reference contract (global_scatter_op.cc): the input is ALREADY grouped
+    by destination expert — local_count[e] tokens for expert e, contiguous —
+    and the op exchanges the variable-size groups between ranks. In the SPMD
+    single-controller model there is no eager cross-rank send: with one
+    process the exchange is the identity on the pre-grouped input (exactly
+    the reference's nranks=1 behavior), which is what this returns after
+    validating the counts. Multi-device expert exchange happens inside
+    jit'ed programs via the fixed-capacity path (dispatch_tokens /
+    MoELayer), where XLA lowers the dispatch einsum to an all-to-all on the
+    expert mesh axis.
     """
-    xv = unwrap(x)
-    lc = jnp.asarray(unwrap(local_count)).astype(jnp.int32)
-
-    def prim(xx, counts):
-        n_chunks = counts.shape[0]
-        # expert id per token from counts via repeat → sort key
-        ids = jnp.repeat(jnp.arange(n_chunks), repeats=counts,
-                         total_repeat_length=xx.shape[0])
-        order = jnp.argsort(ids, stable=True)
-        return jnp.take(xx, order, axis=0)
-
-    return apply(prim, x, lc, name="global_scatter")
+    _check_counts(x, local_count)
+    return apply(lambda xx: xx, x, name="global_scatter")
 
 
 def global_gather(x, local_count, global_count, group=None):
-    """Inverse of global_scatter (reference global_gather_op.cc)."""
-    lc = jnp.asarray(unwrap(local_count)).astype(jnp.int32)
-
-    def prim(xx, counts):
-        n_chunks = counts.shape[0]
-        ids = jnp.repeat(jnp.arange(n_chunks), repeats=counts,
-                         total_repeat_length=xx.shape[0])
-        order = jnp.argsort(ids, stable=True)
-        inv = jnp.argsort(order)
-        return jnp.take(xx, inv, axis=0)
-
-    return apply(prim, x, lc, name="global_gather")
+    """Inverse of global_scatter (reference global_gather_op.cc); identity
+    under single-controller SPMD — see global_scatter."""
+    _check_counts(x, local_count)
+    return apply(lambda xx: xx, x, name="global_gather")

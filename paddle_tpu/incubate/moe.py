@@ -40,19 +40,17 @@ class MoELayer(nn.Layer):
         self.expert_axis = expert_axis  # mesh axis name for expert sharding
         self.gate = nn.Linear(d_model, num_experts, bias_attr=False)
         # batched expert parameters: (E, d_model, d_hidden) / (E, d_hidden, d_model)
-        import numpy as np
-        from ..core.tensor import Parameter
-        rng = np.random.RandomState(0)
-        scale1 = (2.0 / d_model) ** 0.5
-        scale2 = (2.0 / d_hidden) ** 0.5
-        self.w1 = Parameter(
-            (rng.randn(num_experts, d_model, d_hidden) * scale1)
-            .astype("float32"))
-        self.b1 = Parameter(np.zeros((num_experts, 1, d_hidden), "float32"))
-        self.w2 = Parameter(
-            (rng.randn(num_experts, d_hidden, d_model) * scale2)
-            .astype("float32"))
-        self.b2 = Parameter(np.zeros((num_experts, 1, d_model), "float32"))
+        from ..nn import initializer as I
+        self.w1 = self.create_parameter(
+            [num_experts, d_model, d_hidden],
+            default_initializer=I.KaimingNormal(fan_in=d_model))
+        self.b1 = self.create_parameter(
+            [num_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter(
+            [num_experts, d_hidden, d_model],
+            default_initializer=I.KaimingNormal(fan_in=d_hidden))
+        self.b2 = self.create_parameter(
+            [num_experts, 1, d_model], is_bias=True)
         self.aux_loss = None
 
     def forward(self, x):
@@ -99,6 +97,13 @@ class MoELayer(nn.Layer):
             combined = term if combined is None else combined + term
             residual_w = w_k if residual_w is None else residual_w + w_k
 
+        # Switch-style residual: tokens the experts didn't (fully) absorb
+        # pass through scaled by the unapplied gate mass — a fully dropped
+        # token (all top-k over capacity) comes out as x unchanged.
+        def residual(xv, cw):
+            return xv * jnp.clip(1.0 - cw, 0.0, 1.0)
+        combined = combined + apply(residual, xf, residual_w,
+                                    name="moe_residual")
         out = combined.reshape(orig_shape)
         return out
 

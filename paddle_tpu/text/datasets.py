@@ -231,18 +231,28 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         lengths = Tensor(lengths_arr)
 
     def prim(p, trans, lens):
-        def step(carry, emit_t):
-            alpha, backp_dummy = carry
+        lens_i = lens.astype(jnp.int32)  # (B,)
+
+        def step(alpha, inp):
+            emit_t, t = inp
             # alpha: (B, T); score of best path ending in each tag
             scores = alpha[:, :, None] + trans[None, :, :]  # (B, Tprev, T)
             best_prev = jnp.argmax(scores, axis=1)          # (B, T)
             alpha_new = jnp.max(scores, axis=1) + emit_t    # (B, T)
-            return (alpha_new, best_prev), best_prev
+            # sequences already past their length freeze: alpha carries the
+            # final value forward and the backpointer is the identity, so the
+            # backtrace flows the last real tag through the padding
+            active = (t < lens_i)[:, None]
+            alpha_out = jnp.where(active, alpha_new, alpha)
+            ident = jnp.broadcast_to(jnp.arange(T, dtype=best_prev.dtype)
+                                     [None, :], best_prev.shape)
+            backp = jnp.where(active, best_prev, ident)
+            return alpha_out, backp
 
         alpha0 = p[:, 0, :]
         emits = jnp.moveaxis(p[:, 1:, :], 1, 0)  # (S-1, B, T)
-        (alpha_f, _), backps = jax.lax.scan(
-            step, (alpha0, jnp.zeros((B, T), jnp.int32)), emits)
+        alpha_f, backps = jax.lax.scan(
+            step, alpha0, (emits, jnp.arange(1, S)))
         scores = jnp.max(alpha_f, axis=-1)
         last_tag = jnp.argmax(alpha_f, axis=-1)  # (B,)
 

@@ -1,0 +1,75 @@
+"""paddle.utils parity (reference: python/paddle/utils/).
+
+Submodules: download (get_weights_path_from_url), dlpack (to/from_dlpack via
+jax.dlpack), unique_name (fluid/unique_name.py), cpp_extension (JIT-built
+custom C++ ops surfaced as host callbacks inside jitted programs).
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+
+from . import download  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import unique_name  # noqa: F401
+from . import cpp_extension  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+
+__all__ = ["download", "dlpack", "unique_name", "cpp_extension",
+           "try_import", "deprecated", "run_check", "flops"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """paddle.utils.deprecated parity (utils/deprecated.py): warn on call."""
+
+    def decorator(func):
+        msg = f"API {func.__module__}.{func.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use {update_to} instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        if level == 2:
+            raise RuntimeError(msg)
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 1:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__deprecated_message__ = msg
+        return wrapper
+
+    return decorator
+
+
+def run_check():
+    """paddle.utils.run_check parity (utils/install_check.py): verify the
+    framework can run a tiny train step on the current backend."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    dev = paddle.get_device()
+    net = nn.Linear(4, 2)
+    x = paddle.to_tensor(np.ones((2, 4), dtype="float32"))
+    y = net(x).sum()
+    y.backward()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    opt.step()
+    n_dev = paddle.device.device_count()
+    print(f"PaddleTPU works! Device: {dev} ({n_dev} visible device(s)).")
+    if n_dev > 1:
+        print("Multi-device SPMD available via paddle_tpu.distributed.")
+    return True
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """paddle.flops parity — delegates to hapi.dynamic_flops."""
+    from ..hapi.dynamic_flops import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)

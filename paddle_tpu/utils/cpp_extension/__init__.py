@@ -1,0 +1,221 @@
+"""Custom C++ op support (reference parity: python/paddle/utils/cpp_extension
++ paddle/fluid/framework/custom_operator.cc `load_op_library`).
+
+TPU-native design: a custom op is a host C++ function with a plain-C tensor
+ABI (include/paddle_tpu/extension.h). Eagerly it runs on host numpy buffers;
+inside `jit.to_static`/`jax.jit` programs it lowers as `jax.pure_callback`,
+so custom ops compose with XLA programs the way the reference's custom ops
+compose with ProgramDesc. Gradients attach via `register_backward` pairing a
+forward op with a backward op (mirroring the reference's `SetBackwardOp`).
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .extension_utils import (  # noqa: F401
+    build_shared_library, get_build_directory, INCLUDE_DIR,
+)
+
+__all__ = ["load", "load_op_library", "CustomOpModule", "CppExtension",
+           "setup"]
+
+_DTYPE_CODES = {
+    "float32": 0, "float64": 1, "int32": 2, "int64": 3, "bool": 4,
+    "uint8": 5, "int8": 6, "float16": 7, "bfloat16": 8,
+}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+_MAX_NDIM = 8
+
+
+class _PTTensor(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.c_void_p),
+        ("dtype", ctypes.c_int32),
+        ("ndim", ctypes.c_int32),
+        ("shape", ctypes.c_int64 * _MAX_NDIM),
+    ]
+
+
+_OP_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.POINTER(_PTTensor), ctypes.c_int,
+    ctypes.POINTER(_PTTensor), ctypes.c_int)
+
+
+def _descr(arr: np.ndarray) -> _PTTensor:
+    t = _PTTensor()
+    t.data = arr.ctypes.data_as(ctypes.c_void_p)
+    t.dtype = _DTYPE_CODES[str(arr.dtype)]
+    t.ndim = arr.ndim
+    for i, s in enumerate(arr.shape):
+        t.shape[i] = s
+    return t
+
+
+class CustomOp:
+    """One registered op: callable on Tensors/arrays, jit-compatible."""
+
+    def __init__(self, name, cfn, module):
+        self.name = name
+        self._cfn = cfn
+        self._module = module
+        self._backward = None  # (op, which-inputs) gradient binding
+        self.__name__ = name
+
+    def _run_host(self, np_inputs, out_shapes, out_dtypes):
+        np_inputs = [np.ascontiguousarray(a) for a in np_inputs]
+        outs = [np.empty(s, dtype=d) for s, d in zip(out_shapes, out_dtypes)]
+        n_in, n_out = len(np_inputs), len(outs)
+        in_arr = (_PTTensor * max(n_in, 1))(*[_descr(a) for a in np_inputs])
+        out_arr = (_PTTensor * max(n_out, 1))(*[_descr(a) for a in outs])
+        rc = self._cfn(in_arr, n_in, out_arr, n_out)
+        if rc != 0:
+            raise RuntimeError(
+                f"custom op {self.name!r} returned error code {rc}")
+        return outs
+
+    def __call__(self, *inputs, out_shapes=None, out_dtypes=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+
+        vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in inputs]
+        if out_shapes is None:  # default: shape/dtype follow first input
+            out_shapes = [tuple(vals[0].shape)]
+            out_dtypes = [str(vals[0].dtype)]
+        else:
+            out_shapes = [tuple(s) for s in out_shapes]
+            out_dtypes = ([str(vals[0].dtype)] * len(out_shapes)
+                          if out_dtypes is None
+                          else [str(d) for d in out_dtypes])
+        result_specs = [jax.ShapeDtypeStruct(s, np.dtype(d))
+                        for s, d in zip(out_shapes, out_dtypes)]
+
+        def host_fn(*arrs):
+            return tuple(self._run_host(
+                [np.asarray(a) for a in arrs], out_shapes, out_dtypes))
+
+        def prim(*xs):
+            return jax.pure_callback(host_fn, tuple(result_specs), *xs,
+                                     vmap_method="sequential")
+
+        if self._backward is not None:
+            prim = self._attach_grad(prim)
+
+        from ...core.dispatch import apply
+        outs = apply(prim, *inputs, name=self.name)
+        return outs[0] if isinstance(outs, tuple) and len(outs) == 1 else outs
+
+    def _attach_grad(self, prim):
+        """Make prim differentiable: backward runs the paired backward op as
+        another host callback taking (inputs..., grad_outputs...) and
+        returning one gradient per input."""
+        import jax
+
+        bwd_op = self._backward
+
+        @jax.custom_vjp
+        def op(*xs):
+            return prim(*xs)
+
+        def fwd(*xs):
+            return prim(*xs), xs
+
+        def bwd(xs, cts):
+            in_specs = [jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                        for x in xs]
+            in_shapes = [tuple(x.shape) for x in xs]
+            in_dtypes = [str(x.dtype) for x in xs]
+
+            def host_fn(*arrs):
+                return tuple(bwd_op._run_host(
+                    [np.asarray(a) for a in arrs], in_shapes, in_dtypes))
+
+            grads = jax.pure_callback(host_fn, tuple(in_specs),
+                                      *(list(xs) + list(cts)),
+                                      vmap_method="sequential")
+            return tuple(grads)
+
+        op.defvjp(fwd, bwd)
+        return op
+
+    def register_backward(self, backward_op):
+        """Pair with a backward op taking (inputs..., grad_outputs...) and
+        producing one grad per input."""
+        self._backward = backward_op
+        return self
+
+
+class CustomOpModule:
+    """Namespace of ops loaded from one .so (≈ the reference's generated
+    python module per custom-op library)."""
+
+    def __init__(self, name, so_path):
+        self.name = name
+        self.so_path = so_path
+        lib = ctypes.CDLL(so_path)
+        lib.pt_ext_num_ops.restype = ctypes.c_int
+        lib.pt_ext_op_name.restype = ctypes.c_char_p
+        lib.pt_ext_op_name.argtypes = [ctypes.c_int]
+        lib.pt_ext_op_fn.restype = ctypes.c_void_p
+        lib.pt_ext_op_fn.argtypes = [ctypes.c_int]
+        self._lib = lib
+        self._ops = {}
+        for i in range(lib.pt_ext_num_ops()):
+            op_name = lib.pt_ext_op_name(i).decode()
+            cfn = _OP_FN(lib.pt_ext_op_fn(i))
+            op = CustomOp(op_name, cfn, self)
+            self._ops[op_name] = op
+            setattr(self, op_name, op)
+
+    def op_names(self):
+        return sorted(self._ops)
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
+         build_directory=None, verbose=False):
+    """JIT-compile a custom-op library and return its module (reference:
+    cpp_extension.load, utils/cpp_extension/cpp_extension.py:85)."""
+    so_path = build_shared_library(
+        name, sources, extra_cxx_cflags=extra_cxx_cflags,
+        extra_ldflags=extra_ldflags, build_directory=build_directory,
+        verbose=verbose)
+    return CustomOpModule(name, so_path)
+
+
+def load_op_library(so_path):
+    """Load an already-built custom-op .so (reference:
+    fluid.load_op_library / custom_operator.cc LoadOpMetaInfoAndRegisterOp)."""
+    import os
+    return CustomOpModule(os.path.splitext(os.path.basename(so_path))[0],
+                          so_path)
+
+
+class CppExtension:
+    """setuptools-style extension description (reference parity:
+    CppExtension in utils/cpp_extension/cpp_extension.py)."""
+
+    def __init__(self, sources, name=None, extra_compile_args=None,
+                 extra_link_args=None, **kwargs):
+        self.sources = sources
+        self.name = name
+        self.extra_compile_args = extra_compile_args or []
+        self.extra_link_args = extra_link_args or []
+
+
+def setup(name, ext_modules, **kwargs):
+    """Minimal `setup()` analog: builds each CppExtension into the package
+    build dir and returns the loaded modules keyed by name."""
+    if isinstance(ext_modules, CppExtension):
+        ext_modules = [ext_modules]
+    mods = {}
+    for ext in ext_modules:
+        ext_name = ext.name or name
+        mods[ext_name] = load(
+            ext_name, ext.sources,
+            extra_cxx_cflags=ext.extra_compile_args,
+            extra_ldflags=ext.extra_link_args)
+    return mods

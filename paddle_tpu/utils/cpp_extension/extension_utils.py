@@ -1,0 +1,64 @@
+"""Build helpers for custom C++ ops (reference parity:
+python/paddle/utils/cpp_extension/extension_utils.py — compile flags, cache
+dirs, file locks)."""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+
+DEFAULT_BUILD_ROOT = os.path.expanduser("~/.cache/paddle_tpu/extensions")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+INCLUDE_DIR = os.path.join(_HERE, "include")
+
+
+def get_build_directory(name=None):
+    root = os.environ.get("PADDLE_TPU_EXTENSION_DIR", DEFAULT_BUILD_ROOT)
+    path = os.path.join(root, name) if name else root
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _sources_digest(sources, extra):
+    md5 = hashlib.md5()
+    for s in sources:
+        with open(s, "rb") as f:
+            md5.update(f.read())
+    md5.update(" ".join(extra).encode())
+    return md5.hexdigest()[:12]
+
+
+def build_shared_library(name, sources, extra_cxx_cflags=None,
+                         extra_ldflags=None, build_directory=None,
+                         verbose=False):
+    """Compile sources into <build_dir>/<name>.so with g++, cached on a
+    content digest. Returns the .so path."""
+    sources = [os.path.abspath(s) for s in sources]
+    extra_cxx_cflags = list(extra_cxx_cflags or [])
+    extra_ldflags = list(extra_ldflags or [])
+    build_dir = build_directory or get_build_directory(name)
+    os.makedirs(build_dir, exist_ok=True)
+    digest = _sources_digest(sources, extra_cxx_cflags + extra_ldflags)
+    so_path = os.path.join(build_dir, f"{name}.{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cmd = (["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+            f"-I{INCLUDE_DIR}"]
+           + extra_cxx_cflags + ["-o", so_path] + sources + extra_ldflags)
+    if verbose:
+        print("cpp_extension build:", " ".join(cmd))
+    import fcntl
+    lock = so_path + ".lock"
+    with open(lock, "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        try:
+            if not os.path.exists(so_path):
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"cpp_extension compilation of {name} failed:\n"
+                        f"{proc.stderr}")
+        finally:
+            fcntl.flock(lf, fcntl.LOCK_UN)
+    return so_path

@@ -1,0 +1,91 @@
+// paddle_tpu custom-op extension header (reference parity:
+// paddle/extension.h + paddle/fluid/framework/custom_operator.cc, exposed to
+// users through python/paddle/utils/cpp_extension/).
+//
+// TPU-native design: custom C++ ops run on the HOST and are surfaced inside
+// jitted XLA programs as host callbacks (jax.pure_callback). The ABI is a
+// plain-C tensor descriptor so the .so is loadable with ctypes — no pybind11
+// required (not present in this environment).
+//
+// Usage:
+//   #include "paddle_tpu/extension.h"
+//   static int relu2(const PTTensor* ins, int n_in, PTTensor* outs, int n_out) {
+//     const float* x = (const float*)ins[0].data;
+//     float* y = (float*)outs[0].data;            // pre-allocated by caller
+//     for (int64_t i = 0; i < pt_numel(&ins[0]); ++i)
+//       y[i] = x[i] > 0 ? x[i] : 0;
+//     return 0;                                    // nonzero = error
+//   }
+//   PT_REGISTER_OP(relu2, relu2);
+#pragma once
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// dtype codes (match paddle_tpu.core.dtypes ordering used by the loader)
+enum PTDType {
+  PT_FLOAT32 = 0,
+  PT_FLOAT64 = 1,
+  PT_INT32 = 2,
+  PT_INT64 = 3,
+  PT_BOOL = 4,
+  PT_UINT8 = 5,
+  PT_INT8 = 6,
+  PT_FLOAT16 = 7,
+  PT_BFLOAT16 = 8,
+};
+
+typedef struct {
+  void* data;          // host buffer (input: read-only; output: writable)
+  int32_t dtype;       // PTDType
+  int32_t ndim;
+  int64_t shape[8];
+} PTTensor;
+
+typedef int (*PTOpFn)(const PTTensor* inputs, int n_inputs,
+                      PTTensor* outputs, int n_outputs);
+
+}  // extern "C"
+
+inline int64_t pt_numel(const PTTensor* t) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < t->ndim; ++i) n *= t->shape[i];
+  return n;
+}
+
+namespace pt_ext {
+struct Registry {
+  static Registry& Instance() {
+    static Registry r;
+    return r;
+  }
+  std::vector<const char*> names;
+  std::vector<PTOpFn> fns;
+};
+struct Registrar {
+  Registrar(const char* name, PTOpFn fn) {
+    Registry::Instance().names.push_back(name);
+    Registry::Instance().fns.push_back(fn);
+  }
+};
+}  // namespace pt_ext
+
+#define PT_REGISTER_OP(op_name, fn)                                       \
+  static ::pt_ext::Registrar __pt_registrar_##op_name(#op_name, fn)
+
+// Enumeration ABI consumed by the python loader (ctypes). `used` forces
+// emission even though nothing in the .so calls these; extern-"C" inline
+// definitions merge across translation units.
+extern "C" {
+__attribute__((visibility("default"), used)) inline int pt_ext_num_ops() {
+  return (int)::pt_ext::Registry::Instance().names.size();
+}
+__attribute__((visibility("default"), used)) inline const char* pt_ext_op_name(int i) {
+  return ::pt_ext::Registry::Instance().names[(size_t)i];
+}
+__attribute__((visibility("default"), used)) inline PTOpFn pt_ext_op_fn(int i) {
+  return ::pt_ext::Registry::Instance().fns[(size_t)i];
+}
+}

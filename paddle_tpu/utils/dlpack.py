@@ -1,0 +1,68 @@
+"""paddle.utils.dlpack parity (reference: python/paddle/utils/dlpack.py,
+framework/dlpack_tensor.cc). TPU-native: jax arrays speak dlpack natively.
+
+`to_dlpack` returns a DLPack-protocol object (has __dlpack__ and
+__dlpack_device__, delegating to the underlying jax.Array) — consumable by
+torch.from_dlpack / np.from_dlpack / jax.dlpack.from_dlpack, and
+device-correct on TPU. `from_dlpack` ingests protocol objects or legacy raw
+capsules (assumed host-resident).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+class DLPackExporter:
+    """Protocol wrapper around a jax.Array (modern dlpack exchange object)."""
+
+    def __init__(self, array):
+        self._array = array
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._array.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._array.__dlpack_device__()
+
+
+class _CapsuleShim:
+    """Adapts a legacy raw PyCapsule to the protocol (host memory assumed)."""
+
+    kDLCPU = 1
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (self.kDLCPU, 0)
+
+
+def to_dlpack(x):
+    """Export a Tensor for DLPack exchange."""
+    if isinstance(x, Tensor):
+        x = x._value
+    if not isinstance(x, jax.Array):
+        raise TypeError(f"to_dlpack expects a paddle_tpu.Tensor, got {type(x)}")
+    return DLPackExporter(x)
+
+
+def from_dlpack(dlpack):
+    """Import a DLPack-protocol object (torch/numpy/cupy/jax array or
+    to_dlpack result) or a legacy raw capsule as a Tensor."""
+    src = dlpack
+    if type(src).__name__ == "PyCapsule":
+        src = _CapsuleShim(src)
+    if not hasattr(src, "__dlpack__"):
+        raise TypeError(
+            f"from_dlpack expects a DLPack capsule or protocol object, "
+            f"got {type(dlpack)}")
+    arr = jnp.from_dlpack(src)
+    return Tensor(arr, stop_gradient=True)

@@ -1,0 +1,107 @@
+"""paddle.utils.download parity (reference: python/paddle/utils/download.py).
+
+get_weights_path_from_url caches under ~/.cache/paddle_tpu/weights with md5
+verification and decompression, mirroring get_weights_path_from_url /
+get_path_from_url. Supports file:// and local paths so it works in
+air-gapped environments.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import shutil
+import tarfile
+import time
+import zipfile
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle_tpu/weights")
+DOWNLOAD_RETRY_LIMIT = 3
+
+
+def is_url(path):
+    return path.startswith(("http://", "https://", "file://"))
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Download (or copy) weights from url to the weights cache, returning
+    the local path (reference download.py:76)."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True,
+                      decompress=True):
+    """Fetch url into root_dir, verify md5, optionally decompress archives
+    (reference download.py:125)."""
+    fname = osp.split(url)[-1]
+    fullpath = osp.join(root_dir, fname)
+    if osp.exists(fullpath) and check_exist and _md5check(fullpath, md5sum):
+        pass
+    else:
+        fullpath = _download(url, root_dir, md5sum)
+    if decompress and (tarfile.is_tarfile(fullpath)
+                       or zipfile.is_zipfile(fullpath)):
+        fullpath = _decompress(fullpath)
+    return fullpath
+
+
+def _download(url, path, md5sum=None):
+    os.makedirs(path, exist_ok=True)
+    fname = osp.split(url)[-1]
+    fullname = osp.join(path, fname)
+    retry_cnt = 0
+    while not (osp.exists(fullname) and _md5check(fullname, md5sum)):
+        if retry_cnt >= DOWNLOAD_RETRY_LIMIT:
+            raise RuntimeError(
+                f"Download from {url} failed after "
+                f"{DOWNLOAD_RETRY_LIMIT} retries")
+        retry_cnt += 1
+        tmp = fullname + ".tmp"
+        try:
+            if url.startswith("file://"):
+                shutil.copyfile(url[len("file://"):], tmp)
+            elif not is_url(url):
+                shutil.copyfile(url, tmp)
+            else:
+                import urllib.request
+                with urllib.request.urlopen(url, timeout=30) as r, \
+                        open(tmp, "wb") as f:
+                    shutil.copyfileobj(r, f)
+            shutil.move(tmp, fullname)
+        except Exception:
+            if osp.exists(tmp):
+                os.remove(tmp)
+            time.sleep(1)
+            continue
+    return fullname
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return osp.exists(fullname)
+    if not osp.exists(fullname):
+        return False
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _decompress(fname):
+    dirname = osp.dirname(fname)
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as tf:
+            names = tf.getnames()
+            tf.extractall(path=dirname, filter="data")
+    elif zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as zf:
+            names = zf.namelist()
+            zf.extractall(path=dirname)
+    else:
+        raise TypeError(f"Unsupported archive: {fname}")
+    root = names[0].split("/")[0] if names else ""
+    out = osp.join(dirname, root)
+    return out if osp.isdir(out) or osp.isfile(out) else dirname

@@ -1,0 +1,17 @@
+"""paddle.utils.lazy_import parity (utils/lazy_import.py)."""
+from __future__ import annotations
+
+import importlib
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module, raising a friendly ImportError naming the pip
+    package when it is absent (reference: utils/lazy_import.py:21)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        name = module_name.split(".")[0]
+        if err_msg is None:
+            err_msg = (f"Failed to import {module_name}. Install it with "
+                       f"`pip install {name}` to use this feature.")
+        raise ImportError(err_msg) from None

@@ -1,0 +1,54 @@
+"""paddle.utils.unique_name parity (reference: python/paddle/fluid/unique_name.py).
+
+Process-wide generator of unique names keyed by prefix, with guard() for
+scoped isolation and switch() for swapping generators (used by static-graph
+program builders and Layer auto-naming).
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix=""):
+        self.ids = {}
+        self.prefix = prefix
+
+    def __call__(self, key):
+        tmp = self.ids.setdefault(key, 0)
+        self.ids[key] = tmp + 1
+        return "_".join([self.prefix + key, str(tmp)])
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key):
+    return generator(key)
+
+
+def generate_with_ignorable_key(key):
+    return generator(key)
+
+
+def switch(new_generator=None):
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None \
+        else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    elif isinstance(new_generator, bytes):
+        new_generator = UniqueNameGenerator(new_generator.decode())
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

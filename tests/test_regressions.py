@@ -287,3 +287,87 @@ def test_summary_accepts_list_of_shapes():
 
     res = paddle.summary(TwoIn(), [(1, 4), (1, 8)])
     assert res["total_params"] == (4 * 2 + 2) + (8 * 2 + 2)
+
+
+class TestReviewRound2Fixes:
+    """Regressions for the code-review findings fixed alongside the utils
+    package (recompute state writes, viterbi lengths, MoE residual/init,
+    dispatch dtype, VOC split correlation)."""
+
+    def test_recompute_through_stateful_batchnorm(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet.utils import recompute
+        bn = nn.BatchNorm1D(4)
+        bn.train()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4)
+                             .astype("float32"), stop_gradient=False)
+        out = recompute(bn, x)
+        out.sum().backward()
+        # running stats must stay concrete arrays, not leaked tracers
+        mean_val = bn._mean.numpy() if hasattr(bn, "_mean") else None
+        assert x.grad is not None
+        y2 = bn(paddle.to_tensor(np.ones((2, 4), "float32")))
+        assert np.isfinite(y2.numpy()).all()
+
+    def test_viterbi_lengths_respected(self):
+        rng = np.random.RandomState(0)
+        B, S, T = 2, 5, 3
+        pot = rng.randn(B, S, T).astype("float32")
+        trans = rng.randn(T, T).astype("float32")
+        full_s, full_p = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans))
+        # corrupt padding: with lengths=2, emissions at t>=2 must not matter
+        pot2 = pot.copy()
+        pot2[:, 2:, :] = 1e3 * rng.randn(B, S - 2, T)
+        lens = paddle.to_tensor(np.array([2, 2], "int64"))
+        s_a, p_a = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans), lens)
+        s_b, p_b = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot2), paddle.to_tensor(trans), lens)
+        np.testing.assert_allclose(s_a.numpy(), s_b.numpy(), rtol=1e-5)
+        np.testing.assert_array_equal(p_a.numpy()[:, :2], p_b.numpy()[:, :2])
+        # and the truncated score equals decoding the 2-step prefix
+        s_ref, p_ref = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot[:, :2]), paddle.to_tensor(trans))
+        np.testing.assert_allclose(s_a.numpy(), s_ref.numpy(), rtol=1e-5)
+        np.testing.assert_array_equal(p_a.numpy()[:, :2], p_ref.numpy())
+
+    def test_moe_dropped_tokens_pass_through(self):
+        moe = paddle.incubate.MoELayer(d_model=8, d_hidden=16, num_experts=2,
+                                       top_k=1, capacity_factor=0.01)
+        x = paddle.to_tensor(np.random.RandomState(1).randn(8, 8)
+                             .astype("float32"))
+        out = moe(x)
+        # capacity=1 → ≥6 of 8 tokens dropped; they must equal the input
+        diff = np.abs(out.numpy() - x.numpy()).sum(axis=1)
+        n_passthrough = int((diff < 1e-6).sum())
+        assert n_passthrough >= 6, diff
+        assert not np.allclose(out.numpy(), 0.0)
+
+    def test_moe_init_respects_framework_seed(self):
+        paddle.seed(1)
+        m1 = paddle.incubate.MoELayer(8, 16, 2)
+        m2 = paddle.incubate.MoELayer(8, 16, 2)
+        assert not np.allclose(m1.w1.numpy(), m2.w1.numpy())
+        paddle.seed(1)
+        m3 = paddle.incubate.MoELayer(8, 16, 2)
+        np.testing.assert_array_equal(m1.w1.numpy(), m3.w1.numpy())
+
+    def test_dispatch_tokens_int_positions_large_counts(self):
+        from paddle_tpu.distributed.utils import dispatch_tokens
+        n = 600  # > 256 would break bf16 cumsum
+        x = paddle.to_tensor(np.ones((n, 2)).astype("float32"))
+        x = x.astype("bfloat16")
+        idx = paddle.to_tensor(np.zeros(n, "int32"))
+        buf, combine, keep = dispatch_tokens(x, idx, 1, n)
+        assert int(np.asarray(keep.numpy()).sum()) == n
+        # every token occupies a distinct slot
+        slots = combine.numpy().astype("float32").sum(axis=(0, 1))
+        np.testing.assert_allclose(slots, np.ones(n), rtol=0, atol=1e-6)
+
+    def test_voc_splits_not_shifted_duplicates(self):
+        tr = paddle.vision.datasets.VOC2012(mode="train")
+        te = paddle.vision.datasets.VOC2012(mode="test")
+        img_tr, _ = tr[1]
+        img_te, _ = te[0]
+        assert not np.allclose(img_tr, img_te)

@@ -1,0 +1,175 @@
+"""Tests for paddle_tpu.utils: dlpack, download, unique_name, cpp_extension,
+try_import, deprecated, run_check."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import dlpack, download, unique_name
+
+
+class TestDlpack:
+    def test_roundtrip(self):
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+        cap = dlpack.to_dlpack(x)
+        y = dlpack.from_dlpack(cap)
+        np.testing.assert_array_equal(x.numpy(), y.numpy())
+
+    def test_from_numpy_dlpack(self):
+        a = np.arange(6, dtype="float32")
+        y = dlpack.from_dlpack(a)  # numpy has __dlpack__
+        np.testing.assert_array_equal(a, y.numpy())
+
+    def test_torch_interop(self):
+        torch = pytest.importorskip("torch")
+        t = torch.arange(8, dtype=torch.float32)
+        y = dlpack.from_dlpack(t)
+        np.testing.assert_array_equal(t.numpy(), y.numpy())
+
+
+class TestDownload:
+    def test_file_url_and_md5(self, tmp_path):
+        src = tmp_path / "weights.bin"
+        payload = b"0123456789"
+        src.write_bytes(payload)
+        import hashlib
+        md5 = hashlib.md5(payload).hexdigest()
+        out_dir = tmp_path / "cache"
+        p = download.get_path_from_url(f"file://{src}", str(out_dir), md5)
+        assert os.path.exists(p)
+        assert open(p, "rb").read() == payload
+        # second call hits the cache (no error, same path)
+        assert download.get_path_from_url(f"file://{src}", str(out_dir),
+                                          md5) == p
+
+    def test_bad_md5_raises(self, tmp_path):
+        src = tmp_path / "w.bin"
+        src.write_bytes(b"abc")
+        with pytest.raises(RuntimeError):
+            download.get_path_from_url(f"file://{src}",
+                                       str(tmp_path / "c"), "0" * 32)
+
+    def test_tar_decompress(self, tmp_path):
+        import tarfile
+        inner = tmp_path / "model"
+        inner.mkdir()
+        (inner / "a.txt").write_text("hi")
+        tar = tmp_path / "model.tar"
+        with tarfile.open(tar, "w") as tf:
+            tf.add(inner, arcname="model")
+        out = download.get_path_from_url(str(tar), str(tmp_path / "dst"))
+        assert os.path.isdir(out)
+        assert open(os.path.join(out, "a.txt")).read() == "hi"
+
+
+class TestUniqueName:
+    def test_generate_and_guard(self):
+        a = unique_name.generate("fc")
+        b = unique_name.generate("fc")
+        assert a != b
+        with unique_name.guard():
+            c = unique_name.generate("fc")
+        assert c.startswith("fc_0")
+        with unique_name.guard("pre_"):
+            d = unique_name.generate("fc")
+        assert d.startswith("pre_fc")
+
+
+class TestMisc:
+    def test_try_import(self):
+        m = paddle.utils.try_import("math")
+        assert m.sqrt(4) == 2
+        with pytest.raises(ImportError):
+            paddle.utils.try_import("not_a_real_module_xyz")
+
+    def test_deprecated_warns(self):
+        @paddle.utils.deprecated(update_to="new_api", since="0.1", level=1)
+        def old_api():
+            return 7
+
+        with pytest.warns(DeprecationWarning):
+            assert old_api() == 7
+
+    def test_run_check(self, capsys):
+        assert paddle.utils.run_check()
+        assert "works" in capsys.readouterr().out
+
+    def test_flops_alias(self):
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Linear(8, 4), nn.ReLU())
+        n = paddle.flops(net, (1, 8))
+        assert n > 0
+
+
+CPP_SRC = r"""
+#include "paddle_tpu/extension.h"
+#include <cmath>
+
+static int relu2(const PTTensor* ins, int n_in, PTTensor* outs, int n_out) {
+  if (n_in != 1 || n_out != 1) return 1;
+  const float* x = (const float*)ins[0].data;
+  float* y = (float*)outs[0].data;
+  for (int64_t i = 0; i < pt_numel(&ins[0]); ++i)
+    y[i] = x[i] > 0.f ? x[i] : 0.f;
+  return 0;
+}
+PT_REGISTER_OP(relu2, relu2);
+
+// backward: args = (x, grad_y) -> grad_x
+static int relu2_grad(const PTTensor* ins, int n_in, PTTensor* outs, int n_out) {
+  if (n_in != 2 || n_out != 1) return 1;
+  const float* x = (const float*)ins[0].data;
+  const float* gy = (const float*)ins[1].data;
+  float* gx = (float*)outs[0].data;
+  for (int64_t i = 0; i < pt_numel(&ins[0]); ++i)
+    gx[i] = x[i] > 0.f ? gy[i] : 0.f;
+  return 0;
+}
+PT_REGISTER_OP(relu2_grad, relu2_grad);
+"""
+
+
+@pytest.fixture(scope="module")
+def custom_mod(tmp_path_factory):
+    from paddle_tpu.utils import cpp_extension
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "relu2.cc"
+    src.write_text(CPP_SRC)
+    return cpp_extension.load("relu2_lib", [str(src)],
+                              build_directory=str(d))
+
+
+class TestCppExtension:
+    def test_eager_forward(self, custom_mod):
+        assert set(custom_mod.op_names()) == {"relu2", "relu2_grad"}
+        x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0, 4.0], dtype="float32"))
+        y = custom_mod.relu2(x)
+        np.testing.assert_array_equal(y.numpy(), [0, 2, 0, 4])
+
+    def test_backward_through_custom_op(self, custom_mod):
+        custom_mod.relu2.register_backward(custom_mod.relu2_grad)
+        x = paddle.to_tensor(
+            np.array([-1.0, 2.0, -3.0, 4.0], dtype="float32"),
+            stop_gradient=False)
+        y = custom_mod.relu2(x)
+        loss = (y * y).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0, 4, 0, 8], rtol=1e-6)
+
+    def test_inside_jit(self, custom_mod):
+        import jax
+        import jax.numpy as jnp
+        custom_mod.relu2.register_backward(custom_mod.relu2_grad)
+
+        def f(v):
+            t = paddle.to_tensor(v)
+            return custom_mod.relu2(t)._value * 2
+
+        out = jax.jit(f)(jnp.array([-1.0, 3.0], dtype=jnp.float32))
+        np.testing.assert_array_equal(np.asarray(out), [0, 6])
+
+    def test_load_op_library(self, custom_mod):
+        from paddle_tpu.utils import cpp_extension
+        mod2 = cpp_extension.load_op_library(custom_mod.so_path)
+        assert "relu2" in mod2.op_names()
