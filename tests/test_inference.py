@@ -1,0 +1,123 @@
+"""paddle.inference parity tests (SURVEY.md §2.9 — AnalysisPredictor).
+
+Covers: exported StableHLO artifact round-trip (standalone, no model python),
+layer-backed predictor, zero-copy handles, bf16 low-precision mode, jit.save
+round-trip, convert_to_mixed_precision, PredictorPool.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.inference as infer
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+@pytest.fixture()
+def mlp():
+    paddle.seed(7)
+    return _MLP()
+
+
+def test_exported_stablehlo_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    def fn(x, w):
+        return jnp.maximum(x @ w, 0.0)
+
+    x = np.random.RandomState(0).randn(3, 5).astype("float32")
+    w = np.random.RandomState(1).randn(5, 2).astype("float32")
+    prefix = str(tmp_path / "m")
+    infer.save_predictor_model(prefix, fn, (x, w), platforms=["cpu"],
+                               input_names=["x", "w"], output_names=["y"])
+    cfg = infer.Config()
+    cfg.set_exported_model(prefix)
+    p = infer.create_predictor(cfg)
+    assert p.get_input_names() == ["x", "w"]
+    p.get_input_handle("x").copy_from_cpu(x)
+    p.get_input_handle("w").copy_from_cpu(w)
+    assert p.run()
+    out = p.get_output_handle("y").copy_to_cpu()
+    np.testing.assert_allclose(out, np.maximum(x @ w, 0), rtol=1e-5)
+
+
+def test_layer_predictor_matches_eager(mlp):
+    cfg = infer.Config()
+    cfg.set_layer(mlp)
+    p = infer.create_predictor(cfg)
+    x = np.random.RandomState(2).randn(4, 8).astype("float32")
+    out = p.run([x])[0]
+    ref = mlp(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # second run hits the jit cache
+    out2 = p.run([x])[0]
+    np.testing.assert_allclose(out2, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_low_precision_bf16(mlp):
+    cfg = infer.Config()
+    cfg.set_layer(mlp)
+    cfg.enable_low_precision()
+    p = infer.create_predictor(cfg)
+    x = np.random.RandomState(3).randn(2, 8).astype("float32")
+    out = p.run([x])[0]
+    assert str(out.dtype) == "bfloat16"
+    ref = mlp(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out.astype("float32"), ref, rtol=0.1, atol=0.1)
+
+
+def test_jit_save_roundtrip(mlp, tmp_path):
+    prefix = str(tmp_path / "jitm")
+    paddle.jit.save(mlp, prefix)
+    cfg = infer.Config()
+    cfg.set_jit_model(prefix, _MLP)
+    p = infer.create_predictor(cfg)
+    x = np.random.RandomState(4).randn(2, 8).astype("float32")
+    out = p.run([x])[0]
+    ref = mlp(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_convert_to_mixed_precision(tmp_path):
+    from paddle_tpu.framework.io_utils import load as load_obj
+    from paddle_tpu.framework.io_utils import save as save_obj
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    save_obj({"w": np.ones((2, 2), "float32"),
+              "idx": np.arange(3, dtype="int64")}, src + ".pdiparams")
+    infer.convert_to_mixed_precision(src, dst, "bf16")
+    out = load_obj(dst + ".pdiparams")
+    assert str(np.asarray(out["w"]).dtype) in ("bfloat16", "float32")
+    assert np.asarray(out["idx"]).dtype == np.int64
+
+
+def test_predictor_pool(mlp):
+    cfg = infer.Config()
+    cfg.set_layer(mlp)
+    pool = infer.PredictorPool(cfg, size=2)
+    x = np.random.RandomState(5).randn(1, 8).astype("float32")
+    a = pool.retrieve(0).run([x])[0]
+    b = pool.retrieve(1).run([x])[0]
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_config_summary_and_switches():
+    cfg = infer.Config()
+    cfg.enable_use_gpu(100, 0)
+    cfg.switch_ir_optim(True)
+    cfg.enable_memory_optim()
+    cfg.enable_mkldnn()
+    cfg.enable_tensorrt_engine(precision_mode=infer.DataType.FLOAT16)
+    assert cfg.use_gpu()
+    assert cfg._precision == infer.DataType.BFLOAT16
+    assert "tpu" in cfg.summary()
