@@ -44,6 +44,7 @@ class Tensor:
         "persistable",
         "trainable",
         "_hooks",
+        "dist_attr",   # auto_parallel annotation (DistAttr), set lazily
         "__weakref__",
     )
 
@@ -180,7 +181,9 @@ class Tensor:
 
     def clear_gradient(self, set_to_zero=False):
         if set_to_zero and self.grad is not None:
-            self.grad = Tensor(jnp.zeros_like(self.grad._val), stop_gradient=True)
+            # zero in place (hooked write): keeps the grad object stable so
+            # compiled programs can treat it as mutated state
+            self.grad._value = jnp.zeros_like(self.grad._val)
         else:
             self.grad = None
 
@@ -196,7 +199,10 @@ class Tensor:
         if self.grad is None:
             self.grad = Tensor(g, stop_gradient=True)
         else:
-            self.grad = Tensor(self.grad._val + g, stop_gradient=True)
+            # accumulate IN PLACE on the existing grad tensor (hooked write):
+            # gradient-merge/no-clear flows keep `.grad` alive across compiled
+            # programs, so the object must stay stable for state capture
+            self.grad._value = self.grad._value + g
 
     def register_hook(self, hook):
         """Gradient hook on a leaf (imperative/hooks.h parity)."""
