@@ -62,6 +62,8 @@ from . import text  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import slim  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from .utils import flops  # noqa: F401,E402
 from .framework import io_utils as _io_utils  # noqa: F401,E402

@@ -61,10 +61,12 @@ class ProcessMesh:
         self._ids = arr
         self._dim_names = tuple(dim_names)
         devices = jax.devices()
-        if arr.size and int(arr.max()) >= len(devices):
+        if arr.size and (int(arr.max()) >= len(devices)
+                         or int(arr.min()) < 0):
             raise ValueError(
-                f"mesh references process id {int(arr.max())} but only "
-                f"{len(devices)} devices are visible")
+                f"mesh references process ids in "
+                f"[{int(arr.min())}, {int(arr.max())}] but valid ids are "
+                f"[0, {len(devices) - 1}]")
         dev_arr = np.empty(arr.shape, dtype=object)
         for idx in np.ndindex(arr.shape):
             dev_arr[idx] = devices[int(arr[idx])]
@@ -205,20 +207,28 @@ def dtensor_from_fn(fn, process_mesh, shard_spec, *args, **kwargs):
     from ...core.tensor import _TraceHooks
 
     # probe: discover written framework state (snapshot + restore so the
-    # abstract trace leaves no tracers behind) and the output aval
-    written, snap = [], {}
+    # abstract trace leaves no tracers behind) and the output aval. Tensors
+    # CREATED inside the probe are not framework state — in-place init on
+    # them (fill_/zero_) must not capture their tracer values.
+    written, snap, created = [], {}, set()
+
+    def track_create(t):
+        created.add(id(t))
 
     def track_write(t, new_value=None):
+        if id(t) in created:
+            return
         if id(t) not in snap:
             snap[id(t)] = (t, t._val)
             written.append(t)
 
-    prev = _TraceHooks.on_write
+    prev = (_TraceHooks.on_write, _TraceHooks.on_create)
     _TraceHooks.on_write = track_write
+    _TraceHooks.on_create = track_create
     try:
         probe = jax.eval_shape(lambda: _raw(fn(*args, **kwargs)))
     finally:
-        _TraceHooks.on_write = prev
+        _TraceHooks.on_write, _TraceHooks.on_create = prev
         for t, v in snap.values():
             t._val = v
 
@@ -337,17 +347,34 @@ class Engine:
 
     def _shard_batch(self, pm, *tensors):
         axis = self._data_axis(pm)
+        deg = pm.get_dim_size(axis)
         out = []
         for t in tensors:
-            spec = [axis] + [None] * (t._val.ndim - 1)
-            out.append(shard_tensor(t, pm, spec))
+            if t._val.ndim == 0 or t._val.shape[0] % deg != 0:
+                # partial final batch (or scalar): keep replicated rather
+                # than fail the NamedSharding divisibility constraint
+                out.append(t)
+            else:
+                spec = [axis] + [None] * (t._val.ndim - 1)
+                out.append(shard_tensor(t, pm, spec))
         return tuple(out)
+
+    @staticmethod
+    def _xy(batch, who):
+        if isinstance(batch, (tuple, list)) and len(batch) == 2:
+            return batch[0], batch[1]
+        raise ValueError(
+            f"Engine.{who} needs (x, y) batches; got a "
+            f"{type(batch).__name__} — pass (inputs, labels) or a loader "
+            f"yielding pairs (bare arrays are only valid for predict())")
 
     def prepare(self, *args, **kwargs):
         """Apply strategy knobs ahead of the first step. amp → auto_cast in
         the train step; sharding → ZeRO optimizer-state sharding over the
         mesh; gradient_merge → step the optimizer every k_steps. Knobs with
         no wiring raise rather than silently no-op."""
+        if self._prepared:
+            return self
         s = self.strategy
         if s.pipeline.enable:
             raise NotImplementedError(
@@ -361,7 +388,7 @@ class Engine:
                 "Engine cannot rewrite a constructed Layer")
         if s.sharding.enable and self.optimizer is not None:
             from ..fleet.sharding_optimizer import ShardingOptimizerWrapper
-            from ..mesh import set_mesh
+            from ..mesh import _STATE, set_mesh
             pm = self._mesh()
             axis = pm.dim_names[0]
             if pm.get_dim_size(axis) <= 1:
@@ -369,11 +396,24 @@ class Engine:
                     f"strategy.sharding.enable needs a mesh axis with degree "
                     f">1 to shard over; '{axis}' has degree "
                     f"{pm.get_dim_size(axis)}")
-            # ZeRO shards optimizer state over the data axis of THIS mesh
-            set_mesh(pm.jax_mesh)
+            # ZeRO shards optimizer state over the data axis of THIS mesh.
+            # Never clobber an existing global mesh (e.g. a hybrid dp×mp
+            # mesh built by fleet) — reuse it when compatible, else refuse.
+            cur = _STATE.get("mesh")
+            if cur is None:
+                set_mesh(pm.jax_mesh)
+            elif axis not in cur.axis_names or \
+                    cur.devices.shape[cur.axis_names.index(axis)] != \
+                    pm.get_dim_size(axis):
+                raise ValueError(
+                    f"a global mesh {cur.axis_names}×{cur.devices.shape} is "
+                    f"already active and lacks axis '{axis}' with degree "
+                    f"{pm.get_dim_size(axis)}; build the Engine mesh to "
+                    f"match it or reset the global mesh first")
             self.optimizer = ShardingOptimizerWrapper(
                 self.optimizer, axis=axis,
                 shard_params=(int(s.sharding.stage) >= 3))
+        self._prepared = True
         return self
 
     def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
@@ -381,9 +421,7 @@ class Engine:
         import paddle_tpu as paddle
         pm = self._mesh()
         engine = self
-        if not self._prepared:
-            self.prepare()
-            self._prepared = True
+        self.prepare()
 
         if self._step_fn is None:
             amp_on = bool(self.strategy.amp.enable)
@@ -429,7 +467,7 @@ class Engine:
         losses = []
         for epoch in range(epochs):
             for i, batch in enumerate(_iter_batches(train_data, batch_size)):
-                x, y = batch[0], batch[1]
+                x, y = self._xy(batch, "fit")
                 x, y = self._shard_batch(pm, _as_tensor(x), _as_tensor(y))
                 loss = self._step_fn(x, y)
                 losses.append(float(loss.item()))
@@ -452,27 +490,39 @@ class Engine:
             self._eval_fn = estep
 
         total, n = 0.0, 0
-        for i, batch in enumerate(_iter_batches(eval_data, batch_size)):
-            x, y = self._shard_batch(pm, _as_tensor(batch[0]),
-                                     _as_tensor(batch[1]))
-            total += float(self._eval_fn(x, y).item())
-            n += 1
-            if steps and i + 1 >= steps:
-                break
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            for i, batch in enumerate(_iter_batches(eval_data, batch_size)):
+                bx, by = self._xy(batch, "evaluate")
+                x, y = self._shard_batch(pm, _as_tensor(bx), _as_tensor(by))
+                total += float(self._eval_fn(x, y).item())
+                n += 1
+                if steps and i + 1 >= steps:
+                    break
+        finally:
+            if was_training:
+                self.model.train()
         return {"eval_loss": total / max(n, 1)}
 
     def predict(self, data, batch_size=None, steps=None):
         import paddle_tpu as paddle
         pm = self._mesh()
         outs = []
-        for i, batch in enumerate(_iter_batches(data, batch_size)):
-            x = _as_tensor(batch[0] if isinstance(batch, (tuple, list))
-                           else batch)
-            (x,) = self._shard_batch(pm, x)
-            with paddle.no_grad():
-                outs.append(self.model(x))
-            if steps and i + 1 >= steps:
-                break
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            for i, batch in enumerate(_iter_batches(data, batch_size)):
+                x = _as_tensor(batch[0] if isinstance(batch, (tuple, list))
+                               else batch)
+                (x,) = self._shard_batch(pm, x)
+                with paddle.no_grad():
+                    outs.append(self.model(x))
+                if steps and i + 1 >= steps:
+                    break
+        finally:
+            if was_training:
+                self.model.train()
         return outs
 
     def cost(self, mode="train"):
@@ -486,14 +536,24 @@ def _as_tensor(v):
 
 
 def _iter_batches(data, batch_size):
-    """Accept a DataLoader-like iterable, a (x, y) numpy pair, or a list of
-    batches."""
-    if hasattr(data, "__iter__") and not isinstance(data, (tuple, list)):
-        yield from data
+    """Accept a DataLoader-like iterable, a single array (x only), an (x, y)
+    TUPLE pair, or a list of prepared batches. Disambiguation rules: a bare
+    ndarray is one dataset to be sliced by batch_size (never iterated
+    row-by-row); only a 2-TUPLE of equal-length arrays is an (x, y) pair — a
+    list is always a list of batches."""
+    if hasattr(data, "shape"):  # single array dataset
+        x = np.asarray(data)
+        bs = batch_size or len(x)
+        for i in range(0, len(x), bs):
+            yield x[i:i + bs]
         return
-    if (isinstance(data, (tuple, list)) and len(data) == 2
-            and hasattr(data[0], "shape")):
+    if (isinstance(data, tuple) and len(data) == 2
+            and hasattr(data[0], "shape") and hasattr(data[1], "shape")):
         x, y = np.asarray(data[0]), np.asarray(data[1])
+        if x.ndim == 0 or y.ndim == 0 or len(x) != len(y):
+            raise ValueError(
+                f"(x, y) pair with mismatched lengths: {x.shape} vs "
+                f"{y.shape}")
         bs = batch_size or len(x)
         for i in range(0, len(x), bs):
             yield x[i:i + bs], y[i:i + bs]

@@ -27,3 +27,5 @@ from . import sequence_parallel  # noqa: F401,E402
 from . import sharding_optimizer  # noqa: F401,E402
 from . import spmd_pipeline  # noqa: F401,E402
 from .utils import recompute  # noqa: F401,E402
+from . import fs  # noqa: F401,E402  (fleet.utils.fs parity)
+from .fs import HDFSClient, LocalFS  # noqa: F401,E402

@@ -3,5 +3,7 @@ optimizers, fused transformer layers) + TPU-native MoE layer."""
 from . import nn  # noqa: F401
 from .moe import MoELayer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .. import sparsity as asp  # noqa: F401  (fluid.contrib.sparsity parity)
+from . import checkpoint  # noqa: F401  (fluid.incubate.checkpoint parity)
 
-__all__ = ["LookAhead", "ModelAverage", "MoELayer", "nn"]
+__all__ = ["LookAhead", "ModelAverage", "MoELayer", "nn", "asp", "checkpoint"]

@@ -1,0 +1,165 @@
+"""Auto-checkpoint: transparent periodic train-state snapshot + resume.
+
+Reference: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py (687
+LoC) + checkpoint_saver.py — `train_epoch_range(n)` yields epoch indices,
+snapshots executor/program state to an FS between epochs keyed by
+job-id + program hash, and on relaunch resumes from the last saved epoch.
+
+TPU-native: state is state_dicts (Layers/Optimizers registered via
+`register`), storage goes through the fleet FS abstraction
+(distributed/fleet/fs.py), and the snapshot itself is the framework `save`
+(orbax-style np archives). Enabled when PADDLE_RUNNING_ENV=PADDLE_EDL_AUTO_CHECKPOINT
+(reference env contract) or when `train_epoch_range` is given an explicit
+checkpoint_path.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+__all__ = ["train_epoch_range", "register", "CheckpointSaver",
+           "_get_train_epoch_range"]
+
+g_train_epoch_range = None
+_g_registered = []
+
+
+def register(*objs):
+    """Set the EXACT list of Layers/Optimizers whose state_dict is
+    checkpointed — each call REPLACES the previous registration (resume
+    restores by position, so the set must be declared atomically:
+    `register(model, opt)`, not two separate calls). Call before entering
+    train_epoch_range (the dygraph analog of the reference's executor
+    auto-capture)."""
+    _g_registered.clear()
+    _g_registered.extend(objs)
+
+
+class CheckpointSaver:
+    """checkpoint_saver.py parity over an FS object. Serialization happens in
+    a local staging dir; remote FSes (need_upload_download) get the staged
+    dir uploaded/downloaded as a unit."""
+
+    def __init__(self, fs, path):
+        self._fs = fs
+        self._path = path
+
+    def save_checkpoint(self, state, meta):
+        import shutil
+        import tempfile
+
+        from ..framework.io_utils import save as save_obj
+        stage = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
+        try:
+            save_obj(state, os.path.join(stage, "state.pdparams"))
+            with open(os.path.join(stage, "meta.json"), "w") as f:
+                json.dump(meta, f)
+            tmp = self._path + ".tmp"
+            self._fs.delete(tmp)
+            if self._fs.need_upload_download():
+                self._fs.upload(stage, tmp)
+            else:
+                shutil.copytree(stage, tmp)
+            self._fs.delete(self._path)
+            self._fs.mv(tmp, self._path)
+        finally:
+            shutil.rmtree(stage, ignore_errors=True)
+
+    def load_checkpoint(self):
+        import shutil
+        import tempfile
+
+        from ..framework.io_utils import load as load_obj
+        if not self._fs.is_exist(os.path.join(self._path, "meta.json")):
+            return None, None
+        if self._fs.need_upload_download():
+            stage = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
+            try:
+                self._fs.download(self._path, stage)
+                local = os.path.join(stage, os.path.basename(self._path))
+                if not os.path.isdir(local):
+                    local = stage
+                with open(os.path.join(local, "meta.json")) as f:
+                    meta = json.load(f)
+                state = load_obj(os.path.join(local, "state.pdparams"))
+                return state, meta
+            finally:
+                shutil.rmtree(stage, ignore_errors=True)
+        with open(os.path.join(self._path, "meta.json")) as f:
+            meta = json.load(f)
+        state = load_obj(os.path.join(self._path, "state.pdparams"))
+        return state, meta
+
+    def clean_redundant_epochs(self):
+        pass  # single rolling snapshot — nothing to clean
+
+
+class TrainEpochRange:
+    def __init__(self, max_epoch_num, name, checkpoint_path=None,
+                 save_checkpoint_inter=1, fs=None):
+        from ..distributed.fleet.fs import LocalFS
+        self.name = name
+        self.max_epoch_num = max_epoch_num
+        self.save_checkpoint_inter = save_checkpoint_inter
+        self.restored_from = None
+        root = checkpoint_path or os.environ.get(
+            "PADDLE_EDL_FS_CHECKPOINT_DIR", "/tmp/paddle_tpu_auto_ckpt")
+        job = os.environ.get("PADDLE_JOB_ID", "default_job")
+        key = hashlib.md5(f"{job}:{name}".encode()).hexdigest()[:16]
+        self._fs = fs or LocalFS()
+        self._fs.mkdirs(root)
+        self._saver = CheckpointSaver(self._fs, os.path.join(root, key))
+        self._start_epoch = 0
+        state, meta = self._saver.load_checkpoint()
+        if meta is not None and meta.get("max_epoch_num") == max_epoch_num:
+            self._start_epoch = meta["epoch_no"] + 1
+            self.restored_from = "CHECKPOINT"
+            self._restore(state)
+
+    def _restore(self, state):
+        for i, obj in enumerate(_g_registered):
+            sub = state.get(str(i))
+            if sub is not None and hasattr(obj, "set_state_dict"):
+                obj.set_state_dict(sub)
+
+    def _snapshot(self, epoch_no):
+        state = {str(i): obj.state_dict()
+                 for i, obj in enumerate(_g_registered)
+                 if hasattr(obj, "state_dict")}
+        self._saver.save_checkpoint(
+            state, {"epoch_no": epoch_no, "max_epoch_num": self.max_epoch_num,
+                    "name": self.name})
+
+    def next(self):
+        for epoch in range(self._start_epoch, self.max_epoch_num):
+            yield epoch
+            if (epoch + 1) % self.save_checkpoint_inter == 0 or \
+                    epoch == self.max_epoch_num - 1:
+                self._snapshot(epoch)
+
+
+def _get_train_epoch_range():
+    return g_train_epoch_range
+
+
+def _enabled(checkpoint_path):
+    return checkpoint_path is not None or os.environ.get(
+        "PADDLE_RUNNING_ENV") == "PADDLE_EDL_AUTO_CHECKPOINT"
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=1,
+                      checkpoint_path=None, name="train", fs=None):
+    """auto_checkpoint.py:598 parity. Yields epoch numbers, resuming past
+    completed epochs after a crash/relaunch."""
+    global g_train_epoch_range
+    if not _enabled(checkpoint_path):
+        yield from range(max_epoch_num)
+        return
+    g_train_epoch_range = TrainEpochRange(
+        max_epoch_num, name, checkpoint_path=checkpoint_path,
+        save_checkpoint_inter=save_checkpoint_inter, fs=fs)
+    try:
+        yield from g_train_epoch_range.next()
+    finally:
+        g_train_epoch_range = None

@@ -416,3 +416,22 @@ class TracedLayer:
 
     def __call__(self, *args, **kwargs):
         return self._static(*args, **kwargs)
+
+
+def functionalized_call(layer):
+    """Return a jax-traceable fn over plain arrays: params/buffers are closed
+    over as constants, inputs arrive as arrays. Used by export paths
+    (inference.save_predictor_model, onnx.export) — the TPU analog of tracing
+    a Layer into a self-contained ProgramDesc (fluid/dygraph/jit.py save)."""
+    from ..core import autograd as _ag
+    from ..core.tensor import Tensor as _T
+
+    def fn(*array_args):
+        with _ag.no_grad():
+            out = layer(*[_T(a) for a in array_args])
+        if isinstance(out, _T):
+            return out._val
+        leaves = _flatten_tensors(out, [])
+        return [t._val for t in leaves]
+
+    return fn

@@ -13,6 +13,7 @@ from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
+from .sparse_attention import sparse_attention  # noqa: F401
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):

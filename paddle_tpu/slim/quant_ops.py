@@ -1,0 +1,161 @@
+"""Fake-quantization primitives.
+
+Reference: operators/fake_quantize_op.cc (fake_quantize_dequantize_abs_max,
+fake_channel_wise_quantize_dequantize_abs_max,
+fake_quantize_dequantize_moving_average_abs_max) and
+slim/quantization/cal_kl_threshold.py.
+
+All fns quantize-then-dequantize in float (simulated quantization) with the
+straight-through estimator: out = x + stop_grad(q(x) - x), so the backward is
+identity inside the clip range — exactly the reference's grad kernel — and XLA
+folds the whole thing into neighbouring ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import apply, unwrap
+
+__all__ = [
+    "fake_quantize_dequantize_abs_max",
+    "fake_channel_wise_quantize_dequantize_abs_max",
+    "fake_quantize_dequantize_moving_average_abs_max",
+    "quantize_weight", "dequantize_weight", "cal_kl_threshold",
+]
+
+
+def _qdq(v, scale, qmax):
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(v / scale * qmax), -qmax, qmax) * scale / qmax
+    # straight-through estimator
+    return v + lax.stop_gradient(q - v)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8, name=None):
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def prim(v):
+        scale = jnp.max(jnp.abs(lax.stop_gradient(v)))
+        return _qdq(v, scale, qmax)
+
+    return apply(prim, x, name="fake_quantize_dequantize_abs_max")
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=-1, name=None):
+    """Per-output-channel abs-max. quant_axis=-1 matches Linear weight
+    (in, out) layout; conv weights (O,I,H,W) use quant_axis=0."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def prim(v):
+        ax = quant_axis % v.ndim
+        reduce_axes = tuple(i for i in range(v.ndim) if i != ax)
+        scale = jnp.max(jnp.abs(lax.stop_gradient(v)), axis=reduce_axes,
+                        keepdims=True)
+        return _qdq(v, scale, qmax)
+
+    return apply(prim, x, name="fake_channel_wise_quantize_dequantize_abs_max")
+
+
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, scale_tensor, state_tensor=None, accum_tensor=None,
+        moving_rate=0.9, bit_length=8, training=True, name=None):
+    """Activation fake-quant with a moving-average scale held in buffers.
+
+    In training mode the buffers are updated functionally (the update values
+    are computed in-graph, the assignment happens host-side like BatchNorm
+    running stats — nn/functional/norm.py pattern).
+    """
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    if training:
+        if state_tensor is not None and accum_tensor is not None:
+            def prim(v, s, st, ac):
+                cur = jnp.max(jnp.abs(lax.stop_gradient(v)))
+                state = moving_rate * st + 1.0
+                accum = moving_rate * ac + cur
+                new_scale = accum / state
+                return (_qdq(v, lax.stop_gradient(new_scale), qmax),
+                        new_scale, accum, state)
+
+            out, new_scale, accum, state = apply(
+                prim, x, scale_tensor, state_tensor, accum_tensor,
+                name="fake_quantize_dequantize_moving_average_abs_max")
+            scale_tensor._value = new_scale._value
+            state_tensor._value = state._value
+            accum_tensor._value = accum._value
+            return out
+
+        def prim_ema(v, s):
+            cur = jnp.max(jnp.abs(lax.stop_gradient(v)))
+            new_scale = moving_rate * s + (1.0 - moving_rate) * cur
+            return _qdq(v, lax.stop_gradient(new_scale), qmax), new_scale
+
+        out, new_scale = apply(
+            prim_ema, x, scale_tensor,
+            name="fake_quantize_dequantize_moving_average_abs_max")
+        scale_tensor._value = new_scale._value
+        return out
+
+    def prim_eval(v, s):
+        return _qdq(v, s, qmax)
+
+    return apply(prim_eval, x, scale_tensor,
+                 name="fake_quantize_dequantize_moving_average_abs_max")
+
+
+def quantize_weight(w, bit_length=8, quant_axis=-1):
+    """Real (not simulated) quantization: returns (int array, scales).
+    Used by PTQ convert / save_quantized_model."""
+    v = unwrap(w)
+    v = np.asarray(v)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    ax = quant_axis % v.ndim
+    reduce_axes = tuple(i for i in range(v.ndim) if i != ax)
+    scale = np.maximum(np.max(np.abs(v), axis=reduce_axes, keepdims=True),
+                       1e-9)
+    qdtype = (np.int8 if bit_length <= 8
+              else np.int16 if bit_length <= 16 else np.int32)
+    q = np.clip(np.round(v / scale * qmax), -qmax, qmax).astype(qdtype)
+    return q, np.squeeze(scale, axis=reduce_axes)
+
+
+def dequantize_weight(q, scale, bit_length=8, quant_axis=-1):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    ax = quant_axis % q.ndim
+    shape = [1] * q.ndim
+    shape[ax] = q.shape[ax]
+    return q.astype(np.float32) * np.reshape(scale, shape) / qmax
+
+
+def cal_kl_threshold(hist, bin_width, bits=8):
+    """KL-divergence threshold search over an activation histogram
+    (slim/quantization/cal_kl_threshold.py semantics, simplified)."""
+    hist = np.asarray(hist, dtype=np.float64)
+    n_bins = hist.size
+    levels = 2 ** (bits - 1)
+    if n_bins <= levels:
+        return bin_width * n_bins
+    best_kl, best_i = np.inf, n_bins
+    total = hist.sum()
+    if total <= 0:
+        return bin_width * n_bins
+    for i in range(levels, n_bins + 1):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()  # saturate outliers into last bin
+        p /= p.sum()
+        # quantize first i bins down to `levels` bins, then expand back
+        chunks = np.array_split(hist[:i], levels)
+        q = np.concatenate([
+            np.full(len(c), c.sum() / max((c > 0).sum(), 1)) * (c > 0)
+            for c in chunks])
+        if q.sum() <= 0:
+            continue
+        q /= q.sum()
+        mask = p > 0
+        kl = np.sum(p[mask] * np.log(p[mask] / np.maximum(q[mask], 1e-12)))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return bin_width * best_i

@@ -1,0 +1,280 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+Reference: python/paddle/fluid/contrib/sparsity/{utils.py,asp.py} and the
+paddle.static.sparsity facade (SURVEY.md §2.6 "incubate… sparsity (ASP)").
+TPU-native notes: there is no sparse-tensor-core kernel to target — the value
+on TPU is (a) model-compression parity and (b) mask-preserving training whose
+masked matmuls XLA still runs dense on the MXU. Masks are applied eagerly to
+parameter values and re-applied after every optimizer step
+(OptimizerWithSparsityGuarantee ≈ asp.py:535).
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+__all__ = [
+    "MaskAlgo", "CheckMethod", "calculate_density", "check_mask_1d",
+    "get_mask_1d", "check_mask_2d", "get_mask_2d_greedy", "get_mask_2d_best",
+    "create_mask", "check_sparsity", "decorate", "prune_model",
+    "set_excluded_layers", "reset_excluded_layers", "ASPHelper",
+]
+
+
+class MaskAlgo:
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod:
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo):
+        if mask_algo in (MaskAlgo.MASK_2D_GREEDY, MaskAlgo.MASK_2D_BEST):
+            return CheckMethod.CHECK_2D
+        return CheckMethod.CHECK_1D
+
+
+def calculate_density(x):
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / x.size
+
+
+def _reshape_1d(mat, m):
+    """Pad cols to a multiple of m, reshape to (-1, m) (utils.py:109)."""
+    if mat.shape[1] % m > 0:
+        pad = m - (mat.shape[1] % m)
+        mat_padded = np.zeros((mat.shape[0], mat.shape[1] + pad),
+                              dtype=mat.dtype)
+        mat_padded[:, :mat.shape[1]] = mat
+        mat = mat_padded
+    shape = mat.shape
+    return mat.reshape(-1, m), shape
+
+
+def check_mask_1d(mat, n, m):
+    mat_flat, _ = _reshape_1d(np.asarray(mat), m)
+    return bool(np.all(np.count_nonzero(mat_flat, axis=1) <= n))
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the n largest-|.| entries in every group of m along rows."""
+    mat = np.asarray(mat)
+    mat_flat, padded_shape = _reshape_1d(mat, m)
+    mask_flat = np.zeros_like(mat_flat)
+    order = np.argsort(np.abs(mat_flat), axis=1)[:, -n:]
+    np.put_along_axis(mask_flat, order, 1.0, axis=1)
+    mask = mask_flat.reshape(padded_shape)[:mat.shape[0], :mat.shape[1]]
+    return mask.astype(mat.dtype)
+
+
+def _reshape_2d(mat, m):
+    """Pad both dims to multiples of m; emit (m*m)-flattened blocks."""
+    rows = -(-mat.shape[0] // m) * m
+    cols = -(-mat.shape[1] // m) * m
+    padded = np.zeros((rows, cols), dtype=mat.dtype)
+    padded[:mat.shape[0], :mat.shape[1]] = mat
+    blocks = padded.reshape(rows // m, m, cols // m, m).transpose(0, 2, 1, 3)
+    return blocks.reshape(-1, m * m), (rows, cols)
+
+
+def _blocks_to_mat(blocks, padded_shape, m):
+    rows, cols = padded_shape
+    return (blocks.reshape(rows // m, cols // m, m, m)
+            .transpose(0, 2, 1, 3).reshape(rows, cols))
+
+
+def check_mask_2d(mat, n, m):
+    blocks, _ = _reshape_2d(np.asarray(mat), m)
+    b = blocks.reshape(-1, m, m)
+    return bool(np.all(np.count_nonzero(b, axis=1) <= n)
+                and np.all(np.count_nonzero(b, axis=2) <= n))
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Greedy n:m along both rows and cols of each m×m block
+    (utils.py:314)."""
+    mat = np.asarray(mat)
+    blocks, padded_shape = _reshape_2d(mat, m)
+    mask_blocks = np.zeros_like(blocks)
+    for bi in range(blocks.shape[0]):
+        block = np.abs(blocks[bi].reshape(m, m))
+        mask = np.zeros((m, m), dtype=mat.dtype)
+        row_counts = np.zeros(m, dtype=int)
+        col_counts = np.zeros(m, dtype=int)
+        for idx in np.argsort(-block, axis=None):
+            r, c = divmod(int(idx), m)
+            if row_counts[r] < n and col_counts[c] < n:
+                mask[r, c] = 1.0
+                row_counts[r] += 1
+                col_counts[c] += 1
+        mask_blocks[bi] = mask.reshape(-1)
+    full = _blocks_to_mat(mask_blocks, padded_shape, m)
+    return full[:mat.shape[0], :mat.shape[1]].astype(mat.dtype)
+
+
+_PATTERNS_CACHE = {}
+
+
+def _compute_valid_2d_patterns(n, m):
+    """All m×m 0/1 patterns with exactly n per row and per col
+    (utils.py:384)."""
+    key = (n, m)
+    if key in _PATTERNS_CACHE:
+        return _PATTERNS_CACHE[key]
+    row_patterns = [np.array(p) for p in itertools.product([0, 1], repeat=m)
+                    if sum(p) == n]
+    valid = []
+    for combo in itertools.product(row_patterns, repeat=m):
+        pat = np.stack(combo)
+        if np.all(pat.sum(0) == n):
+            valid.append(pat.reshape(-1))
+    patterns = np.stack(valid).astype(np.float64)
+    _PATTERNS_CACHE[key] = patterns
+    return patterns
+
+
+def get_mask_2d_best(mat, n, m):
+    """Exhaustive best n:m-per-row-and-col pattern per block (utils.py:422)."""
+    mat = np.asarray(mat)
+    blocks, padded_shape = _reshape_2d(mat, m)
+    patterns = _compute_valid_2d_patterns(n, m)
+    scores = np.abs(blocks) @ patterns.T.astype(blocks.dtype)
+    best = np.argmax(scores, axis=1)
+    mask_blocks = patterns[best].astype(mat.dtype)
+    full = _blocks_to_mat(mask_blocks, padded_shape, m)
+    return full[:mat.shape[0], :mat.shape[1]].astype(mat.dtype)
+
+
+def _as_2d(t):
+    """View an nD weight as 2D for masking (conv (O,I,kh,kw) → (O, I*kh*kw))."""
+    arr = np.asarray(t)
+    if arr.ndim == 1:
+        return arr.reshape(1, -1), arr.shape
+    if arr.ndim == 2:
+        return arr, arr.shape
+    return arr.reshape(arr.shape[0], -1), arr.shape
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    mat, orig_shape = _as_2d(tensor)
+    fn = {MaskAlgo.MASK_1D: get_mask_1d,
+          MaskAlgo.MASK_2D_GREEDY: get_mask_2d_greedy,
+          MaskAlgo.MASK_2D_BEST: get_mask_2d_best}[func_name]
+    mask = fn(mat, n, m)
+    return mask.reshape(orig_shape)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4):
+    mat, _ = _as_2d(tensor)
+    fn = {CheckMethod.CHECK_1D: check_mask_1d,
+          CheckMethod.CHECK_2D: check_mask_2d}[func_name]
+    return fn(mat, n, m)
+
+
+# ---------------------------------------------------------------------------
+# ASPHelper: dygraph model pruning + optimizer decoration (asp.py:275 parity;
+# the reference is static-program-based, here masks live next to parameters)
+# ---------------------------------------------------------------------------
+
+_SUPPORTED_LAYERS = ("Linear", "Conv2D")
+_EXCLUDED = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+class ASPHelper:
+    MASK_APPENDDED_NAME = "asp_mask"
+
+    @staticmethod
+    def _is_supported_param(layer, pname, param):
+        if type(layer).__name__ not in _SUPPORTED_LAYERS:
+            return False
+        if pname != "weight":
+            return False
+        name = getattr(param, "name", None)
+        if name and name in _EXCLUDED:
+            return False
+        v = param.numpy()
+        return v.ndim >= 2 and v.shape[-1] % 4 == 0
+
+    @staticmethod
+    def prune_model(model, n=2, m=4, mask_algo=MaskAlgo.MASK_1D,
+                    with_mask=True):
+        """Apply n:m masks to supported weights; record masks on the layer."""
+        import jax.numpy as jnp
+        masks = {}
+        for lname, layer in model.named_sublayers(include_self=True):
+            for pname, param in list(layer._parameters.items()):
+                if param is None or not ASPHelper._is_supported_param(
+                        layer, pname, param):
+                    continue
+                mask = create_mask(param.numpy(), mask_algo, n, m)
+                param._value = param._val * jnp.asarray(mask,
+                                                        dtype=param._val.dtype)
+                key = f"{lname}.{pname}" if lname else pname
+                masks[key] = mask
+                if with_mask:
+                    layer._asp_masks = getattr(layer, "_asp_masks", {})
+                    layer._asp_masks[pname] = mask
+        model._asp_masks_flat = masks
+        return masks
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """paddle.static.sparsity.prune_model parity (dygraph-first)."""
+    algo = {"mask_1d": MaskAlgo.MASK_1D,
+            "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+            "mask_2d_best": MaskAlgo.MASK_2D_BEST}.get(mask_algo, mask_algo)
+    return ASPHelper.prune_model(model, n=n, m=m, mask_algo=algo,
+                                 with_mask=with_mask)
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies masks after every step (asp.py:535)."""
+
+    def __init__(self, optimizer, model):
+        self._opt = optimizer
+        self._model = model
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+    def _reapply(self):
+        import jax.numpy as jnp
+        for _, layer in self._model.named_sublayers(include_self=True):
+            amasks = getattr(layer, "_asp_masks", None)
+            if not amasks:
+                continue
+            for pname, mask in amasks.items():
+                p = layer._parameters.get(pname)
+                if p is not None:
+                    p._value = p._val * jnp.asarray(mask, dtype=p._val.dtype)
+
+    def step(self):
+        self._opt.step()
+        self._reapply()
+
+    def minimize(self, loss, *args, **kwargs):
+        out = self._opt.minimize(loss, *args, **kwargs)
+        self._reapply()
+        return out
+
+
+def decorate(optimizer, model=None):
+    """sparsity.decorate parity. `model` is required in dygraph (the reference
+    binds masks via the global program; here they live on the Layer)."""
+    if model is None:
+        raise ValueError("paddle_tpu sparsity.decorate needs the model: "
+                         "decorate(optimizer, model)")
+    return OptimizerWithSparsityGuarantee(optimizer, model)

@@ -17,6 +17,7 @@ from .graph import (  # noqa: F401
     global_scope, in_static_build, program_guard, scope_guard,
 )
 from . import nn  # noqa: F401,E402
+from .. import sparsity  # noqa: F401,E402  (paddle.static.sparsity facade)
 
 _static_mode = [False]
 

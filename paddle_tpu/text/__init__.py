@@ -5,5 +5,11 @@ from .datasets import (  # noqa: F401
     WMT16, viterbi_decode,
 )
 
+from .tokenizer import (  # noqa: F401
+    BasicTokenizer, FasterTokenizer, Vocab, WordpieceTokenizer,
+)
+
 __all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
-           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode", "models"]
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode", "models",
+           "FasterTokenizer", "Vocab", "BasicTokenizer",
+           "WordpieceTokenizer"]

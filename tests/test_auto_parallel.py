@@ -125,3 +125,69 @@ class TestEngine:
         assert c["params"] == 8 * 8 + 8
         assert c["devices"] == 8
         assert c["param_bytes_per_device"] * 8 <= c["param_bytes"] + 8
+
+
+class TestEngineRegressions:
+    """Review-found edge cases: partial batches, eval-mode toggling,
+    idempotent prepare, batch-shape validation, probe tracer leaks."""
+
+    def _engine(self, dropout=False):
+        paddle.seed(0)
+        layers = [nn.Linear(8, 16), nn.ReLU()]
+        if dropout:
+            layers.append(nn.Dropout(0.5))
+        layers.append(nn.Linear(16, 4))
+        model = nn.Sequential(*layers)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        pm = ProcessMesh(np.arange(8), dim_names=["dp"])
+        return Engine(model, loss=F.cross_entropy, optimizer=opt,
+                      strategy=Strategy(), process_mesh=pm), model
+
+    def test_partial_final_batch(self):
+        engine, _ = self._engine()
+        rng = np.random.RandomState(0)
+        x = rng.randn(20, 8).astype("float32")   # 20 % 16 != 0
+        y = rng.randint(0, 4, (20, 1)).astype("int64")
+        hist = engine.fit((x, y), epochs=1, batch_size=16)
+        assert len(hist["loss"]) == 2  # full batch + partial batch
+
+    def test_eval_mode_deterministic_with_dropout(self):
+        engine, model = self._engine(dropout=True)
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 8).astype("float32")
+        y = rng.randint(0, 4, (16, 1)).astype("int64")
+        a = engine.evaluate((x, y))["eval_loss"]
+        b = engine.evaluate((x, y))["eval_loss"]
+        assert a == b
+        assert model.training  # restored
+
+    def test_prepare_idempotent_no_double_wrap(self):
+        engine, _ = self._engine()
+        engine.strategy.sharding.enable = True
+        engine.prepare()
+        inner = engine.optimizer
+        engine.prepare()
+        assert engine.optimizer is inner
+
+    def test_fit_rejects_bare_array(self):
+        engine, _ = self._engine()
+        with pytest.raises(ValueError, match="needs .x, y."):
+            engine.fit(np.ones((16, 8), "float32"), batch_size=8)
+
+    def test_mismatched_xy_raises(self):
+        engine, _ = self._engine()
+        with pytest.raises(ValueError, match="mismatched"):
+            engine.fit((np.ones((10, 8), "f"), np.ones((9, 1), "i")),
+                       batch_size=4)
+
+    def test_negative_process_ids_rejected(self):
+        with pytest.raises(ValueError):
+            ProcessMesh(np.array([0, -1]), dim_names=["x"])
+
+    def test_dtensor_from_fn_inplace_init(self):
+        from paddle_tpu.distributed.auto_parallel import dtensor_from_fn
+        pm = ProcessMesh(np.arange(8), dim_names=["dp"])
+        t = dtensor_from_fn(
+            lambda: paddle.zeros((8, 4)).fill_(1.0), pm, ["dp", None])
+        np.testing.assert_allclose(np.asarray(t._val), np.ones((8, 4)))

@@ -1,0 +1,42 @@
+"""paddle.onnx.export parity test — StableHLO artifact roundtrip."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+
+class TestOnnxExport:
+    def test_export_writes_stablehlo_and_predictor_loads(self, tmp_path):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        prefix = str(tmp_path / "model")
+        out_prefix = paddle.onnx.export(
+            model, prefix + ".onnx",
+            input_spec=[InputSpec([2, 8], "float32")])
+        assert out_prefix == prefix
+        assert os.path.exists(prefix + ".stablehlo")
+
+        from paddle_tpu.inference import Config, create_predictor
+        cfg = Config()
+        cfg.set_exported_model(prefix)
+        pred = create_predictor(cfg)
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        model.eval()
+        expect = model(paddle.to_tensor(x)).numpy()
+        names = pred.get_input_names()
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        pred.run()
+        got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+    def test_export_requires_input_spec(self):
+        model = nn.Linear(4, 4)
+        try:
+            paddle.onnx.export(model, "/tmp/x.onnx")
+            assert False, "expected ValueError"
+        except ValueError:
+            pass

@@ -1,0 +1,131 @@
+"""slim quantization tests (reference: slim/tests/test_imperative_qat.py,
+test_post_training_quantization_* — simplified to the SURVEY §4.1 pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.slim import (
+    AbsmaxQuantizer, HistQuantizer, ImperativePTQ, ImperativeQuantAware,
+    KLQuantizer, PostTrainingQuantization, PTQConfig,
+    fake_channel_wise_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_abs_max, quantize_weight, dequantize_weight,
+)
+
+
+def _np_qdq(x, bits=8):
+    qmax = 2 ** (bits - 1) - 1
+    scale = max(np.abs(x).max(), 1e-9)
+    return np.clip(np.round(x / scale * qmax), -qmax, qmax) * scale / qmax
+
+
+class TestQuantOps:
+    def test_fake_qdq_abs_max_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 5).astype(np.float32)
+        out = fake_quantize_dequantize_abs_max(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), _np_qdq(x), rtol=1e-6,
+                                   atol=1e-7)
+
+    def test_channel_wise_qdq(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(6, 3).astype(np.float32) * np.array([1., 10., 100.],
+                                                          dtype=np.float32)
+        out = fake_channel_wise_quantize_dequantize_abs_max(
+            paddle.to_tensor(x), quant_axis=-1).numpy()
+        for c in range(3):
+            np.testing.assert_allclose(out[:, c], _np_qdq(x[:, c]),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_ste_gradient_is_identity(self):
+        x = paddle.to_tensor(np.array([0.1, -0.5, 0.9], dtype=np.float32),
+                             stop_gradient=False)
+        out = fake_quantize_dequantize_abs_max(x)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(3), atol=1e-6)
+
+    def test_quantize_dequantize_weight_roundtrip(self):
+        rng = np.random.RandomState(2)
+        w = rng.randn(16, 8).astype(np.float32)
+        q, scales = quantize_weight(paddle.to_tensor(w))
+        assert q.dtype == np.int8 and scales.shape == (8,)
+        wd = dequantize_weight(q, scales)
+        assert np.abs(wd - w).max() < np.abs(w).max() / 100
+
+
+class TestQAT:
+    def test_quantize_replaces_layers_and_trains(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        qat = ImperativeQuantAware()
+        qat.quantize(model)
+        names = [type(l).__name__ for l in model.sublayers()]
+        assert names.count("QuantizedLinear") == 2
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(32, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (32,)).astype(np.int64))
+        losses = []
+        import paddle_tpu.nn.functional as F
+        for _ in range(12):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+        # the activation observer must have seen data
+        qlin = model.sublayers()[0]
+        assert float(qlin._act_quant.scale.numpy()) > 0
+
+    def test_conv_qat_forward(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1), nn.ReLU())
+        ImperativeQuantAware().quantize(model)
+        assert type(model.sublayers()[0]).__name__ == "QuantizedConv2D"
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32))
+        out = model(x)
+        assert out.shape == [2, 4, 8, 8]
+        assert np.isfinite(out.numpy()).all()
+
+
+class TestPTQ:
+    def _observed_model_and_data(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        rng = np.random.RandomState(0)
+        data = [paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+                for _ in range(4)]
+        return model, data
+
+    def test_imperative_ptq_convert(self):
+        model, data = self._observed_model_and_data()
+        ref_out = model(data[0]).numpy()
+        ptq = ImperativePTQ()
+        ptq.quantize(model)
+        for x in data:
+            model(x)
+        ptq.convert(model)
+        lin = model.sublayers()[0]
+        assert lin._quant_act_threshold > 0
+        assert lin._quant_weight_scales.shape == (16,)
+        # quantized model output stays close to fp32 output
+        out = model(data[0]).numpy()
+        assert np.abs(out - ref_out).max() < 0.15 * np.abs(ref_out).max() + 0.05
+
+    def test_post_training_quantization_driver(self):
+        model, data = self._observed_model_and_data()
+        ptq = PostTrainingQuantization(model, data_loader=data, algo="hist")
+        qmodel = ptq.quantize()
+        lin = qmodel.sublayers()[0]
+        assert hasattr(lin, "_quant_weight_scales")
+
+    def test_quantizer_thresholds(self):
+        rng = np.random.RandomState(3)
+        data = rng.randn(10000).astype(np.float32)
+        for q in (AbsmaxQuantizer(), HistQuantizer(), KLQuantizer()):
+            q.sample(data)
+            t = q.cal_thresholds()
+            assert 0 < t <= np.abs(data).max() + 1e-6

@@ -1,0 +1,107 @@
+"""sparse_attention numpy-oracle tests (SURVEY §4.1 pattern; reference
+operators/sparse_attention_op.cu semantics)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _np_sparse_attention(q, k, v, offset, columns):
+    b, h, s, d = q.shape
+    out = np.zeros_like(q)
+    for bi in range(b):
+        for hi in range(h):
+            logits = (q[bi, hi] @ k[bi, hi].T) / np.sqrt(d)
+            mask = np.zeros((s, s), dtype=bool)
+            off = offset[bi, hi]
+            cols = columns[bi, hi]
+            for r in range(s):
+                mask[r, cols[off[r]:off[r + 1]]] = True
+            logits = np.where(mask, logits, -1e30)
+            e = np.exp(logits - logits.max(-1, keepdims=True))
+            p = e / e.sum(-1, keepdims=True)
+            p = np.where(mask.any(-1, keepdims=True), p, 0.0)
+            out[bi, hi] = p @ v[bi, hi]
+    return out
+
+
+def _random_csr(rng, b, h, s, keep=0.5):
+    offsets = np.zeros((b, h, s + 1), dtype=np.int32)
+    cols = []
+    for bi in range(b):
+        for hi in range(h):
+            row_cols = []
+            for r in range(s):
+                sel = np.flatnonzero(rng.rand(s) < keep)
+                if sel.size == 0:
+                    sel = np.array([r])
+                row_cols.append(sel.astype(np.int32))
+                offsets[bi, hi, r + 1] = offsets[bi, hi, r] + sel.size
+            cols.append(np.concatenate(row_cols))
+    nnz = max(c.size for c in cols)
+    # pad all (b,h) lanes to a common nnz so the tensor is rectangular;
+    # padded entries are given row seq-1 duplicate columns (harmless: the
+    # offset table never points past the real nnz for that lane)
+    colmat = np.zeros((b, h, nnz), dtype=np.int32)
+    i = 0
+    for bi in range(b):
+        for hi in range(h):
+            c = cols[i]
+            colmat[bi, hi, :c.size] = c
+            # pad region: repeat last real column; rows beyond offset[-1]
+            # are never addressed by the oracle. For the kernel, searchsorted
+            # assigns pad entries to the last row — also set mask there, so
+            # make pads duplicates of an already-set position.
+            if c.size < nnz:
+                colmat[bi, hi, c.size:] = colmat[bi, hi, c.size - 1]
+            i += 1
+    return offsets, colmat
+
+
+class TestSparseAttention:
+    def test_docstring_example(self):
+        q = np.array([[[[0, 1], [2, 3], [0, 1], [2, 3]]]], dtype=np.float32)
+        offset = np.array([[[0, 2, 4, 6, 8]]], dtype=np.int32)
+        columns = np.array([[[0, 1, 0, 1, 2, 3, 2, 3]]], dtype=np.int32)
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(offset), paddle.to_tensor(columns))
+        expect = np.array([[[[1.60885942, 2.60885954],
+                             [1.99830270, 2.99830270],
+                             [1.60885942, 2.60885954],
+                             [1.99830270, 2.99830270]]]], dtype=np.float32)
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5, atol=1e-6)
+
+    def test_vs_numpy_oracle_full_csr(self):
+        rng = np.random.RandomState(7)
+        b, h, s, d = 2, 3, 8, 4
+        q = rng.randn(b, h, s, d).astype(np.float32)
+        k = rng.randn(b, h, s, d).astype(np.float32)
+        v = rng.randn(b, h, s, d).astype(np.float32)
+        # full attention expressed as CSR — every row has all s columns
+        offset = np.tile(np.arange(0, s * s + 1, s, dtype=np.int32),
+                         (b, h, 1))
+        columns = np.tile(np.tile(np.arange(s, dtype=np.int32), s), (b, h, 1))
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(offset), paddle.to_tensor(columns))
+        expect = _np_sparse_attention(q, k, v, offset, columns)
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-5)
+
+    def test_gradient_flows(self):
+        rng = np.random.RandomState(3)
+        b, h, s, d = 1, 2, 4, 4
+        q = paddle.to_tensor(rng.randn(b, h, s, d).astype(np.float32),
+                             stop_gradient=False)
+        k = paddle.to_tensor(rng.randn(b, h, s, d).astype(np.float32),
+                             stop_gradient=False)
+        v = paddle.to_tensor(rng.randn(b, h, s, d).astype(np.float32),
+                             stop_gradient=False)
+        offset = paddle.to_tensor(
+            np.tile(np.arange(0, s * s + 1, s, dtype=np.int32), (b, h, 1)))
+        columns = paddle.to_tensor(
+            np.tile(np.tile(np.arange(s, dtype=np.int32), s), (b, h, 1)))
+        out = F.sparse_attention(q, k, v, offset, columns)
+        out.sum().backward()
+        assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
+        assert v.grad is not None and abs(v.grad.numpy()).sum() > 0

@@ -1,0 +1,96 @@
+"""ASP sparsity tests (reference: unittests/asp/test_asp_utils.py,
+test_asp_pruning_*, test_asp_optimize.py patterns)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import sparsity
+from paddle_tpu.sparsity import (
+    CheckMethod, MaskAlgo, calculate_density, check_mask_1d, check_mask_2d,
+    check_sparsity, create_mask, get_mask_1d, get_mask_2d_best,
+    get_mask_2d_greedy,
+)
+
+
+class TestMaskUtils:
+    def test_get_mask_1d(self):
+        rng = np.random.RandomState(0)
+        mat = rng.randn(8, 16).astype(np.float32)
+        mask = get_mask_1d(mat, 2, 4)
+        assert check_mask_1d(mask, 2, 4)
+        assert calculate_density(mask) == 0.5
+        # kept entries are the per-group top-2 by |.|
+        groups = np.abs(mat).reshape(-1, 4)
+        kept = mask.reshape(-1, 4).astype(bool)
+        for g in range(groups.shape[0]):
+            top2 = set(np.argsort(groups[g])[-2:])
+            assert set(np.flatnonzero(kept[g])) == top2
+
+    def test_get_mask_2d_greedy_and_best(self):
+        rng = np.random.RandomState(1)
+        mat = rng.randn(8, 8).astype(np.float32)
+        for fn in (get_mask_2d_greedy, get_mask_2d_best):
+            mask = fn(mat, 2, 4)
+            assert check_mask_2d(mask, 2, 4), fn.__name__
+        # best must capture at least as much magnitude as greedy
+        g = np.abs(mat * get_mask_2d_greedy(mat, 2, 4)).sum()
+        b = np.abs(mat * get_mask_2d_best(mat, 2, 4)).sum()
+        assert b >= g - 1e-5
+
+    def test_non_divisible_shapes(self):
+        rng = np.random.RandomState(2)
+        mat = rng.randn(5, 7).astype(np.float32)
+        mask = get_mask_1d(mat, 2, 4)
+        assert mask.shape == mat.shape
+
+    def test_create_and_check_conv_weight(self):
+        rng = np.random.RandomState(3)
+        w = rng.randn(8, 4, 3, 3).astype(np.float32)  # (O,I,kh,kw), I*kh*kw=36
+        mask = create_mask(w, MaskAlgo.MASK_1D, 2, 4)
+        assert mask.shape == w.shape
+        assert check_sparsity(mask, CheckMethod.CHECK_1D, 2, 4)
+
+
+class TestASPTraining:
+    def test_prune_model_and_decorate(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        masks = sparsity.prune_model(model, mask_algo="mask_1d")
+        assert len(masks) == 2
+        for _, layer in model.named_sublayers():
+            if type(layer).__name__ == "Linear":
+                assert check_mask_1d(layer.weight.numpy(), 2, 4)
+
+        opt = sparsity.decorate(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=model.parameters()), model)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(32, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (32,)).astype(np.int64))
+        for _ in range(5):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # sparsity survives optimization
+        for _, layer in model.named_sublayers():
+            if type(layer).__name__ == "Linear":
+                assert check_mask_1d(layer.weight.numpy(), 2, 4)
+                assert calculate_density(layer.weight.numpy()) <= 0.5 + 1e-6
+
+    def test_excluded_layers(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 8))
+        lin = model.sublayers()[0]
+        lin.weight.name = "keep_dense"
+        sparsity.set_excluded_layers(["keep_dense"])
+        try:
+            masks = sparsity.prune_model(model)
+            assert len(masks) == 0
+        finally:
+            sparsity.reset_excluded_layers()
+
+    def test_static_facade(self):
+        import paddle_tpu.static as static
+        assert static.sparsity.calculate_density is calculate_density

@@ -1,0 +1,75 @@
+"""FasterTokenizer tests. Oracle: transformers.BertTokenizer built from the
+same vocab file (reference test pattern: unittests/tokenizer/ +
+test_faster_tokenizer_op.py compare against python tokenizer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import FasterTokenizer, Vocab
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "quick", "brown", "fox", "jump", "##s", "##ed", "over",
+         "lazy", "dog", "un", "##want", "##able", "run", "##ning", ",",
+         ".", "!", "hello", "world", "你", "好"]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    return str(p)
+
+
+class TestFasterTokenizer:
+    def test_basic_wordpiece(self, vocab_file):
+        tok = FasterTokenizer(Vocab.load_vocabulary(vocab_file))
+        ids, seg = tok("The quick brown fox jumps over the lazy dog.")
+        arr = ids.numpy()[0]
+        toks = [VOCAB[i] for i in arr]
+        assert toks == ["[CLS]", "the", "quick", "brown", "fox", "jump",
+                        "##s", "over", "the", "lazy", "dog", ".", "[SEP]"]
+        assert (seg.numpy() == 0).all()
+
+    def test_unknown_and_subwords(self, vocab_file):
+        tok = FasterTokenizer(Vocab.load_vocabulary(vocab_file))
+        ids, _ = tok("unwantable zebra running!")
+        toks = [VOCAB[i] for i in ids.numpy()[0]]
+        assert toks == ["[CLS]", "un", "##want", "##able", "[UNK]", "run",
+                        "##ning", "!", "[SEP]"]
+
+    def test_pair_and_padding(self, vocab_file):
+        tok = FasterTokenizer(Vocab.load_vocabulary(vocab_file))
+        ids, seg = tok(["hello world", "the dog"],
+                       text_pair=["the fox", "hello"],
+                       max_seq_len=10, pad_to_max_seq_len=True)
+        assert ids.shape == [2, 10]
+        row = [VOCAB[i] for i in ids.numpy()[0]]
+        assert row[:7] == ["[CLS]", "hello", "world", "[SEP]", "the", "fox",
+                           "[SEP]"]
+        assert row[7:] == ["[PAD]"] * 3
+        s = seg.numpy()[0]
+        assert list(s[:7]) == [0, 0, 0, 0, 1, 1, 1]
+
+    def test_cjk_spacing(self, vocab_file):
+        tok = FasterTokenizer(Vocab.load_vocabulary(vocab_file))
+        ids, _ = tok("你好")
+        toks = [VOCAB[i] for i in ids.numpy()[0]]
+        assert toks == ["[CLS]", "你", "好", "[SEP]"]
+
+    def test_truncation(self, vocab_file):
+        tok = FasterTokenizer(Vocab.load_vocabulary(vocab_file))
+        ids, _ = tok("the quick brown fox jumps over the lazy dog",
+                     max_seq_len=6)
+        assert ids.shape[1] == 6
+        toks = [VOCAB[i] for i in ids.numpy()[0]]
+        assert toks[0] == "[CLS]" and toks[-1] == "[SEP]"
+
+    def test_vs_transformers_oracle(self, vocab_file):
+        hf = pytest.importorskip("transformers")
+        ours = FasterTokenizer(Vocab.load_vocabulary(vocab_file))
+        theirs = hf.BertTokenizer(vocab_file=vocab_file, do_lower_case=True)
+        for text in ["The quick brown fox!", "unwantable running dog.",
+                     "hello, 你好 world"]:
+            got = ours(text)[0].numpy()[0].tolist()
+            want = theirs(text)["input_ids"]
+            assert got == want, text
