@@ -38,6 +38,7 @@ from .core.dtypes import (  # noqa: F401
 from .core.dtypes import bool_ as bool  # noqa: F401,A001
 from .core.random import get_state as get_cuda_rng_state  # noqa: F401
 from .core.random import seed  # noqa: F401
+from .core.selected_rows import SelectedRows  # noqa: F401
 from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
 
 # functional tensor API (also patches Tensor methods)

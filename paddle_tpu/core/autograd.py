@@ -190,6 +190,9 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             if g is None:
                 continue
             if t._grad_capture is not None:
+                from .selected_rows import SelectedRows
+                if isinstance(g, SelectedRows):
+                    g = g.to_dense()  # capture (paddle.grad) is dense-typed
                 t._grad_capture(g)
             elif nxt is None and not t.stop_gradient and accumulate_leaves:
                 t._accumulate_grad(g)

@@ -1,0 +1,82 @@
+"""SelectedRows — sparse row-wise gradients.
+
+Reference: paddle/fluid/framework/selected_rows.h — a (rows, value, height)
+triple used chiefly for embedding gradients (`lookup_table_v2` with
+is_sparse=True) so huge vocab tables never materialize dense grads; sparse
+optimizer kernels (sgd_op, adam_op lazy_mode) update only touched rows.
+
+TPU-native role: XLA happily fuses dense scatter-add embedding grads, so the
+dense path is the default. SelectedRows exists for (a) API parity
+(Embedding(sparse=True) + Adam(lazy_mode=True)), (b) host-side memory: the
+grad holds |tokens|×dim values instead of |vocab|×dim, which matters for
+vocab-scale tables trained eagerly, (c) row-wise optimizer updates that touch
+only gathered rows (scatter ops, still XLA-compiled).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    """rows: int (n,) indices into a height-row table (duplicates allowed —
+    they mean accumulation); value: (n, ...) per-row data."""
+
+    __slots__ = ("rows", "value", "height")
+
+    def __init__(self, rows, value, height):
+        self.rows = jnp.asarray(rows).reshape(-1)
+        value = jnp.asarray(value)
+        self.value = value.reshape(self.rows.shape[0], *value.shape[1:]) \
+            if value.ndim >= 1 else value
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, dtype=self.value.dtype)
+        return dense.at[self.rows].add(self.value)
+
+    def merge(self):
+        """Sum duplicate rows → unique-row SelectedRows (reference
+        scatter::MergeAdd). Eager-only (SelectedRows never enters a jit
+        trace), so the dynamic unique-count shape is fine."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True)
+        summed = jnp.zeros((uniq.shape[0],) + tuple(self.value.shape[1:]),
+                           dtype=self.value.dtype)
+        summed = summed.at[inv.reshape(-1)].add(self.value)
+        return SelectedRows(uniq, summed, self.height)
+
+    def add(self, other):
+        """Concatenate contributions (cheap; densification deferred)."""
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                                jnp.concatenate([self.value, other.value]),
+                                self.height)
+        return self.to_dense() + jnp.asarray(other)
+
+    __add__ = add
+
+    def __radd__(self, other):
+        return self.add(other)
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.value.astype(dtype), self.height)
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"row_shape={tuple(self.value.shape[1:])})")

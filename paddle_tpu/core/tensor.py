@@ -180,7 +180,9 @@ class Tensor:
         self.grad = None
 
     def clear_gradient(self, set_to_zero=False):
-        if set_to_zero and self.grad is not None:
+        from .selected_rows import SelectedRows
+        if set_to_zero and self.grad is not None \
+                and not isinstance(self.grad, SelectedRows):
             # zero in place (hooked write): keeps the grad object stable so
             # compiled programs can treat it as mutated state
             self.grad._value = jnp.zeros_like(self.grad._val)
@@ -188,6 +190,28 @@ class Tensor:
             self.grad = None
 
     def _accumulate_grad(self, g):
+        from .selected_rows import SelectedRows
+        if isinstance(g, SelectedRows):
+            # sparse (embedding) gradient — gradient_accumulator.cc
+            # SelectedRows branch parity. Hooks (e.g. DataParallel) see the
+            # densified value; plain accumulation stays sparse.
+            if self._grad_capture is not None or self._hooks:
+                g = g.to_dense()
+                if isinstance(self.grad, SelectedRows):
+                    self.grad = Tensor(self.grad.to_dense(),
+                                       stop_gradient=True)
+            elif self.grad is None:
+                self.grad = g
+                return
+            elif isinstance(self.grad, SelectedRows):
+                self.grad = self.grad.add(g)
+                return
+            else:
+                self.grad._value = self.grad._value + g.to_dense()
+                return
+        elif isinstance(self.grad, SelectedRows):
+            self.grad = Tensor(self.grad.to_dense() + g, stop_gradient=True)
+            return
         if self._grad_capture is not None:
             self._grad_capture(g)
             return

@@ -44,5 +44,8 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
     return func(*args)
 
 
-def launch():
-    raise NotImplementedError("use python -m paddle_tpu.distributed.launch")
+from . import launch  # noqa: F401,E402  (python -m paddle_tpu.distributed.launch)
+from . import launch_utils  # noqa: F401,E402
+from . import fleet_executor  # noqa: F401,E402  (fleet_executor actor runtime)
+from . import ps  # noqa: F401,E402  (parameter-server stack)
+from . import transpiler  # noqa: F401,E402  (legacy DistributeTranspiler shim)

@@ -29,3 +29,4 @@ from . import spmd_pipeline  # noqa: F401,E402
 from .utils import recompute  # noqa: F401,E402
 from . import fs  # noqa: F401,E402  (fleet.utils.fs parity)
 from .fs import HDFSClient, LocalFS  # noqa: F401,E402
+from . import elastic  # noqa: F401,E402  (fleet.elastic parity)

@@ -1,0 +1,151 @@
+"""Elastic training manager.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:125-240 —
+nodes register in etcd with TTL leases; the manager watches membership,
+rewrites PADDLE_TRAINER_ENDPOINTS on scale-in/out, and relaunches trainers.
+
+TPU-native redesign: etcd is replaced by a pluggable Store. The default
+FileStore (a shared directory — NFS/GCS-fuse on a pod) keeps the same
+TTL-lease semantics with mtime heartbeats; a real deployment can supply an
+etcd/redis-backed store with the same 4-method interface. Scale events
+surface as ElasticStatus transitions, and `ElasticManager.watch` drives the
+launcher's relaunch loop exactly like the reference's manager."""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["ElasticStatus", "FileStore", "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"      # waiting for np to settle
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class FileStore:
+    """TTL-lease key/value store over a shared directory."""
+
+    def __init__(self, root, ttl=10.0):
+        self.root = root
+        self.ttl = ttl
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key):
+        return os.path.join(self.root, key.replace("/", "_"))
+
+    def put(self, key, value):
+        with open(self._path(key), "w") as f:
+            json.dump(value, f)
+
+    def refresh(self, key):
+        p = self._path(key)
+        if os.path.exists(p):
+            os.utime(p, None)
+
+    def get(self, key):
+        p = self._path(key)
+        if not os.path.exists(p):
+            return None
+        if time.time() - os.path.getmtime(p) > self.ttl:
+            return None  # lease expired
+        with open(p) as f:
+            return json.load(f)
+
+    def alive_values(self, prefix):
+        """Values of all non-expired keys under prefix."""
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            if not name.startswith(prefix.replace("/", "_")):
+                continue
+            p = os.path.join(self.root, name)
+            if time.time() - os.path.getmtime(p) <= self.ttl:
+                with open(p) as f:
+                    out.append(json.load(f))
+        return out
+
+    def delete(self, key):
+        p = self._path(key)
+        if os.path.exists(p):
+            os.remove(p)
+
+
+class ElasticManager:
+    """manager.py:125 parity over a Store."""
+
+    def __init__(self, store, job_id, np_min=1, np_max=None, rank=0,
+                 endpoint="127.0.0.1:0", heartbeat_interval=1.0):
+        self.store = store
+        self.job_id = job_id
+        self.np_min = np_min
+        self.np_max = np_max or np_min
+        self.rank = rank
+        self.endpoint = endpoint
+        self.heartbeat_interval = heartbeat_interval
+        self._key = f"{job_id}/node.{rank}"
+        self._registered = False
+        self._last_np = None
+
+    # -- registration / heartbeat ------------------------------------------
+    def register(self):
+        self.store.put(self._key, {"rank": self.rank,
+                                   "endpoint": self.endpoint,
+                                   "ts": time.time()})
+        self._registered = True
+        self._last_np = self.np()
+
+    def heartbeat(self):
+        if not self._registered:
+            self.register()
+        self.store.refresh(self._key)
+
+    def exit(self):
+        if self._registered:
+            self.store.delete(self._key)
+            self._registered = False
+
+    # -- membership --------------------------------------------------------
+    def alive_nodes(self):
+        return self.store.alive_values(f"{self.job_id}/node.")
+
+    def np(self):
+        return len(self.alive_nodes())
+
+    def endpoints(self):
+        nodes = sorted(self.alive_nodes(), key=lambda v: v["rank"])
+        return [v["endpoint"] for v in nodes]
+
+    # -- watch loop --------------------------------------------------------
+    def poll(self):
+        """One membership check → HOLD (below np_min) / RESTART (membership
+        changed) / "ok" (steady state). manager.py watch-step parity."""
+        self.heartbeat()
+        cur = self.np()
+        if cur < self.np_min:
+            return ElasticStatus.HOLD
+        if self._last_np is not None and cur != self._last_np:
+            self._last_np = cur
+            return ElasticStatus.RESTART
+        self._last_np = cur
+        return "ok"
+
+    def watch(self, until=None, on_restart=None):
+        """Heartbeat + watch membership until `until()` returns True.
+        Calls on_restart(new_np) on scale events; returns final status."""
+        while True:
+            self.heartbeat()
+            cur = self.np()
+            if self._last_np is not None and cur != self._last_np and \
+                    cur >= self.np_min:
+                self._last_np = cur
+                if on_restart:
+                    on_restart(cur)
+                return ElasticStatus.RESTART
+            self._last_np = cur
+            if until and until():
+                return ElasticStatus.COMPLETED
+            time.sleep(self.heartbeat_interval)

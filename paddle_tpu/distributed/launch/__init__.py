@@ -1,0 +1,80 @@
+"""`python -m paddle_tpu.distributed.launch` — multi-process job launcher.
+
+Reference: python/paddle/distributed/fleet/launch.py:456 (collective mode
+:281) — builds cluster topology from args, spawns one trainer per local
+device/slot with rank env, watches, and (elastic mode) relaunches on
+membership change.
+
+TPU-native: processes map to hosts (jax multi-host); for single-host testing
+`--nproc_per_node N` simulates N processes each seeing a CPU device slice
+(JAX_PLATFORMS=cpu) so loss-parity subprocess tests (SURVEY §4.5) run without
+a pod.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from ..launch_utils import (
+    get_cluster_from_args, start_local_trainers, terminate_local_procs,
+    watch_local_trainers,
+)
+
+__all__ = ["launch", "main"]
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a multi-process (per-host) distributed job")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host ips (reference --ips)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per node (hosts on TPU; simulated "
+                        "workers on CPU)")
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="per-rank workerlog.N directory")
+    p.add_argument("--start_port", type=int, default=None)
+    p.add_argument("--elastic_retries", type=int, default=0,
+                   help="relaunch attempts on failure (elastic-lite)")
+    p.add_argument("--cpu_sim", action="store_true",
+                   help="force JAX_PLATFORMS=cpu in trainers (virtual mesh)")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    attempts = args.elastic_retries + 1
+    last_err = None
+    for attempt in range(attempts):
+        cluster, pod = get_cluster_from_args(
+            ips=args.ips, nproc_per_node=args.nproc_per_node,
+            start_port=args.start_port)
+        envs = {}
+        if args.cpu_sim:
+            envs["JAX_PLATFORMS"] = "cpu"
+        procs = start_local_trainers(
+            cluster, pod, args.training_script,
+            args.training_script_args, log_dir=args.log_dir, envs=envs)
+        try:
+            codes = watch_local_trainers(procs)
+            return codes
+        except RuntimeError as e:
+            last_err = e
+            if attempt + 1 < attempts:
+                print(f"[launch] attempt {attempt + 1} failed ({e}); "
+                      f"relaunching", file=sys.stderr)
+                time.sleep(1.0)
+            continue
+        except KeyboardInterrupt:
+            terminate_local_procs(procs)
+            raise
+    raise last_err
+
+
+def main():
+    launch()

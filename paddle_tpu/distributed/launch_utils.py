@@ -1,0 +1,220 @@
+"""Launcher topology + process management.
+
+Reference: python/paddle/distributed/fleet/launch_utils.py — Cluster/Pod/
+Trainer topology (launch_utils.py:62,272), free-port picking (:859 region),
+start_local_trainers (:468), watch_local_trainers (:578).
+
+TPU-native redesign: the unit of launch is one process per HOST (jax
+multi-host model) rather than per accelerator — `nproc_per_node` exists for
+CPU-simulation and loss-parity tests, where each local process gets a slice of
+a virtual device mesh via XLA_FLAGS. Env contract keeps the reference names
+(PADDLE_TRAINER_ID, PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINERS_NUM,
+PADDLE_TRAINER_ENDPOINTS) plus the jax.distributed coordinator vars consumed
+by paddle_tpu.distributed.init_parallel_env.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["Trainer", "Pod", "Cluster", "find_free_ports",
+           "get_cluster", "get_cluster_from_args", "start_local_trainers",
+           "watch_local_trainers", "terminate_local_procs", "TrainerProc"]
+
+
+class Trainer:
+    def __init__(self, rank, endpoint, accelerators=None):
+        self.rank = rank
+        self.endpoint = endpoint
+        self.accelerators = accelerators or []
+
+    def __str__(self):
+        return f"Trainer(rank={self.rank}, endpoint={self.endpoint})"
+
+
+class Pod:
+    """One node's worth of trainers (launch_utils.py:272)."""
+
+    def __init__(self, idx, addr):
+        self.rank = idx
+        self.addr = addr
+        self.trainers = []
+
+    def trainers_num(self):
+        return len(self.trainers)
+
+    def get_visible_accelerators(self):
+        return ",".join(str(a) for t in self.trainers
+                        for a in t.accelerators)
+
+
+class Cluster:
+    def __init__(self):
+        self.pods = []
+        self.job_server = None
+
+    def trainers_nranks(self):
+        return sum(p.trainers_num() for p in self.pods)
+
+    def trainers_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def pods_endpoints(self):
+        return [p.addr for p in self.pods]
+
+    def get_pod_by_id(self, idx):
+        for p in self.pods:
+            if p.rank == idx:
+                return p
+        return None
+
+    def __str__(self):
+        return (f"Cluster(nranks={self.trainers_nranks()}, "
+                f"endpoints={self.trainers_endpoints()})")
+
+
+def find_free_ports(num):
+    """Reserve `num` distinct free TCP ports on localhost."""
+    socks, ports = [], []
+    try:
+        for _ in range(num):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, accelerators_per_proc):
+    """launch_utils.py:62 get_cluster parity."""
+    cluster = Cluster()
+    rank = 0
+    for pod_idx, ip in enumerate(node_ips):
+        pod = Pod(pod_idx, ip)
+        for local_idx, ep in enumerate(trainer_endpoints[pod_idx]):
+            accel = accelerators_per_proc[local_idx] \
+                if local_idx < len(accelerators_per_proc) else []
+            pod.trainers.append(Trainer(rank, ep, accel))
+            rank += 1
+        cluster.pods.append(pod)
+    pod = cluster.get_pod_by_id(node_ips.index(node_ip))
+    return cluster, pod
+
+
+def get_cluster_from_args(ips="127.0.0.1", nproc_per_node=1,
+                          current_ip=None, start_port=None):
+    node_ips = [ip.strip() for ip in ips.split(",") if ip.strip()]
+    current_ip = current_ip or node_ips[0]
+    eps = []
+    if len(node_ips) == 1 and start_port is None:
+        # single node: random free ports (reference launch_utils free-port
+        # picking) — safe because no other host needs to predict them
+        ports_per_node = [find_free_ports(nproc_per_node)]
+    else:
+        # multi-node: the endpoint table must be IDENTICAL on every host, so
+        # ports are deterministic (start_port, default 6070) — free-port
+        # randomness would desync PADDLE_TRAINER_ENDPOINTS across hosts
+        base = start_port or 6070
+        ports_per_node = [[base + i for i in range(nproc_per_node)]
+                          for _ in node_ips]
+    for ip, ports in zip(node_ips, ports_per_node):
+        eps.append([f"{ip}:{p}" for p in ports])
+    accel = [[i] for i in range(nproc_per_node)]
+    return get_cluster(node_ips, current_ip, eps, accel)
+
+
+class TrainerProc:
+    def __init__(self, proc, rank, log_fn, cmd):
+        self.proc = proc
+        self.rank = rank
+        self.log_fn = log_fn
+        self.cmd = cmd
+
+
+def _trainer_env(cluster, pod, trainer, extra_env=None):
+    eps = cluster.trainers_endpoints()
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(trainer.rank),
+        "PADDLE_CURRENT_ENDPOINT": trainer.endpoint,
+        "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+        # jax.distributed bootstrap (consumed by init_parallel_env)
+        "PADDLE_COORDINATOR_ADDR": eps[0],
+        "JAX_PROCESS_ID": str(trainer.rank),
+        "JAX_NUM_PROCESSES": str(cluster.trainers_nranks()),
+        "FLAGS_selected_accelerators": ",".join(
+            str(a) for a in trainer.accelerators),
+    })
+    env.update(extra_env or {})
+    return env
+
+
+def start_local_trainers(cluster, pod, training_script,
+                         training_script_args=(), log_dir=None,
+                         envs=None):
+    """launch_utils.py:468 parity: one subprocess per local trainer with the
+    rank env set; stdout/err tee'd to log_dir/workerlog.N."""
+    procs = []
+    for idx, t in enumerate(pod.trainers):
+        env = _trainer_env(cluster, pod, t, envs)
+        cmd = [sys.executable, "-u", training_script,
+               *map(str, training_script_args)]
+        fn = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            fn = open(os.path.join(log_dir, f"workerlog.{idx}"), "a")
+        proc = subprocess.Popen(cmd, env=env, stdout=fn or None,
+                                stderr=subprocess.STDOUT if fn else None)
+        procs.append(TrainerProc(proc, t.rank, fn, cmd))
+    return procs
+
+
+def terminate_local_procs(procs, timeout=15):
+    for tp in procs:
+        if tp.proc.poll() is None:
+            tp.proc.terminate()
+    deadline = time.time() + timeout
+    for tp in procs:
+        if tp.proc.poll() is None:
+            try:
+                tp.proc.wait(max(deadline - time.time(), 0.1))
+            except subprocess.TimeoutExpired:
+                tp.proc.kill()
+        if tp.log_fn:
+            tp.log_fn.close()
+            tp.log_fn = None
+
+
+def watch_local_trainers(procs, nranks=None, poll_interval=0.5):
+    """launch_utils.py:578 parity: block until all trainers exit cleanly or
+    one fails (then terminate the rest). Returns the list of exit codes."""
+    alive = list(procs)
+    try:
+        while alive:
+            for tp in list(alive):
+                ret = tp.proc.poll()
+                if ret is None:
+                    continue
+                alive.remove(tp)
+                if ret != 0:
+                    raise RuntimeError(
+                        f"trainer rank {tp.rank} exited with code {ret} "
+                        f"(cmd: {' '.join(tp.cmd)})")
+            time.sleep(poll_interval)
+    except (RuntimeError, KeyboardInterrupt):
+        terminate_local_procs(procs)
+        raise
+    for tp in procs:
+        if tp.log_fn:
+            tp.log_fn.close()
+            tp.log_fn = None
+    return [tp.proc.returncode for tp in procs]

@@ -1,0 +1,23 @@
+"""Parameter-server stack.
+
+Reference: paddle/fluid/distributed/ — brpc `PsService` (service/
+brpc_ps_server.h, brpc_ps_client.h, ps.proto), table layer (table/
+common_dense_table.h, common_sparse_table.h), async `Communicator`
+(service/communicator.cc), python `TheOnePSRuntime`
+(fleet/runtime/the_one_ps.py). SURVEY.md §2.7 marks this out of the TPU
+critical path; this package provides the same architecture at compact scale
+so PS-mode training (sparse embedding + async push) works end to end.
+
+TPU-native notes: the PS holds host-side numpy state (tables are DRAM-bound,
+not accelerator-bound — same as the reference); workers run their dense math
+on TPU and exchange dense/sparse rows with the PS over length-prefixed
+pickle-over-TCP (brpc's role). SelectedRows grads from Embedding(sparse=True)
+map directly onto push_sparse.
+"""
+from .table import CommonDenseTable, CommonSparseTable, Table
+from .service import PsServer, PsClient
+from .communicator import Communicator
+from .runtime import TheOnePSRuntime
+
+__all__ = ["Table", "CommonDenseTable", "CommonSparseTable", "PsServer",
+           "PsClient", "Communicator", "TheOnePSRuntime"]

@@ -1,0 +1,93 @@
+"""Async communicator.
+
+Reference: distributed/service/communicator.cc — workers enqueue grads; a
+background thread merges (sums) pending grads per table and pushes to the PS
+at send_queue intervals (async SGD). `flush` + `barrier` give the sync-mode
+path.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["Communicator"]
+
+
+class Communicator:
+    def __init__(self, client, send_interval=0.05, merge_size=4):
+        self.client = client
+        self.send_interval = send_interval
+        self.merge_size = merge_size
+        self._q = queue.Queue()
+        self._running = False
+        self._thread = None
+        self._idle = threading.Event()
+        self._idle.set()
+
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._running = False
+        if self._thread:
+            self._q.put(None)  # wake
+            self._thread.join(timeout=10)
+        self.flush()
+
+    # -- worker API --------------------------------------------------------
+    def push_dense(self, table_id, grad):
+        self._idle.clear()
+        self._q.put(("dense", table_id, np.asarray(grad, np.float32)))
+
+    def push_sparse(self, table_id, ids, grads):
+        self._idle.clear()
+        self._q.put(("sparse", table_id, (list(map(int, ids)),
+                                          np.asarray(grads, np.float32))))
+
+    def flush(self, timeout=30):
+        """Drain the queue synchronously (sync-mode barrier point)."""
+        pending = []
+        try:
+            while True:
+                pending.append(self._q.get_nowait())
+        except queue.Empty:
+            pass
+        self._send([p for p in pending if p is not None])
+        self._idle.wait(timeout)
+
+    # -- internals ---------------------------------------------------------
+    def _loop(self):
+        while self._running:
+            batch = []
+            try:
+                item = self._q.get(timeout=self.send_interval)
+                if item is not None:
+                    batch.append(item)
+                while len(batch) < self.merge_size:
+                    item = self._q.get_nowait()
+                    if item is not None:
+                        batch.append(item)
+            except queue.Empty:
+                pass
+            self._send(batch)
+            if self._q.empty():
+                self._idle.set()
+
+    def _send(self, batch):
+        if not batch:
+            return
+        # merge dense grads per table (communicator merge_add semantics)
+        dense = {}
+        for kind, tid, payload in batch:
+            if kind == "dense":
+                dense[tid] = dense.get(tid, 0) + payload
+            else:
+                ids, grads = payload
+                self.client.push_sparse(tid, ids, grads)
+        for tid, g in dense.items():
+            self.client.push_dense(tid, g)

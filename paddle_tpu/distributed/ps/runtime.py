@@ -1,0 +1,138 @@
+"""Worker-side PS runtime.
+
+Reference: python/paddle/distributed/fleet/runtime/the_one_ps.py — builds the
+table layout from the program (dense blocks + sparse embedding tables), wires
+workers to servers, and drives the pull-before/push-after train step.
+
+Dygraph-first here: table layout comes from the Layer tree (Embedding layers
+with sparse=True become sparse tables keyed by token id; every other
+parameter joins the dense table set). step_begin pulls, step_end pushes
+grads (dense full-block, sparse via the SelectedRows grad's rows)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .communicator import Communicator
+from .table import CommonDenseTable, CommonSparseTable
+
+__all__ = ["TheOnePSRuntime"]
+
+
+def _param_tables(model):
+    """(dense: [(table_id, param)], sparse: [(table_id, layer)])"""
+    dense, sparse = [], []
+    sparse_params = set()
+    for name, layer in model.named_sublayers(include_self=True):
+        if type(layer).__name__ == "Embedding" and getattr(layer, "_sparse",
+                                                           False):
+            sparse.append((f"sparse.{name or 'emb'}", layer))
+            sparse_params.add(id(layer.weight))
+    i = 0
+    for p in model.parameters():
+        if id(p) in sparse_params:
+            continue
+        dense.append((f"dense.{i}", p))
+        i += 1
+    return dense, sparse
+
+
+class TheOnePSRuntime:
+    def __init__(self, model, client, lr=0.01, mode="sync", nranks=1,
+                 rank=0, server_optimizer="sgd", assignment=None):
+        self.model = model
+        self.client = client
+        self.mode = mode
+        self.nranks = nranks
+        self.rank = rank
+        self.lr = lr
+        # table_id → server index (multi-pserver sharding; default server 0)
+        self._assignment = assignment or {}
+        self._dense, self._sparse = _param_tables(model)
+        self._comm = None
+        if mode == "async":
+            self._comm = Communicator(client).start()
+        self._last_sparse_ids = {}
+
+    # -- server bootstrap ---------------------------------------------------
+    @staticmethod
+    def build_server_tables(model, lr=0.01, server_optimizer="sgd"):
+        """Construct the server-side tables for this model's layout."""
+        dense, sparse = _param_tables(model)
+        tables = []
+        for tid, p in dense:
+            tables.append(CommonDenseTable(tid, tuple(p._val.shape),
+                                           optimizer=server_optimizer,
+                                           lr=lr))
+        for tid, layer in sparse:
+            tables.append(CommonSparseTable(tid, layer._embedding_dim,
+                                            optimizer=server_optimizer,
+                                            lr=lr))
+        return tables
+
+    def init_params(self):
+        """rank0 seeds the dense tables from its initial values
+        (init_worker/init_server handshake parity)."""
+        if self.rank == 0:
+            for tid, p in self._dense:
+                self.client.init_dense(tid, np.asarray(p._val), server=self._assignment.get(tid, 0))
+        self.client.barrier("init", self.nranks)
+
+    # -- train-step hooks ---------------------------------------------------
+    def step_begin(self, sparse_ids=None):
+        """Pull dense params; pull the batch's sparse rows into the embedding
+        weights. sparse_ids: {table_id or layer name suffix: id array}."""
+        import jax.numpy as jnp
+        for tid, p in self._dense:
+            p._value = jnp.asarray(self.client.pull_dense(tid, server=self._assignment.get(tid, 0)))
+        for tid, layer in self._sparse:
+            ids = None
+            if sparse_ids is not None:
+                for key, v in sparse_ids.items():
+                    if tid == key or tid.endswith(key):
+                        ids = np.unique(np.asarray(v).reshape(-1))
+            if ids is None:
+                continue
+            rows = self.client.pull_sparse(tid, ids, server=self._assignment.get(tid, 0))
+            layer.weight._value = layer.weight._val.at[
+                jnp.asarray(ids)].set(jnp.asarray(rows))
+            self._last_sparse_ids[tid] = ids
+
+    def step_end(self):
+        """Push grads: dense full-block; sparse via SelectedRows rows."""
+        from ...core.selected_rows import SelectedRows
+        for tid, p in self._dense:
+            if p.grad is None:
+                continue
+            g = np.asarray(p.grad._val if hasattr(p.grad, "_val")
+                           else p.grad.to_dense())
+            if self._comm is not None:
+                self._comm.push_dense(tid, g)
+            else:
+                self.client.push_dense(tid, g, server=self._assignment.get(tid, 0))
+        for tid, layer in self._sparse:
+            g = layer.weight.grad
+            if g is None:
+                continue
+            if isinstance(g, SelectedRows):
+                sr = g.merge()
+                ids = np.asarray(sr.rows)
+                grads = np.asarray(sr.value)
+            else:
+                ids = self._last_sparse_ids.get(tid)
+                if ids is None:
+                    continue
+                grads = np.asarray(g._val)[ids]
+            if self._comm is not None:
+                self._comm.push_sparse(tid, ids, grads)
+            else:
+                self.client.push_sparse(tid, ids, grads, server=self._assignment.get(tid, 0))
+        if self.mode == "sync":
+            self.client.barrier(f"step.{id(self)}", 1)
+
+    def flush(self):
+        if self._comm is not None:
+            self._comm.flush()
+
+    def stop(self):
+        if self._comm is not None:
+            self._comm.stop()

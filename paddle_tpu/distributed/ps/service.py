@@ -1,0 +1,181 @@
+"""PS RPC service.
+
+Reference: distributed/service/brpc_ps_server.{h,cc} + brpc_ps_client —
+request/response RPC keyed by (cmd, table_id) over brpc. Here: length-prefixed
+pickle frames over TCP (trusted cluster transport, matching the reference's
+deployment assumption), one thread per connection.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+__all__ = ["PsServer", "PsClient"]
+
+
+def _send_frame(sock, obj):
+    blob = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<Q", len(blob)) + blob)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock):
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class PsServer:
+    """brpc_ps_server parity: serves table ops; also a barrier service
+    (gloo_wrapper HTTP-store role)."""
+
+    def __init__(self, tables=None, host="127.0.0.1", port=0):
+        self.tables = {t.table_id: t for t in (tables or [])}
+        self._barrier_counts = {}
+        self._barrier_cv = threading.Condition()
+        server_self = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        req = _recv_frame(self.request)
+                        resp = server_self._dispatch(req)
+                        _send_frame(self.request, resp)
+                except (ConnectionError, EOFError):
+                    pass
+
+        self._server = socketserver.ThreadingTCPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    def add_table(self, table):
+        self.tables[table.table_id] = table
+
+    def start(self):
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+
+    def _dispatch(self, req):
+        cmd = req["cmd"]
+        try:
+            if cmd == "pull_dense":
+                return {"ok": True,
+                        "value": self.tables[req["table_id"]].pull()}
+            if cmd == "push_dense":
+                self.tables[req["table_id"]].push(req["grad"])
+                return {"ok": True}
+            if cmd == "init_dense":
+                self.tables[req["table_id"]].set(req["value"])
+                return {"ok": True}
+            if cmd == "pull_sparse":
+                return {"ok": True,
+                        "value": self.tables[req["table_id"]].pull(
+                            req["ids"])}
+            if cmd == "push_sparse":
+                self.tables[req["table_id"]].push(req["ids"], req["grads"])
+                return {"ok": True}
+            if cmd == "barrier":
+                return self._barrier(req["name"], req["nranks"])
+            if cmd == "stat":
+                return {"ok": True,
+                        "tables": {tid: getattr(t, "size", lambda: None)()
+                                   for tid, t in self.tables.items()}}
+            return {"ok": False, "error": f"unknown cmd {cmd}"}
+        except Exception as e:  # surfaced client-side as RuntimeError
+            return {"ok": False, "error": repr(e)}
+
+    def _barrier(self, name, nranks):
+        with self._barrier_cv:
+            self._barrier_counts[name] = self._barrier_counts.get(name, 0) + 1
+            self._barrier_cv.notify_all()
+            ok = self._barrier_cv.wait_for(
+                lambda: self._barrier_counts.get(name, 0) >= nranks,
+                timeout=60)
+        return {"ok": ok}
+
+
+class PsClient:
+    """brpc_ps_client parity: one persistent connection per server."""
+
+    def __init__(self, endpoints):
+        if isinstance(endpoints, str):
+            endpoints = [endpoints]
+        self.endpoints = endpoints
+        self._socks = {}
+        self._lock = threading.Lock()
+
+    def _sock(self, ep):
+        if ep not in self._socks:
+            host, port = ep.rsplit(":", 1)
+            self._socks[ep] = socket.create_connection((host, int(port)),
+                                                       timeout=60)
+        return self._socks[ep]
+
+    def _call(self, req, server=0):
+        ep = self.endpoints[server % len(self.endpoints)]
+        with self._lock:
+            sock = self._sock(ep)
+            _send_frame(sock, req)
+            resp = _recv_frame(sock)
+        if not resp.get("ok"):
+            raise RuntimeError(f"ps call {req['cmd']} failed: "
+                               f"{resp.get('error')}")
+        return resp
+
+    # -- dense ------------------------------------------------------------
+    def pull_dense(self, table_id, server=0):
+        return self._call({"cmd": "pull_dense", "table_id": table_id},
+                          server)["value"]
+
+    def push_dense(self, table_id, grad, server=0):
+        self._call({"cmd": "push_dense", "table_id": table_id,
+                    "grad": grad}, server)
+
+    def init_dense(self, table_id, value, server=0):
+        self._call({"cmd": "init_dense", "table_id": table_id,
+                    "value": value}, server)
+
+    # -- sparse -----------------------------------------------------------
+    def pull_sparse(self, table_id, ids, server=0):
+        return self._call({"cmd": "pull_sparse", "table_id": table_id,
+                           "ids": list(map(int, ids))}, server)["value"]
+
+    def push_sparse(self, table_id, ids, grads, server=0):
+        self._call({"cmd": "push_sparse", "table_id": table_id,
+                    "ids": list(map(int, ids)), "grads": grads}, server)
+
+    # -- control ----------------------------------------------------------
+    def barrier(self, name, nranks, server=0):
+        self._call({"cmd": "barrier", "name": name, "nranks": nranks},
+                   server)
+
+    def stat(self, server=0):
+        return self._call({"cmd": "stat"}, server)["tables"]
+
+    def close(self):
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks.clear()

@@ -1,0 +1,84 @@
+"""Legacy DistributeTranspiler shim.
+
+Reference: python/paddle/fluid/transpiler/distribute_transpiler.py:256 — the
+pre-fleet PS program rewriter (split vars across pservers, insert send/recv
+ops, emit per-role programs). SURVEY.md §2.6 marks it superseded by fleet
+meta-optimizers but still shipped.
+
+TPU-native: there is no ProgramDesc to rewrite — the shim keeps the classic
+API shape (transpile → per-role artifacts) and maps it onto the ps package:
+parameters are round-robin assigned to pserver endpoints, pserver roles get
+table lists, trainer roles get a TheOnePSRuntime bound to their client.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributeTranspilerConfig", "DistributeTranspiler"]
+
+
+class DistributeTranspilerConfig:
+    """distribute_transpiler.py DistributeTranspilerConfig parity (the knobs
+    that still mean something here)."""
+
+    def __init__(self):
+        self.slice_var_up = True       # kept for API parity; tables are not
+        self.min_block_size = 8192     # sliced at this scale
+        self.split_method = "RoundRobin"
+        self.sync_mode = True
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._model = None
+        self._pserver_eps = []
+        self._trainer_id = 0
+        self._trainers = 1
+        self._assignment = {}   # table_id -> endpoint
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  model=None, sync_mode=None):
+        """Classic signature; `model` (a Layer) replaces `program`."""
+        from .ps.runtime import _param_tables
+        self._model = model if model is not None else program
+        if self._model is None:
+            raise ValueError("transpile needs the model (Layer) — the TPU "
+                             "build has no ProgramDesc to rewrite")
+        self._trainer_id = trainer_id
+        self._trainers = trainers
+        if sync_mode is not None:
+            self.config.sync_mode = sync_mode
+        self._pserver_eps = [e.strip() for e in pservers.split(",")
+                             if e.strip()]
+        if not self._pserver_eps:
+            raise ValueError("pservers endpoint list is empty")
+        dense, sparse = _param_tables(self._model)
+        for i, (tid, _) in enumerate(list(dense) + list(sparse)):
+            self._assignment[tid] = \
+                self._pserver_eps[i % len(self._pserver_eps)]
+        return self
+
+    def get_pserver_program(self, endpoint, lr=0.01, server_optimizer="sgd"):
+        """→ list of tables this pserver should serve (the per-endpoint
+        'program')."""
+        from .ps.runtime import TheOnePSRuntime
+        tables = TheOnePSRuntime.build_server_tables(
+            self._model, lr=lr, server_optimizer=server_optimizer)
+        return [t for t in tables
+                if self._assignment.get(t.table_id) == endpoint]
+
+    get_pserver_programs = get_pserver_program
+
+    def get_trainer_program(self, lr=0.01, mode=None):
+        """→ TheOnePSRuntime driving pull/push for this trainer."""
+        from .ps.runtime import TheOnePSRuntime
+        from .ps.service import PsClient
+        client = PsClient(self._pserver_eps)
+        idx = {tid: self._pserver_eps.index(ep)
+               for tid, ep in self._assignment.items()}
+        return TheOnePSRuntime(
+            self._model, client, lr=lr,
+            mode=mode or ("sync" if self.config.sync_mode else "async"),
+            nranks=self._trainers, rank=self._trainer_id, assignment=idx)
+
+    def table_assignment(self):
+        return dict(self._assignment)

@@ -80,7 +80,10 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Reference: operators/lookup_table_v2 — gather rows; positions equal to
-    padding_idx produce zero vectors (and contribute zero gradient)."""
+    padding_idx produce zero vectors (and contribute zero gradient).
+    sparse=True yields the weight grad as SelectedRows (|tokens| rows instead
+    of a dense |vocab| table) — eager mode only; under tracing/static build
+    the dense scatter-add path is used (XLA fuses it)."""
     if padding_idx is not None and padding_idx < 0:
         padding_idx = weight.shape[0] + padding_idx
 
@@ -90,7 +93,52 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             mask = (idx != padding_idx)[..., None].astype(out.dtype)
             out = out * mask
         return out
+
+    if sparse:
+        from ...core import autograd as _ag
+        from ...core.dispatch import get_static_builder
+        from ...core.tensor import _TraceHooks
+        import jax.core as jax_core
+        wv, idx = unwrap(weight), unwrap(x)
+        # plain eager only: static build, jit tracing, and to_static
+        # discovery (hooked reads) all need the dense scatter-add grad so
+        # the compiled program's grad-state structure stays dense
+        eager = (get_static_builder() is None
+                 and _TraceHooks.on_read is None
+                 and not isinstance(wv, jax_core.Tracer)
+                 and not isinstance(idx, jax_core.Tracer)
+                 # the SelectedRows cotangent can only be accumulated on a
+                 # LEAF weight; a computed weight's upstream vjp needs arrays
+                 and getattr(weight, "_grad_node", None) is None)
+        if eager and _ag.is_grad_enabled() and isinstance(weight, Tensor) \
+                and not weight.stop_gradient:
+            return _sparse_embedding(idx, weight, padding_idx, prim)
     return apply(prim, weight, unwrap(x), name="embedding")
+
+
+def _sparse_embedding(idx, weight, padding_idx, prim):
+    """Manual tape node whose weight-cotangent is a SelectedRows."""
+    from ...core.autograd import GradNode
+    from ...core.selected_rows import SelectedRows
+
+    wv = weight._val
+    out_val = prim(wv, idx)
+    rows = idx.reshape(-1).astype(jnp.int32)
+
+    def vjp_fn(ct):
+        vals = ct.reshape(-1, wv.shape[1]).astype(wv.dtype)
+        if padding_idx is not None:
+            keep = (rows != padding_idx)[:, None].astype(vals.dtype)
+            vals = vals * keep
+        return (SelectedRows(rows, vals, height=wv.shape[0]),)
+
+    node = GradNode(vjp_fn=vjp_fn, inputs=[weight],
+                    out_meta=[(out_val.shape, out_val.dtype)],
+                    multi_output=False, name="embedding_sparse_grad")
+    out = Tensor(out_val, stop_gradient=False)
+    out._grad_node = node
+    out._out_index = 0
+    return out
 
 
 def one_hot(x, num_classes, name=None):

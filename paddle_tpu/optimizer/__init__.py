@@ -24,6 +24,12 @@ class SGD(Optimizer):
         lr_ = self._lr.astype(p._val.dtype)
         p._value = p._value - lr_ * g.astype(p._val.dtype)
 
+    def _apply_sparse_update(self, p, sr):
+        # sgd_op.h SelectedRows kernel parity: touch only the grad rows
+        lr_ = self._lr.astype(p._val.dtype)
+        p._value = p._value.at[sr.rows].add(
+            -lr_ * sr.value.astype(p._val.dtype))
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -54,6 +60,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def _apply_update(self, p, g):
         m = self._get_accumulator("moment1", p)
@@ -79,6 +86,34 @@ class Adam(Optimizer):
         denom = jnp.sqrt(v_new) + self._epsilon * jnp.sqrt(1 - b2p_new).astype(dtype)
         p._value = p._value - lr_t * (m_new / denom)
 
+    def _apply_sparse_update(self, p, sr):
+        """adam_op.h lazy_mode parity: moments decay + param update touch only
+        the (merged) grad rows; without lazy_mode the dense rule applies."""
+        if not self._lazy_mode:
+            return self._apply_update(p, sr.to_dense())
+        sr = sr.merge()
+        rows = sr.rows
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, shape=())
+        b2p = self._get_accumulator("beta2_pow", p, init=1.0, shape=())
+        dtype = p._val.dtype
+        g = sr.value.astype(dtype)
+        lr_ = self._lr.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        b1p_new = b1p._value * b1
+        b2p_new = b2p._value * b2
+        b1p._value = b1p_new
+        b2p._value = b2p_new
+        m_rows = b1 * m._value[rows] + (1 - b1) * g
+        v_rows = b2 * v._value[rows] + (1 - b2) * g * g
+        m._value = m._value.at[rows].set(m_rows)
+        v._value = v._value.at[rows].set(v_rows)
+        lr_t = (lr_ * jnp.sqrt(1 - b2p_new) / (1 - b1p_new)).astype(dtype)
+        denom = jnp.sqrt(v_rows) + \
+            self._epsilon * jnp.sqrt(1 - b2p_new).astype(dtype)
+        p._value = p._value.at[rows].add(-lr_t * (m_rows / denom))
+
 
 class AdamW(Adam):
     """Decoupled weight decay (reference: adamw semantics in adam_op with
@@ -89,7 +124,7 @@ class AdamW(Adam):
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip)
+                         None, grad_clip, lazy_mode=lazy_mode)
         self._coeff = float(weight_decay) if weight_decay is not None else 0.0
         self._apply_decay_param_fun = apply_decay_param_fun
 
@@ -99,6 +134,18 @@ class AdamW(Adam):
             lr_ = self._lr.astype(p._val.dtype)
             p._value = p._value * (1.0 - lr_ * self._coeff)
         super()._apply_update(p, g)
+
+    def _apply_sparse_update(self, p, sr):
+        if not self._lazy_mode:
+            return self._apply_update(p, sr.to_dense())
+        # lazy decoupled decay: only the touched (merged) rows decay —
+        # reference sparse AdamW row semantics
+        sr = sr.merge()
+        if self._coeff and (self._apply_decay_param_fun is None
+                            or self._apply_decay_param_fun(p.name)):
+            lr_ = self._lr.astype(p._val.dtype)
+            p._value = p._value.at[sr.rows].multiply(1.0 - lr_ * self._coeff)
+        super()._apply_sparse_update(p, sr)
 
 
 class Adagrad(Optimizer):

@@ -95,6 +95,7 @@ class Optimizer:
         append_regularization_ops parity): a per-param regularizer from
         ParamAttr takes precedence over the optimizer-level weight_decay.
         Decoupled decay (AdamW) overrides _apply_update instead."""
+        from ..core.selected_rows import SelectedRows
         wd = self._weight_decay
         coeff = 0.0
         if wd is not None:
@@ -102,6 +103,11 @@ class Optimizer:
         out = []
         for p, g in params_grads:
             if g is None:
+                out.append((p, g))
+                continue
+            if isinstance(g, SelectedRows):
+                # reference behavior: L2Decay on sparse grads is skipped
+                # (regularizer warns + passes through for SelectedRows)
                 out.append((p, g))
                 continue
             reg = getattr(p, "regularizer", None)
@@ -115,14 +121,39 @@ class Optimizer:
 
     @autograd.no_grad()
     def step(self):
+        from ..core.selected_rows import SelectedRows
         pairs = self._collect_params_grads()
         if self._grad_clip is not None:
-            pairs = self._grad_clip(pairs)
+            # Clip fns are elementwise scalers over arrays. A merged
+            # SelectedRows' value block has the same norm as its dense
+            # equivalent, so clip the value block through a proxy Tensor and
+            # rebuild — the grad STAYS sparse (reference clips SelectedRows
+            # via merge, never densifying).
+            sparse_slots = {}
+            proxied = []
+            for i, (p, g) in enumerate(pairs):
+                gv = unwrap(g)
+                if isinstance(gv, SelectedRows):
+                    sr = gv.merge()
+                    sparse_slots[i] = sr
+                    proxied.append((p, Tensor(sr.value, stop_gradient=True)))
+                else:
+                    proxied.append((p, g))
+            clipped = list(self._grad_clip(proxied))
+            for i, sr in sparse_slots.items():
+                p, gt = clipped[i]
+                clipped[i] = (p, SelectedRows(sr.rows, unwrap(gt),
+                                              sr.height))
+            pairs = clipped
         pairs = self._apply_decay(pairs)
         for p, g in pairs:
             if g is None:
                 continue
-            self._apply_update(p, unwrap(g))
+            gv = unwrap(g)
+            if isinstance(gv, SelectedRows):
+                self._apply_sparse_update(p, gv)
+            else:
+                self._apply_update(p, gv)
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
@@ -137,6 +168,11 @@ class Optimizer:
 
     def _apply_update(self, param, grad):
         raise NotImplementedError
+
+    def _apply_sparse_update(self, param, sr):
+        """SelectedRows grad. Default: densify (correct for every rule);
+        optimizers with true row-wise kernels (SGD, Adam lazy_mode) override."""
+        self._apply_update(param, sr.to_dense())
 
     def clear_grad(self, set_to_zero=False):
         if self._parameter_list:

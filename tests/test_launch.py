@@ -1,0 +1,144 @@
+"""Launcher + elastic tests (reference patterns: test_launch_coverage.py,
+test_fleet_elastic_manager.py; subprocess clusters per SURVEY §4.5)."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.launch_utils import (
+    Cluster, find_free_ports, get_cluster_from_args, start_local_trainers,
+    terminate_local_procs, watch_local_trainers,
+)
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, FileStore,
+)
+
+WORKER = """
+import json, os, sys
+out = {
+    "rank": os.environ["PADDLE_TRAINER_ID"],
+    "nranks": os.environ["PADDLE_TRAINERS_NUM"],
+    "endpoint": os.environ["PADDLE_CURRENT_ENDPOINT"],
+    "endpoints": os.environ["PADDLE_TRAINER_ENDPOINTS"],
+}
+with open(sys.argv[1] + "/rank" + out["rank"] + ".json", "w") as f:
+    json.dump(out, f)
+"""
+
+
+class TestClusterTopology:
+    def test_get_cluster_from_args(self):
+        cluster, pod = get_cluster_from_args(ips="127.0.0.1",
+                                             nproc_per_node=4)
+        assert cluster.trainers_nranks() == 4
+        assert pod.trainers_num() == 4
+        eps = cluster.trainers_endpoints()
+        assert len(set(eps)) == 4
+        assert all(ep.startswith("127.0.0.1:") for ep in eps)
+
+    def test_multi_node_topology(self):
+        cluster, pod = get_cluster_from_args(
+            ips="10.0.0.1,10.0.0.2", nproc_per_node=2,
+            current_ip="10.0.0.1", start_port=6170)
+        assert cluster.trainers_nranks() == 4
+        assert cluster.pods_endpoints() == ["10.0.0.1", "10.0.0.2"]
+        assert pod.rank == 0
+        # global ranks are contiguous across pods
+        assert [t.rank for p in cluster.pods for t in p.trainers] == \
+            [0, 1, 2, 3]
+
+    def test_find_free_ports_distinct(self):
+        ports = find_free_ports(8)
+        assert len(set(ports)) == 8
+
+
+class TestLocalLaunch:
+    def test_spawn_watch_and_env(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(WORKER)
+        out = tmp_path / "out"
+        out.mkdir()
+        cluster, pod = get_cluster_from_args(nproc_per_node=2)
+        procs = start_local_trainers(
+            cluster, pod, str(script), [str(out)],
+            log_dir=str(tmp_path / "logs"),
+            envs={"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""})
+        codes = watch_local_trainers(procs)
+        assert codes == [0, 0]
+        for rank in (0, 1):
+            with open(out / f"rank{rank}.json") as f:
+                info = json.load(f)
+            assert info["nranks"] == "2"
+            assert len(info["endpoints"].split(",")) == 2
+            assert info["endpoint"] in info["endpoints"]
+
+    def test_failure_terminates_peers(self, tmp_path):
+        fail = tmp_path / "fail.py"
+        fail.write_text("import os, sys, time\n"
+                        "sys.exit(3) if os.environ['PADDLE_TRAINER_ID']=='1' "
+                        "else time.sleep(60)\n")
+        cluster, pod = get_cluster_from_args(nproc_per_node=2)
+        procs = start_local_trainers(cluster, pod, str(fail), [],
+                                     envs={"PYTHONPATH": ""})
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="rank 1 exited with code 3"):
+            watch_local_trainers(procs)
+        assert time.time() - t0 < 40  # did not wait for the sleeper
+        assert all(tp.proc.poll() is not None for tp in procs)
+
+    def test_module_entrypoint(self, tmp_path):
+        ok = tmp_path / "ok.py"
+        ok.write_text("print('hi')\n")
+        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", str(ok)],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+
+
+class TestElastic:
+    def test_register_heartbeat_membership(self, tmp_path):
+        store = FileStore(str(tmp_path), ttl=2.0)
+        m0 = ElasticManager(store, "job1", np_min=1, np_max=3, rank=0,
+                            endpoint="h0:1")
+        m1 = ElasticManager(store, "job1", np_min=1, np_max=3, rank=1,
+                            endpoint="h1:1")
+        m0.register()
+        assert m0.np() == 1 and m0.poll() == "ok"
+        m1.register()
+        assert m0.np() == 2
+        assert m0.poll() == ElasticStatus.RESTART  # scale-out seen
+        assert m0.poll() == "ok"                   # settled
+        assert m0.endpoints() == ["h0:1", "h1:1"]
+
+    def test_lease_expiry_scale_in(self, tmp_path):
+        store = FileStore(str(tmp_path), ttl=0.5)
+        m0 = ElasticManager(store, "job2", np_min=1, rank=0, endpoint="h0:1")
+        m1 = ElasticManager(store, "job2", np_min=1, rank=1, endpoint="h1:1")
+        m0.register()
+        m1.register()
+        assert m0.poll() in ("ok", ElasticStatus.RESTART)
+        m0.poll()
+        # node 1 dies (stops heartbeating) → lease expires
+        time.sleep(0.8)
+        m0.heartbeat()
+        assert m0.np() == 1
+        assert m0.poll() == ElasticStatus.RESTART
+
+    def test_hold_below_min(self, tmp_path):
+        store = FileStore(str(tmp_path), ttl=5.0)
+        m = ElasticManager(store, "job3", np_min=2, rank=0, endpoint="h0:1")
+        m.register()
+        assert m.poll() == ElasticStatus.HOLD
+
+    def test_exit_removes_node(self, tmp_path):
+        store = FileStore(str(tmp_path), ttl=5.0)
+        m = ElasticManager(store, "job4", np_min=1, rank=0, endpoint="h0:1")
+        m.register()
+        assert m.np() == 1
+        m.exit()
+        assert m.np() == 0

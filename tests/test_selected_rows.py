@@ -1,0 +1,203 @@
+"""SelectedRows sparse-gradient tests (reference patterns:
+test_lookup_table_v2_op.py is_sparse cases, test_adam_op.py lazy_mode,
+gradient_accumulator SelectedRows branches)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import SelectedRows
+
+
+class TestSelectedRows:
+    def test_to_dense_and_merge(self):
+        sr = SelectedRows([1, 3, 1], np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                              dtype=np.float32), height=5)
+        dense = np.asarray(sr.to_dense())
+        expect = np.zeros((5, 2), np.float32)
+        expect[1] = [6., 8.]
+        expect[3] = [3., 4.]
+        np.testing.assert_allclose(dense, expect)
+        merged = sr.merge()
+        assert merged.rows.shape[0] == 2
+        np.testing.assert_allclose(np.asarray(merged.to_dense()), expect)
+
+    def test_add(self):
+        a = SelectedRows([0], np.ones((1, 2), np.float32), height=3)
+        b = SelectedRows([2], np.ones((1, 2), np.float32) * 2, height=3)
+        c = a + b
+        dense = np.asarray(c.to_dense())
+        np.testing.assert_allclose(dense[0], [1, 1])
+        np.testing.assert_allclose(dense[2], [2, 2])
+
+
+class TestSparseEmbeddingGrad:
+    def test_grad_is_selected_rows_and_matches_dense(self):
+        paddle.seed(0)
+        vocab, dim = 10, 4
+        ids = np.array([[1, 2, 1], [7, 2, 0]], dtype=np.int64)
+
+        emb_s = nn.Embedding(vocab, dim, sparse=True)
+        emb_d = nn.Embedding(vocab, dim, sparse=False)
+        emb_d.weight._value = emb_s.weight._val
+
+        out_s = emb_s(paddle.to_tensor(ids))
+        (out_s * out_s).sum().backward()
+        out_d = emb_d(paddle.to_tensor(ids))
+        (out_d * out_d).sum().backward()
+
+        assert isinstance(emb_s.weight.grad, SelectedRows)
+        assert emb_s.weight.grad.height == vocab
+        np.testing.assert_allclose(
+            np.asarray(emb_s.weight.grad.to_dense()),
+            emb_d.weight.grad.numpy(), rtol=1e-5, atol=1e-6)
+        # untouched vocab rows have exactly zero grad
+        np.testing.assert_allclose(
+            np.asarray(emb_s.weight.grad.to_dense())[3], np.zeros(dim))
+
+    def test_padding_idx_zero_grad(self):
+        emb = nn.Embedding(6, 4, padding_idx=0, sparse=True)
+        ids = np.array([[0, 2]], dtype=np.int64)
+        out = emb(paddle.to_tensor(ids))
+        out.sum().backward()
+        dense = np.asarray(emb.weight.grad.to_dense())
+        np.testing.assert_allclose(dense[0], np.zeros(4))
+        assert np.abs(dense[2]).sum() > 0
+
+    def test_sgd_sparse_update_matches_dense(self):
+        paddle.seed(0)
+        ids = np.array([1, 3, 3], dtype=np.int64)
+
+        def run(sparse):
+            paddle.seed(0)
+            emb = nn.Embedding(8, 4, sparse=sparse)
+            opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                       parameters=emb.parameters())
+            for _ in range(3):
+                out = emb(paddle.to_tensor(ids))
+                (out * out).sum().backward()
+                opt.step()
+                opt.clear_grad()
+            return emb.weight.numpy()
+
+        np.testing.assert_allclose(run(True), run(False), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_adam_lazy_mode_touches_only_rows(self):
+        paddle.seed(0)
+        emb = nn.Embedding(8, 4, sparse=True)
+        w0 = emb.weight.numpy().copy()
+        opt = paddle.optimizer.Adam(learning_rate=0.1, lazy_mode=True,
+                                    parameters=emb.parameters())
+        ids = np.array([2, 5], dtype=np.int64)
+        out = emb(paddle.to_tensor(ids))
+        (out * out).sum().backward()
+        opt.step()
+        w1 = emb.weight.numpy()
+        changed = np.abs(w1 - w0).sum(axis=1) > 0
+        assert changed[2] and changed[5]
+        assert not changed[[0, 1, 3, 4, 6, 7]].any()
+
+    def test_adam_non_lazy_dense_fallback(self):
+        paddle.seed(0)
+        ids = np.array([0, 1], dtype=np.int64)
+
+        def run(sparse):
+            paddle.seed(0)
+            emb = nn.Embedding(4, 2, sparse=sparse)
+            opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                        parameters=emb.parameters())
+            for _ in range(2):
+                out = emb(paddle.to_tensor(ids))
+                out.sum().backward()
+                opt.step()
+                opt.clear_grad()
+            return emb.weight.numpy()
+
+        np.testing.assert_allclose(run(True), run(False), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_grad_accumulation_without_clear(self):
+        emb = nn.Embedding(6, 2, sparse=True)
+        ids = paddle.to_tensor(np.array([1], dtype=np.int64))
+        emb(ids).sum().backward()
+        emb(ids).sum().backward()  # accumulates (concat) without clear
+        dense = np.asarray(emb.weight.grad.to_dense())
+        np.testing.assert_allclose(dense[1], [2.0, 2.0], rtol=1e-6)
+
+    def test_to_static_falls_back_to_dense(self):
+        paddle.seed(0)
+        emb = nn.Embedding(8, 4, sparse=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=emb.parameters())
+
+        @paddle.jit.to_static
+        def step(x):
+            loss = (emb(x) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = paddle.to_tensor(np.array([1, 2], dtype=np.int64))
+        vals = [float(step(x).numpy()) for _ in range(4)]
+        assert vals[-1] < vals[0]
+
+
+class TestSparseGradEdgeCases:
+    """Review-found edges: paddle.grad capture, non-leaf weights, AdamW lazy
+    decay, clip keeping grads sparse."""
+
+    def test_paddle_grad_densifies(self):
+        w = paddle.to_tensor(
+            np.random.RandomState(0).randn(6, 3).astype("float32"),
+            stop_gradient=False)
+        x = paddle.to_tensor(np.array([1, 4], dtype=np.int64))
+        out = F.embedding(x, w, sparse=True)
+        (g,) = paddle.grad(out.sum(), w)
+        assert g.shape == [6, 3]
+        assert np.abs(g.numpy()[[1, 4]]).sum() > 0
+
+    def test_non_leaf_weight_falls_back_dense(self):
+        w = paddle.to_tensor(
+            np.random.RandomState(0).randn(6, 3).astype("float32"),
+            stop_gradient=False)
+        scaled = w * 2.0  # non-leaf
+        x = paddle.to_tensor(np.array([1, 4], dtype=np.int64))
+        out = F.embedding(x, scaled, sparse=True)
+        out.sum().backward()
+        assert not isinstance(w.grad, SelectedRows)
+        assert np.abs(w.grad.numpy()[[1, 4]]).sum() > 0
+
+    def test_adamw_lazy_decays_touched_rows_only(self):
+        paddle.seed(0)
+        emb = nn.Embedding(6, 2, sparse=True)
+        import jax.numpy as jnp
+        emb.weight._value = jnp.ones((6, 2))
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                                     lazy_mode=True,
+                                     parameters=emb.parameters())
+        ids = paddle.to_tensor(np.array([2], dtype=np.int64))
+        # zero grad on row 2 (forward * 0) still decays that row
+        (emb(ids).sum() * 0.0).backward()
+        opt.step()
+        w = emb.weight.numpy()
+        assert w[2, 0] < 1.0          # decayed
+        np.testing.assert_allclose(w[0], [1.0, 1.0])  # untouched row intact
+
+    def test_clip_keeps_grad_sparse_and_scales(self):
+        paddle.seed(0)
+        emb = nn.Embedding(8, 4, sparse=True)
+        clip = nn.ClipGradByGlobalNorm(0.01)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, grad_clip=clip,
+                                   parameters=emb.parameters())
+        ids = paddle.to_tensor(np.array([1, 5], dtype=np.int64))
+        (emb(ids) ** 2).sum().backward()
+        w0 = emb.weight.numpy().copy()
+        opt.step()
+        delta = emb.weight.numpy() - w0
+        # untouched rows must stay untouched (sparse kernel ran post-clip)
+        untouched = [i for i in range(8) if i not in (1, 5)]
+        assert np.abs(delta[untouched]).sum() == 0
+        # clipped: total step norm bounded by lr * clip_norm
+        assert np.linalg.norm(delta) <= 0.01 + 1e-5
