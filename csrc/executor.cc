@@ -1,0 +1,197 @@
+// Native parallel executor — dependency-counted DAG scheduler over the
+// graph IR.
+//
+// TPU-native analog of the reference's ParallelExecutor SSA-graph executors
+// (framework/details/fast_threaded_ssa_graph_executor.cc: dep-counted
+// OpHandle DAG on a thread pool) and the new executor's async workqueue
+// (framework/new_executor/interpretercore.cc). On TPU the device math is one
+// XLA program, so what stays native is HOST-side orchestration: running
+// feed/fetch/op callbacks in dependency order with bounded parallelism.
+// Dependencies are computed from the program's def-use chains: RAW (reader
+// after latest prior writer), WAW (writer after prior writer) and WAR
+// (writer after prior readers) — the same hazard edges the reference's SSA
+// graph encodes with vars/dummy deps.
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "graph_ir.h"
+
+namespace paddle_tpu {
+namespace {
+
+// hazard-complete dependency edges for one block
+std::vector<std::vector<int32_t>> DepEdges(const BlockDesc& b) {
+  size_t n = b.ops.size();
+  std::vector<std::vector<int32_t>> deps(n);
+  std::unordered_map<std::string, int32_t> last_writer;
+  std::unordered_map<std::string, std::vector<int32_t>> readers_since_write;
+  auto add = [&](size_t i, int32_t d) {
+    if (d >= 0 && d != static_cast<int32_t>(i))
+      deps[i].push_back(d);
+  };
+  for (size_t i = 0; i < n; ++i) {
+    const OpDesc& op = b.ops[i];
+    for (const auto& kv : op.inputs)
+      for (const auto& v : kv.second) {
+        auto it = last_writer.find(v);
+        if (it != last_writer.end()) add(i, it->second);  // RAW
+        readers_since_write[v].push_back(static_cast<int32_t>(i));
+      }
+    for (const auto& kv : op.outputs)
+      for (const auto& v : kv.second) {
+        auto it = last_writer.find(v);
+        if (it != last_writer.end()) add(i, it->second);  // WAW
+        auto rit = readers_since_write.find(v);
+        if (rit != readers_since_write.end()) {
+          for (int32_t r : rit->second) add(i, r);        // WAR
+          rit->second.clear();
+        }
+        last_writer[v] = static_cast<int32_t>(i);
+      }
+  }
+  for (auto& d : deps) {
+    std::sort(d.begin(), d.end());
+    d.erase(std::unique(d.begin(), d.end()), d.end());
+  }
+  return deps;
+}
+
+class Executor {
+ public:
+  explicit Executor(int32_t threads)
+      : n_threads_(threads < 1 ? 1 : threads) {}
+
+  using Callback = void (*)(int32_t, void*);
+
+  void Run(const BlockDesc& b, Callback cb, void* ud) {
+    size_t n = b.ops.size();
+    if (n == 0) return;
+    auto deps = DepEdges(b);
+    std::vector<std::vector<int32_t>> users(n);
+    std::vector<std::atomic<int32_t>> indeg(n);
+    for (size_t i = 0; i < n; ++i) {
+      indeg[i].store(static_cast<int32_t>(deps[i].size()));
+      for (int32_t d : deps[i]) users[d].push_back(static_cast<int32_t>(i));
+    }
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<int32_t> ready;
+    size_t done = 0;
+    bool failed = false;
+    for (size_t i = 0; i < n; ++i)
+      if (indeg[i].load() == 0) ready.push_back(static_cast<int32_t>(i));
+    PT_ENFORCE(!ready.empty(), kPreconditionNotMet,
+               "op graph has no entry nodes (cycle)");
+
+    auto worker = [&]() {
+      for (;;) {
+        int32_t cur;
+        {
+          std::unique_lock<std::mutex> lk(mu);
+          cv.wait(lk, [&] {
+            return failed || done == n || !ready.empty();
+          });
+          if (failed || done == n) return;
+          cur = ready.front();
+          ready.pop_front();
+        }
+        try {
+          cb(cur, ud);
+        } catch (...) {
+          std::lock_guard<std::mutex> lk(mu);
+          failed = true;
+          cv.notify_all();
+          return;
+        }
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          ++done;
+          for (int32_t u : users[cur])
+            if (indeg[u].fetch_sub(1) == 1) ready.push_back(u);
+          cv.notify_all();
+        }
+      }
+    };
+    std::vector<std::thread> pool;
+    int32_t k = std::min<int32_t>(n_threads_, static_cast<int32_t>(n));
+    pool.reserve(static_cast<size_t>(k));
+    for (int32_t t = 0; t < k; ++t) pool.emplace_back(worker);
+    for (auto& t : pool) t.join();
+    PT_ENFORCE(!failed, kExternal, "op callback raised");
+    PT_ENFORCE(done == n, kPreconditionNotMet,
+               "cycle detected: %zu of %zu ops ran", done, n);
+  }
+
+ private:
+  int32_t n_threads_;
+};
+
+}  // namespace
+
+// Wave schedule: level[i] = longest dep path to op i; ops sharing a level
+// can run concurrently (details/ SSA graph "ready set" snapshot).
+static std::vector<int32_t> Levels(const BlockDesc& b) {
+  auto deps = DepEdges(b);
+  size_t n = b.ops.size();
+  std::vector<int32_t> level(n, 0);
+  for (size_t i = 0; i < n; ++i)  // deps point backwards → one pass works
+    for (int32_t d : deps[i])
+      level[i] = std::max(level[i], level[static_cast<size_t>(d)] + 1);
+  return level;
+}
+
+}  // namespace paddle_tpu
+
+using paddle_tpu::BlockDesc;
+using paddle_tpu::ProgramDesc;
+
+extern "C" {
+
+void* pt_exec_create(int32_t num_threads) {
+  PT_CAPI_BEGIN
+  return new paddle_tpu::Executor(num_threads);
+  PT_CAPI_END(nullptr)
+}
+
+void pt_exec_destroy(void* e) {
+  delete static_cast<paddle_tpu::Executor*>(e);
+}
+
+int32_t pt_exec_run(void* e, void* prog, int32_t blk,
+                    void (*cb)(int32_t, void*), void* ud) {
+  PT_CAPI_BEGIN
+  auto* p = static_cast<ProgramDesc*>(prog);
+  PT_ENFORCE(blk >= 0 && blk < static_cast<int32_t>(p->blocks.size()),
+             kOutOfRange, "bad block %d", blk);
+  static_cast<paddle_tpu::Executor*>(e)->Run(
+      p->blocks[static_cast<size_t>(blk)], cb, ud);
+  return 0;
+  PT_CAPI_END(-1)
+}
+
+// out must have room for num_ops entries; returns number of ops (or -1).
+int32_t pt_exec_levels(void* prog, int32_t blk, int32_t* out, int32_t cap) {
+  PT_CAPI_BEGIN
+  auto* p = static_cast<ProgramDesc*>(prog);
+  PT_ENFORCE(blk >= 0 && blk < static_cast<int32_t>(p->blocks.size()),
+             kOutOfRange, "bad block %d", blk);
+  auto lv = paddle_tpu::Levels(p->blocks[static_cast<size_t>(blk)]);
+  PT_ENFORCE(static_cast<int32_t>(lv.size()) <= cap,
+             kOutOfRange,
+             "levels buffer too small (%zu > %d)", lv.size(), cap);
+  for (size_t i = 0; i < lv.size(); ++i) out[i] = lv[i];
+  return static_cast<int32_t>(lv.size());
+  PT_CAPI_END(-1)
+}
+
+}  // extern "C"

@@ -21,50 +21,11 @@
 #include <vector>
 
 #include "common.h"
+#include "graph_ir.h"
 
 namespace paddle_tpu {
 namespace {
 
-enum class AttrKind : int32_t { kInt = 0, kFloat = 1, kString = 2,
-                                kInts = 3, kFloats = 4, kBool = 5 };
-
-struct Attr {
-  AttrKind kind;
-  int64_t i = 0;
-  double f = 0.0;
-  bool b = false;
-  std::string s;
-  std::vector<int64_t> ints;
-  std::vector<double> floats;
-};
-
-struct VarDesc {
-  std::string name;
-  int32_t dtype = -1;          // framework dtype enum (python side owns map)
-  std::vector<int64_t> shape;  // -1 = dynamic dim
-  bool persistable = false;
-};
-
-struct OpDesc {
-  std::string type;
-  // slot → ordered var names (framework.proto OpDesc.Var repeated arguments)
-  std::map<std::string, std::vector<std::string>> inputs;
-  std::map<std::string, std::vector<std::string>> outputs;
-  std::map<std::string, Attr> attrs;
-};
-
-struct BlockDesc {
-  int32_t idx = 0;
-  int32_t parent = -1;
-  std::vector<VarDesc> vars;
-  std::vector<OpDesc> ops;
-  std::unordered_map<std::string, int32_t> var_index;
-};
-
-struct ProgramDesc {
-  std::vector<BlockDesc> blocks;
-  int64_t version = 1;
-};
 
 // ---- serialization (length-prefixed binary, magic "PTIR") --------------
 class Writer {

@@ -35,8 +35,8 @@ class NativeUnavailable(RuntimeError):
 
 def _sources():
     return [os.path.join(_CSRC, f) for f in
-            ("common.h", "flags.cc", "profiler.cc", "memory.cc", "io.cc",
-             "graph.cc")]
+            ("common.h", "graph_ir.h", "flags.cc", "profiler.cc", "memory.cc",
+             "io.cc", "graph.cc", "executor.cc")]
 
 
 def _stale() -> bool:
@@ -64,6 +64,9 @@ def _build() -> None:
                            cwd=_CSRC)
         finally:
             fcntl.flock(lf, fcntl.LOCK_UN)
+
+
+EXEC_CALLBACK = ctypes.CFUNCTYPE(None, ctypes.c_int32, ctypes.c_void_p)
 
 
 def _declare(lib: ctypes.CDLL) -> None:
@@ -130,6 +133,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     sig("pt_prog_serialize", i64, [p, c.c_char_p, i64])
     sig("pt_prog_deserialize", p, [c.c_char_p, i64])
     sig("pt_prog_to_json", i64, [p, c.c_char_p, i64])
+    sig("pt_exec_create", p, [i32])
+    sig("pt_exec_destroy", None, [p])
+    sig("pt_exec_run", i32, [p, p, i32, EXEC_CALLBACK, p])
+    sig("pt_exec_levels", i32, [p, i32, c.POINTER(i32), i32])
 
 
 def load() -> ctypes.CDLL:

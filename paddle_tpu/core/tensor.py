@@ -191,24 +191,25 @@ class Tensor:
 
     def _accumulate_grad(self, g):
         from .selected_rows import SelectedRows
-        if isinstance(g, SelectedRows):
-            # sparse (embedding) gradient — gradient_accumulator.cc
-            # SelectedRows branch parity. Hooks (e.g. DataParallel) see the
-            # densified value; plain accumulation stays sparse.
-            if self._grad_capture is not None or self._hooks:
+        observed = self._grad_capture is not None or self._hooks
+        if observed:
+            # capture/hooks (paddle.grad, DataParallel) are dense-typed:
+            # densify the incoming grad AND any stale sparse .grad, then
+            # fall through to the normal path so they always fire
+            if isinstance(g, SelectedRows):
                 g = g.to_dense()
-                if isinstance(self.grad, SelectedRows):
-                    self.grad = Tensor(self.grad.to_dense(),
-                                       stop_gradient=True)
-            elif self.grad is None:
+            if isinstance(self.grad, SelectedRows):
+                self.grad = Tensor(self.grad.to_dense(), stop_gradient=True)
+        elif isinstance(g, SelectedRows):
+            # sparse (embedding) gradient — gradient_accumulator.cc
+            # SelectedRows branch parity
+            if self.grad is None:
                 self.grad = g
-                return
             elif isinstance(self.grad, SelectedRows):
                 self.grad = self.grad.add(g)
-                return
             else:
                 self.grad._value = self.grad._value + g.to_dense()
-                return
+            return
         elif isinstance(self.grad, SelectedRows):
             self.grad = Tensor(self.grad.to_dense() + g, stop_gradient=True)
             return

@@ -30,3 +30,4 @@ from .utils import recompute  # noqa: F401,E402
 from . import fs  # noqa: F401,E402  (fleet.utils.fs parity)
 from .fs import HDFSClient, LocalFS  # noqa: F401,E402
 from . import elastic  # noqa: F401,E402  (fleet.elastic parity)
+from . import metrics  # noqa: F401,E402  (fleet.metrics parity)

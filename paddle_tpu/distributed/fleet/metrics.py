@@ -1,0 +1,86 @@
+"""Distributed (global) metrics.
+
+Reference: python/paddle/distributed/fleet/metrics/metric.py — global
+sum/max/min/auc/mae/rmse/mse/acc computed by all-reducing local stat arrays
+over the worker group (gloo all-reduce in the reference; here the mesh
+collective / jax reduction — a single-process mesh reduces to identity, the
+multi-host path rides jax.distributed).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
+
+
+def _to_np(x):
+    if hasattr(x, "numpy"):
+        return np.asarray(x.numpy(), dtype=np.float64)
+    return np.asarray(x, dtype=np.float64)
+
+
+def _allreduce(arr, op="sum"):
+    """All-reduce over worker processes (metric.py gloo path). Single-process
+    jobs (the common single-host TPU mesh: one process drives all chips)
+    return locally."""
+    import jax
+    if jax.process_count() <= 1:
+        return arr
+    from ..collective import all_reduce, ReduceOp
+    import paddle_tpu as paddle
+    t = paddle.to_tensor(arr.astype(np.float32))
+    all_reduce(t, op=ReduceOp.SUM if op == "sum" else
+               ReduceOp.MAX if op == "max" else ReduceOp.MIN)
+    return np.asarray(t.numpy(), dtype=np.float64)
+
+
+def sum(input, scope=None, util=None):  # noqa: A001
+    return _allreduce(_to_np(input), "sum")
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    return _allreduce(_to_np(input), "max")
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    return _allreduce(_to_np(input), "min")
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-bucket positive/negative counts (metric.py:144 —
+    same trapezoid accumulation over the merged histograms)."""
+    pos = _allreduce(_to_np(stat_pos), "sum").reshape(-1)
+    neg = _allreduce(_to_np(stat_neg), "sum").reshape(-1)
+    # walk buckets from highest score to lowest (reference iterates reversed)
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0.0 or tot_neg == 0.0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    e = sum(abserr).reshape(-1).sum()
+    n = sum(total_ins_num).reshape(-1).sum()
+    return float(e) / float(np.maximum(n, 1.0))
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    e = sum(sqrerr).reshape(-1).sum()
+    n = sum(total_ins_num).reshape(-1).sum()
+    return float(e) / float(np.maximum(n, 1.0))
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+
+
+def acc(correct, total, scope=None, util=None):
+    c = sum(correct).reshape(-1).sum()
+    t = sum(total).reshape(-1).sum()
+    return float(c) / float(np.maximum(t, 1.0))

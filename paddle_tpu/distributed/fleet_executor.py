@@ -17,10 +17,11 @@ is exactly what a 1F1B pipeline schedule needs.
 """
 from __future__ import annotations
 
-import json
+import pickle
 import queue
 import socket
 import socketserver
+import struct
 import threading
 
 __all__ = ["TaskNode", "Interceptor", "ComputeInterceptor", "Carrier",
@@ -219,8 +220,10 @@ class MessageBus:
             return True
         addr = self.addr_table[rank]
         host, port = addr.rsplit(":", 1)
-        with socket.create_connection((host, int(port)), timeout=30) as s:
-            s.sendall((json.dumps(msg) + "\n").encode())
+        blob = pickle.dumps(dict(msg), protocol=4)  # arrays survive (brpc
+        with socket.create_connection((host, int(port)),   # proto role)
+                                      timeout=30) as s:
+            s.sendall(struct.pack("<Q", len(blob)) + blob)
         return True
 
     def serve(self, addr):
@@ -230,8 +233,12 @@ class MessageBus:
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
-                for line in self.rfile:
-                    msg = json.loads(line)
+                while True:
+                    head = self.rfile.read(8)
+                    if len(head) < 8:
+                        return
+                    (n,) = struct.unpack("<Q", head)
+                    msg = pickle.loads(self.rfile.read(n))
                     local = bus._local.get(msg["dst_id"])
                     if local is not None:
                         local.enqueue(InterceptorMessage(msg))
@@ -274,6 +281,13 @@ class Carrier:
             self._done.add(task_id)
             self._done_cv.notify_all()
 
+    def reset(self):
+        """Prepare for another run (the reference FleetExecutor runs once per
+        step): clear completion state; interceptors are re-registered by the
+        caller."""
+        with self._done_cv:
+            self._done.clear()
+
     def start(self):
         for it in self.interceptors.values():
             it.start()
@@ -308,14 +322,21 @@ class FleetExecutor:
 
     def run(self, feeds, timeout=60):
         """feeds: list of payloads (micro-batches). Returns sink outputs in
-        completion order."""
+        completion order. Re-runnable: each call resets the carrier and
+        builds fresh interceptors. Only this rank's TaskNodes get local
+        interceptors; nodes pinned to other ranks are routed over the bus
+        (addr_table)."""
+        self.carrier.reset()
+        rank = self.carrier.rank
         n_micro = len(feeds)
-        roots = [n for n in self.nodes.values() if not n.upstream]
-        leaves = [n for n in self.nodes.values() if not n.downstream]
+        roots = [n for n in self.nodes.values()
+                 if not any(u in self.nodes for u in n.upstream)]
+        leaves = [n for n in self.nodes.values()
+                  if not any(d in self.nodes for d in n.downstream)]
 
-        src_node = TaskNode("__source__", rank=self.carrier.rank,
+        src_node = TaskNode("__source__", rank=rank,
                             max_run_times=n_micro)
-        sink_node = TaskNode("__sink__", rank=self.carrier.rank,
+        sink_node = TaskNode("__sink__", rank=rank,
                              max_run_times=n_micro * max(len(leaves), 1))
         for r in roots:
             src_node.add_downstream_task(r.task_id)
@@ -326,8 +347,12 @@ class FleetExecutor:
 
         for node in self.nodes.values():
             node.max_run_times = n_micro
-            self.carrier.add_interceptor(
-                ComputeInterceptor(node.task_id, node, self.carrier))
+            if node.rank == rank:
+                self.carrier.add_interceptor(
+                    ComputeInterceptor(node.task_id, node, self.carrier))
+            else:
+                # remote task: route its id to the owning rank's bus address
+                self.carrier.bus.route(node.task_id, node.rank)
         src = _SourceInterceptor("__source__", src_node, self.carrier, feeds)
         sink = _SinkInterceptor("__sink__", sink_node, self.carrier)
         self.carrier.add_interceptor(src)

@@ -126,8 +126,10 @@ class TheOnePSRuntime:
                 self._comm.push_sparse(tid, ids, grads)
             else:
                 self.client.push_sparse(tid, ids, grads, server=self._assignment.get(tid, 0))
-        if self.mode == "sync":
-            self.client.barrier(f"step.{id(self)}", 1)
+        if self.mode == "sync" and self.nranks > 1:
+            # all trainers rendezvous after pushing so the next pull sees
+            # every rank's update (reusable server-side barrier)
+            self.client.barrier("step", self.nranks)
 
     def flush(self):
         if self._comm is not None:

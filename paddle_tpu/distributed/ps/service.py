@@ -105,12 +105,20 @@ class PsServer:
             return {"ok": False, "error": repr(e)}
 
     def _barrier(self, name, nranks):
+        """Reusable (generation-counted) barrier: when the Nth caller
+        arrives the generation advances and the count resets, so the same
+        name synchronizes every round (per-step/per-epoch reuse)."""
         with self._barrier_cv:
-            self._barrier_counts[name] = self._barrier_counts.get(name, 0) + 1
-            self._barrier_cv.notify_all()
-            ok = self._barrier_cv.wait_for(
-                lambda: self._barrier_counts.get(name, 0) >= nranks,
-                timeout=60)
+            state = self._barrier_counts.setdefault(name, [0, 0])
+            gen = state[1]
+            state[0] += 1
+            if state[0] >= nranks:
+                state[0] = 0
+                state[1] += 1
+                self._barrier_cv.notify_all()
+                return {"ok": True}
+            ok = self._barrier_cv.wait_for(lambda: state[1] != gen,
+                                           timeout=60)
         return {"ok": ok}
 
 

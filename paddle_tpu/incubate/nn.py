@@ -12,7 +12,8 @@ from .. import nn
 from ..ops.attention import scaled_dot_product_attention
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer"]
+           "FusedTransformerEncoderLayer", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]
 
 
 class FusedMultiHeadAttention(nn.Layer):
@@ -95,3 +96,35 @@ class FusedTransformerEncoderLayer(nn.Layer):
 
     def forward(self, src, src_mask=None):
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """incubate/operators/softmax_mask_fuse.py parity (fused_softmax_mask op):
+    softmax(x + mask) in one fused region — XLA fuses the add into the
+    softmax; the reference needs a dedicated CUDA kernel for the same."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.dispatch import apply
+
+    def prim(xv, mv):
+        return jax.nn.softmax((xv + mv).astype(jnp.float32),
+                              axis=-1).astype(xv.dtype)
+
+    return apply(prim, x, mask, name="fused_softmax_mask")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax over the causal (lower-triangular kept) scores
+    (incubate/operators/softmax_mask_fuse_upper_triangle.py)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.dispatch import apply
+
+    def prim(xv):
+        s_q, s_k = xv.shape[-2], xv.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(causal, xv, -1e30)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.where(causal, probs, 0.0).astype(xv.dtype)
+
+    return apply(prim, x, name="fused_softmax_mask_upper_triangle")

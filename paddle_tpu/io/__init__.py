@@ -292,6 +292,35 @@ def default_collate_fn(batch):
     return batch
 
 
+_MP_STATE = {}
+
+
+def _mp_worker_init(dataset, worker_init_fn, num_workers):
+    _MP_STATE["dataset"] = dataset
+    import multiprocessing as mp
+    ident = mp.current_process()._identity
+    wid = (ident[0] - 1) % num_workers if ident else 0
+    _MP_STATE["info"] = _WorkerInfo(id=wid, num_workers=num_workers,
+                                    dataset=dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+
+
+def _mp_fetch(indices):
+    ds = _MP_STATE["dataset"]
+    out = []
+    for i in indices:
+        s = ds[i]
+        # device arrays must not cross the process boundary — force numpy
+        if isinstance(s, tuple):
+            s = tuple(np.asarray(x._value) if isinstance(x, Tensor)
+                      else x for x in s)
+        elif isinstance(s, Tensor):
+            s = np.asarray(s._value)
+        out.append(s)
+    return out
+
+
 class DataLoader:
     """paddle.io.DataLoader parity (fluid/reader.py:146).
 
@@ -305,10 +334,12 @@ class DataLoader:
                  drop_last=False, collate_fn=None, num_workers=0,
                  use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_multiprocess=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_multiprocess = use_multiprocess
+        self.worker_init_fn = worker_init_fn
         self.prefetch_factor = max(2, prefetch_factor)
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
@@ -351,6 +382,9 @@ class DataLoader:
         if self._iterable_ds:
             yield from self._iter_single_producer()
             return
+        if self.use_multiprocess:
+            yield from self._iter_process_pool()
+            return
         yield from self._iter_worker_pool()
 
     def _iter_worker_pool(self):
@@ -378,6 +412,31 @@ class DataLoader:
             finally:
                 for f in pending:
                     f.cancel()
+
+    def _iter_process_pool(self):
+        """Process workers (reference: dataloader/worker.py _worker_loop —
+        one OS process per worker, samples shipped back over queues). Opt-in
+        via use_multiprocess=True: fork-inherited dataset (no pickling of the
+        dataset), index lists to workers, raw numpy samples back, collate in
+        the parent (device arrays must not cross process boundaries)."""
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        window = self.prefetch_factor * self.num_workers
+        pool = ctx.Pool(processes=self.num_workers,
+                        initializer=_mp_worker_init,
+                        initargs=(self.dataset, self.worker_init_fn,
+                                  self.num_workers))
+        try:
+            pending = []
+            for indices in self.batch_sampler:
+                pending.append(pool.apply_async(_mp_fetch, (list(indices),)))
+                if len(pending) >= window:
+                    yield self.collate_fn(pending.pop(0).get())
+            while pending:
+                yield self.collate_fn(pending.pop(0).get())
+        finally:
+            pool.terminate()
+            pool.join()
 
     def _iter_single_producer(self):
         q = _queue.Queue(maxsize=self.prefetch_factor * max(self.num_workers, 1))

@@ -1,0 +1,329 @@
+"""AST rewriting for @to_static control flow.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ — the gast-based
+transformer pipeline (ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py). This compact equivalent rewrites a function's
+`if`/`while`/`and`/`or`/`not` into calls to jit.convert_operators dispatchers
+so data-dependent control flow survives XLA tracing; everything else (python
+predicates, eager tensors) behaves exactly as the original code.
+
+Conversion strategy per node:
+- `if`: hoist both branches into nested fns over the assigned-name tuple,
+  call convert_ifelse. Skipped when a branch contains return/break/continue/
+  yield (the reference has dedicated transformers for those; here the python
+  `if` is left untouched — correct for python predicates, and Tensor
+  predicates in that shape raise a clear tracing error).
+- `while`: hoist test/body into cond/body fns over the loop-var tuple, call
+  convert_while_loop. Same skip rule.
+- `and`/`or`: thunked convert_logical_* (short-circuit preserved for python
+  values); `not` → convert_logical_not.
+
+Failure of any step falls back to the original function (conversion is an
+optimization of semantics coverage, never a hard gate).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+
+__all__ = ["apply_ast_transforms", "convert_to_static_ast"]
+
+_CACHE = {}
+
+
+class _Analyzer(ast.NodeVisitor):
+    """Collect names assigned (stores) within a statement list."""
+
+    def __init__(self):
+        self.stores = set()
+        self.loads = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.stores.add(node.id)
+        else:
+            self.loads.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.stores.add(node.name)  # the def binds its name; don't descend
+
+    def visit_AsyncFunctionDef(self, node):
+        self.stores.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass  # inner scope
+
+    def visit_ClassDef(self, node):
+        self.stores.add(node.name)
+
+
+def _names(stmts_or_expr):
+    a = _Analyzer()
+    if isinstance(stmts_or_expr, list):
+        for s in stmts_or_expr:
+            a.visit(s)
+    else:
+        a.visit(stmts_or_expr)
+    return a
+
+
+class _HasEscape(ast.NodeVisitor):
+    """Detects return/break/continue/yield that would escape a hoisted
+    branch (not counting those inside nested function defs)."""
+
+    def __init__(self):
+        self.found = False
+
+    def _skip(self, node):
+        pass
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_Lambda = _skip
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_Yield(self, node):
+        self.found = True
+
+    def visit_YieldFrom(self, node):
+        self.found = True
+
+
+def _escapes(stmts):
+    v = _HasEscape()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _load(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+def _jst_attr(name):
+    return ast.Attribute(value=_load("_jst"), attr=name, ctx=ast.Load())
+
+
+def _tuple_of(names, ctx):
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                     ctx=ctx())
+
+
+def _init_stmts(names):
+    """name = locals().get('name', _jst.UNDEFINED) for each name."""
+    out = []
+    for n in names:
+        out.append(ast.Assign(
+            targets=[_store(n)],
+            value=ast.Call(
+                func=ast.Attribute(
+                    value=ast.Call(func=_load("locals"), args=[],
+                                   keywords=[]),
+                    attr="get", ctx=ast.Load()),
+                args=[ast.Constant(n), _jst_attr("UNDEFINED")],
+                keywords=[])))
+    return out
+
+
+def _make_fn(name, params, body, returns_names):
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+    body = list(body) + [ast.Return(value=_tuple_of(returns_names,
+                                                    ast.Load))]
+    return ast.FunctionDef(name=name, args=args, body=body,
+                           decorator_list=[], returns=None)
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, local_names=None):
+        self._n = 0
+        # names local to the enclosing function (params + anything assigned
+        # at any depth). Loop-var tuples must NOT capture globals/builtins
+        # read in a while test — shadowing them with the locals().get init
+        # would break e.g. `while i < LIMIT` or `while paddle.any(c)`.
+        self._locals = set(local_names or ())
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- boolean operators -------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        conv = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        out = node.values[0]
+        for rhs in node.values[1:]:
+            out = ast.Call(
+                func=_jst_attr(conv),
+                args=[ast.Lambda(
+                          args=ast.arguments(posonlyargs=[], args=[],
+                                             vararg=None, kwonlyargs=[],
+                                             kw_defaults=[], kwarg=None,
+                                             defaults=[]),
+                          body=out),
+                      ast.Lambda(
+                          args=ast.arguments(posonlyargs=[], args=[],
+                                             vararg=None, kwonlyargs=[],
+                                             kw_defaults=[], kwarg=None,
+                                             defaults=[]),
+                          body=rhs)],
+                keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_jst_attr("convert_logical_not"),
+                            args=[node.operand], keywords=[])
+        return node
+
+    # -- if/else ----------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _escapes(node.body) or _escapes(node.orelse):
+            return node
+        uid = self._uid()
+        names = sorted((_names(node.body).stores
+                        | _names(node.orelse).stores))
+        names = [n for n in names if not n.startswith("__tpu")]
+        t_name, f_name = f"__tpu_true_{uid}", f"__tpu_false_{uid}"
+        t_fn = _make_fn(t_name, names, node.body, names)
+        f_fn = _make_fn(f_name, names, node.orelse or [ast.Pass()], names)
+        call = ast.Call(
+            func=_jst_attr("convert_ifelse"),
+            args=[node.test, _load(t_name), _load(f_name),
+                  _tuple_of(names, ast.Load)],
+            keywords=[])
+        if names:
+            final = ast.Assign(targets=[_tuple_of(names, ast.Store)],
+                               value=call)
+        else:
+            final = ast.Expr(value=call)
+        return _init_stmts(names) + [t_fn, f_fn, final]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _escapes(node.body):
+            return node
+        uid = self._uid()
+        body_an = _names(node.body)
+        test_an = _names(node.test)
+        # loop vars: names the loop writes plus FUNCTION-LOCAL names the test
+        # reads — globals/builtins/modules read in the test or body resolve
+        # through the recompiled namespace instead of the loop-var tuple
+        names = sorted(body_an.stores
+                       | (test_an.loads & (self._locals | body_an.stores)))
+        names = [n for n in names
+                 if not n.startswith("__tpu") and n != "_jst"]
+        c_name, b_name = f"__tpu_cond_{uid}", f"__tpu_body_{uid}"
+        c_fn = ast.FunctionDef(
+            name=c_name,
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg=p) for p in names],
+                               vararg=None, kwonlyargs=[], kw_defaults=[],
+                               kwarg=None, defaults=[]),
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None)
+        b_fn = _make_fn(b_name, names, node.body, names)
+        call = ast.Call(
+            func=_jst_attr("convert_while_loop"),
+            args=[_load(c_name), _load(b_name), _tuple_of(names, ast.Load)],
+            keywords=[])
+        final = ast.Assign(targets=[_tuple_of(names, ast.Store)], value=call)
+        return _init_stmts(names) + [c_fn, b_fn, final]
+
+
+def convert_to_static_ast(fn):
+    """Return the control-flow-converted version of `fn`, or raise."""
+    raw = inspect.unwrap(fn)
+    bound_self = getattr(fn, "__self__", None)
+    func = raw.__func__ if isinstance(raw, types.MethodType) else raw
+
+    src = textwrap.dedent(inspect.getsource(func))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise TypeError("not a function definition")
+    fdef.decorator_list = []
+    fn_locals = _names(fdef.body).stores
+    fn_locals.update(a.arg for a in fdef.args.args)
+    fn_locals.update(a.arg for a in fdef.args.posonlyargs)
+    fn_locals.update(a.arg for a in fdef.args.kwonlyargs)
+    for va in (fdef.args.vararg, fdef.args.kwarg):
+        if va is not None:
+            fn_locals.add(va.arg)
+    new_body = []
+    tr = ControlFlowTransformer(fn_locals)
+    for stmt in fdef.body:
+        res = tr.visit(stmt)
+        if isinstance(res, list):
+            new_body.extend(res)
+        elif res is not None:
+            new_body.append(res)
+    fdef.body = new_body
+    ast.fix_missing_locations(tree)
+
+    from . import convert_operators as _jst
+    namespace = dict(func.__globals__)
+    namespace["_jst"] = _jst
+    if func.__closure__:
+        for name, cell in zip(func.__code__.co_freevars, func.__closure__):
+            try:
+                namespace[name] = cell.cell_contents
+            except ValueError:
+                raise RuntimeError(f"empty closure cell {name}")
+    code = compile(tree, filename=f"<to_static {func.__name__}>", mode="exec")
+    exec(code, namespace)  # noqa: S102 — recompiling the user's own source
+    new_fn = namespace[fdef.name]
+    new_fn.__defaults__ = func.__defaults__
+    new_fn.__kwdefaults__ = func.__kwdefaults__
+    new_fn.__wrapped_original__ = fn
+    if bound_self is not None:
+        return types.MethodType(new_fn, bound_self)
+    return new_fn
+
+
+def apply_ast_transforms(fn):
+    """Best-effort conversion with caching; falls back to `fn`."""
+    import os
+    if os.environ.get("PADDLE_TPU_NO_AST_TRANSFORM"):
+        return fn
+    if getattr(fn, "_not_to_static", False):
+        return fn
+    raw = inspect.unwrap(fn)
+    func = raw.__func__ if isinstance(raw, types.MethodType) else raw
+    # key on code AND closure cells: factory-made functions share one code
+    # object with different closures (paddle.exp vs paddle.log), and the
+    # converted function bakes the closure into its namespace
+    key = (getattr(func, "__code__", None),
+           tuple(id(c) for c in (func.__closure__ or ())))
+    bound_self = getattr(raw, "__self__", None)
+    if key in _CACHE:
+        conv = _CACHE[key]
+        if conv is None:
+            return fn
+        return types.MethodType(conv, bound_self) if bound_self is not None \
+            else conv
+    try:
+        converted = convert_to_static_ast(fn)
+    except Exception:
+        _CACHE[key] = None
+        return fn
+    _CACHE[key] = (converted.__func__
+                   if isinstance(converted, types.MethodType) else converted)
+    return converted

@@ -1,0 +1,208 @@
+"""Runtime control-flow converters for @to_static.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/convert_operators.py
+— the AST transformers rewrite `if/while/and/or/not` into calls to these
+dispatchers, which pick tensor-mode or plain-python behavior at RUN time.
+
+TPU-native semantics:
+- python predicate → exactly the original control flow (only the taken
+  branch runs, side effects preserved);
+- Tensor predicate, eager → concrete bool, original control flow;
+- Tensor predicate, under jit tracing → `convert_ifelse` runs BOTH branches
+  and selects outputs with jnp.where (differentiable, XLA select);
+  `convert_while_loop` lowers to lax.while_loop (forward-only — reverse-mode
+  through a traced while is not supported; the reference's static while has
+  the same practical limitation for most users).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["convert_ifelse", "convert_while_loop", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not", "convert_len",
+           "UNDEFINED", "Undefined"]
+
+
+class Undefined:
+    """Placeholder for names not yet bound when a converted block starts
+    (dygraph_to_static UndefinedVar parity)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEFINED = Undefined()
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _tensor_pred(pred):
+    if isinstance(pred, Tensor):
+        return pred._val
+    return None
+
+
+def convert_ifelse(pred, true_fn, false_fn, args):
+    """convert_operators.py convert_ifelse parity.
+
+    args: tuple of current values of every name either branch assigns; both
+    fns take and return that tuple."""
+    pv = _tensor_pred(pred)
+    if pv is None:
+        return true_fn(*args) if pred else false_fn(*args)
+    if not _is_tracer(pv):
+        # eager tensor: concrete — behave exactly like python `if`
+        return true_fn(*args) if bool(pv) else false_fn(*args)
+
+    # traced tensor predicate: run both branches, select outputs
+    t_out = true_fn(*args)
+    f_out = false_fn(*args)
+    return _select_tree(pv, t_out, f_out)
+
+
+def _select_tree(pred_val, t_out, f_out):
+    multi = isinstance(t_out, tuple)
+    t_flat = t_out if multi else (t_out,)
+    f_flat = f_out if multi else (f_out,)
+    if len(t_flat) != len(f_flat):
+        raise ValueError(
+            "to_static if/else branches assign different variable sets under "
+            "a Tensor condition; make both branches assign the same names "
+            "(or use paddle.static.nn.cond)")
+    out = []
+    for t, f in zip(t_flat, f_flat):
+        if t is f:
+            out.append(t)
+            continue
+        if isinstance(t, Undefined) or isinstance(f, Undefined):
+            raise ValueError(
+                "a variable is defined in only one branch of a Tensor-"
+                "condition if/else; initialize it before the `if`")
+        if isinstance(t, Tensor) or isinstance(f, Tensor):
+            tv, fv = unwrap(t), unwrap(f)
+            if tuple(jnp.shape(tv)) != tuple(jnp.shape(fv)):
+                raise ValueError(
+                    f"Tensor-condition branches produce different shapes "
+                    f"{jnp.shape(tv)} vs {jnp.shape(fv)}; shapes must match "
+                    f"for the XLA select lowering")
+            out.append(apply(
+                lambda p, a, b: jnp.where(p.reshape(()).astype(bool), a,
+                                          b.astype(a.dtype)),
+                Tensor(pred_val), t if isinstance(t, Tensor) else Tensor(tv),
+                f if isinstance(f, Tensor) else Tensor(fv),
+                name="cond_select"))
+        else:
+            # non-tensor python value diverging under a traced cond is
+            # unrepresentable
+            if t != f:
+                raise ValueError(
+                    f"python value diverges under a Tensor condition "
+                    f"({t!r} vs {f!r}); only Tensors can be selected in "
+                    f"compiled code")
+            out.append(t)
+    return tuple(out) if multi else out[0]
+
+
+def convert_while_loop(cond_fn, body_fn, args):
+    """convert_operators.py convert_while_loop parity. args: tuple of loop
+    vars (values of every name the loop reads/writes)."""
+    pred = cond_fn(*args)
+    pv = _tensor_pred(pred)
+    if pv is None or not _is_tracer(pv):
+        # python / concrete-tensor predicate: plain while (side effects
+        # preserved, no trip-count limit)
+        while (bool(pv) if pv is not None else pred):
+            args = body_fn(*args)
+            pred = cond_fn(*args)
+            pv = _tensor_pred(pred)
+        return args
+
+    # traced predicate → lax.while_loop over the tensor loop vars; python
+    # values must stay loop-invariant
+    from ..static.nn import while_loop as static_while
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    if not tensor_idx:
+        raise ValueError("Tensor-condition while loop has no Tensor loop "
+                         "variables")
+    const = list(args)
+
+    def cfn(*tvars):
+        full = list(const)
+        for i, t in zip(tensor_idx, tvars):
+            full[i] = t
+        return cond_fn(*full)
+
+    def bfn(*tvars):
+        full = list(const)
+        for i, t in zip(tensor_idx, tvars):
+            full[i] = t
+        res = body_fn(*full)
+        for i, r in zip(tensor_idx, res):
+            if not isinstance(r, Tensor):
+                raise ValueError(
+                    "a Tensor loop variable became non-Tensor inside a "
+                    "traced while body")
+        return tuple(res[i] for i in tensor_idx)
+
+    out_t = static_while(cfn, bfn, [args[i] for i in tensor_idx])
+    out = list(args)
+    for i, t in zip(tensor_idx, out_t):
+        out[i] = t
+    return tuple(out)
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    """Short-circuit-preserving `and` (convert_logical_and parity): rhs is a
+    thunk, evaluated only when needed for python values."""
+    lhs = lhs_fn()
+    lv = _tensor_pred(lhs)
+    if lv is None:
+        return rhs_fn() if lhs else lhs
+    rhs = rhs_fn()
+    rv = _tensor_pred(rhs)
+    if rv is None:
+        return apply(lambda a: jnp.logical_and(a.astype(bool), bool(rhs)),
+                     lhs, name="logical_and")
+    return apply(lambda a, b: jnp.logical_and(a.astype(bool), b.astype(bool)),
+                 lhs, rhs, name="logical_and")
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    lv = _tensor_pred(lhs)
+    if lv is None:
+        return lhs if lhs else rhs_fn()
+    rhs = rhs_fn()
+    rv = _tensor_pred(rhs)
+    if rv is None:
+        return apply(lambda a: jnp.logical_or(a.astype(bool), bool(rhs)),
+                     lhs, name="logical_or")
+    return apply(lambda a, b: jnp.logical_or(a.astype(bool), b.astype(bool)),
+                 lhs, rhs, name="logical_or")
+
+
+def convert_logical_not(x):
+    xv = _tensor_pred(x)
+    if xv is None:
+        return not x
+    return apply(lambda a: jnp.logical_not(a.astype(bool)), x,
+                 name="logical_not")
+
+
+def convert_len(x):
+    if isinstance(x, Tensor):
+        return x._val.shape[0]
+    return len(x)

@@ -160,7 +160,11 @@ class StaticFunction:
 
     def __init__(self, fn, input_spec=None, build_strategy=None):
         functools.update_wrapper(self, fn)
-        self._fn = fn
+        # AST control-flow conversion (dygraph_to_static transformer parity):
+        # if/while/and/or/not become runtime dispatchers so Tensor-dependent
+        # control flow survives XLA tracing. Falls back to `fn` untouched.
+        from .ast_transform import apply_ast_transforms
+        self._fn = apply_ast_transforms(fn)
         self._input_spec = input_spec
         self._programs = {}
         self._enabled = True

@@ -86,12 +86,13 @@ class Adam(Optimizer):
         denom = jnp.sqrt(v_new) + self._epsilon * jnp.sqrt(1 - b2p_new).astype(dtype)
         p._value = p._value - lr_t * (m_new / denom)
 
-    def _apply_sparse_update(self, p, sr):
+    def _apply_sparse_update(self, p, sr, _merged=False):
         """adam_op.h lazy_mode parity: moments decay + param update touch only
         the (merged) grad rows; without lazy_mode the dense rule applies."""
         if not self._lazy_mode:
             return self._apply_update(p, sr.to_dense())
-        sr = sr.merge()
+        if not _merged:
+            sr = sr.merge()
         rows = sr.rows
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
@@ -145,7 +146,7 @@ class AdamW(Adam):
                             or self._apply_decay_param_fun(p.name)):
             lr_ = self._lr.astype(p._val.dtype)
             p._value = p._value.at[sr.rows].multiply(1.0 - lr_ * self._coeff)
-        super()._apply_sparse_update(p, sr)
+        super()._apply_sparse_update(p, sr, _merged=True)
 
 
 class Adagrad(Optimizer):

@@ -388,6 +388,50 @@ class Program:
         finally:
             lib.pt_prog_destroy(prog)
 
+    def parallel_schedule(self):
+        """Wave schedule from the native executor (csrc/executor.cc
+        pt_exec_levels): level[i] per op — ops sharing a level have no hazard
+        between them (ParallelExecutor SSA-graph readiness parity)."""
+        from ..core import native
+        import ctypes
+        lib = native.load()
+        prog = self.to_native()
+        try:
+            n_ops = native.check(lib.pt_block_num_ops(prog, 0), lib)
+            buf = (ctypes.c_int32 * max(int(n_ops), 1))()
+            native.check(lib.pt_exec_levels(prog, 0, buf, n_ops), lib)
+            return list(buf[:n_ops])
+        finally:
+            lib.pt_prog_destroy(prog)
+
+    def run_host_parallel(self, fn, num_threads=4):
+        """Run fn(op_index) for every op through the native dep-counted
+        thread-pool executor (csrc/executor.cc pt_exec_run). Used for
+        host-side op pipelines (feed/fetch/io); device math goes through the
+        compiled XLA program instead."""
+        from ..core import native
+        lib = native.load()
+        prog = self.to_native()
+        exec_ = lib.pt_exec_create(int(num_threads))
+        errors = []
+
+        def cb(op_idx, _ud):
+            if errors:
+                return  # fail-fast: downstream ops of a failed producer
+            try:       # must not run user code against missing state
+                fn(int(op_idx))
+            except BaseException as e:  # noqa: BLE001 — surfaced after run
+                errors.append(e)
+
+        cfn = native.EXEC_CALLBACK(cb)
+        try:
+            native.check(lib.pt_exec_run(exec_, prog, 0, cfn, None), lib)
+        finally:
+            lib.pt_exec_destroy(exec_)
+            lib.pt_prog_destroy(prog)
+        if errors:
+            raise errors[0]
+
     def serialize_to_string(self):
         from ..core import native
         import ctypes

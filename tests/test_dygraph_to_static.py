@@ -1,0 +1,146 @@
+"""dygraph_to_static AST-transformer tests (reference:
+tests/unittests/dygraph_to_static/test_ifelse.py, test_loop.py,
+test_logical.py patterns — dygraph-vs-static numerical equality)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.ast_transform import apply_ast_transforms
+
+
+# module-level fns so inspect.getsource works
+def branchy(x):
+    if x.sum() > 0:
+        y = x * 2 + 1
+    else:
+        y = -x
+    return y.sum()
+
+
+def loopy(x, steps):
+    i = (x.sum() * 0).astype("int32")
+    acc = x * 0
+    while i < steps:
+        acc = acc + x * 2
+        i = i + 1
+    return acc
+
+
+def logical(x, y):
+    if (x.sum() > 0) and (y.sum() > 0):
+        return x + y
+    if (x.sum() > 0) or (not (y.sum() > 0)):
+        return x - y
+    return x * y
+
+
+def nested(x):
+    if x.sum() > 0:
+        if x.sum() > 10:
+            r = x * 100
+        else:
+            r = x * 10
+    else:
+        r = x
+    return r
+
+
+def early_return(x, flag):
+    if flag:
+        return x + 1  # return inside branch → conversion skipped
+    return x - 1
+
+
+class TestConvertedEager:
+    """Converted code must behave byte-for-byte like the original in eager."""
+
+    def test_if_both_paths(self):
+        f = apply_ast_transforms(branchy)
+        xp = paddle.to_tensor(np.ones((3,), "float32"))
+        xn = paddle.to_tensor(-np.ones((3,), "float32"))
+        assert float(f(xp).numpy()) == 9.0
+        assert float(f(xn).numpy()) == 3.0
+
+    def test_while(self):
+        f = apply_ast_transforms(loopy)
+        x = paddle.to_tensor(np.ones((2,), "float32"))
+        np.testing.assert_allclose(f(x, 4).numpy(), np.full(2, 8.0))
+
+    def test_logical_ops(self):
+        f = apply_ast_transforms(logical)
+        one = paddle.to_tensor(np.ones((2,), "float32"))
+        neg = paddle.to_tensor(-np.ones((2,), "float32"))
+        np.testing.assert_allclose(f(one, one).numpy(), np.full(2, 2.0))
+        np.testing.assert_allclose(f(one, neg).numpy(), np.full(2, 2.0))
+        np.testing.assert_allclose(f(neg, neg).numpy(), np.full(2, 0.0))
+
+    def test_nested_if(self):
+        f = apply_ast_transforms(nested)
+        x = paddle.to_tensor(np.full((4,), 5.0, "float32"))
+        np.testing.assert_allclose(f(x).numpy(), np.full(4, 500.0))
+        x2 = paddle.to_tensor(np.full((4,), 0.5, "float32"))
+        np.testing.assert_allclose(f(x2).numpy(), np.full(4, 5.0))
+
+    def test_early_return_falls_back(self):
+        f = apply_ast_transforms(early_return)
+        x = paddle.to_tensor(np.zeros((2,), "float32"))
+        np.testing.assert_allclose(f(x, True).numpy(), np.ones(2))
+        np.testing.assert_allclose(f(x, False).numpy(), -np.ones(2))
+
+    def test_gradient_through_converted_if(self):
+        f = apply_ast_transforms(branchy)
+        x = paddle.to_tensor(np.ones((3,), "float32"), stop_gradient=False)
+        f(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 2.0))
+        x2 = paddle.to_tensor(-np.ones((3,), "float32"),
+                              stop_gradient=False)
+        f(x2).backward()
+        np.testing.assert_allclose(x2.grad.numpy(), np.full(3, -1.0))
+
+
+class TestConvertedTraced:
+    """Under to_static, Tensor-dependent control flow must be baked into ONE
+    program that takes the data-dependent path at run time."""
+
+    def test_if_single_program_both_paths(self):
+        fn = paddle.jit.to_static(branchy)
+        for sign, want in [(1.0, 9.0), (-1.0, 3.0), (1.0, 9.0),
+                           (-1.0, 3.0), (1.0, 9.0)]:
+            x = paddle.to_tensor(sign * np.ones((3,), "float32"))
+            assert float(fn(x).numpy()) == want
+        assert len(fn.programs) == 1
+
+    def test_while_traced(self):
+        fn = paddle.jit.to_static(loopy)
+        outs = []
+        for _ in range(4):
+            x = paddle.to_tensor(np.ones((2,), "float32"))
+            outs.append(fn(x, 3).numpy())
+        np.testing.assert_allclose(outs[-1], np.full(2, 6.0))
+
+    def test_layer_forward_with_tensor_cond(self):
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.sum() > 0:
+                    out = h * 2
+                else:
+                    out = h * -1
+                return out
+
+        paddle.seed(0)
+        layer = Gate()
+        eager = [layer(paddle.to_tensor(
+            s * np.ones((2, 4), "float32"))).numpy() for s in (1.0, -1.0)]
+        static_fwd = paddle.jit.to_static(layer.forward)
+        for _ in range(3):  # past discovery into compiled
+            got = [static_fwd(paddle.to_tensor(
+                s * np.ones((2, 4), "float32"))).numpy()
+                for s in (1.0, -1.0)]
+        for e, g in zip(eager, got):
+            np.testing.assert_allclose(g, e, rtol=1e-5, atol=1e-6)

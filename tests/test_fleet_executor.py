@@ -119,3 +119,38 @@ class TestMessageBus:
         assert got == [{"x": 42}]
         bus_b.shutdown()
         rec.enqueue(InterceptorMessage.make(-1, "recv_task", "STOP"))
+
+
+class TestRerunAndPayloads:
+    def test_run_twice(self):
+        t1 = TaskNode("inc", fn=lambda x: x + 1)
+        fe = FleetExecutor([t1])
+        assert sorted(fe.run([1, 2, 3])) == [2, 3, 4]
+        assert sorted(fe.run([10, 20])) == [11, 21]
+
+    def test_numpy_payload_over_tcp(self):
+        port = find_free_ports(1)[0]
+        addr = f"127.0.0.1:{port}"
+        bus_b = MessageBus(rank=1)
+        carrier_b = Carrier(rank=1, message_bus=bus_b)
+        node = TaskNode("npk", rank=1, max_run_times=1)
+        got = []
+
+        class Rec(ComputeInterceptor):
+            def handle(self, msg):
+                if msg["message_type"] == "DATA_IS_READY":
+                    got.append(msg["payload"])
+                    self.carrier.notify_task_done(self.node.task_id)
+
+        rec = Rec("npk", node, carrier_b)
+        carrier_b.add_interceptor(rec)
+        bus_b.serve(addr)
+        rec.start()
+        bus_a = MessageBus(rank=0, addr_table={1: addr})
+        bus_a.route("npk", 1)
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        bus_a.send(InterceptorMessage.make("s", "npk", "DATA_IS_READY", arr))
+        carrier_b.wait(timeout=10)
+        np.testing.assert_allclose(got[0], arr)
+        bus_b.shutdown()
+        rec.enqueue(InterceptorMessage.make(-1, "npk", "STOP"))

@@ -144,3 +144,44 @@ class TestRecompute:
         loss2.backward()
         g_ckpt = np.asarray(lin.weight.grad._value)
         np.testing.assert_allclose(g_plain, g_ckpt, rtol=1e-5)
+
+
+class TestFusedSoftmaxMask:
+    def test_softmax_mask_fuse_matches_numpy(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import softmax_mask_fuse
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 2, 4, 4).astype("float32")
+        m = np.where(rng.rand(2, 1, 4, 4) < 0.3, -1e4, 0.0).astype("float32")
+        out = softmax_mask_fuse(paddle.to_tensor(x),
+                                paddle.to_tensor(m)).numpy()
+        z = x + m
+        e = np.exp(z - z.max(-1, keepdims=True))
+        np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_upper_triangle_is_causal(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import softmax_mask_fuse_upper_triangle
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(1, 1, 5, 5).astype("float32"))
+        out = softmax_mask_fuse_upper_triangle(x).numpy()[0, 0]
+        assert np.allclose(np.triu(out, 1), 0.0)
+        np.testing.assert_allclose(out.sum(-1), np.ones(5), rtol=1e-5)
+
+
+class TestFleetMetrics:
+    def test_global_metrics_single_process(self):
+        import numpy as np
+        from paddle_tpu.distributed.fleet import metrics as M
+        assert M.acc(np.array([8.0]), np.array([10.0])) == 0.8
+        assert M.mae(np.array([5.0]), np.array([10.0])) == 0.5
+        assert M.rmse(np.array([40.0]), np.array([10.0])) == 2.0
+        # perfect separation → auc 1; symmetric → 0.5
+        pos = np.array([0.0, 0, 0, 5, 5])
+        neg = np.array([5.0, 5, 0, 0, 0])
+        assert M.auc(pos, neg) == 1.0
+        assert abs(M.auc(pos, pos) - 0.5) < 1e-9
+        np.testing.assert_allclose(M.sum(np.array([1.0, 2.0])), [1.0, 2.0])

@@ -191,3 +191,20 @@ class TestDistributeTranspiler:
         finally:
             for srv in servers:
                 srv.stop()
+
+
+class TestBarrierReuse:
+    def test_barrier_reusable_per_round(self, server):
+        c1, c2 = PsClient(server.endpoint), PsClient(server.endpoint)
+        for _ in range(3):  # same name every round must still synchronize
+            done = []
+            t = threading.Thread(
+                target=lambda: (c1.barrier("epoch", 2), done.append(1)))
+            t.start()
+            time.sleep(0.1)
+            assert done == []  # second rank not arrived → still blocked
+            c2.barrier("epoch", 2)
+            t.join(timeout=5)
+            assert done == [1]
+        c1.close()
+        c2.close()

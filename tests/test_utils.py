@@ -173,3 +173,24 @@ class TestCppExtension:
         from paddle_tpu.utils import cpp_extension
         mod2 = cpp_extension.load_op_library(custom_mod.so_path)
         assert "relu2" in mod2.op_names()
+
+
+class TestMultiprocessDataLoader:
+    def test_process_workers_order_and_values(self):
+        import numpy as np
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                return (np.full((2,), float(i), dtype=np.float32),
+                        np.int64(i))
+
+        dl = DataLoader(DS(), batch_size=4, shuffle=False, num_workers=2,
+                        use_multiprocess=True)
+        batches = list(dl)
+        assert len(batches) == 8
+        xs = np.concatenate([b[0].numpy() for b in batches])
+        np.testing.assert_allclose(xs[:, 0], np.arange(32))
