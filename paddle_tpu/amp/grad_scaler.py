@@ -52,13 +52,22 @@ class AmpScaler:
         self._unscaled_opts.add(id(optimizer))
         pairs = optimizer._collect_params_grads()
         inv = 1.0 / self._scale._value
-        found = jnp.asarray(False)
+        flags = []
         for p, g in pairs:
             if g is None:
                 continue
             gv = unwrap(g) * inv.astype(g._val.dtype)
-            found = found | ~jnp.all(jnp.isfinite(gv))
+            flags.append(~jnp.all(jnp.isfinite(gv)))
             g._value = gv
+        # grads may be committed to disjoint sub-meshes (pipeline stages):
+        # fold concrete flags on the host; keep device math under tracing
+        import jax.core as jax_core
+        if flags and not any(isinstance(f, jax_core.Tracer) for f in flags):
+            found = jnp.asarray(any(bool(f) for f in flags))
+        else:
+            found = jnp.asarray(False)
+            for f in flags:
+                found = found | f
         self._found_inf._value = found
 
     def minimize(self, optimizer, scaled_loss):

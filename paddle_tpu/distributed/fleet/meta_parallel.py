@@ -253,6 +253,7 @@ class PipelineLayer(Layer):
         self.descs = layers
         self.loss_fn = loss_fn
         self.num_stages = num_stages or 1
+        self.seg_method = seg_method
         from ...nn.layer.container import LayerList
         built = []
         self._shared = {}
@@ -277,18 +278,42 @@ class PipelineLayer(Layer):
 
 
 class PipelineParallel(_ParallelWrapper):
-    """pipeline_parallel.py:30 parity at the API level: train_batch(data, opt,
-    scaler). Executes micro-batches (gradient accumulation) over the full
-    model; stage placement via GSPMD pipe-axis sharding of per-stage params is
-    wired when pp_degree>1 (host 1F1B iteration planned)."""
+    """pipeline_parallel.py:30 parity: train_batch(data, opt, scaler).
+
+    When wrapping a PipelineLayer with num_stages>1, runs the host-driven
+    1F1B engine (pipeline_engine.PipelineEngine): per-stage jitted programs
+    on per-stage sub-meshes, warmup/steady/cooldown unit schedule, recompute
+    backward — the real pipelined schedule, reference
+    pipeline_parallel.py:152-330. For plain models it falls back to GPipe
+    micro-batch gradient accumulation (semantics-equal, no stage placement).
+    """
 
     def __init__(self, layers, hcg, strategy=None):
         super().__init__(layers, hcg, strategy)
         cfgs = getattr(strategy, "pipeline_configs", {}) or {}
         self.accumulate_steps = cfgs.get("accumulate_steps", 1)
+        self._engine = None
+        if isinstance(layers, PipelineLayer) and layers.num_stages > 1:
+            from .pipeline_engine import PipelineEngine
+            self._engine = PipelineEngine(
+                layers, num_microbatches=max(self.accumulate_steps, 1),
+                seg_method=layers.seg_method)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         inputs, labels = data
+        if self._engine is not None:
+            scale = float(unwrap(scaler._scale)) \
+                if scaler is not None and scaler.is_enable() else 1.0
+            loss = self._engine.train_batch(unwrap(inputs), unwrap(labels),
+                                            scale=scale)
+            if scaler is not None:
+                scaler.step(optimizer)
+            else:
+                optimizer.step()
+            optimizer.clear_grad()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return loss
         micro = self.accumulate_steps
         from ...tensor.manipulation import chunk
         x_chunks = chunk(inputs, micro, axis=0) if micro > 1 else [inputs]
@@ -318,6 +343,9 @@ class PipelineParallel(_ParallelWrapper):
 
     def eval_batch(self, data, compute_loss=True):
         inputs, labels = data
+        if self._engine is not None:
+            return self._engine.eval_batch(unwrap(inputs), unwrap(labels),
+                                           compute_loss=compute_loss)
         out = self._layers(inputs)
         loss_fn = getattr(self._layers, "loss_fn", None)
         if compute_loss and loss_fn is not None:

@@ -1,0 +1,384 @@
+"""Host-driven 1F1B pipeline engine.
+
+Reference parity: fleet/meta_parallel/pipeline_parallel.py:152-330 (1F1B
+schedule: warmup forwards, steady-state 1F1B interleave, cooldown backwards)
+over pp_utils/p2p_communication.py:216 send_v2/recv_v2 NCCL p2p.
+
+TPU-native redesign (single controller, no per-stage process):
+  - each stage is a contiguous segment of a PipelineLayer, compiled to XLA
+    programs (forward; recompute-vjp backward — megatron-style full
+    recompute, so no activation tensors cross the jit boundary),
+  - non-trainable state (BatchNorm running stats) is functionalized: buffer
+    values are explicit stage inputs/outputs threaded microbatch-to-
+    microbatch and written back after the batch,
+  - stage s's parameters live on the sub-mesh obtained by fixing the 'pipe'
+    axis coordinate to s (keeping any tensor-parallel sharding_spec on the
+    remaining axes); activations are device_put between consecutive
+    sub-meshes (the ICI p2p transfer ≈ send_v2/recv_v2),
+  - the host issues (stage, microbatch, fwd|bwd) units in 1F1B order; JAX's
+    async dispatch overlaps units that run on disjoint sub-meshes, which is
+    exactly the pipeline overlap the reference gets from per-process NCCL,
+  - data parallelism inside a stage is GSPMD: the microbatch stays sharded
+    over the 'data' axis of the sub-mesh and XLA inserts the grad psum.
+
+The schedule bounds live stashed microbatch inputs per stage to (S - s), the
+same memory envelope as the reference's 1F1B.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Parameter, Tensor
+from ..mesh import axis_degree, get_mesh
+
+__all__ = ["PipelineEngine"]
+
+
+def _segment_uniform(items, k):
+    n = len(items)
+    base, rem = divmod(n, k)
+    out, i = [], 0
+    for s in range(k):
+        size = base + (1 if s < rem else 0)
+        out.append(items[i:i + size])
+        i += size
+    return out
+
+
+def _segment_by_params(layers, k):
+    """Greedy contiguous split balancing parameter counts (reference
+    pp_layers.py 'layer:param' seg_method analog)."""
+    costs = []
+    for ly in layers:
+        n = sum(int(jnp.size(p._val)) for p in ly.parameters()) \
+            if hasattr(ly, "parameters") else 0
+        costs.append(max(n, 1))
+    total = sum(costs)
+    target = total / k
+    out, cur, acc, remaining_stages = [], [], 0.0, k
+    for i, (ly, c) in enumerate(zip(layers, costs)):
+        cur.append(ly)
+        acc += c
+        # must leave at least one layer per remaining stage
+        remaining_layers = len(layers) - i - 1
+        if (acc >= target and remaining_stages > 1
+                and remaining_layers >= remaining_stages - 1):
+            out.append(cur)
+            cur, acc = [], 0.0
+            remaining_stages -= 1
+    out.append(cur)
+    assert len(out) == k, (len(out), k)  # guard above reserves 1 layer/stage
+    return out
+
+
+class _Stage:
+    """One pipeline stage: a contiguous group of layers + compiled programs.
+
+    state_dict entries split into trainable params (differentiated) and
+    buffers (functionalized: substituted in, mutated values read back out).
+    """
+
+    def __init__(self, layers, loss_fn, is_last):
+        self.layers = layers
+        self.loss_fn = loss_fn if is_last else None
+        self.is_last = is_last
+        self.params = []   # (name, Parameter) — differentiated
+        self.buffers = []  # (name, Tensor) — threaded state (BN stats, ...)
+        for i, ly in enumerate(layers):
+            for name, t in ly.state_dict().items():
+                dst = self.params if isinstance(t, Parameter) else self.buffers
+                dst.append((f"{i}.{name}", t))
+        self._fwd = None
+        self._bwd = None
+        self._fwd_out = None
+
+    # -- pure stage function over substituted parameter/buffer values --------
+    def _run(self, param_vals, buf_vals, x, y=None):
+        from ...core.dispatch import unwrap
+        tensors = [t for _, t in self.params] + [t for _, t in self.buffers]
+        vals = list(param_vals) + list(buf_vals)
+        saved = [t._val for t in tensors]
+        try:
+            for t, v in zip(tensors, vals):
+                t._val = v
+            out = Tensor(x)
+            for ly in self.layers:
+                out = ly(out)
+            # buffers the layers mutated in place (hooked _value writes under
+            # trace) are read back and returned as explicit outputs
+            new_bufs = [t._val for _, t in self.buffers]
+            if self.loss_fn is not None and y is not None:
+                loss = self.loss_fn(out, Tensor(y))
+                if loss.ndim > 0:
+                    from ...tensor.math import mean
+                    loss = mean(loss)
+                return unwrap(loss), new_bufs
+            return unwrap(out), new_bufs
+        finally:
+            for t, v in zip(tensors, saved):
+                t._val = v
+
+    def compile(self):
+        run = self._run
+        if self.is_last:
+            self._fwd = jax.jit(lambda pv, bv, x, y: run(pv, bv, x, y))
+            self._bwd = jax.jit(
+                lambda pv, bv, x, y, g: jax.vjp(
+                    lambda pv_, x_: run(pv_, bv, x_, y)[0], pv, x)[1](g))
+        else:
+            self._fwd = jax.jit(lambda pv, bv, x: run(pv, bv, x))
+            self._bwd = jax.jit(
+                lambda pv, bv, x, g: jax.vjp(
+                    lambda pv_, x_: run(pv_, bv, x_)[0], pv, x)[1](g))
+        # label-free forward (predict path); buffer updates dropped (eval)
+        self._fwd_out = jax.jit(lambda pv, bv, x: run(pv, bv, x, None)[0])
+
+
+class PipelineEngine:
+    def __init__(self, pipeline_layer, num_microbatches, axis="pipe",
+                 seg_method="uniform"):
+        self.pl = pipeline_layer
+        self.M = max(int(num_microbatches), 1)
+        self.axis = axis
+        layers = list(pipeline_layer.run_function)
+        S = pipeline_layer.num_stages
+        deg = axis_degree(axis)
+        if deg > 1 and deg != S:
+            raise ValueError(
+                f"num_stages ({S}) must equal the '{axis}' mesh axis degree "
+                f"({deg}) — one stage per pipe-axis coordinate")
+        if S > len(layers):
+            raise ValueError(
+                f"num_stages ({S}) exceeds layer count ({len(layers)})")
+        if str(seg_method).endswith("param"):
+            segments = _segment_by_params(layers, S)
+        else:
+            segments = _segment_uniform(layers, S)
+        self.S = S
+        self.stages = [
+            _Stage(seg, pipeline_layer.loss_fn, is_last=(s == S - 1))
+            for s, seg in enumerate(segments)]
+        for st in self.stages:
+            st.compile()
+        self._submeshes = self._build_submeshes(deg)
+        self._shared_ids = self._find_shared_param_ids()
+        self._place_params()
+
+    # -- placement -----------------------------------------------------------
+    def _build_submeshes(self, deg):
+        mesh = get_mesh()
+        if deg <= 1:
+            return [None] * self.S
+        ax = mesh.axis_names.index(self.axis)
+        subs = []
+        for s in range(self.S):
+            dev_arr = mesh.devices.take(s, axis=ax)
+            names = tuple(n for i, n in enumerate(mesh.axis_names) if i != ax)
+            subs.append(Mesh(dev_arr, names))
+        return subs
+
+    def _find_shared_param_ids(self):
+        seen, shared = set(), set()
+        for st in self.stages:
+            for _, p in st.params:
+                if id(p) in seen:
+                    shared.add(id(p))
+                seen.add(id(p))
+        return shared
+
+    def _sub_sharding(self, t, sub):
+        """Sub-mesh placement that keeps any TP sharding_spec on the axes
+        that survive into the sub-mesh (pipe axis is fixed, so it drops)."""
+        spec = getattr(t, "sharding_spec", None)
+        if spec:
+            names = [a if isinstance(a, str) and a in sub.axis_names else None
+                     for a in spec]
+            return NamedSharding(sub, P(*names))
+        return NamedSharding(sub, P())
+
+    def _place_params(self):
+        """Pin each stage's (non-shared) params + buffers onto its sub-mesh
+        (≈ the reference's per-process parameter residence)."""
+        for st, sub in zip(self.stages, self._submeshes):
+            if sub is None:
+                continue
+            for _, t in st.params + st.buffers:
+                if id(t) in self._shared_ids:
+                    continue  # per-batch copies handle these
+                t._value = jax.device_put(t._val, self._sub_sharding(t, sub))
+
+    def _act_sharding(self, sub, ndim):
+        if "data" in sub.axis_names:
+            return NamedSharding(sub, P("data", *([None] * (ndim - 1))))
+        return NamedSharding(sub, P())
+
+    def _to_stage(self, arr, s):
+        sub = self._submeshes[s]
+        if sub is None:
+            return arr
+        return jax.device_put(arr, self._act_sharding(sub, arr.ndim))
+
+    def _stage_param_vals(self, s):
+        sub = self._submeshes[s]
+        vals = []
+        for _, p in self.stages[s].params:
+            v = p._val
+            if sub is not None and id(p) in self._shared_ids:
+                # shared (tied) param: ship a per-stage replica; its grads
+                # from every stage accumulate onto the one master Parameter
+                # (≈ reference allreduce over the shared-embedding group)
+                v = jax.device_put(v, self._sub_sharding(p, sub))
+            vals.append(v)
+        return vals
+
+    def _stage_buf_vals(self, s):
+        return [t._val for _, t in self.stages[s].buffers]
+
+    # -- 1F1B schedule --------------------------------------------------------
+    def _unit_order(self):
+        """Per-stage unit queues in non-interleaved 1F1B order
+        (pipeline_parallel.py:152-330: warmup fwds, steady 1F1B, cooldown)."""
+        qs = []
+        for s in range(self.S):
+            warm = min(self.S - 1 - s, self.M)
+            units = ["F"] * warm
+            for _ in range(self.M - warm):
+                units += ["F", "B"]
+            units += ["B"] * warm
+            qs.append(deque(units))
+        return qs
+
+    def train_batch(self, inputs, labels, scale=1.0):
+        """Run one 1F1B pipelined batch; accumulates param .grad, returns the
+        mean loss. `scale` multiplies the seed cotangent (GradScaler)."""
+        M, S = self.M, self.S
+        if inputs.shape[0] % M:
+            raise ValueError(
+                f"batch size {inputs.shape[0]} not divisible by "
+                f"accumulate_steps ({M})")
+        x_chunks = jnp.split(inputs, M, axis=0) if M > 1 else [inputs]
+        y_chunks = jnp.split(labels, M, axis=0) if M > 1 else [labels]
+
+        queues = self._unit_order()
+        fwd_idx = [0] * S
+        bwd_idx = [0] * S
+        acts_in = [{} for _ in range(S)]    # stage -> {m: fwd stash}
+        grads_in = [{} for _ in range(S)]   # stage -> {m: output cotangent}
+        fwd_done = [set() for _ in range(S)]
+        losses = []
+        grad_acc = [{} for _ in range(S)]   # stage -> {param_idx: arr}
+        pvals = [self._stage_param_vals(s) for s in range(S)]
+        bufs = [self._stage_buf_vals(s) for s in range(S)]
+        seed = jnp.asarray(scale / M, dtype=jnp.float32)
+
+        def run_fwd(s, m):
+            x = self._to_stage(x_chunks[m], 0) if s == 0 else acts_in[s][m]
+            st = self.stages[s]
+            bv = bufs[s]
+            if st.is_last:
+                y = self._to_stage(y_chunks[m], s)
+                loss, bufs[s] = st._fwd(pvals[s], bv, x, y)
+                losses.append(loss)
+                acts_in[s][m] = (x, y, bv)  # stash for recompute backward
+            else:
+                out, bufs[s] = st._fwd(pvals[s], bv, x)
+                acts_in[s][m] = (x, bv)
+                acts_in[s + 1][m] = self._to_stage(out, s + 1)
+            fwd_done[s].add(m)
+
+        def run_bwd(s, m):
+            st = self.stages[s]
+            if st.is_last:
+                x, y, bv = acts_in[s].pop(m)
+                gp, gx = st._bwd(pvals[s], bv, x, y, seed)
+            else:
+                x, bv = acts_in[s].pop(m)
+                g = grads_in[s].pop(m)
+                gp, gx = st._bwd(pvals[s], bv, x, g)
+            for i, gv in enumerate(gp):
+                acc = grad_acc[s].get(i)
+                grad_acc[s][i] = gv if acc is None else acc + gv
+            if s > 0:
+                grads_in[s - 1][m] = self._to_stage(gx, s - 1)
+
+        def ready(s, kind):
+            if kind == "F":
+                m = fwd_idx[s]
+                return s == 0 or m in acts_in[s]
+            m = bwd_idx[s]
+            if m not in fwd_done[s]:
+                return False
+            return s == S - 1 or m in grads_in[s]
+
+        remaining = sum(len(q) for q in queues)
+        while remaining:
+            progressed = False
+            for s in range(S):
+                if not queues[s]:
+                    continue
+                kind = queues[s][0]
+                if not ready(s, kind):
+                    continue
+                queues[s].popleft()
+                if kind == "F":
+                    run_fwd(s, fwd_idx[s])
+                    fwd_idx[s] += 1
+                else:
+                    run_bwd(s, bwd_idx[s])
+                    bwd_idx[s] += 1
+                remaining -= 1
+                progressed = True
+            if not progressed:
+                raise RuntimeError(
+                    "1F1B schedule deadlocked (internal error): "
+                    f"queues={[list(q) for q in queues]}")
+
+        # write back threaded buffer state (BN running stats etc.)
+        for s in range(S):
+            for (name, t), v in zip(self.stages[s].buffers, bufs[s]):
+                t._value = v
+        # write accumulated grads onto Parameters (optimizer.step consumes)
+        for s in range(S):
+            for i, (_, p) in enumerate(self.stages[s].params):
+                g = grad_acc[s].get(i)
+                if g is None:
+                    continue
+                if id(p) in self._shared_ids and p._val.sharding != g.sharding:
+                    g = jax.device_put(g, p._val.sharding)
+                if p.grad is None:
+                    p.grad = Tensor(g, stop_gradient=True)
+                else:
+                    p.grad._value = p.grad._val + g
+        total = jnp.mean(jnp.stack(losses))
+        return Tensor(total)
+
+    def eval_batch(self, inputs, labels=None, compute_loss=True):
+        # eval tolerates ragged batches: fall back to one whole-batch
+        # microbatch when the training accumulate_steps doesn't divide it
+        M = self.M if inputs.shape[0] % self.M == 0 else 1
+        x_chunks = jnp.split(inputs, M, axis=0) if M > 1 else [inputs]
+        y_chunks = (jnp.split(labels, M, axis=0) if M > 1 else [labels]) \
+            if labels is not None else [None] * M
+        with_loss = (compute_loss and labels is not None
+                     and self.stages[-1].loss_fn is not None)
+        pvals = [self._stage_param_vals(s) for s in range(self.S)]
+        bufs = [self._stage_buf_vals(s) for s in range(self.S)]
+        outs = []
+        for m in range(M):
+            act = self._to_stage(x_chunks[m], 0)
+            for s, st in enumerate(self.stages):
+                if s:
+                    act = self._to_stage(act, s)
+                if st.is_last and with_loss:
+                    act, _ = st._fwd(pvals[s], bufs[s], act,
+                                     self._to_stage(y_chunks[m], s))
+                else:
+                    act = st._fwd_out(pvals[s], bufs[s], act)
+            outs.append(act)
+        if with_loss:
+            return Tensor(jnp.mean(jnp.stack(outs)))
+        return Tensor(jnp.concatenate(outs, axis=0))

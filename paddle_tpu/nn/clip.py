@@ -70,7 +70,15 @@ class ClipGradByGlobalNorm(ClipGradBase):
             sq.append(jnp.sum(jnp.square(gv.astype(jnp.float32))))
         if not sq:
             return params_grads
-        global_norm = jnp.sqrt(sum(sq))
+        # grads may be committed to disjoint sub-meshes (pipeline stages):
+        # fold concrete per-grad norms on the host (≈ the reference's
+        # cross-group allreduce in HybridParallelOptimizer); device math
+        # is kept when tracing so jit paths stay fused
+        import jax.core as jax_core
+        if not any(isinstance(s, jax_core.Tracer) for s in sq):
+            global_norm = jnp.sqrt(sum(float(s) for s in sq))
+        else:
+            global_norm = jnp.sqrt(sum(sq))
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
         out = []
         for p, g in params_grads:

@@ -1,0 +1,195 @@
+"""Tests for the TPU-native stretch components (SURVEY.md §5):
+ring attention (sequence parallel), the SPMD circular pipeline, and the
+Pallas flash-attention kernel (run under the pallas interpreter on CPU).
+
+Each is asserted against a dense/sequential oracle — forward AND backward —
+on the virtual 8-device CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.ops.attention import scaled_dot_product_attention
+
+NDEV = len(jax.devices())
+pytestmark = pytest.mark.skipif(NDEV < 8, reason="needs 8 virtual devices")
+
+
+@pytest.fixture()
+def mesh_guard():
+    yield
+    build_mesh()
+
+
+def _qkv(b=2, s=32, h=2, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(b, s, h, d).astype("float32") * 0.5
+    return mk(), mk(), mk()
+
+
+class TestRingAttention:
+    """ring_attention over the 'sep' axis vs dense SDPA oracle."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_parity(self, mesh_guard, causal):
+        from paddle_tpu.distributed.fleet.sequence_parallel import (
+            ring_attention,
+        )
+        q_np, k_np, v_np = _qkv()
+        build_mesh({"sep": 8})
+        q, k, v = (paddle.to_tensor(a) for a in (q_np, k_np, v_np))
+        out_ring = np.asarray(
+            ring_attention(q, k, v, is_causal=causal)._val)
+
+        build_mesh()  # dense oracle on the default mesh
+        out_ref = np.asarray(scaled_dot_product_attention(
+            paddle.to_tensor(q_np), paddle.to_tensor(k_np),
+            paddle.to_tensor(v_np), is_causal=causal)._val)
+        np.testing.assert_allclose(out_ring, out_ref, rtol=2e-5, atol=2e-6)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_backward_parity(self, mesh_guard, causal):
+        from paddle_tpu.distributed.fleet.sequence_parallel import (
+            ring_attention,
+        )
+        q_np, k_np, v_np = _qkv(seed=1)
+
+        def grads(attn_fn):
+            ts = [paddle.to_tensor(a) for a in (q_np, k_np, v_np)]
+            for t in ts:
+                t.stop_gradient = False
+            out = attn_fn(*ts)
+            (out * out).sum().backward()
+            return [np.asarray(t.grad._val) for t in ts]
+
+        build_mesh({"sep": 8})
+        g_ring = grads(lambda q, k, v: ring_attention(
+            q, k, v, is_causal=causal))
+        build_mesh()
+        g_ref = grads(lambda q, k, v: scaled_dot_product_attention(
+            q, k, v, is_causal=causal))
+        for gr, gd, nm in zip(g_ring, g_ref, "qkv"):
+            np.testing.assert_allclose(gr, gd, rtol=5e-4, atol=5e-6,
+                                       err_msg=f"grad wrt {nm}")
+
+    def test_split_gather_sequence_roundtrip(self, mesh_guard):
+        from paddle_tpu.distributed.fleet.sequence_parallel import (
+            gather_sequence, split_sequence,
+        )
+        build_mesh({"sep": 8})
+        x = paddle.to_tensor(np.arange(64, dtype="float32").reshape(2, 16, 2))
+        s = split_sequence(x)
+        assert len({sh.device for sh in s._val.addressable_shards}) == 8
+        g = gather_sequence(s)
+        np.testing.assert_allclose(np.asarray(g._val), np.asarray(x._val))
+
+
+class TestSpmdPipeline:
+    """PipelineStageStack pipelined (pipe axis) vs sequential execution."""
+
+    def _make_stack(self, num_stages, num_micro):
+        from paddle_tpu.distributed.fleet.spmd_pipeline import (
+            PipelineStageStack,
+        )
+        paddle.seed(42)
+        return PipelineStageStack(
+            lambda: nn.Sequential(nn.Linear(16, 16), nn.Tanh()),
+            num_stages=num_stages, num_microbatches=num_micro)
+
+    def test_pipelined_equals_sequential(self, mesh_guard):
+        build_mesh({"pipe": 4})  # data axis auto-padded to 2
+        stack = self._make_stack(num_stages=4, num_micro=4)
+        x_np = np.random.RandomState(0).randn(8, 16).astype("float32")
+        out_pipe = np.asarray(stack(paddle.to_tensor(x_np))._val)
+
+        build_mesh()  # degree('pipe') == 1 -> sequential path, same params
+        out_seq = np.asarray(stack(paddle.to_tensor(x_np))._val)
+        np.testing.assert_allclose(out_pipe, out_seq, rtol=2e-5, atol=1e-6)
+        # sanity: sequential path really applies all 4 stages
+        assert not np.allclose(out_seq, x_np)
+
+    def test_backward_parity_and_training(self, mesh_guard):
+        build_mesh({"pipe": 4})
+        stack = self._make_stack(num_stages=4, num_micro=2)
+        x_np = np.random.RandomState(1).randn(4, 16).astype("float32")
+
+        def param_grads():
+            out = stack(paddle.to_tensor(x_np))
+            (out * out).sum().backward()
+            gs = {k: np.asarray(p.grad._val)
+                  for k, p in stack.named_parameters() if p.grad is not None}
+            for p in stack.parameters():
+                p.clear_grad()
+            return gs
+
+        g_pipe = param_grads()
+        build_mesh()
+        g_seq = param_grads()
+        assert set(g_pipe) == set(g_seq) and g_pipe
+        for k in g_seq:
+            np.testing.assert_allclose(g_pipe[k], g_seq[k], rtol=1e-4,
+                                       atol=1e-6, err_msg=k)
+
+    def test_stage_count_must_match_axis(self, mesh_guard):
+        build_mesh({"pipe": 4})
+        with pytest.raises(ValueError, match="must equal"):
+            self._make_stack(num_stages=3, num_micro=2)
+
+
+class TestFlashAttention:
+    """Pallas flash attention (interpret mode on CPU) vs XLA SDPA."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_kernel_forward_parity(self, causal):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        rng = np.random.RandomState(3)
+        b, s, h, d = 2, 64, 2, 16
+        q = jnp.asarray(rng.randn(b, s, h, d).astype("float32"))
+        k = jnp.asarray(rng.randn(b, s, h, d).astype("float32"))
+        v = jnp.asarray(rng.randn(b, s, h, d).astype("float32"))
+        scale = 1.0 / np.sqrt(d)
+        out = flash_attention(q, k, v, causal=causal, scale=scale,
+                              block_q=16, block_k=16)
+        ref = np.asarray(scaled_dot_product_attention(
+            paddle.to_tensor(np.asarray(q)), paddle.to_tensor(np.asarray(k)),
+            paddle.to_tensor(np.asarray(v)), is_causal=causal,
+            use_pallas=False)._val)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-6)
+
+    def test_sdpa_pallas_path_forward_backward(self):
+        """scaled_dot_product_attention(use_pallas=True) end-to-end: pallas
+        forward (interpreted on CPU), XLA-recompute backward."""
+        rng = np.random.RandomState(4)
+        b, s, h, d = 1, 128, 2, 128  # shapes the TPU kernel would accept
+        mk = lambda: rng.randn(b, s, h, d).astype("float32") * 0.3
+
+        def run(use_pallas):
+            ts = [paddle.to_tensor(mk_np) for mk_np in arrays]
+            for t in ts:
+                t.stop_gradient = False
+            out = scaled_dot_product_attention(*ts, is_causal=True,
+                                               use_pallas=use_pallas)
+            (out * out).sum().backward()
+            return (np.asarray(out._val),
+                    [np.asarray(t.grad._val) for t in ts])
+
+        arrays = [mk(), mk(), mk()]
+        out_p, g_p = run(True)
+        out_x, g_x = run(False)
+        np.testing.assert_allclose(out_p, out_x, rtol=2e-5, atol=2e-6)
+        for a, b_, nm in zip(g_p, g_x, "qkv"):
+            np.testing.assert_allclose(a, b_, rtol=5e-4, atol=5e-6,
+                                       err_msg=f"grad wrt {nm}")
+
+    def test_rejects_mask_with_pallas(self):
+        q = paddle.to_tensor(np.zeros((1, 16, 1, 8), "float32"))
+        mask = paddle.to_tensor(np.zeros((1, 1, 16, 16), "float32"))
+        with pytest.raises(ValueError, match="incompatible"):
+            scaled_dot_product_attention(q, q, q, attn_mask=mask,
+                                         use_pallas=True)
