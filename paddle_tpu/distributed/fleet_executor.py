@@ -9,7 +9,7 @@ intended future unified runtime (skeleton stage, ~1k LoC).
 
 TPU-native redesign: actors are threads with queue inboxes; one Carrier per
 process; the MessageBus routes in-proc by dict lookup and cross-process over
-TCP sockets (json frames) — brpc's role. Compute payloads are arbitrary
+TCP sockets (non-executable wire codec, distributed/wire.py) — brpc's role. Compute payloads are arbitrary
 callables (typically jitted XLA programs), so the runtime schedules whole
 compiled programs rather than op lists — the buffer/credit flow-control
 protocol (DATA_IS_READY / DATA_IS_USELESS) is kept from the reference, which
@@ -17,12 +17,12 @@ is exactly what a 1F1B pipeline schedule needs.
 """
 from __future__ import annotations
 
-import pickle
 import queue
 import socket
 import socketserver
-import struct
 import threading
+
+from .wire import read_frame_from, recv_frame, send_frame  # noqa: F401
 
 __all__ = ["TaskNode", "Interceptor", "ComputeInterceptor", "Carrier",
            "MessageBus", "FleetExecutor"]
@@ -94,7 +94,12 @@ class Interceptor(threading.Thread):
             if msg["message_type"] == _MsgType.STOP:
                 self._stopped = True
                 break
-            self.handle(msg)
+            try:
+                self.handle(msg)
+            except Exception as e:  # surface the real error from wait()
+                self._stopped = True
+                self.carrier.notify_error(e, self.interceptor_id)
+                break
 
     def handle(self, msg):
         raise NotImplementedError
@@ -195,7 +200,7 @@ class _SinkInterceptor(Interceptor):
 
 class MessageBus:
     """message_bus.h parity: routes by interceptor id. In-proc: direct
-    enqueue. Cross-process: json frames over TCP (rank → addr table)."""
+    enqueue. Cross-process: wire-codec frames over TCP (rank → addr table)."""
 
     def __init__(self, rank=0, addr_table=None):
         self.rank = rank
@@ -220,10 +225,9 @@ class MessageBus:
             return True
         addr = self.addr_table[rank]
         host, port = addr.rsplit(":", 1)
-        blob = pickle.dumps(dict(msg), protocol=4)  # arrays survive (brpc
-        with socket.create_connection((host, int(port)),   # proto role)
-                                      timeout=30) as s:
-            s.sendall(struct.pack("<Q", len(blob)) + blob)
+        # non-executable wire codec (brpc/proto role; arrays survive)
+        with socket.create_connection((host, int(port)), timeout=30) as s:
+            send_frame(s, dict(msg))
         return True
 
     def serve(self, addr):
@@ -234,11 +238,14 @@ class MessageBus:
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
                 while True:
-                    head = self.rfile.read(8)
-                    if len(head) < 8:
+                    try:
+                        msg = read_frame_from(self.rfile)
+                    except ValueError:
+                        return  # malformed/unverified frame: drop connection
+                    if msg is None:
                         return
-                    (n,) = struct.unpack("<Q", head)
-                    msg = pickle.loads(self.rfile.read(n))
+                    if not isinstance(msg, dict) or "dst_id" not in msg:
+                        return  # well-formed frame, wrong shape: drop peer
                     local = bus._local.get(msg["dst_id"])
                     if local is not None:
                         local.enqueue(InterceptorMessage(msg))
@@ -266,6 +273,7 @@ class Carrier:
         self._done = set()
         self._all_tasks = set()
         self._done_cv = threading.Condition()
+        self._error = None  # (exception, interceptor_id) from a dead actor
 
     def add_interceptor(self, interceptor):
         self.interceptors[interceptor.interceptor_id] = interceptor
@@ -281,12 +289,21 @@ class Carrier:
             self._done.add(task_id)
             self._done_cv.notify_all()
 
+    def notify_error(self, exc, interceptor_id=None):
+        """An actor's handle() raised: record and wake wait() immediately
+        instead of letting it time out with the cause hidden."""
+        with self._done_cv:
+            if self._error is None:
+                self._error = (exc, interceptor_id)
+            self._done_cv.notify_all()
+
     def reset(self):
         """Prepare for another run (the reference FleetExecutor runs once per
         step): clear completion state; interceptors are re-registered by the
         caller."""
         with self._done_cv:
             self._done.clear()
+            self._error = None
 
     def start(self):
         for it in self.interceptors.values():
@@ -298,7 +315,13 @@ class Carrier:
     def wait(self, timeout=60):
         with self._done_cv:
             ok = self._done_cv.wait_for(
-                lambda: self._done >= self._all_tasks, timeout)
+                lambda: self._error is not None
+                or self._done >= self._all_tasks, timeout)
+            err = self._error
+        if err is not None:
+            exc, iid = err
+            raise RuntimeError(
+                f"interceptor {iid} failed: {exc!r}") from exc
         if not ok:
             raise TimeoutError(
                 f"carrier rank {self.rank}: tasks "

@@ -2,38 +2,19 @@
 
 Reference: distributed/service/brpc_ps_server.{h,cc} + brpc_ps_client —
 request/response RPC keyed by (cmd, table_id) over brpc. Here: length-prefixed
-pickle frames over TCP (trusted cluster transport, matching the reference's
-deployment assumption), one thread per connection.
+frames over TCP in the non-executable codec (distributed/wire.py — protobuf's
+role: deserializing peer bytes can never run code; optional HMAC via
+PADDLE_TPU_WIRE_SECRET), one thread per connection, loopback bind by default.
 """
 from __future__ import annotations
 
-import pickle
 import socket
 import socketserver
-import struct
 import threading
 
+from ..wire import recv_frame as _recv_frame, send_frame as _send_frame
+
 __all__ = ["PsServer", "PsClient"]
-
-
-def _send_frame(sock, obj):
-    blob = pickle.dumps(obj, protocol=4)
-    sock.sendall(struct.pack("<Q", len(blob)) + blob)
-
-
-def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
-
-
-def _recv_frame(sock):
-    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
 
 
 class PsServer:
@@ -51,10 +32,13 @@ class PsServer:
                 try:
                     while True:
                         req = _recv_frame(self.request)
+                        if not isinstance(req, dict):
+                            return  # wrong shape: drop the peer
                         resp = server_self._dispatch(req)
                         _send_frame(self.request, resp)
-                except (ConnectionError, EOFError):
-                    pass
+                except (ConnectionError, EOFError, ValueError, KeyError,
+                        TypeError):
+                    pass  # peer closed or sent a malformed/unverified frame
 
         self._server = socketserver.ThreadingTCPServer((host, port), Handler)
         self._server.daemon_threads = True

@@ -1,0 +1,250 @@
+"""Non-executable wire format for the PS / FleetExecutor TCP transports.
+
+Reference: distributed/service/sendrecv.proto + brpc — protobuf frames, no
+code execution on deserialize. Round-1 used pickle, which gives any peer that
+can reach the port arbitrary code execution (ADVICE r1, medium). This module
+replaces it with a tiny tag-based binary codec that can only construct plain
+data (None/bool/int/float/str/bytes/list/tuple/dict/ndarray) — deserializing
+attacker bytes can never run code.
+
+Optional integrity: set PADDLE_TPU_WIRE_SECRET on every process and each
+frame carries an HMAC-SHA256 that receivers verify before decoding.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["encode", "decode", "send_frame", "recv_frame", "FrameError"]
+
+_MAX_FRAME = 1 << 33  # 8 GiB sanity bound
+_MAX_DEPTH = 64
+
+
+class FrameError(ValueError):
+    pass
+
+
+def _secret():
+    s = os.environ.get("PADDLE_TPU_WIRE_SECRET")
+    return s.encode() if s else None
+
+
+# accelerator dtypes (ml_dtypes) have numpy kind 'V'; carry them by name
+_ML_DTYPES = ("bfloat16", "float8_e4m3fn", "float8_e5m2", "float8_e4m3",
+              "float8_e3m4", "float8_e4m3b11fnuz", "float8_e5m2fnuz",
+              "float8_e4m3fnuz", "float4_e2m1fn", "int4", "uint4")
+
+
+def _named_dtype(name):
+    import ml_dtypes
+    return np.dtype(getattr(ml_dtypes, name))
+
+
+# -- codec -------------------------------------------------------------------
+
+def _enc(obj, out, depth=0):
+    if depth > _MAX_DEPTH:
+        raise FrameError("structure too deep")
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, (np.integer,)):
+        _enc(int(obj), out, depth)
+    elif isinstance(obj, (np.floating,)):
+        _enc(float(obj), out, depth)
+    elif isinstance(obj, np.bool_):
+        _enc(bool(obj), out, depth)
+    elif isinstance(obj, int):
+        try:
+            out.append(b"i" + struct.pack("<q", obj))
+        except struct.error:  # bigint
+            s = str(obj).encode()
+            out.append(b"I" + struct.pack("<I", len(s)) + s)
+    elif isinstance(obj, float):
+        out.append(b"f" + struct.pack("<d", obj))
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out.append(b"s" + struct.pack("<Q", len(b)) + b)
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        b = bytes(obj)
+        out.append(b"b" + struct.pack("<Q", len(b)) + b)
+    elif isinstance(obj, (list, tuple)):
+        out.append((b"l" if isinstance(obj, list) else b"t")
+                   + struct.pack("<Q", len(obj)))
+        for it in obj:
+            _enc(it, out, depth + 1)
+    elif isinstance(obj, dict):
+        out.append(b"d" + struct.pack("<Q", len(obj)))
+        for k, v in obj.items():
+            _enc(k, out, depth + 1)
+            _enc(v, out, depth + 1)
+    elif isinstance(obj, np.ndarray):
+        if obj.dtype.kind in "biufc":
+            dt = obj.dtype.str.encode()
+        elif obj.dtype.name in _ML_DTYPES:  # bf16 / fp8 (kind 'V')
+            dt = obj.dtype.name.encode()
+        else:
+            raise FrameError(f"unsupported array dtype {obj.dtype}")
+        arr = np.ascontiguousarray(obj)
+        raw = arr.tobytes()
+        out.append(b"a" + struct.pack("<B", len(dt)) + dt
+                   + struct.pack("<B", arr.ndim)
+                   + struct.pack(f"<{arr.ndim}q", *arr.shape)
+                   + struct.pack("<Q", len(raw)) + raw)
+    else:
+        # jax arrays and anything array-like with __array__ go as ndarray
+        a = np.asarray(obj)
+        if a.dtype.kind in "biufc":
+            _enc(a, out, depth)
+        else:
+            raise FrameError(f"unserializable type {type(obj).__name__}")
+
+
+def encode(obj) -> bytes:
+    out = []
+    _enc(obj, out)
+    return b"".join(out)
+
+
+class _Reader:
+    def __init__(self, buf):
+        self.buf = memoryview(buf)
+        self.off = 0
+
+    def take(self, n):
+        if self.off + n > len(self.buf):
+            raise FrameError("truncated frame")
+        v = self.buf[self.off:self.off + n]
+        self.off += n
+        return v
+
+    def unpack(self, fmt):
+        size = struct.calcsize(fmt)
+        return struct.unpack(fmt, self.take(size))
+
+
+def _dec(r, depth=0):
+    if depth > _MAX_DEPTH:
+        raise FrameError("structure too deep")
+    tag = bytes(r.take(1))
+    if tag == b"N":
+        return None
+    if tag == b"T":
+        return True
+    if tag == b"F":
+        return False
+    if tag == b"i":
+        return r.unpack("<q")[0]
+    if tag == b"I":
+        (n,) = r.unpack("<I")
+        return int(bytes(r.take(n)).decode())
+    if tag == b"f":
+        return r.unpack("<d")[0]
+    if tag == b"s":
+        (n,) = r.unpack("<Q")
+        return bytes(r.take(n)).decode("utf-8")
+    if tag == b"b":
+        (n,) = r.unpack("<Q")
+        return bytes(r.take(n))
+    if tag in (b"l", b"t"):
+        (n,) = r.unpack("<Q")
+        items = [_dec(r, depth + 1) for _ in range(n)]
+        return items if tag == b"l" else tuple(items)
+    if tag == b"d":
+        (n,) = r.unpack("<Q")
+        out = {}
+        for _ in range(n):
+            k = _dec(r, depth + 1)
+            out[k] = _dec(r, depth + 1)
+        return out
+    if tag == b"a":
+        (dtn,) = r.unpack("<B")
+        dts = bytes(r.take(dtn))
+        try:
+            if dts.decode(errors="replace") in _ML_DTYPES:
+                dt = _named_dtype(dts.decode())
+            else:
+                dt = np.dtype(dts.decode())
+        except (TypeError, ValueError, UnicodeDecodeError,
+                AttributeError, ImportError) as e:
+            raise FrameError(f"bad array dtype: {e}") from None
+        if dt.kind not in "biufc" and dt.name not in _ML_DTYPES:
+            raise FrameError(f"disallowed array dtype {dt}")
+        (ndim,) = r.unpack("<B")
+        shape = r.unpack(f"<{ndim}q") if ndim else ()
+        (nraw,) = r.unpack("<Q")
+        raw = r.take(nraw)
+        return np.frombuffer(raw, dtype=dt).reshape(shape).copy()
+    raise FrameError(f"bad tag {tag!r}")
+
+
+def decode(buf):
+    r = _Reader(buf)
+    obj = _dec(r)
+    if r.off != len(r.buf):
+        raise FrameError("trailing bytes in frame")
+    return obj
+
+
+# -- framed socket IO --------------------------------------------------------
+
+def _recv_exact(sock, n):
+    chunks = []
+    got = 0
+    while got < n:
+        c = sock.recv(min(n - got, 1 << 20))
+        if not c:
+            raise ConnectionError("peer closed")
+        chunks.append(c)
+        got += len(c)
+    return b"".join(chunks)
+
+
+def send_frame(sock, obj):
+    payload = encode(obj)
+    secret = _secret()
+    mac = hmac.new(secret, payload, hashlib.sha256).digest() if secret \
+        else b""
+    sock.sendall(struct.pack("<QB", len(payload), len(mac)) + mac + payload)
+
+
+def recv_frame(sock):
+    n, maclen = struct.unpack("<QB", _recv_exact(sock, 9))
+    if n > _MAX_FRAME:
+        raise FrameError(f"frame too large ({n})")
+    mac = _recv_exact(sock, maclen) if maclen else b""
+    payload = _recv_exact(sock, n)
+    secret = _secret()
+    if secret:
+        want = hmac.new(secret, payload, hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, want):
+            raise FrameError("HMAC verification failed")
+    return decode(payload)
+
+
+def read_frame_from(rfile):
+    """recv_frame over a buffered file object (socketserver StreamHandler)."""
+    head = rfile.read(9)
+    if len(head) < 9:
+        return None
+    n, maclen = struct.unpack("<QB", head)
+    if n > _MAX_FRAME:
+        raise FrameError(f"frame too large ({n})")
+    mac = rfile.read(maclen) if maclen else b""
+    payload = rfile.read(n)
+    if len(payload) < n:
+        return None
+    secret = _secret()
+    if secret:
+        want = hmac.new(secret, payload, hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, want):
+            raise FrameError("HMAC verification failed")
+    return decode(payload)

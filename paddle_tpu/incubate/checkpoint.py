@@ -56,13 +56,19 @@ class CheckpointSaver:
             with open(os.path.join(stage, "meta.json"), "w") as f:
                 json.dump(meta, f)
             tmp = self._path + ".tmp"
+            old = self._path + ".old"
             self._fs.delete(tmp)
             if self._fs.need_upload_download():
                 self._fs.upload(stage, tmp)
             else:
                 shutil.copytree(stage, tmp)
-            self._fs.delete(self._path)
+            # crash-safe swap: keep the previous snapshot aside until the new
+            # one is in place, so no crash window leaves zero checkpoints
+            self._fs.delete(old)
+            if self._fs.is_exist(self._path):
+                self._fs.mv(self._path, old)
             self._fs.mv(tmp, self._path)
+            self._fs.delete(old)
         finally:
             shutil.rmtree(stage, ignore_errors=True)
 
@@ -72,7 +78,13 @@ class CheckpointSaver:
 
         from ..framework.io_utils import load as load_obj
         if not self._fs.is_exist(os.path.join(self._path, "meta.json")):
-            return None, None
+            # crash fell between the swap's mv steps: recover the snapshot
+            # that was renamed aside by save_checkpoint
+            old = self._path + ".old"
+            if self._fs.is_exist(os.path.join(old, "meta.json")):
+                self._fs.mv(old, self._path)
+            else:
+                return None, None
         if self._fs.need_upload_download():
             stage = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
             try:

@@ -292,33 +292,83 @@ def default_collate_fn(batch):
     return batch
 
 
-_MP_STATE = {}
+class _ProcessPool:
+    """Persistent spawn-based worker pool (reference dataloader_iter.py:
+    per-worker index queues, shared result queue, ordered reorder buffer).
+    Spawn (not fork): the parent holds a live multithreaded XLA runtime."""
 
+    def __init__(self, dataset, num_workers, worker_init_fn,
+                 use_shared_memory, timeout):
+        import multiprocessing as mp
+        import os
+        from . import worker as _worker
+        self._worker_mod = _worker
+        self._timeout = timeout or None
+        ctx = mp.get_context("spawn")
+        self.index_queues = [ctx.Queue() for _ in range(num_workers)]
+        self.result_queue = ctx.Queue()
+        self.procs = []
+        # children must never claim the ambient TPU platform
+        old = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for wid in range(num_workers):
+                p = ctx.Process(
+                    target=_worker.worker_loop,
+                    args=(dataset, self.index_queues[wid], self.result_queue,
+                          wid, num_workers, worker_init_fn,
+                          use_shared_memory),
+                    daemon=True)
+                p.start()
+                self.procs.append(p)
+        finally:
+            if old is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = old
+        self.num_workers = num_workers
+        self._next_send = 0  # global batch counter (round-robin dispatch)
 
-def _mp_worker_init(dataset, worker_init_fn, num_workers):
-    _MP_STATE["dataset"] = dataset
-    import multiprocessing as mp
-    ident = mp.current_process()._identity
-    wid = (ident[0] - 1) % num_workers if ident else 0
-    _MP_STATE["info"] = _WorkerInfo(id=wid, num_workers=num_workers,
-                                    dataset=dataset)
-    if worker_init_fn is not None:
-        worker_init_fn(wid)
+    def submit(self, indices):
+        bidx = self._next_send
+        self.index_queues[bidx % self.num_workers].put((bidx, list(indices)))
+        self._next_send += 1
+        return bidx
 
+    def recv(self):
+        """Next result; polls so a dead worker raises instead of hanging."""
+        import queue as q
+        waited = 0.0
+        while True:
+            try:
+                return self.result_queue.get(timeout=1.0)
+            except q.Empty:
+                waited += 1.0
+                dead = [i for i, p in enumerate(self.procs)
+                        if not p.is_alive()]
+                if dead:
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} died unexpectedly "
+                        "(exitcodes "
+                        f"{[self.procs[i].exitcode for i in dead]})"
+                    ) from None
+                if self._timeout is not None and waited >= self._timeout:
+                    raise TimeoutError(
+                        f"DataLoader worker timed out after {waited}s "
+                        "(slow dataset)") from None
 
-def _mp_fetch(indices):
-    ds = _MP_STATE["dataset"]
-    out = []
-    for i in indices:
-        s = ds[i]
-        # device arrays must not cross the process boundary — force numpy
-        if isinstance(s, tuple):
-            s = tuple(np.asarray(x._value) if isinstance(x, Tensor)
-                      else x for x in s)
-        elif isinstance(s, Tensor):
-            s = np.asarray(s._value)
-        out.append(s)
-    return out
+    def shutdown(self):
+        for iq in self.index_queues:
+            try:
+                iq.put(None)
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        for p in self.procs:
+            p.join(timeout=1)
 
 
 class DataLoader:
@@ -339,8 +389,12 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.use_multiprocess = use_multiprocess
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.persistent_workers = persistent_workers
         self.worker_init_fn = worker_init_fn
         self.prefetch_factor = max(2, prefetch_factor)
+        self._pool = None  # persistent spawn pool (persistent_workers=True)
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -414,29 +468,79 @@ class DataLoader:
                     f.cancel()
 
     def _iter_process_pool(self):
-        """Process workers (reference: dataloader/worker.py _worker_loop —
-        one OS process per worker, samples shipped back over queues). Opt-in
-        via use_multiprocess=True: fork-inherited dataset (no pickling of the
-        dataset), index lists to workers, raw numpy samples back, collate in
-        the parent (device arrays must not cross process boundaries)."""
-        import multiprocessing as mp
-        ctx = mp.get_context("fork")
+        """Spawn-based process workers (reference: dataloader/worker.py
+        _worker_loop over per-worker index queues + shared-memory tensors,
+        dataloader_iter.py ordering). Opt-in via use_multiprocess=True; the
+        dataset must be picklable and should return numpy. Collate runs in
+        the parent (device arrays never cross process boundaries);
+        persistent_workers=True keeps the pool alive across epochs."""
+        from . import worker as _worker
+        pool = self._pool
+        if pool is None:
+            pool = _ProcessPool(self.dataset, self.num_workers,
+                                self.worker_init_fn, self.use_shared_memory,
+                                self.timeout)
+            if self.persistent_workers:
+                self._pool = pool
         window = self.prefetch_factor * self.num_workers
-        pool = ctx.Pool(processes=self.num_workers,
-                        initializer=_mp_worker_init,
-                        initargs=(self.dataset, self.worker_init_fn,
-                                  self.num_workers))
+        state = {"ready": {}, "next_yield": None, "in_flight": 0}
+
+        def drain_one():
+            """Receive one result into the reorder buffer (raises on a
+            failed worker)."""
+            ridx, status, payload = pool.recv()
+            state["in_flight"] -= 1
+            if status == "err":
+                raise RuntimeError(f"DataLoader worker failed:\n{payload}")
+            state["ready"][ridx] = payload
+
+        def pop_ready():
+            ready = state["ready"]
+            while state["next_yield"] in ready:
+                payload = ready.pop(state["next_yield"])
+                state["next_yield"] += 1
+                yield self.collate_fn(_worker.decode(payload))
+
         try:
-            pending = []
             for indices in self.batch_sampler:
-                pending.append(pool.apply_async(_mp_fetch, (list(indices),)))
-                if len(pending) >= window:
-                    yield self.collate_fn(pending.pop(0).get())
-            while pending:
-                yield self.collate_fn(pending.pop(0).get())
+                bidx = pool.submit(indices)
+                if state["next_yield"] is None:
+                    state["next_yield"] = bidx  # this epoch's first batch
+                state["in_flight"] += 1
+                while state["in_flight"] >= window:
+                    drain_one()
+                    yield from pop_ready()
+            while state["in_flight"]:
+                drain_one()
+            yield from pop_ready()
         finally:
-            pool.terminate()
-            pool.join()
+            # early close/error: drain in-flight results so a persistent pool
+            # starts the next epoch clean, and free all shm segments
+            import queue as _q
+            while state["in_flight"]:
+                try:
+                    _, status, payload = pool.result_queue.get(timeout=5)
+                except (_q.Empty, OSError):
+                    break
+                state["in_flight"] -= 1
+                if status == "ok":
+                    _worker.discard(payload)
+            for payload in state["ready"].values():
+                _worker.discard(payload)
+            if state["in_flight"]:
+                # drain timed out: the shared queue still holds stale
+                # results — a persistent pool would desync next epoch, so
+                # retire it entirely
+                if pool is self._pool:
+                    self._pool = None
+                pool.shutdown()
+            elif pool is not self._pool:
+                pool.shutdown()
+
+    def __del__(self):
+        pool, self._pool = getattr(self, "_pool", None), None
+        if pool is not None:
+            pool.shutdown()
 
     def _iter_single_producer(self):
         q = _queue.Queue(maxsize=self.prefetch_factor * max(self.num_workers, 1))

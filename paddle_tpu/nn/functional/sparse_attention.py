@@ -34,11 +34,15 @@ def sparse_attention(query, key, value, sparse_csr_offset,
         def one_head(qh, kh, vh, off, cols):
             nnz = cols.shape[0]
             # row of each CSR entry t: r s.t. off[r] <= t < off[r+1]
-            rows = jnp.searchsorted(off, jnp.arange(nnz, dtype=off.dtype),
-                                    side="right") - 1
+            entry = jnp.arange(nnz, dtype=off.dtype)
+            rows = jnp.searchsorted(off, entry, side="right") - 1
             rows = jnp.clip(rows, 0, seq_len - 1)
+            # entries at positions >= off[-1] are padding (nnz can differ
+            # across batch/head lanes); scatter False for them so they never
+            # unmask a spurious key position
+            valid = entry < off[-1]
             mask = jnp.zeros((seq_len, seq_len), dtype=bool)
-            mask = mask.at[rows, cols].set(True)
+            mask = mask.at[rows, cols].max(valid)
             logits = (qh @ kh.T) * scale
             logits = jnp.where(mask, logits, -1e30)
             probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)

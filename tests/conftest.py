@@ -15,6 +15,10 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
 # keep compile times sane on the 1-core CI box
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# persistent XLA compilation cache: repeat suite runs skip recompiles
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/paddle_tpu_xla_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 if os.environ.get("PADDLE_TPU_TEST_DEVICE", "cpu") == "cpu":
     os.environ["JAX_PLATFORMS"] = "cpu"

@@ -8,6 +8,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from paddle_tpu.distributed.launch_utils import (
     Cluster, find_free_ports, get_cluster_from_args, start_local_trainers,
     terminate_local_procs, watch_local_trainers,

@@ -3,6 +3,8 @@ test_post_training_quantization_* — simplified to the SURVEY §4.1 pattern).""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 from paddle_tpu.slim import (

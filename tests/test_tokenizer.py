@@ -64,6 +64,7 @@ class TestFasterTokenizer:
         toks = [VOCAB[i] for i in ids.numpy()[0]]
         assert toks[0] == "[CLS]" and toks[-1] == "[SEP]"
 
+    @pytest.mark.slow
     def test_vs_transformers_oracle(self, vocab_file):
         hf = pytest.importorskip("transformers")
         ours = FasterTokenizer(Vocab.load_vocabulary(vocab_file))

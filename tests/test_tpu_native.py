@@ -53,7 +53,10 @@ class TestRingAttention:
             paddle.to_tensor(v_np), is_causal=causal)._val)
         np.testing.assert_allclose(out_ring, out_ref, rtol=2e-5, atol=2e-6)
 
-    @pytest.mark.parametrize("causal", [False, True])
+    # non-causal backward exercises the same vjp path; keep one variant in
+    # the default lane and the other in the slow lane (compile-bound)
+    @pytest.mark.parametrize(
+        "causal", [pytest.param(False, marks=pytest.mark.slow), True])
     def test_backward_parity(self, mesh_guard, causal):
         from paddle_tpu.distributed.fleet.sequence_parallel import (
             ring_attention,

@@ -175,22 +175,87 @@ class TestCppExtension:
         assert "relu2" in mod2.op_names()
 
 
+class _SpawnDS:
+    """Module-level so spawn workers can unpickle it."""
+
+    def __init__(self, n=32, shape=(2,)):
+        self.n = n
+        self.shape = shape
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return (np.full(self.shape, float(i), dtype=np.float32), np.int64(i))
+
+
+class _FailingDS(_SpawnDS):
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("sample 5 is poisoned")
+        return super().__getitem__(i)
+
+
+def _winit(worker_id):
+    os.environ["PADDLE_TPU_TEST_WID"] = str(worker_id)
+
+
 class TestMultiprocessDataLoader:
+    """Spawn-based process workers (reference dataloader_iter.py pattern;
+    spawned, not forked — the parent holds a live XLA runtime)."""
+
     def test_process_workers_order_and_values(self):
-        import numpy as np
-        from paddle_tpu.io import DataLoader, Dataset
-
-        class DS(Dataset):
-            def __len__(self):
-                return 32
-
-            def __getitem__(self, i):
-                return (np.full((2,), float(i), dtype=np.float32),
-                        np.int64(i))
-
-        dl = DataLoader(DS(), batch_size=4, shuffle=False, num_workers=2,
-                        use_multiprocess=True)
+        from paddle_tpu.io import DataLoader
+        dl = DataLoader(_SpawnDS(), batch_size=4, shuffle=False,
+                        num_workers=2, use_multiprocess=True,
+                        worker_init_fn=_winit)
         batches = list(dl)
         assert len(batches) == 8
         xs = np.concatenate([b[0].numpy() for b in batches])
         np.testing.assert_allclose(xs[:, 0], np.arange(32))
+
+    @pytest.mark.slow
+    def test_shared_memory_path_large_samples(self):
+        from paddle_tpu.io import DataLoader
+        # 128*260 f32 > 64KiB threshold -> rides POSIX shared memory
+        dl = DataLoader(_SpawnDS(n=8, shape=(128, 260)), batch_size=2,
+                        shuffle=False, num_workers=2, use_multiprocess=True)
+        batches = list(dl)
+        assert len(batches) == 4
+        np.testing.assert_allclose(batches[1][0].numpy()[0, 0, 0], 2.0)
+        got = np.concatenate([b[0].numpy()[:, 0, 0] for b in batches])
+        np.testing.assert_allclose(got, np.arange(8))
+
+    @pytest.mark.slow
+    def test_persistent_workers_across_epochs(self):
+        from paddle_tpu.io import DataLoader
+        dl = DataLoader(_SpawnDS(n=8), batch_size=4, shuffle=False,
+                        num_workers=2, use_multiprocess=True,
+                        persistent_workers=True)
+        try:
+            e1 = [b[1].numpy().tolist() for b in dl]
+            pool = dl._pool
+            assert pool is not None and all(p.is_alive() for p in pool.procs)
+            e2 = [b[1].numpy().tolist() for b in dl]
+            assert e1 == e2 == [[0, 1, 2, 3], [4, 5, 6, 7]]
+            assert dl._pool is pool  # same workers, no respawn
+        finally:
+            pool = dl._pool
+            dl._pool = None
+            if pool is not None:
+                pool.shutdown()
+
+    def test_worker_error_propagates(self):
+        from paddle_tpu.io import DataLoader
+        dl = DataLoader(_FailingDS(n=16), batch_size=4, shuffle=False,
+                        num_workers=2, use_multiprocess=True)
+        with pytest.raises(RuntimeError, match="sample 5 is poisoned"):
+            list(dl)
+
+    def test_early_close_no_hang(self):
+        from paddle_tpu.io import DataLoader
+        dl = DataLoader(_SpawnDS(n=64, shape=(128, 260)), batch_size=4,
+                        shuffle=False, num_workers=2, use_multiprocess=True)
+        for i, b in enumerate(dl):
+            if i == 1:
+                break  # generator close must drain + free shm, not hang

@@ -6,6 +6,8 @@ each model, run a small input through, check the logit shape.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
 

@@ -1,0 +1,169 @@
+"""Wire codec + transport-hardening tests (ADVICE r1: pickle-over-TCP RCE).
+
+The codec must round-trip everything the PS/FleetExecutor protocols carry,
+and decoding attacker-controlled bytes must never execute code (there is no
+code path to execute — only data tags)."""
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import wire
+
+
+class TestCodecRoundtrip:
+    CASES = [
+        None, True, False, 0, -1, 2 ** 40, 2 ** 100, -2 ** 100, 3.5,
+        "hello", "", "日本語", b"\x00\xff", [1, 2, [3, "x"]],
+        (1, "a", None), {"cmd": "push", "table_id": 3},
+        {1: "int-key", (2, 3): "tuple-key"},
+        {"nested": {"arrays": [1.5, {"deep": (True, b"z")}]}},
+    ]
+
+    @pytest.mark.parametrize("obj", CASES, ids=repr)
+    def test_roundtrip(self, obj):
+        assert wire.decode(wire.encode(obj)) == obj
+
+    def test_ndarray_roundtrip(self):
+        for arr in [np.arange(12, dtype="float32").reshape(3, 4),
+                    np.asarray(7, dtype="int64"),
+                    np.random.RandomState(0).randn(2, 3, 4),
+                    np.asarray([True, False]),
+                    np.asarray([1 + 2j], dtype="complex64")]:
+            got = wire.decode(wire.encode({"a": arr}))["a"]
+            np.testing.assert_array_equal(got, arr)
+            assert got.dtype == arr.dtype
+
+    def test_bfloat16_roundtrip(self):
+        import ml_dtypes
+        arr = np.asarray([[1.5, -2.25]], dtype=ml_dtypes.bfloat16)
+        got = wire.decode(wire.encode(arr))
+        assert got.dtype == arr.dtype
+        np.testing.assert_array_equal(got.astype("f4"), arr.astype("f4"))
+
+    def test_numpy_scalars_normalize(self):
+        out = wire.decode(wire.encode({"i": np.int32(5), "f": np.float64(2.5),
+                                       "b": np.bool_(True)}))
+        assert out == {"i": 5, "f": 2.5, "b": True}
+
+    def test_rejects_object_dtype(self):
+        with pytest.raises(wire.FrameError):
+            wire.encode(np.asarray([object()]))
+
+    def test_rejects_unserializable(self):
+        with pytest.raises(wire.FrameError):
+            wire.encode(lambda: 1)
+
+    def test_malformed_bytes_raise_not_execute(self):
+        for bad in [b"", b"z", b"i\x01", b"a\x04<f8\x02",
+                    wire.encode({"x": 1})[:-1],
+                    wire.encode({"x": 1}) + b"junk"]:
+            with pytest.raises((wire.FrameError, ValueError)):
+                wire.decode(bad)
+
+    def test_disallowed_array_dtype_rejected_on_decode(self):
+        # hand-craft an 'a' frame claiming dtype '|O8' (object)
+        import struct
+        dt = b"|O8"
+        frame = (b"a" + struct.pack("<B", len(dt)) + dt
+                 + struct.pack("<B", 1) + struct.pack("<q", 1)
+                 + struct.pack("<Q", 8) + b"\x00" * 8)
+        with pytest.raises((wire.FrameError, TypeError, ValueError)):
+            wire.decode(frame)
+
+
+class TestFramedSockets:
+    def _pair(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        cli = socket.create_connection(srv.getsockname())
+        conn, _ = srv.accept()
+        srv.close()
+        return cli, conn
+
+    def test_send_recv_frame(self):
+        cli, conn = self._pair()
+        try:
+            payload = {"cmd": "pull", "vals": np.ones((4, 2), "float32")}
+            t = threading.Thread(target=wire.send_frame, args=(cli, payload))
+            t.start()
+            got = wire.recv_frame(conn)
+            t.join()
+            assert got["cmd"] == "pull"
+            np.testing.assert_array_equal(got["vals"], payload["vals"])
+        finally:
+            cli.close()
+            conn.close()
+
+    def test_hmac_rejects_tampered_frame(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_WIRE_SECRET", "sekrit")
+        cli, conn = self._pair()
+        try:
+            t = threading.Thread(target=wire.send_frame,
+                                 args=(cli, {"x": 1}))
+            t.start()
+            # receiver with a different secret must reject
+            t.join()
+            monkeypatch.setenv("PADDLE_TPU_WIRE_SECRET", "other")
+            with pytest.raises(wire.FrameError, match="HMAC"):
+                wire.recv_frame(conn)
+        finally:
+            cli.close()
+            conn.close()
+
+
+class TestInterceptorErrorPropagation:
+    def test_failing_fn_surfaces_real_error(self):
+        from paddle_tpu.distributed.fleet_executor import (
+            FleetExecutor, TaskNode,
+        )
+
+        def boom(x):
+            raise ZeroDivisionError("boom")
+
+        node = TaskNode("t0", fn=boom, max_run_times=2)
+        ex = FleetExecutor([node])
+        with pytest.raises(RuntimeError, match="ZeroDivisionError"):
+            ex.run([1, 2], timeout=10)
+
+
+class TestCheckpointCrashRecovery:
+    def test_old_snapshot_recovered(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.fleet.fs import LocalFS
+        from paddle_tpu.incubate.checkpoint import CheckpointSaver
+        path = str(tmp_path / "ckpt")
+        saver = CheckpointSaver(LocalFS(), path)
+        state = {"w": paddle.to_tensor(np.ones((2, 2), "float32"))}
+        saver.save_checkpoint(state, {"epoch": 3})
+        # simulate a crash between "mv path -> path.old" and "mv tmp -> path"
+        import os
+        os.rename(path, path + ".old")
+        st, meta = saver.load_checkpoint()
+        assert meta["epoch"] == 3
+        np.testing.assert_array_equal(np.asarray(st["w"]._val),
+                                      np.ones((2, 2)))
+
+
+class TestSparseAttentionPadEntries:
+    def test_pad_entries_do_not_unmask(self):
+        """CSR pad entries (>= offset[-1]) must not attend anywhere
+        (ADVICE r1: they used to land on the last row as True)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.nn.functional import sparse_attention
+        rng = np.random.RandomState(0)
+        b, h, s, d = 1, 1, 4, 8
+        q = rng.randn(b, h, s, d).astype("float32")
+        k = rng.randn(b, h, s, d).astype("float32")
+        v = rng.randn(b, h, s, d).astype("float32")
+        # diagonal-only pattern, nnz buffer padded with DISTINCT column ids
+        # that must be ignored (entries beyond offset[-1]=4)
+        offset = np.asarray([[[0, 1, 2, 3, 4]]], dtype="int32")
+        cols_pad_garbage = np.asarray([[[0, 1, 2, 3, 0, 1]]], dtype="int32")
+        out = sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(offset), paddle.to_tensor(cols_pad_garbage))
+        # diagonal-only attention == each row attends solely to itself -> V
+        np.testing.assert_allclose(np.asarray(out._val), v, rtol=1e-5)
