@@ -44,6 +44,9 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
     return func(*args)
 
 
+from .checkpoint import (  # noqa: F401,E402
+    load_hybrid_checkpoint, reshard_model, save_hybrid_checkpoint,
+)
 from . import launch  # noqa: F401,E402  (python -m paddle_tpu.distributed.launch)
 from . import launch_utils  # noqa: F401,E402
 from . import fleet_executor  # noqa: F401,E402  (fleet_executor actor runtime)

@@ -28,6 +28,11 @@ class ReduceOp:
     AVG = "avg"
 
 
+# eager (host) reduction table shared by all_reduce / reduce_scatter
+_EAGER_REDUCE = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+                 "prod": jnp.prod, "avg": jnp.mean}
+
+
 class Group:
     """≈ NCCL ring: identifies a mesh axis (+ optional rank subset)."""
 
@@ -46,7 +51,25 @@ class Group:
 
     @property
     def rank(self):
-        return 0  # per-device rank is only meaningful inside shard_map
+        # in-group process rank (DCN), -1 for non-members (reference Group
+        # semantics); per-device ranks exist only inside shard_map
+        # (jax.lax.axis_index)
+        import jax as _jax
+        g = _jax.process_index()
+        if self.ranks is not None:
+            return self.ranks.index(g) if g in self.ranks else -1
+        return g
+
+    def _check_eager_subgroup(self, opname):
+        """Eager DCN collectives run over ALL processes
+        (multihost_utils); proper rank subsets would need a split
+        coordination service — fail loudly rather than mis-slice."""
+        import jax as _jax
+        if self.ranks is not None and \
+                len(self.ranks) != _jax.process_count():
+            raise NotImplementedError(
+                f"eager {opname} over a rank subgroup {self.ranks}; use the "
+                "in-trace path (shard_map on a mesh axis) for subgroups")
 
     def __repr__(self):
         return f"Group(axis={self.axis}, nranks={self.nranks})"
@@ -108,11 +131,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return tensor
     if get_world_size() <= 1:
         return tensor
+    g._check_eager_subgroup("all_reduce")
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(v)
-    red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
-           "prod": jnp.prod, "avg": jnp.mean}[op](gathered, axis=0)
-    tensor._value = red
+    tensor._value = _EAGER_REDUCE[op](gathered, axis=0)
     return tensor
 
 
@@ -171,7 +193,20 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
             out = apply(prim, stacked, name="c_scatter")
             tensor._value = out._value
             return tensor
-    return tensor
+        # eager: every process holds src's list (single-controller) — take
+        # this rank's slice (c_scatter_op parity); in-group rank for
+        # subgroups
+        rank = g.rank if g.ranks is not None else jax.process_index()
+        if rank < 0 or rank >= len(tensor_list):
+            raise ValueError(
+                f"scatter got {len(tensor_list)} tensors for rank {rank}")
+        tensor._value = unwrap(tensor_list[rank])
+        return tensor
+    if get_world_size() <= 1:
+        return tensor
+    raise ValueError(
+        "scatter on the eager multi-process path needs tensor_list on "
+        "every rank (single-controller SPMD has no src-only data)")
 
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
@@ -189,10 +224,24 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
             src, name="c_reducescatter")
         tensor._value = out._value
         return tensor
-    if get_world_size() <= 1:
+    world = get_world_size()
+    if world <= 1:
         tensor._value = v
         return tensor
-    raise NotImplementedError("eager multi-host reduce_scatter")
+    g._check_eager_subgroup("reduce_scatter")
+    # eager DCN path (c_reducescatter parity): gather every process's
+    # contribution, reduce, keep this rank's chunk
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(v)  # (world, ...)
+    red = _EAGER_REDUCE[op](gathered, axis=0)
+    if red.shape[0] % world:
+        raise ValueError(
+            f"reduce_scatter dim0 ({red.shape[0]}) not divisible by "
+            f"world size ({world})")
+    chunk = red.shape[0] // world
+    rank = jax.process_index()
+    tensor._value = red[rank * chunk:(rank + 1) * chunk]
+    return tensor
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
@@ -216,14 +265,28 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
             out_tensor_list.extend(parts)
             return out_tensor_list
         return out
-    if get_world_size() <= 1:
+    world = get_world_size()
+    if world <= 1:
         if out_tensor_list is not None:
             out_tensor_list.clear()
             out_tensor_list.extend(
                 in_tensor_list if isinstance(in_tensor_list, list) else [x])
             return out_tensor_list
         return x
-    raise NotImplementedError("eager multi-host alltoall")
+    g._check_eager_subgroup("alltoall")
+    # eager DCN path (alltoall_op parity): chunk i of rank j goes to rank i.
+    # gathered[j, i] = rank j's chunk i; this rank r receives gathered[:, r].
+    if v.shape[0] != world:
+        raise ValueError(
+            f"alltoall needs {world} chunks, got leading dim {v.shape[0]}")
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(v)  # (world, world, ...)
+    mine = gathered[:, jax.process_index()]
+    if out_tensor_list is not None:
+        out_tensor_list.clear()
+        out_tensor_list.extend(Tensor(mine[i]) for i in range(world))
+        return out_tensor_list
+    return Tensor(mine)
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
@@ -241,7 +304,16 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    return tensor
+    """recv_v2 parity. Only meaningful paired with send inside an SPMD
+    trace (where send lowers to ppermute and its result IS the received
+    value). Eagerly there is no p2p channel to pull from — fail loudly
+    instead of silently returning the input unchanged."""
+    v = unwrap(tensor)
+    if _is_traced(v) or get_world_size() <= 1:
+        return tensor
+    raise NotImplementedError(
+        "eager cross-process recv has no DCN channel; restructure as an "
+        "in-trace ppermute (see fleet pipeline) or use all_gather")
 
 
 def barrier(group=None):

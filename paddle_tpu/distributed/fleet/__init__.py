@@ -31,3 +31,7 @@ from . import fs  # noqa: F401,E402  (fleet.utils.fs parity)
 from .fs import HDFSClient, LocalFS  # noqa: F401,E402
 from . import elastic  # noqa: F401,E402  (fleet.elastic parity)
 from . import metrics  # noqa: F401,E402  (fleet.metrics parity)
+from . import meta_optimizers  # noqa: F401,E402
+from ..checkpoint import (  # noqa: F401,E402  (hybrid save/load parity)
+    load_hybrid_checkpoint, save_hybrid_checkpoint,
+)

@@ -207,15 +207,33 @@ class Fleet:
             return TensorParallel(model, self._hcg, self._strategy)
         if mode == "sharding":
             return ShardingParallel(model, self._hcg, self._strategy)
-        return DataParallel(model)
+        st = self._strategy
+        dp = DataParallel(
+            model, strategy=st,
+            comm_buffer_size=getattr(st, "fuse_grad_size_in_MB", 25),
+            find_unused_parameters=getattr(st, "find_unused_parameters",
+                                           False))
+        if getattr(st, "fp16_allreduce", False) and dp._reducer is not None:
+            import jax.numpy as jnp
+            dp._reducer.comm_dtype = jnp.bfloat16  # TPU-native half regime
+        return dp
 
     def distributed_optimizer(self, optimizer, strategy=None):
         if strategy is not None:
             self._strategy = strategy
+        st = self._strategy
         if (self._hcg is not None
                 and self._hcg.get_sharding_parallel_world_size() > 1):
             from .sharding_optimizer import ShardingOptimizerWrapper
             optimizer = ShardingOptimizerWrapper(optimizer)
+        if st is not None and getattr(st, "gradient_merge", False):
+            # strategy-driven micro-batch accumulation
+            # (meta_optimizers/gradient_merge_optimizer.py parity)
+            from .meta_optimizers import GradientMergeOptimizer
+            cfg = getattr(st, "gradient_merge_configs", {}) or {}
+            optimizer = GradientMergeOptimizer(
+                optimizer, k_steps=cfg.get("k_steps", 1),
+                avg=cfg.get("avg", True))
         from .meta_parallel import HybridParallelOptimizer
         if self._hcg is not None and self._hcg.get_parallel_mode() != "data":
             return HybridParallelOptimizer(optimizer, self._hcg,

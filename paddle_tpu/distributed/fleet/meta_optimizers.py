@@ -1,0 +1,68 @@
+"""DistributedStrategy-driven optimizer behaviors.
+
+Reference: fleet/meta_optimizers/gradient_merge_optimizer.py (micro-batch
+gradient accumulation via program rewriting) and
+fp16_allreduce_optimizer.py (cast grads to half precision for the
+allreduce). TPU-native: the static-graph program rewrites become small
+eager wrappers — under jit the same arithmetic fuses into the step program.
+
+Knobs deliberately NOT implemented (documented non-goals, README scope):
+DGC (deep gradient compression) and LocalSGD — both trade convergence for
+interconnect bandwidth that ICI makes cheap.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["GradientMergeOptimizer"]
+
+
+class GradientMergeOptimizer:
+    """Accumulate grads for k_steps calls, apply once (avg optional).
+
+    step()/clear_grad() pairs from a standard train loop work unchanged:
+    the k-1 intermediate step() calls are no-ops and the paired
+    clear_grad() calls are suppressed so grads keep accumulating
+    (reference gradient_merge_optimizer.py semantics).
+    """
+
+    def __init__(self, optimizer, k_steps=1, avg=True):
+        self._inner = optimizer
+        self._k = max(int(k_steps), 1)
+        self._avg = avg
+        self._count = 0
+        self._applied = True  # first clear_grad before any step is honored
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    def step(self):
+        self._count += 1
+        if self._count % self._k:
+            self._applied = False
+            return
+        if self._avg and self._k > 1:
+            from ...core.selected_rows import SelectedRows
+            for p, g in self._inner._collect_params_grads():
+                if g is None:
+                    continue
+                if isinstance(g, SelectedRows):
+                    g.value = g.value / self._k
+                else:
+                    g._value = g._val / self._k
+        self._inner.step()
+        self._applied = True
+
+    def clear_grad(self, *a, **kw):
+        if self._applied:
+            self._inner.clear_grad(*a, **kw)
+        # else: mid-merge — keep accumulating
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()

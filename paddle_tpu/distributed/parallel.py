@@ -29,6 +29,21 @@ class DataParallel(Layer):
         self._layers = layers
         self.group = group
         self.find_unused_parameters = find_unused_parameters
+        self._reducer = None
+        if get_world_size() > 1:
+            # bucketed fused allreduce from backward hooks
+            # (imperative/reducer.cc parity; see distributed/reducer.py).
+            # Re-wrapping the same module must not stack reducers: detach
+            # any reducer a previous DataParallel attached to these params.
+            old = getattr(layers, "_pt_dp_reducer", None)
+            if old is not None:
+                old.detach()
+            from .reducer import Reducer
+            self._reducer = Reducer(
+                list(layers.parameters()),
+                comm_buffer_size=comm_buffer_size,
+                last_comm_buffer_size=last_comm_buffer_size, group=group)
+            layers._pt_dp_reducer = self._reducer
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -37,8 +52,27 @@ class DataParallel(Layer):
         # SPMD all-reduce-mean happens in the grad sync; parity no-op
         return loss
 
+    def no_sync(self):
+        """Context manager pausing grad sync (gradient accumulation across
+        micro-batches, reference DataParallel.no_sync)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            if self._reducer is not None:
+                self._reducer.pause()
+            try:
+                yield
+            finally:
+                if self._reducer is not None:
+                    self._reducer.resume()
+        return guard()
+
     def apply_collective_grads(self):
         if get_world_size() <= 1:
+            return
+        if self._reducer is not None:
+            self._reducer.finalize()
             return
         for p in self._layers.parameters():
             if p.grad is not None:
