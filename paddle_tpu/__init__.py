@@ -61,6 +61,9 @@ from . import fft  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
+# paddle.DataParallel is a top-level name in the reference
+# (fluid/dygraph/parallel.py re-export)
+from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import slim  # noqa: F401,E402

@@ -107,6 +107,13 @@ def _reachable_nodes(root_nodes):
 # case outer gradient flow through the compiled program is skipped.
 backward_run_counter = [0]
 
+# Fired after a leaf-accumulating backward completes (the seam the reference
+# uses for Reducer::FinalizeBackward — flush incomplete DP buckets, reconcile
+# late grad contributions). Callbacks take no args; DataParallel's Reducer
+# registers here so the standard backward/step/clear_grad loop stays in sync
+# without an explicit apply_collective_grads() call.
+post_backward_callbacks = []
+
 
 def backward(tensors, grad_tensors=None, retain_graph=False,
              accumulate_leaves=True):
@@ -198,6 +205,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
                 t._accumulate_grad(g)
         if not retain_graph:
             node.release()
+
+    if accumulate_leaves:
+        for cb in list(post_backward_callbacks):
+            cb()
 
 
 def grad_for_tensors(outputs, inputs, grad_outputs=None, retain_graph=False,

@@ -92,6 +92,10 @@ def load_hybrid_checkpoint(path, model, optimizer=None):
     for k, t in sd.items():
         arr = saved[k]
         arr = arr._val if isinstance(arr, Tensor) else jnp.asarray(arr)
+        if tuple(arr.shape) != tuple(t._val.shape):
+            raise ValueError(
+                f"checkpoint param '{k}' has shape {tuple(arr.shape)}, "
+                f"model expects {tuple(t._val.shape)}")
         t._value = arr.astype(t._val.dtype) if arr.dtype != t._val.dtype \
             else arr
     reshard_model(model)

@@ -196,7 +196,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         # eager: every process holds src's list (single-controller) — take
         # this rank's slice (c_scatter_op parity); in-group rank for
         # subgroups
-        rank = g.rank if g.ranks is not None else jax.process_index()
+        rank = g.rank
         if rank < 0 or rank >= len(tensor_list):
             raise ValueError(
                 f"scatter got {len(tensor_list)} tensors for rank {rank}")

@@ -57,9 +57,27 @@ class Reducer:
                 self._bucket_of[id(p)] = b
         self._extras = {}   # id(param) -> local delta after its flush
         self._extra_params = {}
+        self._dirty = False  # any grad activity since the last finalize
         self._hooks = [p.register_hook(self._make_hook(p)) for p in params]
-        from ..core.autograd import backward_run_counter
-        self._seen_backward = backward_run_counter[0]
+        from ..core import autograd as _ag
+        self._seen_backward = _ag.backward_run_counter[0]
+        # finalize at every backward boundary (Reducer::FinalizeBackward
+        # parity) so the standard backward/step/clear_grad loop reconciles
+        # incomplete buckets and late deltas without apply_collective_grads.
+        # Registered through a weakref so the global list never pins a
+        # dropped model; a dead callback unregisters itself.
+        import weakref
+        ref = weakref.ref(self)
+
+        def _cb():
+            r = ref()
+            if r is None:
+                _ag.post_backward_callbacks.remove(_cb)
+            else:
+                r.finalize()
+
+        self._pb_cb = _cb
+        _ag.post_backward_callbacks.append(_cb)
 
     def detach(self):
         """Remove all grad hooks (re-wrapping a model must not stack
@@ -67,6 +85,9 @@ class Reducer:
         for h in self._hooks:
             h.remove()
         self._hooks = []
+        from ..core import autograd as _ag
+        if self._pb_cb in _ag.post_backward_callbacks:
+            _ag.post_backward_callbacks.remove(self._pb_cb)
 
     def _maybe_new_backward(self):
         """Auto-reset bucket state when a NEW backward pass starts, so the
@@ -102,6 +123,7 @@ class Reducer:
             if self._paused:
                 return None
             self._maybe_new_backward()
+            self._dirty = True
             b = self._bucket_of[id(p)]
             if b.flushed:
                 # late accumulation after the fused reduce: remember the
@@ -168,14 +190,23 @@ class Reducer:
         return t._val.astype(orig)
 
     def finalize(self):
-        """Step boundary: flush incomplete buckets (unused-param case) and
-        reconcile post-flush local deltas. Then reset for the next step."""
+        """Backward/step boundary: flush incomplete buckets (unused-param
+        case) and reconcile post-flush local deltas, then reset. Idempotent:
+        runs only when grad activity happened since the last finalize, so the
+        auto post-backward call and an explicit apply_collective_grads()
+        don't double-reduce."""
+        if self._paused or not self._dirty:
+            return
+        from ..core.selected_rows import SelectedRows
         for b in self.buckets:
             if not b.flushed and b.ready:
                 # some params never produced grads (unused); reduce the ones
                 # that did, per-param (reference find_unused_parameters)
                 for p in b.params:
                     if p.grad is not None:
+                        if isinstance(p.grad, SelectedRows):
+                            p.grad = Tensor(p.grad.to_dense(),
+                                            stop_gradient=True)
                         p.grad._value = self._reduce_value(p.grad._val)
                 b.flushed = True
         for pid, delta in self._extras.items():
@@ -191,6 +222,7 @@ class Reducer:
             b.flushed = False
         self._extras.clear()
         self._extra_params.clear()
+        self._dirty = False
 
     def pause(self):
         self._paused = True

@@ -527,6 +527,37 @@ class TestBucketedReducer:
                                    rtol=1e-5)
         assert len(calls) >= 2  # bucket flush + extras reconciliation
 
+    def test_standard_loop_reconciles_without_explicit_finalize(
+            self, monkeypatch):
+        """backward alone (no apply_collective_grads) must reconcile late
+        deltas and unused-param buckets via the post-backward callback."""
+        from paddle_tpu.distributed.reducer import Reducer
+        calls = self._fake_allreduce(monkeypatch)
+        # late-delta case: param consumed twice
+        w = paddle.to_tensor(np.ones((4, 4), "f4"))
+        w.stop_gradient = False
+        x1 = paddle.to_tensor(np.full((2, 4), 2.0, "f4"))
+        x2 = paddle.to_tensor(np.full((3, 4), 5.0, "f4"))
+        red = Reducer([w])
+        (paddle.matmul(x1, w).sum() + paddle.matmul(x2, w).sum()).backward()
+        expected = 3.0 * (np.full((4, 4), 2.0 * 2) + np.full((4, 4), 5.0 * 3))
+        np.testing.assert_allclose(np.asarray(w.grad._val), expected.T,
+                                   rtol=1e-5)
+        # unused-param case: only one param of the bucket gets a grad
+        u = paddle.to_tensor(np.ones((4, 4), "f4"))
+        u.stop_gradient = False
+        v = paddle.to_tensor(np.ones((4, 4), "f4"))
+        v.stop_gradient = False
+        red.detach()
+        red2 = Reducer([u, v])
+        n0 = len(calls)
+        paddle.matmul(x1, u).sum().backward()
+        assert len(calls) > n0, "incomplete bucket never reduced"
+        np.testing.assert_allclose(np.asarray(u.grad._val),
+                                   3.0 * np.full((4, 4), 4.0), rtol=1e-5)
+        assert v.grad is None
+        red2.detach()
+
     def test_auto_reset_across_backwards(self, monkeypatch):
         """Standard loop (no explicit finalize) must keep reducing every
         step — bucket state auto-resets when a new backward starts."""

@@ -1,0 +1,291 @@
+"""Detection op family vs numpy oracles (reference test pattern:
+python/paddle/fluid/tests/unittests/test_deformable_conv_op.py,
+test_roi_align_op.py, test_roi_pool_op.py, test_psroi_pool_op.py,
+test_yolo_box_op.py, test_yolov3_loss_op.py — op semantics defined by
+independent numpy implementations, SURVEY §4.1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+# ---------- numpy oracles ----------
+
+def np_bilinear(feat, y, x):
+    C, H, W = feat.shape
+    if y < -1 or y > H or x < -1 or x > W:
+        return np.zeros(C, feat.dtype)
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    out = np.zeros(C, np.float64)
+    for iy, wy in ((y0, 1 - (y - y0)), (y0 + 1, y - y0)):
+        for ix, wx in ((x0, 1 - (x - x0)), (x0 + 1, x - x0)):
+            if 0 <= iy < H and 0 <= ix < W:
+                out += feat[:, iy, ix] * wy * wx
+    return out
+
+
+def np_deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                     dilation=1, dg=1, groups=1, mask=None):
+    N, Cin, H, W = x.shape
+    Cout, _, kh, kw = weight.shape
+    Hout = (H + 2 * padding - (dilation * (kh - 1) + 1)) // stride + 1
+    Wout = (W + 2 * padding - (dilation * (kw - 1) + 1)) // stride + 1
+    K = kh * kw
+    out = np.zeros((N, Cout, Hout, Wout))
+    cpg = Cin // groups
+    opg = Cout // groups
+    cpd = Cin // dg
+    for n in range(N):
+        off = offset[n].reshape(dg, K, 2, Hout, Wout)
+        mk = (mask[n].reshape(dg, K, Hout, Wout) if mask is not None
+              else np.ones((dg, K, Hout, Wout)))
+        cols = np.zeros((Cin, K, Hout, Wout))
+        for d in range(dg):
+            for k in range(K):
+                ky, kx = divmod(k, kw)
+                for i in range(Hout):
+                    for j in range(Wout):
+                        py = i * stride - padding + ky * dilation + off[d, k, 0, i, j]
+                        px = j * stride - padding + kx * dilation + off[d, k, 1, i, j]
+                        cols[d * cpd:(d + 1) * cpd, k, i, j] = np_bilinear(
+                            x[n, d * cpd:(d + 1) * cpd], py, px) * mk[d, k, i, j]
+        for g in range(groups):
+            wg = weight[g * opg:(g + 1) * opg].reshape(opg, cpg * K)
+            cg = cols[g * cpg:(g + 1) * cpg].reshape(cpg * K, Hout * Wout)
+            out[n, g * opg:(g + 1) * opg] = (wg @ cg).reshape(opg, Hout, Wout)
+    if bias is not None:
+        out += bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def np_roi_align(x, boxes, box_batch, output_size, spatial_scale, sr, aligned):
+    ph, pw = output_size
+    R = boxes.shape[0]
+    C = x.shape[1]
+    out = np.zeros((R, C, ph, pw))
+    for r in range(R):
+        feat = x[box_batch[r]]
+        off = 0.5 if aligned else 0.0
+        x1, y1, x2, y2 = boxes[r] * spatial_scale - off
+        w = x2 - x1
+        h = y2 - y1
+        if not aligned:
+            w = max(w, 1.0)
+            h = max(h, 1.0)
+        bh, bw = h / ph, w / pw
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(C)
+                for si in range(sr):
+                    for sj in range(sr):
+                        py = y1 + bh * (i + (si + 0.5) / sr)
+                        px = x1 + bw * (j + (sj + 0.5) / sr)
+                        acc += np_bilinear(feat, py, px)
+                out[r, :, i, j] = acc / (sr * sr)
+    return out
+
+
+def np_roi_pool(x, boxes, box_batch, output_size, spatial_scale):
+    ph, pw = output_size
+    R = boxes.shape[0]
+    N, C, H, W = x.shape
+    out = np.zeros((R, C, ph, pw))
+    for r in range(R):
+        feat = x[box_batch[r]]
+        x1 = int(round(boxes[r, 0] * spatial_scale))
+        y1 = int(round(boxes[r, 1] * spatial_scale))
+        x2 = int(round(boxes[r, 2] * spatial_scale))
+        y2 = int(round(boxes[r, 3] * spatial_scale))
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for i in range(ph):
+            hs = min(max(int(np.floor(i * rh / ph)) + y1, 0), H)
+            he = min(max(int(np.ceil((i + 1) * rh / ph)) + y1, 0), H)
+            for j in range(pw):
+                ws = min(max(int(np.floor(j * rw / pw)) + x1, 0), W)
+                we = min(max(int(np.ceil((j + 1) * rw / pw)) + x1, 0), W)
+                if he > hs and we > ws:
+                    out[r, :, i, j] = feat[:, hs:he, ws:we].max(axis=(1, 2))
+    return out
+
+
+def np_psroi_pool(x, boxes, box_batch, output_size, spatial_scale):
+    ph, pw = output_size
+    R = boxes.shape[0]
+    N, C, H, W = x.shape
+    oc = C // (ph * pw)
+    out = np.zeros((R, oc, ph, pw))
+    for r in range(R):
+        feat = x[box_batch[r]].reshape(oc, ph, pw, H, W)
+        x1 = np.round(boxes[r, 0]) * spatial_scale
+        y1 = np.round(boxes[r, 1]) * spatial_scale
+        x2 = (np.round(boxes[r, 2]) + 1.0) * spatial_scale
+        y2 = (np.round(boxes[r, 3]) + 1.0) * spatial_scale
+        rh = max(y2 - y1, 0.1)
+        rw = max(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        for i in range(ph):
+            hs = min(max(int(np.floor(y1 + i * bh)), 0), H)
+            he = min(max(int(np.ceil(y1 + (i + 1) * bh)), 0), H)
+            for j in range(pw):
+                ws = min(max(int(np.floor(x1 + j * bw)), 0), W)
+                we = min(max(int(np.ceil(x1 + (j + 1) * bw)), 0), W)
+                area = (he - hs) * (we - ws)
+                if area > 0:
+                    out[r, :, i, j] = feat[:, i, j, hs:he, ws:we].sum(
+                        axis=(1, 2)) / area
+    return out
+
+
+# ---------- tests ----------
+
+class TestDeformConv2D:
+    def test_zero_offset_equals_conv(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 4, 6, 6)).astype('float32')
+        w = rng.standard_normal((6, 4, 3, 3)).astype('float32')
+        off = np.zeros((2, 18, 6, 6), 'float32')
+        got = ops.deform_conv2d(_t(x), _t(off), _t(w), padding=1).numpy()
+        import paddle_tpu.nn.functional as F
+        ref = F.conv2d(_t(x), _t(w), padding=1).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    @pytest.mark.parametrize("dg,groups,mask", [(1, 1, False), (2, 2, True)])
+    def test_vs_numpy(self, dg, groups, mask):
+        rng = np.random.default_rng(1)
+        N, Cin, H, W = 2, 4, 5, 5
+        Cout, kh = 4, 3
+        x = rng.standard_normal((N, Cin, H, W)).astype('float32')
+        w = rng.standard_normal((Cout, Cin // groups, kh, kh)).astype('float32')
+        b = rng.standard_normal(Cout).astype('float32')
+        off = (rng.standard_normal((N, 2 * dg * 9, H, W)) * 0.5).astype('float32')
+        mk = rng.uniform(0, 1, (N, dg * 9, H, W)).astype('float32') if mask else None
+        got = ops.deform_conv2d(
+            _t(x), _t(off), _t(w), _t(b), stride=1, padding=1,
+            deformable_groups=dg, groups=groups,
+            mask=_t(mk) if mask else None).numpy()
+        ref = np_deform_conv2d(x, off, w, b, 1, 1, 1, dg, groups, mk)
+        np.testing.assert_allclose(got, ref, atol=1e-3)
+
+    def test_gradients_flow(self):
+        rng = np.random.default_rng(2)
+        layer = ops.DeformConv2D(3, 4, 3, padding=1)
+        x = _t(rng.standard_normal((1, 3, 4, 4)).astype('float32'))
+        off = _t((rng.standard_normal((1, 18, 4, 4)) * 0.3).astype('float32'))
+        off.stop_gradient = False
+        y = layer(x, off)
+        y.sum().backward()
+        assert layer.weight.grad is not None
+        assert np.isfinite(layer.weight.grad.numpy()).all()
+        assert off.grad is not None and np.abs(off.grad.numpy()).sum() > 0
+
+
+class TestRoIOps:
+    def _case(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((2, 8, 10, 10)).astype('float32')
+        boxes = np.array([[1.0, 1.0, 6.0, 7.0],
+                          [0.0, 2.0, 8.0, 9.5],
+                          [2.5, 0.5, 9.0, 6.0]], 'float32')
+        boxes_num = np.array([2, 1], 'int32')
+        batch = np.array([0, 0, 1])
+        return x, boxes, boxes_num, batch
+
+    @pytest.mark.parametrize("aligned", [True, False])
+    def test_roi_align(self, aligned):
+        x, boxes, bn, batch = self._case()
+        got = ops.roi_align(_t(x), _t(boxes), _t(bn), (3, 3), 0.5,
+                            sampling_ratio=2, aligned=aligned).numpy()
+        ref = np_roi_align(x, boxes, batch, (3, 3), 0.5, 2, aligned)
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_roi_pool(self):
+        x, boxes, bn, batch = self._case()
+        got = ops.roi_pool(_t(x), _t(boxes), _t(bn), (3, 3), 0.5).numpy()
+        ref = np_roi_pool(x, boxes, batch, (3, 3), 0.5)
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_psroi_pool(self):
+        x, boxes, bn, batch = self._case()
+        x = x[:, :2 * 3 * 3]  # C = oc*ph*pw = 2*9
+        x = np.ascontiguousarray(
+            np.repeat(x, 3, axis=1)[:, :18])
+        got = ops.psroi_pool(_t(x), _t(boxes), _t(bn), (3, 3), 0.5).numpy()
+        ref = np_psroi_pool(x, boxes, batch, (3, 3), 0.5)
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_layers_and_grad(self):
+        x, boxes, bn, _ = self._case()
+        xt = _t(x)
+        xt.stop_gradient = False
+        y = ops.RoIAlign((2, 2), 1.0)(xt, _t(boxes), _t(bn))
+        y.sum().backward()
+        assert xt.grad is not None and np.abs(xt.grad.numpy()).sum() > 0
+
+
+class TestYolo:
+    def test_yolo_box_shapes_and_decode(self):
+        rng = np.random.default_rng(4)
+        N, H, W, cls = 2, 4, 4, 3
+        anchors = [10, 13, 16, 30]
+        na = 2
+        x = rng.standard_normal((N, na * (5 + cls), H, W)).astype('float32')
+        img = np.array([[128, 128], [96, 64]], 'int32')
+        boxes, scores = ops.yolo_box(_t(x), _t(img), anchors, cls,
+                                     conf_thresh=0.0, downsample_ratio=32)
+        boxes, scores = boxes.numpy(), scores.numpy()
+        assert boxes.shape == (N, H * W * na, 4)
+        assert scores.shape == (N, H * W * na, cls)
+        # decode oracle for one cell
+        p = x.reshape(N, na, 5 + cls, H, W)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        n, a, i, j = 0, 1, 2, 3
+        cx = (sig(p[n, a, 0, i, j]) + j) / W * img[n, 1]
+        bw = np.exp(p[n, a, 2, i, j]) * anchors[2] / (32 * W) * img[n, 1]
+        x1 = np.clip(cx - bw / 2, 0, img[n, 1] - 1)
+        flat = (i * W + j) * na + a
+        np.testing.assert_allclose(boxes[n, flat, 0], x1, rtol=1e-4)
+
+    def test_yolo_loss_runs_and_grads(self):
+        rng = np.random.default_rng(5)
+        N, H, W, cls = 2, 4, 4, 2
+        anchors = [10, 13, 16, 30, 33, 23]
+        mask = [0, 1, 2]
+        x = _t(rng.standard_normal((N, 3 * (5 + cls), H, W)).astype('float32'))
+        x.stop_gradient = False
+        gtb = np.zeros((N, 4, 4), 'float32')
+        gtb[:, 0] = [0.3, 0.4, 0.2, 0.3]
+        gtb[:, 1] = [0.7, 0.6, 0.1, 0.1]
+        gtl = np.zeros((N, 4), 'int64')
+        gtl[:, 1] = 1
+        loss = ops.yolo_loss(x, _t(gtb), _t(gtl), anchors, mask, cls,
+                             ignore_thresh=0.5, downsample_ratio=32)
+        assert loss.shape == [N]
+        loss.sum().backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_yolo_loss_perfect_pred_low_xywh_loss(self):
+        # a prediction matching the gt exactly should have ~zero wh loss
+        N, H, W, cls = 1, 2, 2, 1
+        anchors = [16, 16]
+        mask = [0]
+        gtb = np.zeros((N, 1, 4), 'float32')
+        gtb[0, 0] = [0.25, 0.25, 0.25, 0.25]  # center cell(0,0), 16px@64
+        gtl = np.zeros((N, 1), 'int64')
+        x = np.zeros((N, 5 + cls, H, W), 'float32')
+        x[0, 4] = -10.0  # no obj elsewhere
+        x[0, 0, 0, 0] = 0.0  # sigmoid=0.5 -> cx=0.25 ✓
+        x[0, 1, 0, 0] = 0.0
+        x[0, 2, 0, 0] = 0.0  # exp(0)*16/64=0.25 ✓
+        x[0, 3, 0, 0] = 0.0
+        x[0, 4, 0, 0] = 10.0
+        x[0, 5, 0, 0] = 10.0
+        loss = ops.yolo_loss(_t(x), _t(gtb), _t(gtl), anchors, mask, cls,
+                             ignore_thresh=0.7, downsample_ratio=32).numpy()
+        assert loss[0] < 3.0  # xy BCE at exact match is ln2-scale, wh ~0
