@@ -180,9 +180,14 @@ def try_load() -> ctypes.CDLL | None:
 
 
 def check(rc, lib=None):
-    """Raise RuntimeError from native thread-local error state on failure."""
+    """Raise the typed enforce exception from native thread-local error state
+    (csrc ErrorCode -> framework.errors taxonomy, error_codes.proto parity)."""
     if rc is None or (isinstance(rc, int) and rc < 0):
         lib = lib or _lib
-        msg = lib.pt_last_error().decode() if lib is not None else "native error"
-        raise RuntimeError(f"paddle_tpu native: {msg}")
+        from ..framework.errors import raise_from_code
+        if lib is None:
+            raise_from_code(0, "paddle_tpu native: native error")
+        msg = lib.pt_last_error().decode()
+        code = int(lib.pt_last_error_code())
+        raise_from_code(code, f"paddle_tpu native: {msg}")
     return rc

@@ -247,7 +247,8 @@ class Tensor:
             value = value._val
         value = jnp.asarray(value, dtype=self._val.dtype)
         if tuple(value.shape) != tuple(self._val.shape):
-            raise ValueError(
+            from ..framework.errors import InvalidArgumentError
+            raise InvalidArgumentError(
                 f"set_value shape mismatch: {value.shape} vs {self._val.shape}")
         self._value = value
 

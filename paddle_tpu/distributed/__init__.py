@@ -23,6 +23,7 @@ from .env import (  # noqa: F401
 from .mesh import get_mesh, global_mesh, set_mesh  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     ProcessMesh, dtensor_from_fn, reshard, shard_op, shard_tensor,

@@ -1,0 +1,135 @@
+"""Dataset ingestion for in-process trainers (reference:
+python/paddle/distributed/fleet/dataset/dataset.py over framework/data_set.cc
++ data_feed.cc MultiSlotDataFeed).
+
+TPU-native reinterpretation: the reference's dataset is a C++ multi-threaded
+file reader feeding per-worker channels of slot records. Here a dataset is a
+host-side batch producer: samples live in memory (InMemoryDataset) or stream
+from generators (QueueDataset), are sharded round-robin across workers, and
+are stacked into name->numpy feed dicts — the XLA input boundary. File
+parsing (the reference's protobuf slot pipelines) is replaced by arbitrary
+python readers, which is the idiomatic host-ingest path on TPU VMs.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_vars = []
+        self._drop_last = True
+
+    # -- reference configuration surface (dataset.py set_* family) --
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread_num = max(1, int(thread_num))
+
+    def set_use_var(self, var_list):
+        """Feed targets, in sample-tuple order (MultiSlot slots parity)."""
+        self._use_vars = list(var_list)
+
+    def _var_names(self):
+        names = []
+        for v in self._use_vars:
+            names.append(v if isinstance(v, str) else v.name)
+        return names
+
+    def _samples(self):
+        raise NotImplementedError
+
+    def batches(self, worker_id=0, num_workers=1):
+        """Yield name->np.ndarray feed dicts for this worker's shard.
+        Batches (not samples) are sharded round-robin, matching the
+        reference's per-worker channel split."""
+        names = self._var_names()
+        if not names:
+            raise ValueError("dataset.set_use_var(...) was not called")
+        buf = []
+        bidx = 0
+        for sample in self._samples():
+            if not isinstance(sample, (tuple, list)):
+                sample = (sample,)
+            buf.append(sample)
+            if len(buf) == self._batch_size:
+                if bidx % num_workers == worker_id:
+                    yield self._stack(names, buf)
+                bidx += 1
+                buf = []
+        if buf and not self._drop_last and bidx % num_workers == worker_id:
+            yield self._stack(names, buf)
+
+    @staticmethod
+    def _stack(names, buf):
+        cols = list(zip(*buf))
+        return {n: np.stack([np.asarray(v) for v in col])
+                for n, col in zip(names, cols)}
+
+
+class InMemoryDataset(DatasetBase):
+    """Samples held in host memory; load via a reader callable or an explicit
+    list (reference InMemoryDataset.load_into_memory over file channels)."""
+
+    def __init__(self):
+        super().__init__()
+        self._data = []
+        self._lock = threading.Lock()
+
+    def set_sample_list(self, samples):
+        self._data = list(samples)
+
+    def load_into_memory(self, reader=None):
+        """reader: callable returning an iterable of sample tuples (the
+        DataGenerator seam). No-op when samples were set directly."""
+        if reader is not None:
+            with self._lock:
+                self._data = list(reader())
+
+    def local_shuffle(self, seed=None):
+        rng = np.random.RandomState(seed)
+        with self._lock:
+            idx = rng.permutation(len(self._data))
+            self._data = [self._data[i] for i in idx]
+
+    def global_shuffle(self, fleet=None, seed=None):
+        """Single-controller SPMD: every process holds the full sample list,
+        so a seeded local shuffle IS globally consistent (the reference
+        shuffles across PS shards; there is no sharded store here)."""
+        self.local_shuffle(seed if seed is not None else 12343)
+
+    def release_memory(self):
+        self._data = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._data)
+
+    def _samples(self):
+        return iter(self._data)
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: samples come from generator factories, one pass,
+    never materialized (reference QueueDataset single-pass channel)."""
+
+    def __init__(self):
+        super().__init__()
+        self._readers = []
+
+    def set_filelist(self, readers):
+        """The reference takes data files; here each entry is a callable
+        returning an iterable of samples (file parsing is user-side)."""
+        self._readers = list(readers)
+
+    def _samples(self):
+        for r in self._readers:
+            it = r() if callable(r) else r
+            for s in it:
+                yield s

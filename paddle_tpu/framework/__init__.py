@@ -1,1 +1,1 @@
-from . import flags, io_utils  # noqa: F401
+from . import flags, io_utils, errors  # noqa: F401

@@ -1,0 +1,135 @@
+"""In-process thread-per-worker trainers (reference: framework/trainer.h:56
+MultiTrainer, device_worker.h:150 HogwildWorker, trainer_factory.cc).
+
+Reference shape: Executor.train_from_dataset builds a TrainerDesc, a
+MultiTrainer spawns one HogwildWorker thread per dataset channel, and every
+worker executes the program op-by-op against the SHARED scope — lock-free
+("hogwild") parameter updates, tolerated by design.
+
+TPU-native reinterpretation: a worker's "program" is the static Program's
+cached compiled step (one XLA executable), so a worker iteration is one
+device launch, not an op interpreter loop. The shared-scope hogwild semantics
+survive: state reads/writes happen per-variable on the host between launches
+(GIL-atomic), so concurrent workers interleave whole-step updates. Worker
+threads overlap their hosts-side batch prep with each other's device steps —
+the same pipelining the reference gets from DataFeed channels. Compilation is
+warmed on the first batch single-threaded (XLA trace is not re-entrant);
+steady state runs fully threaded.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["DeviceWorker", "HogwildWorker", "MultiTrainer", "TrainerFactory"]
+
+
+class DeviceWorker:
+    """One worker thread's run loop over its dataset shard."""
+
+    def __init__(self, worker_id, num_workers):
+        self.worker_id = worker_id
+        self.num_workers = num_workers
+        self.steps = 0
+        self.fetch_log = []  # (step, {name: value}) when debug
+
+    def train_step(self, feed):
+        raise NotImplementedError
+
+    def run(self, dataset, debug=False, print_period=100, fetch_info=None):
+        for feed in dataset.batches(self.worker_id, self.num_workers):
+            out = self.train_step(feed)
+            self.steps += 1
+            if debug and self.steps % print_period == 0:
+                self.fetch_log.append((self.steps, out))
+
+
+class HogwildWorker(DeviceWorker):
+    """device_worker.h HogwildWorker parity: executes the program against the
+    shared scope with no cross-worker locking."""
+
+    def __init__(self, worker_id, num_workers, executor, program, fetch_list):
+        super().__init__(worker_id, num_workers)
+        self._exe = executor
+        self._program = program
+        self._fetch = fetch_list or []
+
+    def train_step(self, feed):
+        feed = {k: v for k, v in feed.items() if k in self._program.feed_vars}
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch, return_numpy=True)
+        return {getattr(f, "name", str(f)): o
+                for f, o in zip(self._fetch, outs)}
+
+
+class MultiTrainer:
+    """trainer.h MultiTrainer parity: owns the worker fleet for one
+    train_from_dataset call."""
+
+    def __init__(self, workers):
+        self.workers = workers
+
+    def run(self, dataset, debug=False, print_period=100, fetch_info=None):
+        from ..jit.to_static import pause_donation
+        with pause_donation():
+            self._run_inner(dataset, debug, print_period, fetch_info)
+
+    def _run_inner(self, dataset, debug, print_period, fetch_info):
+        # warm the full discovery+compile sequence (3 calls: two eager
+        # discovery passes, then the XLA build) before going threaded, so
+        # steady-state workers hit only the compiled fast path. Donation is
+        # paused for the whole call: concurrent launches over shared state
+        # must not donate each other's input buffers.
+        warm = None
+        for feed in dataset.batches(0, 1):
+            warm = feed
+            break
+        if warm is None:
+            return
+        for _ in range(3):
+            self.workers[0].train_step(warm)
+
+        errors = []
+
+        def loop(w):
+            try:
+                w.run(dataset, debug=debug, print_period=print_period,
+                      fetch_info=fetch_info)
+            except BaseException as e:  # surface the real error from join
+                errors.append((w.worker_id, e))
+
+        threads = [threading.Thread(target=loop, args=(w,), daemon=True)
+                   for w in self.workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            wid, err = errors[0]
+            raise RuntimeError(f"trainer worker {wid} failed: {err!r}") from err
+
+    @property
+    def total_steps(self):
+        return sum(w.steps for w in self.workers)
+
+    @property
+    def fetch_logs(self):
+        logs = []
+        for w in self.workers:
+            logs.extend(w.fetch_log)
+        return logs
+
+
+class TrainerFactory:
+    """trainer_factory.cc parity: build the trainer for a (program, dataset)
+    pair. Only the Hogwild/MultiTrainer pair exists — the reference's
+    SectionWorker (pipeline) maps to fleet's 1F1B engine, and PS workers to
+    the_one_ps runtime."""
+
+    @staticmethod
+    def create(executor, program, dataset, thread=0, fetch_list=None):
+        n = thread or dataset._thread_num or 1
+        workers = [HogwildWorker(i, n, executor, program, fetch_list)
+                   for i in range(n)]
+        return MultiTrainer(workers)

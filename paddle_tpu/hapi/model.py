@@ -40,6 +40,14 @@ class Model:
         self._metrics = _to_list(metrics)
         for m in self._metrics:
             assert isinstance(m, Metric)
+        # distributed fit (reference hapi/model.py:906: DynamicGraphAdapter
+        # wraps in DataParallel when nranks>1): multi-process runs get the
+        # bucketed-reducer DP wrapper; fit() then shards batches per rank
+        from ..distributed import env as _dist_env
+        from ..distributed.parallel import DataParallel
+        if _dist_env.get_world_size() > 1 and \
+                not isinstance(self.network, DataParallel):
+            self.network = DataParallel(self.network)
         return self
 
     # -- single-batch entry points (hapi parity) -------------------------------
@@ -97,9 +105,8 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
         from .callbacks import CallbackList, ProgBarLogger
-        loader = train_data if isinstance(train_data, DataLoader) else \
-            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
-                       drop_last=drop_last, num_workers=num_workers)
+        loader = self._make_loader(train_data, batch_size, shuffle, drop_last,
+                                   num_workers)
         cbs = CallbackList(_to_list(callbacks) or [ProgBarLogger(log_freq,
                                                                  verbose)])
         cbs.set_model(self)
@@ -150,8 +157,8 @@ class Model:
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):
         from .callbacks import CallbackList
-        loader = eval_data if isinstance(eval_data, DataLoader) else \
-            DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        loader = self._make_loader(eval_data, batch_size, False, False,
+                                   num_workers)
         cbs = CallbackList(_to_list(callbacks))
         cbs.set_model(self)
         cbs.on_eval_begin({})
@@ -182,8 +189,11 @@ class Model:
         loader = test_data if isinstance(test_data, DataLoader) else \
             DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
         import inspect
+        # introspect the USER network: a DataParallel wrapper's forward is
+        # (*inputs, **kwargs) and would truncate every input to zero
+        net = getattr(self.network, "_layers", self.network)
         try:
-            sig = inspect.signature(type(self.network).forward)
+            sig = inspect.signature(type(net).forward)
             max_ins = sum(1 for p in sig.parameters.values()
                           if p.kind in (p.POSITIONAL_ONLY,
                                         p.POSITIONAL_OR_KEYWORD)
@@ -225,6 +235,23 @@ class Model:
         return summary(self.network, input_size, dtypes=dtype)
 
     # -- helpers ----------------------------------------------------------------
+    @staticmethod
+    def _make_loader(data, batch_size, shuffle, drop_last, num_workers):
+        """Per-rank sharded loader in multi-process runs (reference fit()
+        builds a DistributedBatchSampler when _parallel_env.nranks > 1)."""
+        if isinstance(data, DataLoader):
+            return data
+        from ..distributed import env as _dist_env
+        if _dist_env.get_world_size() > 1:
+            from ..io import DistributedBatchSampler
+            sampler = DistributedBatchSampler(
+                data, batch_size=batch_size, shuffle=shuffle,
+                drop_last=drop_last)
+            return DataLoader(data, batch_sampler=sampler,
+                              num_workers=num_workers)
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
     def _metric_names(self):
         names = ["loss"]
         for m in self._metrics:

@@ -28,6 +28,7 @@ semantics as the reference's to_static for non-tensor conditions).
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -155,6 +156,76 @@ class _Program:
         self.internal_backward = False
 
 
+# Discovery/trace phases mutate global state (_TraceHooks, and shared model
+# variables temporarily hold tracers while jax traces the pure function), so
+# compiles from concurrent threads (framework/trainer.py hogwild workers)
+# must serialize — AND must not overlap compiled-path runs, which read the
+# same shared variables. Reader/compiler coordination: compiled fast-path
+# calls register as readers; a compile waits for in-flight readers to drain
+# and readers arriving while a compile is pending divert into the compile
+# lock. _compile_lock is an RLock so nested to_static calls inside a trace
+# re-enter on the same thread.
+_compile_lock = threading.RLock()
+_state_lock = threading.Lock()
+_state_cv = threading.Condition(_state_lock)
+_readers = [0]
+_compiling = [0]
+
+
+def _enter_fast_path():
+    """Register as a compiled-path reader; False if a compile is pending
+    (caller must take the slow path)."""
+    with _state_lock:
+        if _compiling[0]:
+            return False
+        _readers[0] += 1
+        return True
+
+
+def _exit_fast_path():
+    with _state_cv:
+        _readers[0] -= 1
+        if _readers[0] == 0:
+            _state_cv.notify_all()
+
+
+class _compile_guard:
+    """Hold the compile lock and wait out in-flight compiled runs."""
+
+    def __enter__(self):
+        _compile_lock.acquire()
+        with _state_cv:
+            _compiling[0] += 1
+            while _readers[0] > 0:
+                _state_cv.wait()
+        return self
+
+    def __exit__(self, *exc):
+        with _state_lock:
+            _compiling[0] -= 1
+        _compile_lock.release()
+        return False
+
+# Donating state buffers (FLAGS_donate_state_buffers) is unsafe when several
+# threads drive the SAME compiled program over shared state: each launch
+# donates the buffer every other in-flight launch still holds as input.
+# Hogwild trainers pause donation for their threaded phase.
+_donation_paused = [0]
+
+
+class pause_donation:
+    """Context manager: compiled programs run their non-donating executables
+    while active (framework/trainer.py multi-worker phase)."""
+
+    def __enter__(self):
+        _donation_paused[0] += 1
+        return self
+
+    def __exit__(self, *exc):
+        _donation_paused[0] -= 1
+        return False
+
+
 class StaticFunction:
     """Callable wrapper (program_translator.py:234 StaticFunction parity)."""
 
@@ -194,14 +265,23 @@ class StaticFunction:
             return self._fn(*args, **kwargs)
         key = (_sig_of(args), _sig_of(kwargs), autograd.is_grad_enabled())
         prog = self._programs.get(key)
-        # Two eager discovery calls: the first warms lazily-created state
-        # (optimizer accumulators, RNG splits); the second records the
-        # steady-state capture/mutation sets. Compile on the third call.
-        if prog is None or prog.stage < 2:
-            return self._discover(key, args, kwargs)
-        if prog.jitted is None:
-            self._build(prog, args, kwargs)
-        return self._run(prog, args, kwargs)
+        if prog is not None and prog.stage >= 2 and prog.jitted is not None:
+            if _enter_fast_path():
+                try:
+                    return self._run(prog, args, kwargs)
+                finally:
+                    _exit_fast_path()
+        with _compile_guard():
+            prog = self._programs.get(key)
+            # Two eager discovery calls: the first warms lazily-created
+            # state (optimizer accumulators, RNG splits); the second
+            # records the steady-state capture/mutation sets. Compile on
+            # the third call.
+            if prog is None or prog.stage < 2:
+                return self._discover(key, args, kwargs)
+            if prog.jitted is None:
+                self._build(prog, args, kwargs)
+            return self._run(prog, args, kwargs)
 
     # -- phase A ---------------------------------------------------------------
     def _discover(self, key, args, kwargs):
@@ -313,7 +393,8 @@ class StaticFunction:
                     diff_tensors.append(t)
 
         if not diff_tensors:
-            flat = prog.jitted_donate(mut_vals, ro_vals, arg_vals)
+            exec_fn = prog.jitted if _donation_paused[0] else prog.jitted_donate
+            flat = exec_fn(mut_vals, ro_vals, arg_vals)
             out_vals, new_state = flat[:n_outs], flat[n_outs:]
             for t, v in zip(prog.mutated, new_state):
                 t._val = v

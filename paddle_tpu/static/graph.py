@@ -800,5 +800,34 @@ class Executor:
             return [np.asarray(o._val) for o in outs]
         return list(outs)
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Dataset-driven in-process training (reference executor.py
+        train_from_dataset → MultiTrainer + HogwildWorker fleet, trainer.h:56).
+        Spawns `thread` workers sharing this program's compiled step; see
+        framework/trainer.py for the hogwild semantics. Returns the trainer
+        (total_steps / fetch_logs readable by the caller; the reference
+        returns None but exposes nothing — returning the trainer is strictly
+        more observable)."""
+        from ..framework.trainer import TrainerFactory
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        if program is None:
+            program = default_main_program()
+        trainer = TrainerFactory.create(self, program, dataset, thread=thread,
+                                        fetch_list=fetch_list)
+        trainer.run(dataset, debug=debug, print_period=print_period,
+                    fetch_info=fetch_info)
+        return trainer
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Same fleet of workers, inference program (no optimizer nodes —
+        the program simply has no update ops to replay)."""
+        return self.train_from_dataset(program, dataset, scope, thread, debug,
+                                       fetch_list, fetch_info, print_period)
+
     def close(self):
         pass

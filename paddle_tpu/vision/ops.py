@@ -125,7 +125,7 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=1,
             def per_group(f, yy, xx):
                 return _bilinear_gather(f, yy, xx)  # (C/dg, K, Hout, Wout)
             cols = jax.vmap(per_group)(fg, py, px)  # (dg, C/dg, K, Hout, Wout)
-            if m_i is not None:
+            if m_i is not None:  # v2 modulation only; v1 skips the multiply
                 cols = cols * m_i.reshape(dg, 1, K, Hout, Wout)
             # (Cin, K, L) -> grouped contraction with w (Cout, Cin/G, kh, kw)
             cols = cols.reshape(Cin, K, Hout * Wout)
@@ -135,8 +135,9 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=1,
                              preferred_element_type=jnp.float32)
             return out.reshape(Cout, Hout, Wout).astype(xv.dtype)
 
-        mm = m if m is not None else jnp.ones((N, dg * K, Hout, Wout), xv.dtype)
-        return jax.vmap(one)(xv, off, mm)
+        if m is None:
+            return jax.vmap(lambda f, o: one(f, o, None))(xv, off)
+        return jax.vmap(one)(xv, off, m)
 
     extra = (mask,) if mask is not None else ()
     out = apply(prim, x, offset, weight, *extra, name="deform_conv2d")
@@ -289,8 +290,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.005,
              downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0):
     """YOLOv3 head decode (reference vision/ops.py:252,
     operators/detection/yolo_box_op.*). x (N, na*(5+cls), H, W);
-    img_size (N, 2) as (h, w). Returns boxes (N, H*W*na, 4) xyxy in image
-    coords and scores (N, H*W*na, cls)."""
+    img_size (N, 2) as (h, w). Returns boxes (N, na*H*W, 4) xyxy in image
+    coords and scores (N, na*H*W, cls), anchor-major flat order
+    (a*H*W + i*W + j) matching the reference kernel's output layout."""
     anchors = np.asarray(anchors, dtype=np.float32).reshape(-1, 2)
     na = anchors.shape[0]
 
@@ -321,12 +323,10 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.005,
             y1 = jnp.clip(y1, 0, imh - 1)
             x2 = jnp.clip(x2, 0, imw - 1)
             y2 = jnp.clip(y2, 0, imh - 1)
-        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
-        boxes = jnp.transpose(boxes, (0, 2, 3, 1, 4)).reshape(N, -1, 4)
-        zero = (conf <= 0)[..., None]
-        boxes = jnp.where(jnp.transpose(zero, (0, 2, 3, 1, 4)
-                                        ).reshape(N, -1, 1), 0.0, boxes)
-        scores = jnp.transpose(probs, (0, 3, 4, 1, 2)).reshape(
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # (N,na,H,W,4)
+        boxes = boxes.reshape(N, -1, 4)               # anchor-major
+        boxes = jnp.where((conf <= 0).reshape(N, -1, 1), 0.0, boxes)
+        scores = jnp.transpose(probs, (0, 1, 3, 4, 2)).reshape(
             N, -1, class_num)
         return boxes, scores
 
@@ -408,10 +408,14 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                 valid[..., None]
         clsg = sc(valid, la_s, gj, gi, onehot)                    # (N,na,H,W,cls)
         # --- ignore mask: predicted boxes w/ IoU>thresh vs any gt ---
+        # decode with the same scale_x_y yolo_box uses so train and
+        # inference share one box parameterization
+        s_xy = float(scale_x_y)
+        b_xy = -0.5 * (s_xy - 1.0)
         gx_ = jnp.arange(W, dtype=xv.dtype)[None, None, None, :]
         gy_ = jnp.arange(H, dtype=xv.dtype)[None, None, :, None]
-        px = (jax.nn.sigmoid(p[:, :, 0]) + gx_) / W
-        py = (jax.nn.sigmoid(p[:, :, 1]) + gy_) / H
+        px = (jax.nn.sigmoid(p[:, :, 0]) * s_xy + b_xy + gx_) / W
+        py = (jax.nn.sigmoid(p[:, :, 1]) * s_xy + b_xy + gy_) / H
         pw_ = jnp.exp(jnp.clip(p[:, :, 2], -10, 10)) * haw[None, :, None, None] / in_w
         ph_ = jnp.exp(jnp.clip(p[:, :, 3], -10, 10)) * hah[None, :, None, None] / in_h
 
@@ -437,7 +441,11 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         best_iou = jax.vmap(iou_vs_gt)(px, py, pw_, ph_, gtb)   # (N,na,H,W)
         noobj = (1.0 - obj) * (best_iou <= ignore_thresh)
         # --- loss terms ---
-        lxy = (bce(p[:, :, 0], txg) + bce(p[:, :, 1], tyg)) * tsg * obj
+        # xy targets live in sigmoid space: decode is sigmoid(t)*s + bias,
+        # so the BCE label is the inverse (t_cell - bias)/s (identity at s=1)
+        txg_l = jnp.clip((txg - b_xy) / s_xy, 0.0, 1.0)
+        tyg_l = jnp.clip((tyg - b_xy) / s_xy, 0.0, 1.0)
+        lxy = (bce(p[:, :, 0], txg_l) + bce(p[:, :, 1], tyg_l)) * tsg * obj
         lwh = (jnp.abs(p[:, :, 2] - twg) + jnp.abs(p[:, :, 3] - thg)) * \
             tsg * obj
         lobj = bce(p[:, :, 4], obj) * (obj + noobj)

@@ -37,6 +37,39 @@ class TestHapi:
         model.save(str(tmp_path / "m"))
         model.load(str(tmp_path / "m"))
 
+    def test_distributed_prepare_wraps_and_shards(self, monkeypatch):
+        """hapi/model.py:906 parity: nranks>1 -> DataParallel wrap in
+        prepare() and per-rank DistributedBatchSampler in fit loaders."""
+        from paddle_tpu.distributed import env as dist_env
+        from paddle_tpu.distributed.parallel import DataParallel
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.io import DataLoader
+        monkeypatch.setattr(dist_env, "get_world_size", lambda: 2)
+        import paddle_tpu.distributed as dist
+        monkeypatch.setattr(dist, "get_world_size", lambda: 2)
+        net = nn.Linear(4, 3)
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                           parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        assert isinstance(model.network, DataParallel)
+        # save path must still see unprefixed parameter names
+        assert set(model.network.state_dict()) == set(net.state_dict())
+        # double prepare must not double-wrap
+        model.prepare(optimizer=model._optimizer, loss=model._loss)
+        assert not isinstance(model.network._layers, DataParallel)
+        ds = self._dataset(20)
+        loader = Model._make_loader(ds, batch_size=4, shuffle=False,
+                                    drop_last=False, num_workers=0)
+        from paddle_tpu.io import DistributedBatchSampler
+        assert isinstance(loader.batch_sampler, DistributedBatchSampler)
+        # rank 0 of 2 sees ceil(20/2)=10 samples -> 3 batches of <=4
+        assert len(loader.batch_sampler) == 3
+        # a prebuilt DataLoader passes through untouched
+        dl = DataLoader(ds, batch_size=4)
+        assert Model._make_loader(dl, 4, False, False, 0) is dl
+
     def test_early_stopping(self):
         from paddle_tpu.hapi import Model
         from paddle_tpu.hapi.callbacks import EarlyStopping

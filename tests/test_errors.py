@@ -1,0 +1,83 @@
+"""Typed error taxonomy (reference platform/error_codes.proto + errors.h):
+codes 0-12, reference type strings, builtin-exception compatibility, and the
+native C boundary rehydration path."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import errors
+
+
+class TestTaxonomy:
+    def test_codes_match_error_codes_proto(self):
+        expected = {
+            errors.EnforceNotMet: 0,
+            errors.InvalidArgumentError: 1,
+            errors.NotFoundError: 2,
+            errors.OutOfRangeError: 3,
+            errors.AlreadyExistsError: 4,
+            errors.ResourceExhaustedError: 5,
+            errors.PreconditionNotMetError: 6,
+            errors.PermissionDeniedError: 7,
+            errors.ExecutionTimeoutError: 8,
+            errors.UnimplementedError: 9,
+            errors.UnavailableError: 10,
+            errors.FatalError: 11,
+            errors.ExternalError: 12,
+        }
+        for cls, code in expected.items():
+            assert cls.code == code, cls
+
+    def test_builtin_compatibility(self):
+        # idiomatic `except ValueError` etc must keep catching typed errors
+        assert issubclass(errors.InvalidArgumentError, ValueError)
+        assert issubclass(errors.NotFoundError, FileNotFoundError)
+        assert issubclass(errors.OutOfRangeError, IndexError)
+        assert issubclass(errors.UnimplementedError, NotImplementedError)
+        assert issubclass(errors.ExecutionTimeoutError, TimeoutError)
+        assert issubclass(errors.ResourceExhaustedError, MemoryError)
+        for cls in (errors.InvalidArgumentError, errors.FatalError):
+            assert issubclass(cls, errors.EnforceNotMet)
+            assert issubclass(cls, RuntimeError)
+
+    def test_type_string_rendered(self):
+        e = errors.InvalidArgument("bad dim %d", 3)
+        assert "InvalidArgumentError" in str(e)
+        assert "bad dim 3" in str(e)
+        # NotFoundError must not eat the message into OSError.strerror
+        assert "no such thing" in str(errors.NotFound("no such thing"))
+
+    def test_raise_from_code(self):
+        with pytest.raises(errors.NotFoundError):
+            errors.raise_from_code(2, "gone")
+        with pytest.raises(errors.EnforceNotMet):
+            errors.raise_from_code(99, "unknown code falls back to base")
+
+    def test_factories_build_instances(self):
+        for name in ("InvalidArgument", "NotFound", "OutOfRange",
+                     "AlreadyExists", "ResourceExhausted", "PreconditionNotMet",
+                     "PermissionDenied", "ExecutionTimeout", "Unimplemented",
+                     "Unavailable", "Fatal", "External"):
+            e = getattr(errors, name)("msg")
+            assert isinstance(e, errors.EnforceNotMet)
+            assert errors.code_of(e) > 0
+
+
+class TestWiredSites:
+    def test_set_value_raises_invalid_argument(self):
+        t = paddle.to_tensor(np.zeros((2, 2), "f4"))
+        with pytest.raises(errors.InvalidArgumentError):
+            t.set_value(np.zeros((3, 3), "f4"))
+        with pytest.raises(ValueError):  # builtin contract preserved
+            t.set_value(np.zeros((3, 3), "f4"))
+
+    def test_native_boundary_rehydrates_typed_error(self):
+        from paddle_tpu.core import native
+        lib = native.try_load()
+        if lib is None:
+            pytest.skip("native library unavailable")
+        # unknown flag -> csrc kNotFound -> python NotFoundError
+        rc = lib.pt_flag_get(b"__no_such_flag__")
+        assert not rc  # NULL from the C boundary
+        with pytest.raises(errors.NotFoundError):
+            native.check(rc, lib)

@@ -1,0 +1,140 @@
+"""In-process Trainer/DeviceWorker fleet + fleet datasets (reference:
+framework/trainer.h MultiTrainer + device_worker.h HogwildWorker driven by
+Executor.train_from_dataset; datasets from fleet/dataset/dataset.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import InMemoryDataset, QueueDataset
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+class TestDatasets:
+    def test_inmemory_batching_and_shard(self):
+        ds = InMemoryDataset()
+        ds.set_batch_size(4)
+        ds.set_use_var(["x", "y"])
+        ds.set_sample_list([(np.full(3, i, "f4"), np.int64(i % 2))
+                            for i in range(20)])
+        all_batches = list(ds.batches(0, 1))
+        assert len(all_batches) == 5
+        assert all_batches[0]["x"].shape == (4, 3)
+        assert all_batches[0]["y"].shape == (4,)
+        # round-robin shard: two workers see disjoint batches covering all
+        b0 = list(ds.batches(0, 2))
+        b1 = list(ds.batches(1, 2))
+        assert len(b0) + len(b1) == 5
+        seen = sorted(float(b["x"][0, 0]) for b in b0 + b1)
+        assert seen == sorted(float(b["x"][0, 0]) for b in all_batches)
+
+    def test_local_shuffle_and_size(self):
+        ds = InMemoryDataset()
+        ds.set_use_var(["x"])
+        ds.set_sample_list([(np.float32(i),) for i in range(10)])
+        assert ds.get_memory_data_size() == 10
+        before = [float(s[0]) for s in ds._data]
+        ds.local_shuffle(seed=3)
+        after = [float(s[0]) for s in ds._data]
+        assert sorted(before) == sorted(after) and before != after
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_queue_dataset_streams_readers(self):
+        ds = QueueDataset()
+        ds.set_batch_size(2)
+        ds.set_use_var(["x"])
+        ds.set_filelist([
+            lambda: ((np.float32(i),) for i in range(4)),
+            lambda: ((np.float32(10 + i),) for i in range(4)),
+        ])
+        got = [b["x"].tolist() for b in ds.batches()]
+        assert got == [[0.0, 1.0], [2.0, 3.0], [10.0, 11.0], [12.0, 13.0]]
+
+
+class TestTrainFromDataset:
+    def _build_regression(self):
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            lin = paddle.nn.Linear(4, 1)
+            loss = F.mse_loss(lin(x), y)
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=lin.parameters())
+            opt.minimize(loss)
+        return main, startup, loss
+
+    def _dataset(self, n=64, batch=8, seed=0):
+        rng = np.random.RandomState(seed)
+        w = np.array([[1.0], [-2.0], [0.5], [3.0]], "f4")
+        xs = rng.randn(n, 4).astype("f4")
+        ys = xs @ w + 0.1
+        ds = InMemoryDataset()
+        ds.set_batch_size(batch)
+        ds.set_use_var(["x", "y"])
+        ds.set_sample_list([(xs[i], ys[i]) for i in range(n)])
+        return ds
+
+    def test_single_thread_trains(self, static_mode):
+        main, startup, loss = self._build_regression()
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        ds = self._dataset()
+        first = exe.run(main, feed=next(ds.batches(0, 1).__iter__()),
+                        fetch_list=[loss])[0]
+        for _ in range(6):
+            trainer = exe.train_from_dataset(main, ds, thread=1,
+                                             fetch_list=[loss])
+        last = exe.run(main, feed=next(ds.batches(0, 1).__iter__()),
+                       fetch_list=[loss])[0]
+        assert trainer.total_steps == 8  # warm-up replay not counted
+        assert float(last) < float(first) * 0.5
+
+    def test_hogwild_threads_train_and_cover_all_batches(self, static_mode):
+        main, startup, loss = self._build_regression()
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        ds = self._dataset(n=96, batch=8)
+        first = exe.run(main, feed=next(ds.batches(0, 1).__iter__()),
+                        fetch_list=[loss])[0]
+        for _ in range(6):
+            trainer = exe.train_from_dataset(main, ds, thread=4,
+                                             fetch_list=[loss])
+        # 12 batches spread over 4 hogwild workers (warm-up not counted)
+        assert trainer.total_steps == 12
+        assert sum(w.steps > 0 for w in trainer.workers) == 4
+        last = exe.run(main, feed=next(ds.batches(0, 1).__iter__()),
+                       fetch_list=[loss])[0]
+        assert float(last) < float(first) * 0.5
+
+    def test_debug_fetch_logs(self, static_mode):
+        main, startup, loss = self._build_regression()
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        ds = self._dataset(n=32, batch=4)
+        trainer = exe.train_from_dataset(main, ds, thread=2, debug=True,
+                                         print_period=2, fetch_list=[loss])
+        assert trainer.fetch_logs, "debug mode recorded no fetches"
+        step, vals = trainer.fetch_logs[0]
+        assert step % 2 == 0 and len(vals) == 1
+
+    def test_worker_error_surfaces(self, static_mode):
+        main, startup, loss = self._build_regression()
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        ds = self._dataset(n=16, batch=4)
+        bad = InMemoryDataset()
+        bad.set_batch_size(4)
+        bad.set_use_var(["x", "wrong_name"])
+        bad.set_sample_list([(np.zeros(4, "f4"), np.zeros(1, "f4"))
+                             for _ in range(16)])
+        with pytest.raises((RuntimeError, KeyError)):
+            exe.train_from_dataset(main, bad, thread=2, fetch_list=[loss])

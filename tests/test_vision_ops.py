@@ -248,7 +248,7 @@ class TestYolo:
         cx = (sig(p[n, a, 0, i, j]) + j) / W * img[n, 1]
         bw = np.exp(p[n, a, 2, i, j]) * anchors[2] / (32 * W) * img[n, 1]
         x1 = np.clip(cx - bw / 2, 0, img[n, 1] - 1)
-        flat = (i * W + j) * na + a
+        flat = a * H * W + i * W + j  # anchor-major (reference layout)
         np.testing.assert_allclose(boxes[n, flat, 0], x1, rtol=1e-4)
 
     def test_yolo_loss_runs_and_grads(self):
