@@ -22,6 +22,31 @@ if _os.environ.get("JAX_PLATFORMS") == "axon":
     _os.environ["JAX_PLATFORMS"] = "axon,cpu"
     _os.environ.setdefault("PADDLE_TPU_HOST_STAGING", "1")
 
+# Persistent XLA compilation cache (PADDLE_TPU_COMPILATION_CACHE=0 disables).
+# Eager dispatch compiles one executable per (op, shape) — cold-start cost is
+# dominated by those compiles (a ResNet-50 discovery pass is ~100s of CPU op
+# compiles, ~7s warm). Whole-program to_static/scan compiles are cached too.
+if _os.environ.get("PADDLE_TPU_COMPILATION_CACHE", "1") == "1":
+    import jax as _jax
+
+    # cache entries depend on ambient XLA flags (the axon relay site tunes
+    # CPU codegen); segregate by flavor so AOT code never loads under
+    # mismatched machine-feature flags
+    _flavor = "axon" if (
+        "axon" in (_os.environ.get("JAX_PLATFORMS") or "").split(",")
+        or "axon_site" in (_os.environ.get("PYTHONPATH") or "")
+    ) else "plain"
+    _cache_dir = _os.environ.get("JAX_COMPILATION_CACHE_DIR") or _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        ".jax_cache", _flavor)
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except (OSError, AttributeError):
+        pass
+
 from .core import autograd as _autograd_mod  # noqa: F401
 from .core.autograd import enable_grad, no_grad, set_grad_enabled  # noqa: F401
 from .core.device import (  # noqa: F401

@@ -85,6 +85,9 @@ def apply(prim, *args, name=None, **kwargs):
 
 
 def _apply_impl(prim, args, kwargs, name):
+    # NOTE: unwrap() reads Tensor._value, which (under host staging) pulls
+    # accelerator-resident state back to the host before eager execution —
+    # see core/tensor.py _pull_host_value.
     raw = [unwrap(a) for a in args]
     record = autograd.is_grad_enabled()
     diff_idx = []

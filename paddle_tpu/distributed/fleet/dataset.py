@@ -117,19 +117,63 @@ class InMemoryDataset(DatasetBase):
 
 class QueueDataset(DatasetBase):
     """Streaming dataset: samples come from generator factories, one pass,
-    never materialized (reference QueueDataset single-pass channel)."""
+    never materialized (reference QueueDataset single-pass channel).
+
+    Concurrency: multiple trainer workers consume ONE shared single-pass
+    stream of batches, handed out first-come-first-served under a lock (the
+    reference's channel pop). Per-worker re-reads would double-consume
+    non-callable iterables and multiply reader I/O. Non-callable iterables
+    are single-pass by nature: a second epoch yields nothing — pass callables
+    for re-runnable sources.
+    """
+
+    _EXHAUSTED = object()
 
     def __init__(self):
         super().__init__()
         self._readers = []
+        self._stream_lock = threading.Lock()
+        self._stream = None  # live shared batch iterator, or _EXHAUSTED
 
     def set_filelist(self, readers):
         """The reference takes data files; here each entry is a callable
         returning an iterable of samples (file parsing is user-side)."""
         self._readers = list(readers)
+        self._stream = None
 
     def _samples(self):
         for r in self._readers:
             it = r() if callable(r) else r
             for s in it:
                 yield s
+
+    # trainer-pass protocol (framework/trainer.py MultiTrainer): one shared
+    # stream per threaded pass, created before the workers start so a fast
+    # worker finishing early can never trigger a surprise re-read
+    def _begin_pass(self, num_workers):
+        with self._stream_lock:
+            self._stream = super().batches(0, 1)
+
+    def _end_pass(self):
+        with self._stream_lock:
+            self._stream = None
+
+    def batches(self, worker_id=0, num_workers=1):
+        if num_workers <= 1:
+            yield from super().batches(worker_id, num_workers)
+            return
+        with self._stream_lock:
+            if self._stream is None:
+                # direct concurrent use without _begin_pass: first caller
+                # opens the pass; it stays closed once exhausted
+                self._stream = super().batches(0, 1)
+        while True:
+            with self._stream_lock:
+                if self._stream is self._EXHAUSTED or self._stream is None:
+                    return
+                try:
+                    b = next(self._stream)
+                except StopIteration:
+                    self._stream = self._EXHAUSTED
+                    return
+            yield b

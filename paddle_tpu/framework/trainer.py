@@ -76,19 +76,28 @@ class MultiTrainer:
             self._run_inner(dataset, debug, print_period, fetch_info)
 
     def _run_inner(self, dataset, debug, print_period, fetch_info):
-        # warm the full discovery+compile sequence (3 calls: two eager
+        # Warm the full discovery+compile sequence (3 calls: two eager
         # discovery passes, then the XLA build) before going threaded, so
-        # steady-state workers hit only the compiled fast path. Donation is
-        # paused for the whole call: concurrent launches over shared state
-        # must not donate each other's input buffers.
-        warm = None
-        for feed in dataset.batches(0, 1):
-            warm = feed
-            break
-        if warm is None:
-            return
-        for _ in range(3):
-            self.workers[0].train_step(warm)
+        # steady-state workers hit only the compiled fast path. Warmed ONCE
+        # per program — repeat train_from_dataset calls must not re-apply
+        # extra updates to the first batch. Donation is paused for the whole
+        # call: concurrent launches over shared state must not donate each
+        # other's input buffers.
+        prog = getattr(self.workers[0], "_program", None)
+        if prog is None or not getattr(prog, "_trainer_warmed", False):
+            warm = None
+            for feed in dataset.batches(0, 1):
+                warm = feed
+                break
+            if warm is None:
+                return
+            for _ in range(3):
+                self.workers[0].train_step(warm)
+            if prog is not None:
+                try:
+                    prog._trainer_warmed = True
+                except AttributeError:
+                    pass
 
         errors = []
 
@@ -101,10 +110,18 @@ class MultiTrainer:
 
         threads = [threading.Thread(target=loop, args=(w,), daemon=True)
                    for w in self.workers]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        begin = getattr(dataset, "_begin_pass", None)
+        if begin is not None:
+            begin(len(self.workers))
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            end = getattr(dataset, "_end_pass", None)
+            if end is not None:
+                end()
         if errors:
             wid, err = errors[0]
             raise RuntimeError(f"trainer worker {wid} failed: {err!r}") from err

@@ -139,7 +139,8 @@ class _DiscoveryCtx:
 
 class _Program:
     __slots__ = ("captured", "mutated", "ro", "jitted", "jitted_donate",
-                 "out_tree", "n_outs", "stage", "internal_backward")
+                 "out_tree", "n_outs", "stage", "internal_backward",
+                 "pure_fn", "scanned", "scanned_donate", "scanned_ready")
 
     def __init__(self):
         self.captured = []
@@ -154,6 +155,12 @@ class _Program:
         # are post-update losses — outer grad flow would re-trace the whole
         # program per call for a gradient nobody consumes, so skip it
         self.internal_backward = False
+        self.pure_fn = None
+        # lax.scan-over-steps executables (run_steps), built lazily;
+        # scanned_ready flips after the first traced execution completes
+        self.scanned = None
+        self.scanned_donate = None
+        self.scanned_ready = False
 
 
 # Discovery/trace phases mutate global state (_TraceHooks, and shared model
@@ -170,6 +177,7 @@ _state_lock = threading.Lock()
 _state_cv = threading.Condition(_state_lock)
 _readers = [0]
 _compiling = [0]
+_tl = threading.local()  # per-thread reader count (nested-call re-entrancy)
 
 
 def _enter_fast_path():
@@ -179,24 +187,34 @@ def _enter_fast_path():
         if _compiling[0]:
             return False
         _readers[0] += 1
+        _tl.readers = getattr(_tl, "readers", 0) + 1
         return True
 
 
 def _exit_fast_path():
     with _state_cv:
         _readers[0] -= 1
-        if _readers[0] == 0:
-            _state_cv.notify_all()
+        _tl.readers = getattr(_tl, "readers", 0) - 1
+        # notify unconditionally: a _compile_guard waiter excludes its own
+        # registrations, so it may become runnable before the count hits 0
+        _state_cv.notify_all()
 
 
 class _compile_guard:
-    """Hold the compile lock and wait out in-flight compiled runs."""
+    """Hold the compile lock and wait out in-flight compiled runs.
+
+    A thread may reach here while itself registered as a fast-path reader
+    (a compiled program whose re-trace runs a nested, not-yet-compiled
+    to_static function) — waiting for its OWN reader registration to drain
+    would self-deadlock, so the wait only covers OTHER threads' readers.
+    """
 
     def __enter__(self):
         _compile_lock.acquire()
         with _state_cv:
             _compiling[0] += 1
-            while _readers[0] > 0:
+            own = getattr(_tl, "readers", 0)
+            while _readers[0] - own > 0:
                 _state_cv.wait()
         return self
 
@@ -259,6 +277,195 @@ class StaticFunction:
     @property
     def programs(self):
         return self._programs
+
+    # -- multi-step execution (steps_per_execution) -----------------------------
+    def run_steps(self, *args, **kwargs):
+        """Run K steps of this program in ONE device dispatch.
+
+        Every Tensor argument must carry a leading axis of the same length K
+        (the step index); python-scalar arguments are held fixed across steps.
+        The program's mutated state (parameters, optimizer moments, BN stats,
+        RNG keys) is threaded step-to-step through `lax.scan`, so the result
+        is bit-identical to calling the function K times — minus K-1 host
+        round-trips. Returns the function's outputs stacked on a leading K
+        axis (outputs are non-differentiable; split train/eval phases into
+        separate to_static functions if you need outer gradients).
+
+        TPU rationale: host→device dispatch latency dominates small/medium
+        step times (SURVEY.md §2.8 names the per-op interpreter loop as the
+        reference's throughput seam; its answer is the C++ executor loop +
+        CUDA graphs — run_program_op.cc. Keras' steps_per_execution is the
+        same idea on TPU). One scan dispatch amortizes the latency K×.
+        """
+        leaves = _flatten_tensors((args, kwargs), [])
+        if not leaves:
+            raise ValueError("run_steps needs at least one Tensor argument "
+                             "with a leading steps axis")
+        ks = {t._val.shape[0] if t._val.ndim else None for t in leaves}
+        if len(ks) != 1 or None in ks:
+            raise ValueError(
+                f"run_steps: all Tensor args must share the same leading "
+                f"steps-axis length; got lengths {sorted(map(str, ks))}")
+        k = ks.pop()
+        if k == 0:
+            raise ValueError("run_steps: leading steps axis is empty (K=0)")
+
+        # discovery slices must execute eagerly on the host under staging —
+        # leaving them on the accelerator would run the whole discovery pass
+        # op-by-op over the relay (the exact pathology staging exists for)
+        from ..core.device import host_staging_enabled
+        cpu_dev = None
+        if host_staging_enabled():
+            try:
+                cpu_dev = jax.devices("cpu")[0]
+            except RuntimeError:
+                pass
+
+        def _host(v):
+            sh = getattr(v, "sharding", None)
+            if cpu_dev is not None and sh is not None and any(
+                    d.platform != "cpu" for d in sh.device_set):
+                return jax.device_put(v, cpu_dev)
+            return v
+
+        def step_slice(i):
+            vals = iter([Tensor(_host(t._val[i]), stop_gradient=True)
+                         for t in leaves])
+            def sub(obj):
+                if isinstance(obj, Tensor):
+                    return next(vals)
+                if isinstance(obj, (list, tuple)):
+                    return type(obj)(sub(v) for v in obj)
+                if isinstance(obj, dict):
+                    return {kk: sub(obj[kk]) for kk in sorted(obj)}
+                return obj
+            a2 = sub(args)
+            kw2 = sub(kwargs)
+            return a2, kw2
+
+        # per-step signature derived symbolically (dropping the leading steps
+        # axis) — actually slicing here would dispatch device ops and pull
+        # data host-side on EVERY call just to compute a cache key
+        def _sig_step(value):
+            if isinstance(value, Tensor):
+                return ("T", tuple(value._val.shape[1:]),
+                        str(value._val.dtype))
+            if isinstance(value, (list, tuple)):
+                return (type(value).__name__,
+                        tuple(_sig_step(v) for v in value))
+            if isinstance(value, dict):
+                return ("dict", tuple(sorted(
+                    (k, _sig_step(v)) for k, v in value.items())))
+            return ("py", value if isinstance(
+                value, (int, float, str, bool, type(None)))
+                else str(type(value)))
+
+        key = (_sig_step(args), _sig_step(kwargs), autograd.is_grad_enabled())
+
+        # warm eagerly until the per-step program is discovered (two eager
+        # passes); warmup calls ARE real steps (state advances), their
+        # outputs are stitched onto the front of the scanned outputs. The
+        # single-step executable is deliberately NOT built/compiled — only
+        # the scanned program ever runs on the device.
+        eager_outs = []
+        i = 0
+        while i < k:
+            prog = self._programs.get(key)
+            if prog is not None and prog.stage >= 2:
+                break
+            ai, kwi = step_slice(i)
+            eager_outs.append(self(*ai, **kwi))
+            i += 1
+        if i == k:
+            stacked = [jnp.stack([t._val for t in per_leaf])
+                       for per_leaf in zip(*(
+                           _flatten_tensors(o, []) for o in eager_outs))]
+            outs = [Tensor(v, stop_gradient=True) for v in stacked]
+            return _unflatten(self._programs[key].out_tree, outs)
+
+        prog = self._programs[key]
+        if prog.pure_fn is None or prog.scanned is None:
+            with _compile_guard():
+                if prog.pure_fn is None:
+                    ai, kwi = step_slice(i)
+                    self._build(prog, ai, kwi)
+                if prog.scanned is None:
+                    self._build_scan(prog)
+
+        # steady state (i == 0): pass buffers through untouched — a [0:]
+        # slice would dispatch a device op and copy the whole stack per call
+        rest_vals = (tuple(t._val for t in leaves) if i == 0
+                     else tuple(t._val[i:] for t in leaves))
+
+        def _exec_scan():
+            mut_vals = tuple(t._val for t in prog.mutated)
+            ro_vals = tuple(t._val for t in prog.ro)
+            rest = rest_vals
+            from ..core.device import accelerator_device, host_staging_enabled
+            if host_staging_enabled():
+                accel = accelerator_device()
+                if accel is not None:
+                    def put(vals):
+                        return tuple(
+                            v if getattr(v, "sharding", None) is not None
+                            and accel in v.sharding.device_set
+                            else jax.device_put(v, accel) for v in vals)
+                    mut_vals = put(mut_vals)
+                    ro_vals = put(ro_vals)
+                    rest = put(rest)
+            exec_fn = (prog.scanned if _donation_paused[0]
+                       else prog.scanned_donate)
+            outs, new_state = exec_fn(mut_vals, ro_vals, rest)
+            for t, v in zip(prog.mutated, new_state):
+                t._val = v
+            return outs
+
+        # the FIRST execution traces pure_fn (temporarily rebinding shared
+        # model tensors to tracers) — it must hold the compile guard so no
+        # concurrent fast-path run observes tracer-bound state
+        if prog.scanned_ready and _enter_fast_path():
+            try:
+                outs = _exec_scan()
+            finally:
+                _exit_fast_path()
+        else:
+            with _compile_guard():
+                outs = _exec_scan()
+                prog.scanned_ready = True
+
+        if eager_outs:
+            eager_leaves = [[t._val for t in _flatten_tensors(o, [])]
+                            for o in eager_outs]
+
+            def _cat(j, v):
+                head = jnp.stack([el[j] for el in eager_leaves])
+                sh = getattr(v, "sharding", None)
+                if sh is not None:
+                    head = jax.device_put(head, list(sh.device_set)[0])
+                return jnp.concatenate([head, v], axis=0)
+
+            outs = [_cat(j, v) for j, v in enumerate(outs)]
+        leaves_out = [Tensor(v, stop_gradient=True) for v in outs]
+        return _unflatten(prog.out_tree, leaves_out)
+
+    def _build_scan(self, prog):
+        pure_fn = prog.pure_fn
+        n_outs = prog.n_outs
+
+        def scan_fn(mut_vals, ro_vals, stacked_arg_vals):
+            def body(carry, xs):
+                flat = pure_fn(carry, ro_vals, xs)
+                return tuple(flat[n_outs:]), tuple(flat[:n_outs])
+            new_state, outs = jax.lax.scan(body, tuple(mut_vals),
+                                           stacked_arg_vals)
+            return outs, new_state
+
+        prog.scanned = jax.jit(scan_fn)
+        from ..framework.flags import get_flag
+        if get_flag("FLAGS_donate_state_buffers", True):
+            prog.scanned_donate = jax.jit(scan_fn, donate_argnums=(0,))
+        else:
+            prog.scanned_donate = prog.scanned
 
     def __call__(self, *args, **kwargs):
         if not self._enabled:
@@ -355,6 +562,7 @@ class StaticFunction:
                 for t, v in stray.values():
                     t._val = v
 
+        prog.pure_fn = pure_fn
         prog.jitted = jax.jit(pure_fn)
         from ..framework.flags import get_flag
         if get_flag("FLAGS_donate_state_buffers", True):

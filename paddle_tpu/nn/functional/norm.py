@@ -46,15 +46,19 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         args = [a for a in (weight, bias) if a is not None]
         out, mean_t, var_t = apply(prim, x, *args, name="batch_norm")
         if running_mean is not None:
-            running_mean._value = (momentum * running_mean._val
-                                   + (1.0 - momentum) * mean_t._value.astype(running_mean._val.dtype))
+            rm = running_mean._value  # hooked read (trace capture + host pull)
+            running_mean._value = (momentum * rm
+                                   + (1.0 - momentum)
+                                   * mean_t._value.astype(rm.dtype))
         if running_var is not None:
             n = 1
             for a in reduce_axes:
                 n *= xv.shape[a]
             unbiased = var_t._value * (n / max(n - 1, 1))
-            running_var._value = (momentum * running_var._val
-                                  + (1.0 - momentum) * unbiased.astype(running_var._val.dtype))
+            rv = running_var._value
+            running_var._value = (momentum * rv
+                                  + (1.0 - momentum)
+                                  * unbiased.astype(rv.dtype))
         return out
 
     def prim_eval(v, m, s, *wb):
