@@ -79,7 +79,7 @@ def _apply_dtype(model):
 def _timed_steps(step, args, steps, warmup=5):
     """Time `steps` optimizer steps; returns wall seconds.
 
-    BENCH_SPE (steps-per-execution, default 8) batches that many steps into
+    BENCH_SPE (steps-per-execution, default 16) batches that many steps into
     one compiled `lax.scan` dispatch via StaticFunction.run_steps — the
     idiomatic TPU loop (host dispatch latency otherwise dominates sub-100ms
     steps). BENCH_SPE=1 falls back to one dispatch per step.
@@ -87,7 +87,7 @@ def _timed_steps(step, args, steps, warmup=5):
     import jax.numpy as jnp
     from paddle_tpu import Tensor
 
-    spe = max(1, int(os.environ.get("BENCH_SPE", 8)))
+    spe = max(1, int(os.environ.get("BENCH_SPE", 16)))
     if spe == 1:
         for _ in range(warmup):
             loss = step(*args)
@@ -153,7 +153,7 @@ def bench_bert():
 
     batch = int(os.environ.get("BENCH_BATCH", 16))
     seq = int(os.environ.get("BENCH_SEQ", 128))
-    steps = int(os.environ.get("BENCH_STEPS", 20))
+    steps = int(os.environ.get("BENCH_STEPS", 64))
 
     paddle.seed(0)
     cfg = BertConfig.base()
@@ -196,7 +196,7 @@ def bench_resnet50():
     import paddle_tpu.nn.functional as F
 
     batch = int(os.environ.get("BENCH_BATCH", 64))
-    steps = int(os.environ.get("BENCH_STEPS", 20))
+    steps = int(os.environ.get("BENCH_STEPS", 64))
     hw = int(os.environ.get("BENCH_HW", 224))
 
     paddle.seed(0)

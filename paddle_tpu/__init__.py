@@ -29,13 +29,12 @@ if _os.environ.get("JAX_PLATFORMS") == "axon":
 if _os.environ.get("PADDLE_TPU_COMPILATION_CACHE", "1") == "1":
     import jax as _jax
 
-    # cache entries depend on ambient XLA flags (the axon relay site tunes
-    # CPU codegen); segregate by flavor so AOT code never loads under
-    # mismatched machine-feature flags
-    _flavor = "axon" if (
-        "axon" in (_os.environ.get("JAX_PLATFORMS") or "").split(",")
-        or "axon_site" in (_os.environ.get("PYTHONPATH") or "")
-    ) else "plain"
+    # cache entries depend on which PJRT stack compiled them (the axon relay
+    # plugin changes XLA codegen flags process-wide once its sitecustomize
+    # registers it — even for the CPU backend); segregate by flavor so AOT
+    # code never loads under mismatched machine-feature flags
+    import sys as _sys
+    _flavor = "axon" if "axon" in _sys.modules else "plain"
     _cache_dir = _os.environ.get("JAX_COMPILATION_CACHE_DIR") or _os.path.join(
         _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
         ".jax_cache", _flavor)

@@ -1,0 +1,203 @@
+"""Sequence decoding: BeamSearchDecoder + dynamic_decode
+(reference python/paddle/fluid/layers/rnn.py:858 BeamSearchDecoder,
+:1269 dynamic_decode; paddle.nn re-exports them as the seq2seq inference
+surface; C side: operators/math/beam_search.*).
+
+TPU-native design: the decode loop runs host-side over whole-batch*beam
+tensor steps (each step is a handful of XLA ops: cell, log_softmax, top-k,
+gathers), rather than the reference's per-hypothesis C++ beam structures.
+Shapes are static per step — batch and beam are folded into one leading axis
+so the cell kernel sees a fixed [batch*beam, ...] problem. Wrap the caller in
+`to_static`/`run_steps` for compiled decoding of fixed-length loops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract decode-step provider (reference rnn.py:790 Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+def _tile_beam(v, beam_size):
+    # (B, ...) -> (B*beam, ...) with each row repeated beam_size times
+    return jnp.repeat(v, beam_size, axis=0)
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNNCell-compatible step function.
+
+    cell: Layer with `forward(inputs, states) -> (outputs, new_states)`.
+    embedding_fn: maps int64 token ids -> cell inputs (usually an Embedding).
+    output_fn: maps cell outputs -> vocab logits (usually a Linear).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """(B, ...) -> (B*beam, ...) (reference rnn.py:920): expand encoder
+        outputs so per-beam rows share their source batch row."""
+        return apply(lambda v: _tile_beam(v, beam_size), x,
+                     name="tile_beam_merge_with_batch")
+
+    def initialize(self, initial_cell_states):
+        states = initial_cell_states
+        self._single_state = isinstance(states, Tensor)
+        if self._single_state:
+            states = (states,)
+        batch = int(unwrap(states[0]).shape[0])
+        beam = self.beam_size
+        tiled = tuple(apply(lambda v: _tile_beam(v, beam), s,
+                            name="beam_tile") for s in states)
+        # log-prob 0 for beam 0, -inf others: forces first expansion from a
+        # single live hypothesis per batch row
+        lp0 = np.full((batch, beam), -1e9, np.float32)
+        lp0[:, 0] = 0.0
+        init = {
+            "cell_states": tiled,
+            "log_probs": Tensor(jnp.asarray(lp0)),
+            "finished": Tensor(jnp.zeros((batch, beam), jnp.bool_)),
+            "lengths": Tensor(jnp.zeros((batch, beam), jnp.int32)),
+        }
+        ids = Tensor(jnp.full((batch * beam,), self.start_token, jnp.int32))
+        return ids, init
+
+    def step(self, time, inputs, states, **kwargs):
+        beam = self.beam_size
+        cell_in = self.embedding_fn(inputs) if self.embedding_fn else inputs
+        cell_states = states["cell_states"]
+        if getattr(self, "_single_state", False):
+            cell_states = cell_states[0]
+        cell_out, new_cell_states = self.cell(cell_in, cell_states)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+
+        def prim(lg, lp, fin, ln):
+            import jax
+            b_beam, vocab = lg.shape
+            batch = b_beam // beam
+            lps = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            lps = lps.reshape(batch, beam, vocab)
+            # finished beams may only emit end_token at zero cost
+            fin_row = jnp.full((vocab,), -1e9, jnp.float32
+                               ).at[self.end_token].set(0.0)
+            lps = jnp.where(fin[:, :, None], fin_row[None, None, :], lps)
+            total = lp[:, :, None] + lps                 # (B, beam, V)
+            flat = total.reshape(batch, beam * vocab)
+            top_lp, top_idx = jax.lax.top_k(flat, beam)
+            src_beam = (top_idx // vocab).astype(jnp.int32)   # (B, beam)
+            tok = (top_idx % vocab).astype(jnp.int32)
+            was_fin = jnp.take_along_axis(fin, src_beam, axis=1)
+            new_fin = was_fin | (tok == self.end_token)
+            old_len = jnp.take_along_axis(ln, src_beam, axis=1)
+            new_len = old_len + (~was_fin).astype(jnp.int32)
+            return top_lp, tok, src_beam, new_fin, new_len
+
+        top_lp, tok, src_beam, new_fin, new_len = apply(
+            prim, logits, states["log_probs"], states["finished"],
+            states["lengths"], name="beam_search_step")
+
+        # gather cell states along the selected source beams
+        def gather_state(s, sb):
+            def g(v, sbv):
+                b_beam = v.shape[0]
+                batch = b_beam // beam
+                vr = v.reshape((batch, beam) + v.shape[1:])
+                idx = sbv[(...,) + (None,) * (v.ndim - 1)].astype(jnp.int32)
+                out = jnp.take_along_axis(vr, idx, axis=1)
+                return out.reshape((batch * beam,) + v.shape[1:])
+            return apply(g, s, sb, name="beam_gather_state")
+
+        cs = new_cell_states
+        if isinstance(cs, Tensor):
+            cs = (cs,)
+        gathered = tuple(gather_state(s, src_beam) for s in cs)
+        next_states = {
+            "cell_states": gathered,
+            "log_probs": top_lp,
+            "finished": new_fin,
+            "lengths": new_len,
+        }
+        next_inputs = apply(lambda t: t.reshape(-1), tok,
+                            name="beam_next_inputs")
+        outputs = (tok, src_beam)
+        return outputs, next_states, next_inputs, new_fin
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrack through (token, parent-beam) history into full
+        sequences: (B, T, beam) predicted ids, best beam first."""
+        toks, parents = outputs  # lists of (B, beam) Tensors
+
+        def prim(*flat):
+            t = len(flat) // 2
+            tk = jnp.stack(flat[:t])          # (T, B, beam)
+            pr = jnp.stack(flat[t:])
+            T, batch, beam = tk.shape
+            # walk parents backwards from the final beam order
+            cur = jnp.broadcast_to(jnp.arange(beam)[None], (batch, beam))
+            seqs = []
+            for step_i in range(T - 1, -1, -1):
+                seqs.append(jnp.take_along_axis(tk[step_i], cur, axis=1))
+                cur = jnp.take_along_axis(pr[step_i], cur, axis=1)
+            out = jnp.stack(seqs[::-1])       # (T, B, beam)
+            return jnp.transpose(out, (1, 0, 2))
+
+        return apply(prim, *toks, *parents, name="beam_finalize"), final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run decoder.step until every hypothesis finishes or max_step_num
+    (reference rnn.py:1269). Returns (outputs, final_states[, lengths])."""
+    if max_step_num is None:
+        max_step_num = 64
+    inputs, states = decoder.initialize(inits)
+    toks, parents = [], []
+    final_states = states
+    for t in range(int(max_step_num)):
+        outputs, states, inputs, finished = decoder.step(t, inputs, states,
+                                                         **kwargs)
+        toks.append(outputs[0])
+        parents.append(outputs[1])
+        final_states = states
+        if bool(np.asarray(unwrap(finished)).all()):
+            break
+    preds, final_states = decoder.finalize((toks, parents), final_states,
+                                           final_states["lengths"])
+    if output_time_major:
+        preds = apply(lambda v: jnp.transpose(v, (1, 0, 2)), preds,
+                      name="decode_time_major")
+    if return_length:
+        return preds, final_states, final_states["lengths"]
+    return preds, final_states

@@ -14,6 +14,7 @@ from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .sparse_attention import sparse_attention  # noqa: F401
+from .vision import *  # noqa: F401,F403
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):

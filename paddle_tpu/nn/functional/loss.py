@@ -17,6 +17,8 @@ __all__ = [
     "smooth_l1_loss", "kl_div", "margin_ranking_loss", "ctc_loss",
     "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
     "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
+    "soft_margin_loss", "multi_label_soft_margin_loss", "poisson_nll_loss",
+    "triplet_margin_with_distance_loss", "margin_cross_entropy",
 ]
 
 
@@ -309,3 +311,115 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return apply(prim, log_probs, unwrap(labels), unwrap(input_lengths),
                  unwrap(label_lengths), name="ctc_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label * input)); label in {-1, 1}
+    (reference nn/functional/loss.py soft_margin_loss)."""
+    def prim(x, y):
+        # stable softplus form: log(1 + exp(-yx)) = -log_sigmoid(yx)
+        v = -jax.nn.log_sigmoid(y.astype(x.dtype) * x)
+        return _reduce(v, reduction)
+    return apply(prim, input, label, name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    """Per-class sigmoid BCE averaged over classes (reference
+    nn/functional/loss.py multi_label_soft_margin_loss); label in {0, 1}."""
+    def prim(x, y, *w):
+        y = y.astype(x.dtype)
+        term = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        if w:
+            term = term * w[0]
+        v = -jnp.mean(term, axis=-1)
+        return _reduce(v, reduction)
+    args = [weight] if weight is not None else []
+    return apply(prim, input, label, *args,
+                 name="multi_label_soft_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    """Poisson negative log likelihood (reference poisson_nll_loss)."""
+    def prim(x, y):
+        y = y.astype(x.dtype)
+        if log_input:
+            v = jnp.exp(x) - y * x
+        else:
+            v = x - y * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation for log(y!) when y > 1
+            stir = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            v = v + jnp.where(y > 1, stir, jnp.zeros_like(y))
+        return _reduce(v, reduction)
+    return apply(prim, input, label, name="poisson_nll_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    """Triplet loss with a custom distance callable (reference
+    triplet_margin_with_distance_loss); default distance = pairwise L2."""
+    if distance_function is None:
+        def distance_function(a, b):
+            import paddle_tpu  # late import: avoid cycle at module load
+            return paddle_tpu.norm(a - b, p=2, axis=-1)
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_neg2 = distance_function(positive, negative)
+        d_neg = apply(lambda a, b: jnp.minimum(a, b), d_neg, d_neg2,
+                      name="triplet_swap_min")
+
+    def prim(dp, dn):
+        v = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce(v, reduction)
+    return apply(prim, d_pos, d_neg,
+                 name="triplet_margin_with_distance_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """ArcFace-family margin softmax (reference
+    operators/margin_cross_entropy_op.*, python margin_cross_entropy):
+    target-class cosine theta is re-margined as
+    cos(margin1*theta + margin2) - margin3, then scaled softmax CE.
+
+    `group` (model-parallel class sharding) follows the SPMD design: pass a
+    mesh axis name to reduce the softmax denominator with psum inside
+    shard_map/pjit-traced code; the single-process path needs no group.
+    """
+    axis_name = group if isinstance(group, str) else None
+
+    def prim(lg, lb):
+        x = lg.astype(jnp.float32)
+        theta = jnp.arccos(jnp.clip(x, -1.0 + 1e-7, 1.0 - 1e-7))
+        cos_m = jnp.cos(margin1 * theta + margin2) - margin3
+        n_cls = x.shape[-1]
+        lb_local = lb
+        if axis_name is not None:
+            # class-sharded logits: labels are GLOBAL class ids — shift by
+            # this shard's class offset so one_hot hits only the owning
+            # shard (out-of-range ids produce all-zero rows, by design)
+            lb_local = lb - jax.lax.axis_index(axis_name) * n_cls
+        onehot = jax.nn.one_hot(lb_local, n_cls, dtype=x.dtype)
+        logits_m = jnp.where(onehot > 0, cos_m, x) * scale
+        mx = jnp.max(logits_m, axis=-1, keepdims=True)
+        if axis_name is not None:
+            mx = jax.lax.pmax(mx, axis_name)
+        ex = jnp.exp(logits_m - mx)
+        denom = jnp.sum(ex, axis=-1, keepdims=True)
+        if axis_name is not None:
+            denom = jax.lax.psum(denom, axis_name)
+        logp = (logits_m - mx) - jnp.log(denom)
+        tgt = jnp.sum(logp * onehot, axis=-1)
+        if axis_name is not None:
+            tgt = jax.lax.psum(tgt, axis_name)
+        loss = _reduce(-tgt, reduction)
+        if return_softmax:
+            return loss, ex / denom
+        return loss
+
+    return apply(prim, logits, label, name="margin_cross_entropy")

@@ -12,7 +12,7 @@ __all__ = [
     "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
     "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
     "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
-    "adaptive_max_pool3d",
+    "adaptive_max_pool3d", "max_unpool1d", "max_unpool2d",
 ]
 
 
@@ -58,18 +58,27 @@ def _pool(x, n, kernel, stride, padding, mode, ceil_mode, exclusive,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, 1, kernel_size, stride, padding,
+                                   "NLC" if data_format == "NLC" else "NCW", ceil_mode=ceil_mode)
     return _pool(x, 1, kernel_size, stride, padding, "max", ceil_mode, True,
                  "NLC" if data_format == "NLC" else "NCW")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, 2, kernel_size, stride, padding,
+                                   data_format, ceil_mode=ceil_mode)
     return _pool(x, 2, kernel_size, stride, padding, "max", ceil_mode, True,
                  data_format)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, 3, kernel_size, stride, padding,
+                                   data_format, ceil_mode=ceil_mode)
     return _pool(x, 3, kernel_size, stride, padding, "max", ceil_mode, True,
                  data_format)
 
@@ -150,3 +159,107 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, 3, output_size, "max", "NCDHW")
+
+
+def _max_pool_with_mask(x, n, kernel, stride, padding, data_format,
+                        ceil_mode=False):
+    """Max pooling that also returns the argmax mask (flat index into the
+    input's spatial extent, reference max_pool_with_index_op.*). Window
+    patches are enumerated explicitly (kernels are tiny) so XLA sees static
+    slices; the mask feeds max_unpool*d."""
+    if ceil_mode:
+        raise NotImplementedError(
+            "return_mask=True with ceil_mode=True is not supported; pad the "
+            "input explicitly or use ceil_mode=False")
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride, n) if stride is not None else kernel
+    pad = _norm_padding(padding, n, stride, (1,) * n, kernel)
+    if isinstance(pad, str):
+        raise ValueError("return_mask does not support string padding modes")
+    pads = [p if isinstance(p, tuple) else (p, p) for p in pad]
+    if data_format in ("NHWC", "NLC", "NDHWC"):
+        raise ValueError("return_mask requires channel-first data_format")
+
+    def prim(v):
+        spatial = v.shape[2:]
+        out_sizes = tuple(
+            (spatial[i] + pads[i][0] + pads[i][1] - kernel[i]) // stride[i] + 1
+            for i in range(n))
+        neg = (-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+               else jnp.iinfo(v.dtype).min)
+        vp = jnp.pad(v, [(0, 0), (0, 0)] + pads, constant_values=neg)
+        import itertools
+        vals, idxs = [], []
+        # flat index of each window element in ORIGINAL (unpadded) coords
+        grids = jnp.meshgrid(
+            *[jnp.arange(o) * s for o, s in zip(out_sizes, stride)],
+            indexing="ij")
+        for offs in itertools.product(*[range(k) for k in kernel]):
+            sl = [slice(None), slice(None)] + [
+                slice(offs[i], offs[i] + out_sizes[i] * stride[i], stride[i])
+                for i in range(n)]
+            vals.append(vp[tuple(sl)])
+            coords = [grids[i] + offs[i] - pads[i][0] for i in range(n)]
+            flat = coords[0]
+            for i in range(1, n):
+                flat = flat * spatial[i] + coords[i]
+            idxs.append(jnp.broadcast_to(flat, vals[-1].shape[2:]))
+        stacked = jnp.stack(vals)                    # (K, N, C, *out)
+        which = jnp.argmax(stacked, axis=0)          # (N, C, *out)
+        out = jnp.max(stacked, axis=0)
+        # take idx per selected window offset: gather over leading K axis
+        idx_stack = jnp.stack(idxs)                  # (K, *out)
+        flat_idx = jnp.take_along_axis(
+            jnp.broadcast_to(idx_stack[:, None, None],
+                             (idx_stack.shape[0],) + out.shape),
+            which[None], axis=0)[0]
+        return out, flat_idx.astype(jnp.int32)
+
+    return apply(prim, x, name=f"max_pool{n}d_with_mask")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Inverse of max_pool1d(return_mask=True) (reference unpool_op.*)."""
+    kernel = _norm_tuple(kernel_size, 1)
+    stride_ = _norm_tuple(stride, 1) if stride is not None else kernel
+    if data_format != "NCL":
+        raise ValueError("max_unpool1d requires NCL")
+
+    def prim(v, idx):
+        nb, c, l = v.shape
+        out_l = (output_size[-1] if output_size
+                 else (l - 1) * stride_[0] - 2 * _norm_tuple(padding, 1)[0]
+                 + kernel[0])
+        out = jnp.zeros((nb, c, out_l), v.dtype)
+        b = jnp.arange(nb)[:, None, None]
+        ch = jnp.arange(c)[None, :, None]
+        return out.at[b, ch, idx].set(v)
+
+    return apply(prim, x, indices, name="max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d(return_mask=True) (reference unpool_op.*):
+    scatters each pooled value back to its argmax position, zeros elsewhere."""
+    kernel = _norm_tuple(kernel_size, 2)
+    stride_ = _norm_tuple(stride, 2) if stride is not None else kernel
+    pad2 = _norm_tuple(padding, 2)
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d requires NCHW")
+
+    def prim(v, idx):
+        nb, c, h, w = v.shape
+        if output_size:
+            oh, ow = int(output_size[-2]), int(output_size[-1])
+        else:
+            oh = (h - 1) * stride_[0] - 2 * pad2[0] + kernel[0]
+            ow = (w - 1) * stride_[1] - 2 * pad2[1] + kernel[1]
+        out = jnp.zeros((nb, c, oh * ow), v.dtype)
+        b = jnp.arange(nb)[:, None, None]
+        ch = jnp.arange(c)[None, :, None]
+        out = out.at[b, ch, idx.reshape(nb, c, -1)].set(v.reshape(nb, c, -1))
+        return out.reshape(nb, c, oh, ow)
+
+    return apply(prim, x, indices, name="max_unpool2d")

@@ -1,0 +1,143 @@
+"""Spatial-transform functionals (python/paddle/nn/functional/vision.py
+parity: affine_grid, grid_sample; operators/affine_grid_op.cc,
+grid_sampler_op.* in the reference).
+
+TPU-native design: grid_sample gathers the four bilinear corners with
+`jnp.take` over a flattened spatial axis (gathers lower to efficient XLA
+dynamic-slices; weights stay in the differentiable path), instead of the
+reference's per-pixel CUDA kernel.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+__all__ = ["affine_grid", "grid_sample", "temporal_shift"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta: (N, 2, 3) affine matrices; out_shape: [N, C, H, W] (list/tuple).
+    Returns sampling grid (N, H, W, 2) in normalized [-1, 1] xy coords."""
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy().tolist()]
+    n, _, h, w = [int(v) for v in out_shape]
+
+    def prim(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)               # (H, W)
+        # explicit multiply-add instead of einsum: coordinates must be exact
+        # f32 (dot_general may be lowered to reduced-precision matrix units)
+        t = th.astype(jnp.float32)[:, :, :, None, None]   # (N,2,3,1,1)
+        ox = t[:, 0, 0] * gx + t[:, 0, 1] * gy + t[:, 0, 2]
+        oy = t[:, 1, 0] * gx + t[:, 1, 1] * gy + t[:, 1, 2]
+        return jnp.stack([ox, oy], axis=-1).astype(th.dtype)
+
+    return apply(prim, theta, name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x: (N, C, H, W); grid: (N, Hg, Wg, 2) normalized xy in [-1, 1].
+    mode: bilinear|nearest; padding_mode: zeros|border|reflection."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample: unsupported mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(
+            f"grid_sample: unsupported padding_mode {padding_mode!r}")
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) / 2.0 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    def reflect(ix, size):
+        # reflect into [0, size-1] (align_corners grid of reflection)
+        if align_corners:
+            span = 2.0 * (size - 1) if size > 1 else 1.0
+            ix = jnp.abs(ix)
+            ix = ix % span
+            return jnp.where(ix > (size - 1), span - ix, ix)
+        span = 2.0 * size
+        ix = (ix + 0.5) % span
+        ix = jnp.abs(ix)
+        ix = jnp.where(ix > size, span - ix, ix)
+        return jnp.clip(ix - 0.5, 0, size - 1)
+
+    def prim(xv, gv):
+        n, c, h, w = xv.shape
+        gf = gv.astype(jnp.float32)
+        ix = unnormalize(gf[..., 0], w)             # (N, Hg, Wg)
+        iy = unnormalize(gf[..., 1], h)
+        if padding_mode == "border":
+            ix = jnp.clip(ix, 0, w - 1)
+            iy = jnp.clip(iy, 0, h - 1)
+        elif padding_mode == "reflection":
+            ix = reflect(ix, w)
+            iy = reflect(iy, h)
+
+        def gather(yi, xi):
+            # integer gather with zero padding outside
+            valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            yc = jnp.clip(yi, 0, h - 1)
+            xc = jnp.clip(xi, 0, w - 1)
+            flat = xv.reshape(n, c, h * w)
+            idx = (yc * w + xc).reshape(n, -1)       # (N, Hg*Wg)
+            got = jnp.take_along_axis(
+                flat, idx[:, None, :].astype(jnp.int32), axis=2)
+            got = got.reshape(n, c, *yi.shape[1:])
+            return jnp.where(valid[:, None], got, jnp.zeros((), xv.dtype))
+
+        if mode == "nearest":
+            xi = jnp.round(ix).astype(jnp.int32)
+            yi = jnp.round(iy).astype(jnp.int32)
+            return gather(yi, xi)
+
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1 = x0 + 1
+        y1 = y0 + 1
+        wx1 = (ix - x0).astype(xv.dtype)
+        wy1 = (iy - y0).astype(xv.dtype)
+        wx0 = 1.0 - wx1
+        wy0 = 1.0 - wy1
+        v00 = gather(y0.astype(jnp.int32), x0.astype(jnp.int32))
+        v01 = gather(y0.astype(jnp.int32), x1.astype(jnp.int32))
+        v10 = gather(y1.astype(jnp.int32), x0.astype(jnp.int32))
+        v11 = gather(y1.astype(jnp.int32), x1.astype(jnp.int32))
+        return (v00 * (wy0 * wx0)[:, None] + v01 * (wy0 * wx1)[:, None]
+                + v10 * (wy1 * wx0)[:, None] + v11 * (wy1 * wx1)[:, None])
+
+    return apply(prim, x, grid, name="grid_sample")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM temporal shift (operators/temporal_shift_op.*): input (N*T, C, H,
+    W); shifts the first `shift_ratio` of channels backward in time, the next
+    chunk forward, rest unshifted."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"temporal_shift: bad data_format {data_format!r}")
+
+    def prim(xv):
+        v = xv if data_format == "NCHW" else jnp.moveaxis(xv, -1, 1)
+        nt, c, h, w = v.shape
+        t = seg_num
+        n = nt // t
+        r = v.reshape(n, t, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [r[:, 1:, :c1], jnp.zeros_like(r[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(r[:, :1, c1:c2]), r[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, r[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        return out if data_format == "NCHW" else jnp.moveaxis(out, 1, -1)
+
+    return apply(prim, x, name="temporal_shift")

@@ -1,6 +1,8 @@
 """Common layers (python/paddle/nn/layer/common.py parity)."""
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from .. import functional as F
 from .. import initializer as I
 from .layers import Layer
@@ -9,7 +11,8 @@ __all__ = ["Linear", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
            "Embedding", "Flatten", "Upsample", "UpsamplingNearest2D",
            "UpsamplingBilinear2D", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
            "CosineSimilarity", "Bilinear", "Identity", "Unfold", "Fold",
-           "PixelShuffle", "PixelUnshuffle", "ChannelShuffle"]
+           "PixelShuffle", "PixelUnshuffle", "ChannelShuffle",
+           "PairwiseDistance", "MaxUnPool2D"]
 
 
 class Identity(Layer):
@@ -271,3 +274,36 @@ class ChannelShuffle(Layer):
 
     def forward(self, x):
         return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row pairs (reference nn/layer/distance.py)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._p = p
+        self._epsilon = epsilon
+        self._keepdim = keepdim
+
+    def forward(self, x, y):
+        def prim(a, b):
+            d = a - b + self._epsilon
+            return jnp.sum(jnp.abs(d) ** self._p, axis=-1,
+                           keepdims=self._keepdim) ** (1.0 / self._p)
+        from ...core.dispatch import apply
+        return apply(prim, x, y, name="pairwise_distance")
+
+
+class MaxUnPool2D(Layer):
+    """Inverse of MaxPool2D(return_mask=True) (reference nn/layer/pooling.py
+    MaxUnPool2D over unpool_op)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._args
+        return F.max_unpool2d(x, indices, k, stride=s, padding=p,
+                              data_format=df, output_size=osz)

@@ -12,7 +12,7 @@ from . import lr  # noqa: F401
 from .optimizer import Optimizer
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
-           "Adadelta", "Adamax", "RMSProp", "Lamb", "lr"]
+           "Adadelta", "Adamax", "RMSProp", "Lamb", "Lars", "LarsMomentum", "Ftrl", "lr"]
 
 
 class SGD(Optimizer):
@@ -286,3 +286,85 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
         lr_ = self._lr
         p._value = (pv - lr_ * trust * update).astype(dtype)
+
+
+class Lars(Optimizer):
+    """LARS momentum (reference operators/optimizers/lars_momentum_op.cc,
+    python fluid.optimizer.LarsMomentumOptimizer): layerwise-adaptive local
+    learning rate lr * coeff * ||p|| / (||g|| + wd * ||p|| + eps)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, weight_decay=None,
+                 grad_clip=None, epsilon=1e-9, exclude_from_weight_decay=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _apply_update(self, p, g):
+        vel = self._get_accumulator("velocity", p)
+        dtype = p._val.dtype
+        g = g.astype(dtype)
+        lr_ = self._lr.astype(jnp.float32)
+        wd = self._lars_wd
+        if self._exclude and any(s in (getattr(p, "name", "") or "")
+                                 for s in self._exclude):
+            wd = 0.0
+        pf = p._value.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(pf * pf))
+        g_norm = jnp.sqrt(jnp.sum(gf * gf))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr_ * self._lars_coeff * p_norm
+            / (g_norm + wd * p_norm + self._epsilon),
+            lr_)
+        v_new = (self._momentum * vel._value.astype(jnp.float32)
+                 + local_lr * (gf + wd * pf))
+        vel._value = v_new.astype(dtype)
+        p._value = (pf - v_new).astype(dtype)
+
+
+LarsMomentum = Lars
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference operators/optimizers/ftrl_op.h,
+    fluid.optimizer.FtrlOptimizer): per-coordinate adaptive update with L1/L2
+    shrinkage; accumulators: squared (n) and linear (z)."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _apply_update(self, p, g):
+        sq = self._get_accumulator("squared", p)
+        lin = self._get_accumulator("linear", p)
+        dtype = p._val.dtype
+        gf = g.astype(jnp.float32)
+        pf = p._value.astype(jnp.float32)
+        nf = sq._value.astype(jnp.float32)
+        zf = lin._value.astype(jnp.float32)
+        lr_ = self._lr.astype(jnp.float32)
+        new_n = nf + gf * gf
+        lp = self._lr_power
+        if lp == -0.5:
+            sigma = (jnp.sqrt(new_n) - jnp.sqrt(nf)) / lr_
+            y = jnp.sqrt(new_n) / lr_ + 2.0 * self._l2
+        else:
+            sigma = (new_n ** (-lp) - nf ** (-lp)) / lr_
+            y = new_n ** (-lp) / lr_ + 2.0 * self._l2
+        new_z = zf + gf - sigma * pf
+        pre = (self._l1 * jnp.sign(new_z) - new_z) / y
+        new_p = jnp.where(jnp.abs(new_z) > self._l1, pre,
+                          jnp.zeros_like(pre))
+        sq._value = new_n.astype(dtype)
+        lin._value = new_z.astype(dtype)
+        p._value = new_p.astype(dtype)

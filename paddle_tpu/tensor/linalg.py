@@ -206,3 +206,78 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return apply(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
                                    fweights=unwrap(fweights) if fweights is not None else None,
                                    aweights=unwrap(aweights) if aweights is not None else None), x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """paddle.linalg.lu (reference operators/lu_op.*): packed LU plus
+    1-based pivot vector (and zero info tensor when get_infos)."""
+    from jax.lax.linalg import lu as lax_lu
+
+    def prim(v):
+        packed, piv, _ = lax_lu(v)
+        return packed, (piv + 1).astype(jnp.int32)
+
+    out = apply(prim, x, name="lu")
+    if get_infos:
+        from ..core.tensor import Tensor
+        m = out[0]
+        info = Tensor(jnp.zeros(m._val.shape[:-2], jnp.int32))
+        return out[0], out[1], info
+    return out
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """paddle.linalg.lu_unpack: expand packed LU + pivots into (P, L, U)."""
+    def prim(packed, piv):
+        *batch, m, n = packed.shape
+        k = min(m, n)
+        tri_l = jnp.tril(packed[..., :, :k], k=-1)
+        eye = jnp.eye(m, k, dtype=packed.dtype)
+        L = tri_l + eye
+        U = jnp.triu(packed[..., :k, :])
+        # pivots (1-based sequential row swaps) -> permutation matrix
+        def perm_of(pv):
+            perm = jnp.arange(m)
+            def body(i, pr):
+                j = pv[i] - 1
+                a, b = pr[i], pr[j]
+                pr = pr.at[i].set(b).at[j].set(a)
+                return pr
+            return jax.lax.fori_loop(0, pv.shape[0], body, perm)
+        pvs = piv.reshape((-1, piv.shape[-1]))
+        perms = jax.vmap(perm_of)(pvs)
+        perms = perms.reshape(tuple(batch) + (m,))
+        P = jax.nn.one_hot(perms, m, dtype=packed.dtype)
+        # rows of P select permuted order: P[perm[i], i] = 1 -> build transpose
+        P = jnp.swapaxes(P, -1, -2)
+        return P, L, U
+
+    outs = apply(prim, x, y, name="lu_unpack")
+    if not unpack_ludata:
+        return outs[0], None, None
+    if not unpack_pivots:
+        return None, outs[1], outs[2]
+    return outs
+
+
+def householder_product(x, tau, name=None):
+    """paddle.linalg.householder_product: accumulate Householder reflectors
+    (geqrf convention) into the explicit Q matrix."""
+    def prim(a, t):
+        *batch, m, n = a.shape
+        def one(av, tv):
+            q = jnp.eye(m, dtype=a.dtype)
+            def body(i, acc):
+                v = jnp.where(jnp.arange(m) > i, av[:, i], 0.0)
+                v = v.at[i].set(1.0)
+                h = jnp.eye(m, dtype=a.dtype) - tv[i] * jnp.outer(v, v)
+                return acc @ h
+            q = jax.lax.fori_loop(0, tv.shape[0], body, q)
+            return q[:, :n]
+        if batch:
+            af = a.reshape((-1, m, n))
+            tf = t.reshape((-1, t.shape[-1]))
+            out = jax.vmap(one)(af, tf)
+            return out.reshape(tuple(batch) + (m, n))
+        return one(a, t)
+    return apply(prim, x, tau, name="householder_product")

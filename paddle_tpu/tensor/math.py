@@ -293,3 +293,25 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
 def increment(x, value=1.0, name=None):
     x._value = x._val + value
     return x
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (reference tensor/math.py vander)."""
+    def prim(v):
+        return jnp.vander(v, N=n, increasing=increasing)
+    return apply(prim, x, name="vander")
+
+
+def frexp(x, name=None):
+    """Decompose into mantissa in [0.5, 1) and integer exponent."""
+    def prim(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(jnp.int32)
+    return apply(prim, x, name="frexp")
+
+
+def ldexp(x, y, name=None):
+    """x * 2**y (reference tensor/math.py ldexp)."""
+    def prim(a, b):
+        return jnp.ldexp(a, b.astype(jnp.int32))
+    return apply(prim, x, y, name="ldexp")

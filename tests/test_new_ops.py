@@ -1,0 +1,367 @@
+"""Tests for the round-2 completeness batch: spatial transforms
+(affine_grid/grid_sample/temporal_shift), max-pool masks + unpool, new
+losses, Lars/Ftrl optimizers, LU factorization family, vander/frexp/ldexp,
+and beam-search decoding. Oracles: torch CPU where available, numpy else."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestSpatialTransforms:
+    def test_affine_grid_identity_roundtrip(self):
+        theta = paddle.to_tensor(
+            np.tile(np.array([[1, 0, 0], [0, 1, 0]], "float32"), (2, 1, 1)))
+        grid = F.affine_grid(theta, [2, 3, 5, 7])
+        assert grid.shape == [2, 5, 7, 2]
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 3, 5, 7).astype("float32"))
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=2e-5)
+
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("padding_mode", ["zeros", "border", "reflection"])
+    @pytest.mark.parametrize("align_corners", [True, False])
+    def test_grid_sample_matches_torch(self, mode, padding_mode,
+                                       align_corners):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 6, 5).astype("float32")
+        grid = (rng.rand(2, 4, 7, 2).astype("float32") * 2.4 - 1.2)
+        want = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode=mode,
+            padding_mode=padding_mode, align_corners=align_corners).numpy()
+        got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            mode=mode, padding_mode=padding_mode,
+                            align_corners=align_corners).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+    def test_grid_sample_gradient_flows(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(1, 2, 4, 4).astype("float32"))
+        x.stop_gradient = False
+        theta = paddle.to_tensor(
+            np.array([[[1, 0, 0.1], [0, 1, -0.1]]], "float32"))
+        theta.stop_gradient = False
+        out = F.grid_sample(x, F.affine_grid(theta, [1, 2, 4, 4]))
+        out.sum().backward()
+        assert x.grad is not None and float(np.abs(x.grad.numpy()).sum()) > 0
+        assert theta.grad is not None
+        assert float(np.abs(theta.grad.numpy()).sum()) > 0
+
+    def test_temporal_shift_oracle(self):
+        x = np.arange(2 * 2 * 4 * 1 * 1, dtype="float32").reshape(4, 4, 1, 1)
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25).numpy()
+        r = x.reshape(2, 2, 4, 1, 1)
+        want = np.zeros_like(r)
+        want[:, :-1, :1] = r[:, 1:, :1]          # backward shift
+        want[:, 1:, 1:2] = r[:, :-1, 1:2]        # forward shift
+        want[:, :, 2:] = r[:, :, 2:]
+        np.testing.assert_allclose(out, want.reshape(4, 4, 1, 1))
+
+
+class TestUnpool:
+    def test_max_pool2d_mask_and_unpool_roundtrip(self):
+        x = np.random.RandomState(3).randn(2, 3, 8, 8).astype("float32")
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, return_mask=True)
+        torch = pytest.importorskip("torch")
+        to, tm = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), to.numpy())
+        np.testing.assert_array_equal(mask.numpy(), tm.numpy())
+        rec = F.max_unpool2d(out, mask, 2)
+        trec = torch.nn.functional.max_unpool2d(to, tm, 2)
+        np.testing.assert_allclose(rec.numpy(), trec.numpy())
+
+    def test_max_unpool2d_layer_and_1d(self):
+        x = np.random.RandomState(4).randn(1, 2, 6).astype("float32")
+        out, mask = F.max_pool1d(paddle.to_tensor(x), 2, return_mask=True)
+        rec = F.max_unpool1d(out, mask, 2).numpy()
+        assert rec.shape == (1, 2, 6)
+        nz = rec != 0
+        np.testing.assert_allclose(rec[nz], x[nz])
+        layer = nn.MaxUnPool2D(2)
+        x2 = np.random.RandomState(5).randn(1, 1, 4, 4).astype("float32")
+        o2, m2 = F.max_pool2d(paddle.to_tensor(x2), 2, return_mask=True)
+        assert layer(o2, m2).shape == [1, 1, 4, 4]
+
+
+class TestNewLosses:
+    def test_soft_margin_loss(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 5).astype("float32")
+        y = np.sign(rng.randn(4, 5)).astype("float32")
+        want = torch.nn.functional.soft_margin_loss(
+            torch.tensor(x), torch.tensor(y)).numpy()
+        got = F.soft_margin_loss(paddle.to_tensor(x),
+                                 paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        assert nn.SoftMarginLoss()(paddle.to_tensor(x),
+                                   paddle.to_tensor(y)).shape == []
+
+    def test_multi_label_soft_margin_loss(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 6).astype("float32")
+        y = (rng.rand(4, 6) > 0.5).astype("float32")
+        want = torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(x), torch.tensor(y)).numpy()
+        got = F.multi_label_soft_margin_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_poisson_nll_loss(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(2)
+        x = rng.randn(8).astype("float32")
+        y = rng.poisson(3, 8).astype("float32")
+        for log_input in (True, False):
+            for full in (True, False):
+                want = torch.nn.functional.poisson_nll_loss(
+                    torch.tensor(np.abs(x) + 0.1 if not log_input else x),
+                    torch.tensor(y), log_input=log_input, full=full).numpy()
+                got = F.poisson_nll_loss(
+                    paddle.to_tensor(np.abs(x) + 0.1 if not log_input else x),
+                    paddle.to_tensor(y), log_input=log_input,
+                    full=full).numpy()
+                np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_triplet_margin_with_distance_loss(self):
+        rng = np.random.RandomState(3)
+        a, p, n = [paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+                   for _ in range(3)]
+        got = F.triplet_margin_with_distance_loss(a, p, n, margin=0.5)
+        av, pv, nv = a.numpy(), p.numpy(), n.numpy()
+        dp = np.linalg.norm(av - pv, axis=-1)
+        dn = np.linalg.norm(av - nv, axis=-1)
+        want = np.maximum(dp - dn + 0.5, 0).mean()
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+    def test_margin_cross_entropy_zero_margin_is_scaled_ce(self):
+        rng = np.random.RandomState(4)
+        cos = np.tanh(rng.randn(6, 10)).astype("float32")  # valid cosines
+        lb = rng.randint(0, 10, (6,)).astype("int64")
+        got = F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(lb),
+            margin1=1.0, margin2=0.0, margin3=0.0, scale=16.0).numpy()
+        z = cos * 16.0
+        z = z - z.max(-1, keepdims=True)
+        logp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+        want = -logp[np.arange(6), lb].mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_margin_cross_entropy_margin_increases_loss(self):
+        rng = np.random.RandomState(5)
+        cos = np.tanh(rng.randn(6, 10)).astype("float32")
+        lb = rng.randint(0, 10, (6,)).astype("int64")
+        base = F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(lb),
+            margin1=1.0, margin2=0.0, margin3=0.0).numpy()
+        arc = F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(lb),
+            margin1=1.0, margin2=0.5, margin3=0.0).numpy()
+        assert float(arc) > float(base)
+
+
+class TestNewOptimizers:
+    def _quad_converges(self, make_opt, tol=1e-2, steps=200):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.array([3.0, -2.0], "float32"))
+        w.stop_gradient = False
+        opt = make_opt([w])
+        for _ in range(steps):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return float((w * w).sum().numpy())
+
+    def test_lars_converges(self):
+        final = self._quad_converges(
+            lambda ps: paddle.optimizer.Lars(
+                learning_rate=0.5, momentum=0.9, lars_coeff=0.1,
+                lars_weight_decay=0.0, parameters=ps))
+        assert final < 1e-2, final
+
+    def test_ftrl_converges(self):
+        final = self._quad_converges(
+            lambda ps: paddle.optimizer.Ftrl(
+                learning_rate=0.5, parameters=ps))
+        assert final < 1e-2, final
+
+    def test_ftrl_l1_induces_sparsity(self):
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 8).astype("float32")
+        true_w = np.zeros(8, "float32")
+        true_w[:2] = [2.0, -3.0]
+        y = X @ true_w
+        w = paddle.to_tensor(np.zeros(8, "float32"))
+        w.stop_gradient = False
+        opt = paddle.optimizer.Ftrl(learning_rate=0.5, l1=2.0,
+                                    parameters=[w])
+        xt, yt = paddle.to_tensor(X), paddle.to_tensor(y)
+        for _ in range(150):
+            pred = (xt * w).sum(-1)
+            loss = ((pred - yt) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        wv = w.numpy()
+        assert (np.abs(wv[2:]) < 0.15).all(), wv
+        assert np.abs(wv[0]) > 1.0 and np.abs(wv[1]) > 1.5, wv
+
+
+class TestLinalgLu:
+    def test_lu_unpack_reconstructs(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(5, 5).astype("float32")
+        LU, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, L, U = paddle.linalg.lu_unpack(LU, piv)
+        rec = P.numpy() @ L.numpy() @ U.numpy()
+        np.testing.assert_allclose(rec, a, atol=1e-5)
+
+    def test_lu_batched_and_infos(self):
+        rng = np.random.RandomState(1)
+        a = rng.randn(3, 4, 4).astype("float32")
+        LU, piv, info = paddle.linalg.lu(paddle.to_tensor(a),
+                                         get_infos=True)
+        assert LU.shape == [3, 4, 4] and piv.shape == [3, 4]
+        assert info.shape == [3]
+        P, L, U = paddle.linalg.lu_unpack(LU, piv)
+        rec = np.einsum("bij,bjk,bkl->bil", P.numpy(), L.numpy(), U.numpy())
+        np.testing.assert_allclose(rec, a, atol=1e-4)
+
+    def test_householder_product_matches_qr(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(2)
+        a = rng.randn(5, 3).astype("float32")
+        h, tau = torch.geqrf(torch.tensor(a))
+        want = torch.linalg.householder_product(h, tau).numpy()
+        got = paddle.linalg.householder_product(
+            paddle.to_tensor(h.numpy()), paddle.to_tensor(tau.numpy()))
+        np.testing.assert_allclose(got.numpy(), want, atol=1e-5)
+
+
+class TestSmallMath:
+    def test_vander(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        np.testing.assert_allclose(paddle.vander(x).numpy(),
+                                   np.vander([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(
+            paddle.vander(x, n=2, increasing=True).numpy(),
+            np.vander([1.0, 2.0, 3.0], 2, increasing=True))
+
+    def test_frexp_ldexp_roundtrip(self):
+        x = np.array([0.5, -3.75, 100.0, 1e-8], "float32")
+        m, e = paddle.frexp(paddle.to_tensor(x))
+        mn, en = np.frexp(x)
+        np.testing.assert_allclose(m.numpy(), mn)
+        np.testing.assert_array_equal(e.numpy(), en)
+        back = paddle.ldexp(m, e).numpy()
+        np.testing.assert_allclose(back, x)
+
+
+class TestBeamSearch:
+    def _table_cell(self, V=7, seed=0, scale=2.0):
+        rng = np.random.RandomState(seed)
+
+        class TableCell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.table = paddle.to_tensor(
+                    rng.randn(V, V).astype("float32") * scale)
+
+            def forward(self, inputs, states):
+                from paddle_tpu.core.dispatch import apply
+                import jax.numpy as jnp
+                out = apply(lambda t, idx: t[idx.astype(jnp.int32)],
+                            self.table, inputs, name="lookup")
+                return out, (out,)
+
+        return TableCell()
+
+    def test_beam1_matches_greedy(self):
+        cell = self._table_cell()
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=6,
+                                   beam_size=1)
+        init = paddle.to_tensor(np.zeros((2, 7), "float32"))
+        preds, _ = nn.dynamic_decode(dec, inits=init, max_step_num=8)
+        tbl = cell.table.numpy()
+        tok, greedy = 0, []
+        for _ in range(preds.shape[1]):
+            tok = int(np.argmax(tbl[tok]))
+            greedy.append(tok)
+            if tok == 6:
+                break
+        got = preds.numpy()[0, :len(greedy), 0].tolist()
+        assert got == greedy
+
+    def test_beam_top_hypothesis_beats_greedy(self):
+        # adversarial table: greedy's first choice leads to poor continuations
+        V = 5
+        tbl = np.full((V, V), -5.0, "float32")
+        tbl[0, 1] = 1.0     # greedy picks 1
+        tbl[0, 2] = 0.9     # beam keeps 2
+        tbl[1] = [-5, -5, -5, -4.9, -5]
+        tbl[2, 3] = 2.0     # 2 -> 3 is great
+        tbl[3, 4] = 2.0
+        tbl[4, 4] = 0.0
+
+        class Fixed(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.table = paddle.to_tensor(tbl)
+
+            def forward(self, inputs, states):
+                from paddle_tpu.core.dispatch import apply
+                import jax.numpy as jnp
+                out = apply(lambda t, idx: t[idx.astype(jnp.int32)],
+                            self.table, inputs, name="lookup")
+                return out, (out,)
+
+        cell = Fixed()
+        g = nn.dynamic_decode(
+            nn.BeamSearchDecoder(cell, 0, V - 1, 1),
+            inits=paddle.to_tensor(np.zeros((1, V), "float32")),
+            max_step_num=3)[0].numpy()[0, :, 0]
+        b = nn.dynamic_decode(
+            nn.BeamSearchDecoder(cell, 0, V - 1, 3),
+            inits=paddle.to_tensor(np.zeros((1, V), "float32")),
+            max_step_num=3)[0].numpy()[0, :, 0]
+
+        def score(seq):
+            s, tok = 0.0, 0
+            for t in seq:
+                row = tbl[tok]
+                lse = np.log(np.exp(row - row.max()).sum()) + row.max()
+                s += row[t] - lse
+                tok = t
+            return s
+
+        assert score(list(b)) >= score(list(g))
+        assert list(b[:2]) == [2, 3]
+
+    def test_tile_beam_merge_with_batch(self):
+        x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+        t = nn.BeamSearchDecoder.tile_beam_merge_with_batch(x, 2).numpy()
+        np.testing.assert_allclose(t, np.repeat(x.numpy(), 2, axis=0))
+
+    def test_beam_with_gru_cell_single_state(self):
+        # GRUCell takes a PLAIN tensor state — the decoder must preserve the
+        # caller's state structure (regression: tuple was forced before)
+        paddle.seed(0)
+        emb = nn.Embedding(8, 6)
+        cell = nn.GRUCell(6, 6)
+        proj = nn.Linear(6, 8)
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                                   beam_size=2, embedding_fn=emb,
+                                   output_fn=proj)
+        preds, _ = nn.dynamic_decode(
+            dec, inits=paddle.to_tensor(np.zeros((3, 6), "float32")),
+            max_step_num=4)
+        assert preds.shape[0] == 3 and preds.shape[2] == 2
