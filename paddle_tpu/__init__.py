@@ -34,7 +34,9 @@ if _os.environ.get("PADDLE_TPU_COMPILATION_CACHE", "1") == "1":
     # registers it — even for the CPU backend); segregate by flavor so AOT
     # code never loads under mismatched machine-feature flags
     import sys as _sys
-    _flavor = "axon" if "axon" in _sys.modules else "plain"
+    _flavor = ("axon" if ("axon" in _sys.modules or "axon" in
+               (_os.environ.get("JAX_PLATFORMS") or "").split(","))
+               else "plain")
     _cache_dir = _os.environ.get("JAX_COMPILATION_CACHE_DIR") or _os.path.join(
         _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
         ".jax_cache", _flavor)

@@ -191,3 +191,49 @@ def check(rc, lib=None):
         code = int(lib.pt_last_error_code())
         raise_from_code(code, f"paddle_tpu native: {msg}")
     return rc
+
+
+class HostArena:
+    """Python handle over the native slab arena (csrc/memory.cc pt_arena_* —
+    the host-side analog of memory/allocation/buddy_allocator). Used for
+    pinned host staging buffers; stats feed paddle.device.memory_stats()."""
+
+    def __init__(self, slab_bytes=1 << 22):
+        self._lib = load()
+        self._h = self._lib.pt_arena_create(slab_bytes)
+
+    def alloc(self, nbytes):
+        return self._lib.pt_arena_alloc(self._h, nbytes)
+
+    def free(self, ptr):
+        return self._lib.pt_arena_free(self._h, ptr)
+
+    def stats(self):
+        import ctypes as c
+        in_use = c.c_int64()
+        peak = c.c_int64()
+        slabs = c.c_int64()
+        self._lib.pt_arena_stats(self._h, c.byref(in_use), c.byref(peak),
+                                 c.byref(slabs))
+        return in_use.value, peak.value, slabs.value
+
+    def __del__(self):
+        try:
+            self._lib.pt_arena_destroy(self._h)
+        except Exception:
+            pass
+
+
+_default_arena = None
+
+
+def default_arena():
+    """Lazily-created process-wide host arena, or None when the native
+    runtime is unavailable."""
+    global _default_arena
+    if _default_arena is None:
+        try:
+            _default_arena = HostArena()
+        except NativeUnavailable:
+            return None
+    return _default_arena

@@ -49,7 +49,8 @@ class _DeviceNS:
         import jax
         try:
             stats = jax.devices()[0].memory_stats()
-            return stats.get("peak_bytes_in_use", 0)
+            return max(0, stats.get("peak_bytes_in_use", 0)
+                       - _PEAK_BASELINE["bytes"])
         except Exception:
             return 0
 
@@ -62,6 +63,96 @@ class _DeviceNS:
         except Exception:
             return 0
 
+    @staticmethod
+    def memory_reserved(device=None):
+        # backends without a reserved-bytes stat report 0 (bytes_limit is
+        # total HBM capacity, NOT a reservation — see memory_stats())
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_reserved", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_reserved", 0)
+        except Exception:
+            return 0
+
+
+_PEAK_BASELINE = {"bytes": 0}
+
+
+def memory_stats(device=None):
+    """Full allocator statistics facade (reference
+    memory/stats.h DEVICE_MEMORY_STAT / paddle.device.cuda.memory_* family).
+
+    Merges the PJRT device allocator's stats (XLA owns device HBM — the
+    reference's per-place allocator registry collapses into this single
+    view) with the native host-arena counters (csrc/memory.cc) when the
+    native runtime is loaded.
+    """
+    import jax
+    out = {}
+    try:
+        dev = jax.devices()[0] if device is None else device
+        out.update(dev.memory_stats() or {})
+    except Exception:
+        pass
+    try:
+        from ..core import native
+        arena = native.default_arena()
+        if arena is not None:
+            in_use, peak = arena.stats()[:2]
+            out["host_arena_bytes_in_use"] = in_use
+            out["host_arena_peak_bytes"] = peak
+    except Exception:
+        pass
+    return out
+
+
+def reset_max_memory_allocated(device=None):
+    """PJRT exposes a monotonically-tracked peak; reset is emulated by
+    snapshotting the current value as the new baseline (peak queries return
+    max(0, peak - baseline))."""
+    import jax
+    try:
+        stats = jax.devices()[0].memory_stats()
+        _PEAK_BASELINE["bytes"] = stats.get("peak_bytes_in_use", 0)
+    except Exception:
+        _PEAK_BASELINE["bytes"] = 0
+
+
+def set_allocator_strategy(strategy):
+    """FLAGS_allocator_strategy facade (reference
+    memory/allocation/allocator_strategy.cc: naive_best_fit | auto_growth).
+    XLA's client allocator is configured via env BEFORE backend init — calls
+    after jax initialization raise so misuse is loud."""
+    import os
+
+    import jax
+    mapping = {"auto_growth": "platform", "naive_best_fit": "bfc"}
+    if strategy not in mapping:
+        raise ValueError(
+            f"unknown allocator strategy {strategy!r}; "
+            f"expected one of {sorted(mapping)}")
+    try:
+        initialized = bool(jax._src.xla_bridge._backends)
+    except AttributeError:  # private probe moved in a jax upgrade
+        initialized = True  # conservative: direct users to the env var
+    if initialized:
+        raise RuntimeError(
+            "set_allocator_strategy must be called before the first device "
+            "use (the XLA client allocator is fixed at backend init); set "
+            "XLA_PYTHON_CLIENT_ALLOCATOR instead for an initialized process")
+    os.environ["XLA_PYTHON_CLIENT_ALLOCATOR"] = mapping[strategy]
+
 
 cuda = _DeviceNS()
 tpu = _DeviceNS()
+__all__ += ["memory_stats", "reset_max_memory_allocated",
+            "set_allocator_strategy"]

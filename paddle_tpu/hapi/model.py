@@ -24,6 +24,16 @@ def _to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def _batch_sig(b):
+    """Shape signature of one (inputs, labels) pair — a scan group must be
+    shape-static, so signatures are computed once per batch on append."""
+    ins, labs = b
+    leaves = _to_list(ins) + _to_list(labs)
+    return tuple(
+        tuple(x.shape) if hasattr(x, "shape") else np.asarray(x).shape
+        for x in leaves)
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -72,6 +82,36 @@ class Model:
         loss = self._compiled_train_step(ins, labs)
         return [float(loss.item())]
 
+    def _train_steps(self, batches):
+        """Run len(batches) optimizer steps in ONE compiled scan dispatch
+        (StaticFunction.run_steps). batches: list of (inputs, labels)."""
+        import jax.numpy as jnp
+
+        self.network.train()
+        head = []
+        if self._compiled_train_step is None:
+            # build the same step StaticFunction train_batch uses; its loss
+            # is step 0 of this group
+            head = [self.train_batch(*batches[0])]
+            batches = batches[1:]
+            if not batches:
+                return head
+        def to_tensors(ins, labs):
+            return ([i if isinstance(i, Tensor) else Tensor(np.asarray(i))
+                     for i in _to_list(ins)],
+                    [l if isinstance(l, Tensor) else Tensor(np.asarray(l))
+                     for l in _to_list(labs)])
+        pairs = [to_tensors(i, l) for i, l in batches]
+        n_in = len(pairs[0][0])
+        ins_stacked = [Tensor(jnp.stack([p[0][j]._val for p in pairs]))
+                       for j in range(n_in)]
+        labs_stacked = [Tensor(jnp.stack([p[1][j]._val for p in pairs]))
+                        for j in range(len(pairs[0][1]))]
+        losses = self._compiled_train_step.run_steps(ins_stacked,
+                                                     labs_stacked)
+        return head + [[float(v)]
+                       for v in np.asarray(losses.numpy()).reshape(-1)]
+
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
         ins = [i if isinstance(i, Tensor) else Tensor(np.asarray(i))
@@ -103,7 +143,17 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None,
+            steps_per_execution=1):
+        """Keras-style training loop (reference hapi/model.py:1556 fit).
+
+        steps_per_execution (TPU extension, Keras parity): batch that many
+        optimizer steps into ONE compiled lax.scan dispatch
+        (StaticFunction.run_steps) — host dispatch latency stops dominating
+        small steps. Callbacks still fire once per step, after the group
+        executes; groups with ragged/mismatched batch shapes fall back to
+        single-step dispatch.
+        """
         from .callbacks import CallbackList, ProgBarLogger
         loader = self._make_loader(train_data, batch_size, shuffle, drop_last,
                                    num_workers)
@@ -117,21 +167,61 @@ class Model:
         cbs.on_train_begin({"epochs": epochs, "steps": steps,
                             "metrics": self._metric_names()})
         self.stop_training = False
+        spe = max(1, int(steps_per_execution))
         it = 0
         for epoch in range(epochs):
             cbs.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(loader):
-                cbs.on_train_batch_begin(step)
+            step = 0
+            group = []
+
+            def run_group(group, step0):
+                nonlocal logs, it
+                if len(group) > 1:
+                    losses = self._train_steps(group)
+                else:
+                    losses = [self.train_batch(*group[0])]
+                for k, loss in enumerate(losses):
+                    s = step0 + k
+                    cbs.on_train_batch_begin(s)
+                    logs = {"loss": loss, "step": s}
+                    cbs.on_train_batch_end(s, logs)
+                    it += 1
+
+            group_sig = None
+            for batch in loader:
                 ins, labs = self._split_batch(batch)
-                loss = self.train_batch(ins, labs)
-                logs = {"loss": loss, "step": step}
-                cbs.on_train_batch_end(step, logs)
-                it += 1
+                sig = _batch_sig((ins, labs)) if spe > 1 else None
+                if group and spe > 1 and sig != group_sig:
+                    # ragged boundary: flush what we have single-step
+                    for g in group:
+                        run_group([g], step)
+                        step += 1
+                    group = []
+                if not group:
+                    group_sig = sig
+                group.append((ins, labs))
+                # never run past num_iters: cap the group to remaining steps
+                remaining = (None if num_iters is None
+                             else max(0, num_iters - it))
+                if len(group) == spe or (remaining is not None
+                                         and len(group) >= remaining):
+                    if remaining is not None:
+                        group = group[:remaining]
+                    if group:
+                        run_group(group, step)
+                        step += len(group)
+                    group = []
                 if num_iters is not None and it >= num_iters:
                     break
+            remaining = None if num_iters is None else max(0, num_iters - it)
+            if remaining is not None:
+                group = group[:remaining]
+            if group:  # tail remainder in one scan (shapes already uniform)
+                run_group(group, step)
+                step += len(group)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 eval_result = self.evaluate(eval_data, batch_size=batch_size,
                                             verbose=0, num_workers=num_workers,

@@ -365,3 +365,90 @@ class TestBeamSearch:
             dec, inits=paddle.to_tensor(np.zeros((3, 6), "float32")),
             max_step_num=4)
         assert preds.shape[0] == 3 and preds.shape[2] == 2
+
+
+class TestHapiStepsPerExecution:
+    """Model.fit(steps_per_execution=K): K optimizer steps per compiled scan
+    dispatch, loss/callback/parameter parity with single-step fit."""
+
+    class _DS:
+        def __len__(self):
+            return 20
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            return rng.randn(8).astype("float32"), np.array([i % 3], "int64")
+
+    def _run(self, spe):
+        from paddle_tpu.hapi.callbacks import Callback
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+        m = paddle.Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        seen = []
+
+        class Rec(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.append((step, logs["loss"][0]))
+
+        m.fit(self._DS(), batch_size=4, epochs=2, verbose=0, shuffle=False,
+              steps_per_execution=spe, callbacks=[Rec()])
+        return seen, [p.numpy().astype(np.float64).sum()
+                      for p in net.parameters()]
+
+    def test_parity_with_single_step(self):
+        s1, p1 = self._run(1)
+        s4, p4 = self._run(4)
+        assert len(s1) == len(s4) == 10
+        for (a, la), (b, lb) in zip(s1, s4):
+            assert a == b
+            np.testing.assert_allclose(la, lb, rtol=2e-4, atol=2e-4)
+        for a, b in zip(p1, p4):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=2e-3)
+
+    def test_num_iters_not_overshot_by_group(self):
+        from paddle_tpu.hapi.callbacks import Callback
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 3))
+        m = paddle.Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        seen = []
+
+        class Rec(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.append(step)
+
+        m.fit(self._DS(), batch_size=4, epochs=1, verbose=0, shuffle=False,
+              steps_per_execution=4, num_iters=2, callbacks=[Rec()])
+        assert seen == [0, 1], seen
+
+
+class TestMemoryFacade:
+    def test_memory_stats_and_reset(self):
+        stats = paddle.device.memory_stats()
+        assert isinstance(stats, dict)
+        assert paddle.device.cuda.memory_allocated() >= 0
+        assert paddle.device.cuda.max_memory_allocated() >= 0
+        paddle.device.reset_max_memory_allocated()
+        assert paddle.device.cuda.max_memory_allocated() >= 0
+
+    def test_allocator_strategy_validation(self):
+        with pytest.raises(ValueError):
+            paddle.device.set_allocator_strategy("nonsense")
+        # backend is initialized in the test session -> loud error
+        with pytest.raises(RuntimeError):
+            paddle.device.set_allocator_strategy("auto_growth")
+
+    def test_host_arena_stats(self):
+        from paddle_tpu.core import native
+        if not native.available():
+            pytest.skip("native runtime not built")
+        arena = native.default_arena()
+        ptr = arena.alloc(1024)
+        in_use, peak, slabs = arena.stats()
+        assert in_use >= 1024 and peak >= in_use and slabs >= 1
+        arena.free(ptr)
