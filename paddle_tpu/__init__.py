@@ -92,6 +92,7 @@ from . import distributed  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import cost_model  # noqa: F401,E402
 from . import slim  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import utils  # noqa: F401,E402

@@ -49,7 +49,9 @@ class _DeviceNS:
         import jax
         try:
             stats = jax.devices()[0].memory_stats()
-            return max(0, stats.get("peak_bytes_in_use", 0)
+            # reset semantics: peak restarts from CURRENT usage, never below
+            return max(stats.get("bytes_in_use", 0),
+                       stats.get("peak_bytes_in_use", 0)
                        - _PEAK_BASELINE["bytes"])
         except Exception:
             return 0
@@ -105,7 +107,9 @@ def memory_stats(device=None):
         pass
     try:
         from ..core import native
-        arena = native.default_arena()
+        # probe only an ALREADY-created arena: creating one here could
+        # trigger a blocking native build inside a stats query
+        arena = getattr(native, "_default_arena", None)
         if arena is not None:
             in_use, peak = arena.stats()[:2]
             out["host_arena_bytes_in_use"] = in_use

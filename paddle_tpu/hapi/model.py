@@ -29,9 +29,12 @@ def _batch_sig(b):
     shape-static, so signatures are computed once per batch on append."""
     ins, labs = b
     leaves = _to_list(ins) + _to_list(labs)
-    return tuple(
-        tuple(x.shape) if hasattr(x, "shape") else np.asarray(x).shape
-        for x in leaves)
+
+    def one(x):
+        if not hasattr(x, "shape"):
+            x = np.asarray(x)
+        return (tuple(x.shape), str(getattr(x, "dtype", "")))
+    return tuple(one(x) for x in leaves)
 
 
 class Model:
@@ -179,13 +182,22 @@ class Model:
 
             def run_group(group, step0):
                 nonlocal logs, it
-                if len(group) > 1:
-                    losses = self._train_steps(group)
-                else:
-                    losses = [self.train_batch(*group[0])]
+                if len(group) == 1:
+                    # single-step path keeps the begin-before-execute
+                    # callback contract (timers/profiler regions)
+                    cbs.on_train_batch_begin(step0)
+                    loss = self.train_batch(*group[0])
+                    logs = {"loss": loss, "step": step0}
+                    cbs.on_train_batch_end(step0, logs)
+                    it += 1
+                    return
+                # grouped: all begins fire, the scan executes once, then
+                # all ends report per-step losses
+                for k in range(len(group)):
+                    cbs.on_train_batch_begin(step0 + k)
+                losses = self._train_steps(group)
                 for k, loss in enumerate(losses):
                     s = step0 + k
-                    cbs.on_train_batch_begin(s)
                     logs = {"loss": loss, "step": s}
                     cbs.on_train_batch_end(s, logs)
                     it += 1
@@ -216,10 +228,8 @@ class Model:
                     group = []
                 if num_iters is not None and it >= num_iters:
                     break
-            remaining = None if num_iters is None else max(0, num_iters - it)
-            if remaining is not None:
-                group = group[:remaining]
-            if group:  # tail remainder in one scan (shapes already uniform)
+            if group:  # tail remainder in one scan (shapes already
+                # uniform; the in-loop cap guarantees len < remaining)
                 run_group(group, step)
                 step += len(group)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:

@@ -1,5 +1,9 @@
 from .bert import BertModel, BertForSequenceClassification  # noqa: F401
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieForSequenceClassification, ErnieModel,
+)
 from .gpt import GPTForCausalLM, GPTModel  # noqa: F401
 
 __all__ = ["BertModel", "BertForSequenceClassification", "GPTModel",
-           "GPTForCausalLM"]
+           "GPTForCausalLM", "ErnieConfig", "ErnieModel",
+           "ErnieForSequenceClassification"]

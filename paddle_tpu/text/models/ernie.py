@@ -1,0 +1,40 @@
+"""ERNIE model family (reference: ERNIE ships via PaddleNLP on top of the
+repo's transformer stack — nn/layer/transformer.py:109,622; BASELINE.json
+config 3 names ERNIE-base as the fine-tune target).
+
+Architecturally ERNIE-base is a BERT-style encoder (12L/768H/12 heads) with
+its own vocabulary and pretraining objectives (knowledge masking); the
+fine-tune-time compute graph is identical. The implementation therefore
+composes the BERT encoder with ERNIE's configuration defaults — one encoder
+implementation, two checkpoints' worth of API surface.
+"""
+from __future__ import annotations
+
+from .bert import (BertConfig, BertForSequenceClassification, BertModel)
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForSequenceClassification"]
+
+
+class ErnieConfig(BertConfig):
+    """ERNIE-base defaults: 18000-token zh vocab (ernie-1.0), otherwise the
+    12L/768H encoder geometry BERT-base uses."""
+
+    def __init__(self, vocab_size=18000, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_position=513,
+                 type_vocab_size=2, dropout=0.1):
+        super().__init__(vocab_size=vocab_size, hidden_size=hidden_size,
+                         num_layers=num_layers, num_heads=num_heads,
+                         intermediate_size=intermediate_size,
+                         max_position=max_position,
+                         type_vocab_size=type_vocab_size, dropout=dropout)
+
+
+class ErnieModel(BertModel):
+    def __init__(self, config=None, **kwargs):
+        super().__init__(config or ErnieConfig(**kwargs))
+
+
+class ErnieForSequenceClassification(BertForSequenceClassification):
+    def __init__(self, config=None, num_classes=2, **kwargs):
+        super().__init__(config or ErnieConfig(**kwargs),
+                         num_classes=num_classes)

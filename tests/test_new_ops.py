@@ -452,3 +452,52 @@ class TestMemoryFacade:
         in_use, peak, slabs = arena.stats()
         assert in_use >= 1024 and peak >= in_use and slabs >= 1
         arena.free(ptr)
+
+
+class TestCostModel:
+    def test_profile_measure_static_program(self):
+        import paddle_tpu.static as static
+        from paddle_tpu.cost_model import CostModel
+
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [4, 8], "float32")
+                w = paddle.to_tensor(
+                    np.random.RandomState(0).randn(8, 8).astype("float32"))
+                y = (x @ w).sum()
+            cm = CostModel()
+            cd = cm.profile_measure(prog)
+            assert len(cd.op_time) >= 1
+            assert all(v >= 0 for v in cd.op_time.values())
+            assert cd.get_whole_time_ms() >= 0
+            some_op = next(iter(cd.op_name.values()))
+            assert cm.get_static_op_time(some_op) is not None
+        finally:
+            paddle.disable_static()
+
+
+class TestErnie:
+    def test_ernie_forward_and_train_step(self):
+        from paddle_tpu.text.models import (ErnieConfig,
+                                            ErnieForSequenceClassification)
+        paddle.seed(0)
+        cfg = ErnieConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                          num_heads=4, intermediate_size=64, dropout=0.0)
+        m = ErnieForSequenceClassification(cfg, num_classes=3)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randint(0, 128, (2, 16)).astype("int64"))
+        y = paddle.to_tensor(rng.randint(0, 3, (2,)).astype("int64"))
+        losses = []
+        for _ in range(5):
+            loss = m(x, labels=y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses
+        logits = m(x)
+        assert logits.shape == [2, 3]
