@@ -168,3 +168,68 @@ def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
 def flops(net, input_size, custom_ops=None, print_detail=False):
     from .hapi.dynamic_flops import flops as _flops
     return _flops(net, input_size, custom_ops=custom_ops, print_detail=print_detail)
+
+
+# -- remaining top-level reference names (python/paddle/__init__.py __all__) --
+from .framework.param_attr import ParamAttr  # noqa: E402,F401
+from .nn.functional.activation import tanh_  # noqa: E402,F401
+import numpy as _np  # noqa: E402
+dtype = _np.dtype  # paddle.dtype: the type of dtype objects (VarType parity)
+from .core.device import CPUPlace as CUDAPinnedPlace  # noqa: E402,F401
+from .core.device import TPUPlace as NPUPlace  # noqa: E402,F401
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """paddle.create_parameter parity (fluid/layers/tensor.py)."""
+    from .core.dtypes import convert_dtype
+    from .framework.param_attr import ParamAttr
+    from .nn import initializer as I
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = attr.initializer or default_initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    value = init(list(shape), convert_dtype(dtype))
+    prm = Parameter(value, name=name or attr.name, trainable=attr.trainable)
+    return prm
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Deprecated fluid-style batch reader decorator (fluid/io.py batch)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def set_cuda_rng_state(state):
+    """Reference set_cuda_rng_state — maps onto the single RNG state."""
+    from .core import random as _random
+    _random.set_state(state)
+
+
+def disable_signal_handler():
+    """Reference disables its C++ fatal-signal dumper; no native signal
+    handlers are installed here, so this is a documented no-op."""
+    return None
+
+
+def check_shape(shape):
+    """Static shape validity check (framework utils parity)."""
+    if isinstance(shape, Tensor):
+        return
+    for d in list(shape):
+        if not isinstance(d, int) and not hasattr(d, "shape"):
+            raise TypeError(f"invalid dim {d!r} in shape {shape!r}")

@@ -379,3 +379,33 @@ class Parameter(Tensor):
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
     return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def inplace_assign(x, out):
+    """Shared implementation of paddle's `op_(x)` in-place family: rebind
+    x's buffer to `out`'s AND transplant out's tape node so autograd flows
+    through the in-place op (imperative inplace-version semantics). In-place
+    on a leaf that requires grad is an error, as in the reference.
+
+    Tape surgery: `out`'s GradNode holds x ITSELF as an input edge; after the
+    rebind that edge must point at x's PRE-assign history, so the old
+    (value, node, slot) triple moves to a snapshot tensor and the node's
+    input list is rewired to it.
+    """
+    from . import autograd as _ag
+    if (_ag.is_grad_enabled() and not x.stop_gradient
+            and x._grad_node is None and x._val is not out._val):
+        raise RuntimeError(
+            "a leaf Tensor that requires grad is being used in an in-place "
+            "operation; detach it or disable gradients first")
+    node = out._grad_node
+    if node is not None and getattr(node, "inputs", None):
+        snap = Tensor(x._val, stop_gradient=x.stop_gradient)
+        snap._grad_node = x._grad_node
+        snap._out_index = x._out_index
+        node.inputs = [snap if t is x else t for t in node.inputs]
+    x._value = out._val
+    x._grad_node = node
+    x._out_index = getattr(out, "_out_index", None)
+    x.stop_gradient = out.stop_gradient
+    return x

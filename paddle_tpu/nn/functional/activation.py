@@ -4,13 +4,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...core.dispatch import apply
+from ...core.dispatch import apply, unwrap
 
 __all__ = [
-    "relu", "relu6", "relu_", "elu", "selu", "celu", "gelu", "sigmoid",
+    "relu", "relu6", "relu_", "tanh_", "elu", "selu", "celu", "gelu", "sigmoid",
     "log_sigmoid", "tanh", "tanhshrink", "hardtanh", "hardshrink",
     "hardsigmoid", "hardswish", "leaky_relu", "prelu", "rrelu", "softmax",
     "log_softmax", "softplus", "softshrink", "softsign", "swish", "silu",
+    "elu_", "softmax_",
     "mish", "maxout", "glu", "gumbel_softmax", "thresholded_relu",
 ]
 
@@ -20,8 +21,8 @@ def relu(x, name=None):
 
 
 def relu_(x, name=None):
-    x._value = jax.nn.relu(x._val)
-    return x
+    from ...core.tensor import inplace_assign
+    return inplace_assign(x, relu(x))
 
 
 def relu6(x, name=None):
@@ -191,3 +192,18 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             y = jax.lax.stop_gradient(onehot - y) + y
         return y
     return apply(prim, x, kd, name="gumbel_softmax")
+
+
+def tanh_(x, name=None):
+    from ...core.tensor import inplace_assign
+    return inplace_assign(x, tanh(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...core.tensor import inplace_assign
+    return inplace_assign(x, elu(x, alpha))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...core.tensor import inplace_assign
+    return inplace_assign(x, softmax(x, axis, dtype))

@@ -19,6 +19,7 @@ __all__ = [
     "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
     "soft_margin_loss", "multi_label_soft_margin_loss", "poisson_nll_loss",
     "triplet_margin_with_distance_loss", "margin_cross_entropy",
+    "hsigmoid_loss",
 ]
 
 
@@ -423,3 +424,43 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
         return loss
 
     return apply(prim, logits, label, name="margin_cross_entropy")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference
+    operators/hierarchical_sigmoid_op.*, nn/functional/loss.py
+    hsigmoid_loss). Default tree: complete binary tree over classes; the
+    path of class c = binary digits of (c + num_classes) walked from the
+    root (the standard Morin&Bengio layout the reference uses).
+    """
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not implemented; "
+            "the default complete-binary-tree layout is supported")
+    import numpy as _np
+    depth = max(1, int(_np.ceil(_np.log2(max(2, num_classes)))))
+
+    def prim(x, lb, w, *b):
+        # codes for every class: walk from root; node ids in [0, num_classes)
+        lbl = lb.reshape(-1).astype(jnp.int32)
+        node = lbl + num_classes  # leaf position in the implicit heap
+        losses = jnp.zeros(lbl.shape, jnp.float32)
+        for _ in range(depth):
+            bit = node % 2          # which child we are
+            parent = node // 2
+            nidx = jnp.clip(parent - 1, 0, num_classes - 1)
+            logit = jnp.sum(x * w[nidx], axis=-1)
+            if b:
+                logit = logit + b[0].reshape(-1)[nidx]
+            # sigmoid CE against the path bit; parents above root contribute 0
+            active = (parent >= 1).astype(jnp.float32)
+            tgt = bit.astype(jnp.float32)
+            losses = losses + active * (
+                jnp.maximum(logit, 0) - logit * tgt
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+            node = parent
+        return losses.reshape(-1, 1)  # paddle contract: [N, 1]
+    args = [a for a in (bias,) if a is not None]
+    return apply(prim, input, label, weight, *args, name="hsigmoid_loss")

@@ -5,7 +5,7 @@ from .. import functional as F
 from .. import initializer as I
 from .layers import Layer
 
-__all__ = ["ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Sigmoid",
+__all__ = ["Silu", "ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Sigmoid",
            "LogSigmoid", "Tanh", "Tanhshrink", "Hardtanh", "Hardshrink",
            "Hardsigmoid", "Hardswish", "LeakyReLU", "PReLU", "Softmax",
            "LogSoftmax", "Softplus", "Softshrink", "Softsign", "Swish",
@@ -78,3 +78,8 @@ class PReLU(Layer):
 
     def forward(self, x):
         return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class Silu(Layer):
+    def forward(self, x):
+        return F.silu(x)

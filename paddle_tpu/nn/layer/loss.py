@@ -9,7 +9,8 @@ __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "MarginRankingLoss", "CTCLoss", "HingeEmbeddingLoss",
            "CosineEmbeddingLoss", "TripletMarginLoss",
            "SoftMarginLoss", "MultiLabelSoftMarginLoss", "PoissonNLLLoss",
-           "TripletMarginWithDistanceLoss"]
+           "TripletMarginWithDistanceLoss",
+           "HSigmoidLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -215,3 +216,27 @@ class TripletMarginWithDistanceLoss(Layer):
             input, positive, negative,
             distance_function=self._distance_function, margin=self._margin,
             swap=self._swap, reduction=self._reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference nn/layer/loss.py
+    HSigmoidLoss): owns the internal-node weight table and delegates to
+    F.hsigmoid_loss's complete-binary-tree path layout."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom-tree HSigmoidLoss is not implemented")
+        self._num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1 if num_classes > 1 else 1, feature_size],
+            attr=weight_attr)
+        self.bias = (self.create_parameter(
+            [num_classes - 1 if num_classes > 1 else 1], attr=bias_attr,
+            is_bias=True) if bias_attr is not False else None)
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias)

@@ -8,7 +8,7 @@ from .. import functional as F
 from .. import initializer as I
 from .layers import Layer
 
-__all__ = ["BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+__all__ = ["SpectralNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
            "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm1D",
            "InstanceNorm2D", "InstanceNorm3D", "LocalResponseNorm"]
 
@@ -182,3 +182,50 @@ class LocalResponseNorm(Layer):
     def forward(self, x):
         return F.local_response_norm(x, self.size, self.alpha, self.beta,
                                      self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight tensor (reference
+    nn/layer/norm.py SpectralNorm over operators/spectral_norm_op.*):
+    power-iteration estimate of the largest singular value; forward returns
+    weight / sigma."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ...core.dispatch import apply
+        dim, iters, eps = self._dim, self._power_iters, self._eps
+
+        def prim(wt, u, v):
+            perm = (dim,) + tuple(i for i in range(wt.ndim) if i != dim)
+            mat = jnp.transpose(wt, perm).reshape(wt.shape[dim], -1)
+            uu, vv = u, v
+            for _ in range(iters):
+                vv = mat.T @ uu
+                vv = vv / (jnp.linalg.norm(vv) + eps)
+                uu = mat @ vv
+                uu = uu / (jnp.linalg.norm(uu) + eps)
+            sigma = uu @ mat @ vv
+            return wt / sigma, uu, vv
+
+        out, u_new, v_new = apply(prim, weight, self.weight_u, self.weight_v,
+                                  name="spectral_norm")
+        self.weight_u._value = u_new._value
+        self.weight_v._value = v_new._value
+        return out

@@ -16,7 +16,7 @@ from .. import initializer as I
 from .layers import Layer
 
 __all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM",
-           "GRU", "BiRNN"]
+           "GRU", "BiRNN", "RNNCellBase"]
 
 
 def _lstm_step(carry, x_t, wi, wh, bi, bh):

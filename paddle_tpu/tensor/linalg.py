@@ -281,3 +281,22 @@ def householder_product(x, tau, name=None):
             return out.reshape(tuple(batch) + (m, n))
         return one(a, t)
     return apply(prim, x, tau, name="householder_product")
+
+
+def inv(x, name=None):
+    """paddle.linalg.inv — matrix inverse (alias of paddle.inverse)."""
+    return inverse(x, name=name)
+
+
+def cond(x, p=None, name=None):
+    """paddle.linalg.cond — matrix condition number in norm p (default 2)."""
+    pv = 2 if p is None else p
+
+    def prim(v):
+        if pv in (2, -2):
+            s = jnp.linalg.svd(v, compute_uv=False)
+            return (s[..., 0] / s[..., -1] if pv == 2
+                    else s[..., -1] / s[..., 0])
+        return (jnp.linalg.norm(v, ord=pv, axis=(-2, -1))
+                * jnp.linalg.norm(jnp.linalg.inv(v), ord=pv, axis=(-2, -1)))
+    return apply(prim, x, name="cond")

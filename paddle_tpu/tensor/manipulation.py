@@ -351,3 +351,25 @@ def as_complex(x, name=None):
 def as_real(x, name=None):
     return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
 
+
+
+def reverse(x, axis, name=None):
+    """fluid.layers.reverse parity — alias of flip."""
+    return flip(x, axis, name=name)
+
+
+def squeeze_(x, axis=None, name=None):
+    """In-place squeeze (reference inplace-api family): rebinds the buffer
+    AND transplants the tape node so autograd includes the op."""
+    from ..core.tensor import inplace_assign
+    return inplace_assign(x, squeeze(x, axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    from ..core.tensor import inplace_assign
+    return inplace_assign(x, unsqueeze(x, axis))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from ..core.tensor import inplace_assign
+    return inplace_assign(x, scatter(x, index, updates, overwrite=overwrite))

@@ -315,3 +315,71 @@ def ldexp(x, y, name=None):
     def prim(a, b):
         return jnp.ldexp(a, b.astype(jnp.int32))
     return apply(prim, x, y, name="ldexp")
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (reference operators/sum_op.*)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+
+    def prim(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+    return apply(prim, *inputs, name="add_n")
+
+
+def tensordot(x, y, axes=2, name=None):
+    """numpy-semantics tensordot (reference tensor/manipulation tensordot)."""
+    import builtins
+    if isinstance(axes, (list, tuple)):
+        if builtins.all(isinstance(a, int) for a in axes):
+            # paddle semantics: a flat int list names the SAME axes of both
+            # tensors (numpy would split a length-2 list per-tensor)
+            ax = (tuple(axes), tuple(axes))
+        elif len(axes) >= 2:
+            ax = (tuple(axes[0]) if isinstance(axes[0], (list, tuple))
+                  else (axes[0],),
+                  tuple(axes[1]) if isinstance(axes[1], (list, tuple))
+                  else (axes[1],))
+        else:
+            sub = tuple(axes[0]) if isinstance(axes[0], (list, tuple)) \
+                else (axes[0],)
+            ax = (sub, sub)
+    else:
+        ax = int(axes)
+
+    def prim(a, b):
+        return jnp.tensordot(a, b, axes=ax)
+    return apply(prim, x, y, name="tensordot")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    def prim(v):
+        return jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2)
+    return apply(prim, x, name="diagonal")
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Static shape-broadcast helper (framework broadcast rules)."""
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Remap global ids to shard-local ids (reference
+    operators/shard_index_op.*): ids owned by shard_id map to local offsets,
+    others to ignore_value."""
+    if shard_id < 0 or shard_id >= nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for nshards {nshards}")
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def prim(v):
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        inside = (v >= lo) & (v < hi)
+        return jnp.where(inside, v - lo, ignore_value)
+    return apply(prim, input, name="shard_index")

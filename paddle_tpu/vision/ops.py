@@ -16,7 +16,7 @@ from ..core.tensor import Tensor
 
 __all__ = ["nms", "box_iou", "deform_conv2d", "DeformConv2D",
            "roi_align", "RoIAlign", "roi_pool", "RoIPool",
-           "psroi_pool", "PSRoIPool", "yolo_box", "yolo_loss"]
+           "psroi_pool", "PSRoIPool", "yolo_box", "yolo_loss", "read_file", "decode_jpeg"]
 
 
 def box_iou(boxes1, boxes2):
@@ -520,3 +520,40 @@ class PSRoIPool(_nn.Layer):
     def forward(self, x, boxes, boxes_num):
         return psroi_pool(x, boxes, boxes_num, self._output_size,
                          self._spatial_scale)
+
+
+def read_file(filename, name=None):
+    """Read raw file bytes into a uint8 tensor (reference
+    operators/read_file_op.cc / paddle.vision.ops.read_file)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference
+    operators/decode_jpeg_op.* via nvjpeg; host-side decode here — image IO
+    belongs on the host in a TPU input pipeline)."""
+    import io
+
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg requires Pillow on the host") from e
+    raw = bytes(np.asarray(x.numpy(), dtype=np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode.lower() in ("rgb",):
+        img = img.convert("RGB")
+    elif mode.lower() in ("gray", "grey", "l"):
+        img = img.convert("L")
+    arr = np.asarray(img, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(jnp.asarray(arr))

@@ -501,3 +501,144 @@ class TestErnie:
         assert losses[-1] < losses[0], losses
         logits = m(x)
         assert logits.shape == [2, 3]
+
+
+class TestApiSweepAdditions:
+    """Top-level/namespace names from the reference __all__ audit."""
+
+    def test_reference_all_coverage(self):
+        """Every name in the reference's public __all__ lists resolves here
+        (top level + the big sub-namespaces)."""
+        import re
+
+        def get_all(path):
+            try:
+                s = open(path).read()
+            except OSError:
+                return None
+            m = re.search(r"__all__\s*=\s*\[(.*?)\]", s, re.S)
+            if not m:
+                return []
+            return [a or b for a, b in
+                    re.findall(r"'([^']+)'|\"([^\"]+)\"", m.group(1))]
+
+        ref = "/root/reference/python/paddle/"
+        targets = [
+            ("__init__.py", paddle),
+            ("nn/__init__.py", paddle.nn),
+            ("nn/functional/__init__.py", paddle.nn.functional),
+            ("linalg.py", paddle.linalg),
+            ("signal.py", paddle.signal),
+            ("vision/ops.py", paddle.vision.ops),
+        ]
+        problems = {}
+        skipped = True
+        for sub, mod in targets:
+            names = get_all(ref + sub)
+            if names is None:
+                continue
+            skipped = False
+            missing = [n for n in names if not hasattr(mod, n)]
+            if missing:
+                problems[sub] = missing
+        if skipped:
+            pytest.skip("reference tree unavailable")
+        assert not problems, problems
+
+    def test_small_ops(self):
+        x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+        np.testing.assert_allclose(paddle.add_n([x, x]).numpy(),
+                                   2 * x.numpy())
+        np.testing.assert_allclose(
+            paddle.tensordot(x, x, axes=[[1], [1]]).numpy(),
+            x.numpy() @ x.numpy().T)
+        np.testing.assert_allclose(paddle.diagonal(x).numpy(),
+                                   np.diagonal(x.numpy()))
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        si = paddle.shard_index(
+            paddle.to_tensor(np.array([1, 5, 9], "int64")), 10, 2, 0)
+        np.testing.assert_array_equal(si.numpy(), [1, -1, -1])
+        np.testing.assert_allclose(paddle.reverse(x, [0]).numpy(),
+                                   x.numpy()[::-1])
+
+    def test_inplace_variants(self):
+        x = paddle.to_tensor(np.zeros((2, 1, 3), "float32"))
+        y = paddle.squeeze_(x, 1)
+        assert y is x and x.shape == [2, 3]
+        paddle.unsqueeze_(x, 0)
+        assert x.shape == [1, 2, 3]
+        t = paddle.to_tensor(np.array([0.5], "float32"))
+        paddle.tanh_(t)
+        np.testing.assert_allclose(t.numpy(), np.tanh([0.5]), rtol=1e-6)
+
+    def test_gather_tree(self):
+        ids = paddle.to_tensor(np.array(
+            [[[2, 5]], [[3, 6]], [[4, 7]]], "int64"))     # (T=3, B=1, beam=2)
+        parents = paddle.to_tensor(np.array(
+            [[[0, 0]], [[0, 0]], [[1, 0]]], "int64"))
+        out = paddle.nn.functional.gather_tree(ids, parents).numpy()
+        # beam 0 at t=2 came from parent 1: path = ids via parent chain
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 6, 4])
+
+    def test_spectral_norm(self):
+        paddle.seed(0)
+        sn = nn.SpectralNorm([4, 6], dim=0, power_iters=8)
+        w = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 6).astype("float32") * 3)
+        out = sn(w)
+        s = np.linalg.svd(w.numpy(), compute_uv=False)[0]
+        s_out = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+        np.testing.assert_allclose(s_out, 1.0, rtol=0.05)
+        np.testing.assert_allclose(out.numpy() * s, w.numpy(), rtol=0.05,
+                                   atol=0.05)
+
+    def test_hsigmoid_loss(self):
+        paddle.seed(0)
+        feat, ncls = 8, 6
+        layer = nn.HSigmoidLoss(feat, ncls)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, feat).astype("float32"))
+        x.stop_gradient = False
+        lb = paddle.to_tensor(np.array([0, 2, 4, 5], "int64"))
+        loss = layer(x, lb)
+        total = loss.sum()
+        total.backward()
+        assert float(total.numpy()) > 0
+        assert x.grad is not None
+
+    def test_linalg_cond_inv(self):
+        a = np.diag([4.0, 1.0]).astype("float32")
+        assert abs(float(paddle.linalg.cond(
+            paddle.to_tensor(a)).numpy()) - 4.0) < 1e-4
+        np.testing.assert_allclose(
+            paddle.linalg.inv(paddle.to_tensor(a)).numpy(),
+            np.linalg.inv(a), atol=1e-6)
+
+    def test_read_file_decode_jpeg(self, tmp_path):
+        PIL = pytest.importorskip("PIL")
+        from PIL import Image
+        img = Image.fromarray(
+            (np.random.RandomState(0).rand(8, 6, 3) * 255).astype("uint8"))
+        fp = str(tmp_path / "t.jpg")
+        img.save(fp)
+        raw = paddle.vision.ops.read_file(fp)
+        assert raw.numpy().dtype == np.uint8 and raw.shape[0] > 0
+        out = paddle.vision.ops.decode_jpeg(raw, mode="rgb")
+        assert out.shape == [3, 8, 6]
+
+    def test_inplace_ops_participate_in_autograd(self):
+        # regression: in-place rebind used to drop the tape node
+        w = paddle.to_tensor(np.ones((3, 2), "float32"))
+        w.stop_gradient = False
+        y = w * 2.0
+        paddle.scatter_(y, paddle.to_tensor(np.array([0], "int64")),
+                        paddle.to_tensor(np.zeros((1, 2), "float32")))
+        y.sum().backward()
+        np.testing.assert_allclose(
+            w.grad.numpy(), [[0, 0], [2, 2], [2, 2]])
+
+    def test_inplace_on_leaf_requiring_grad_raises(self):
+        w = paddle.to_tensor(np.ones((2,), "float32"))
+        w.stop_gradient = False
+        with pytest.raises(RuntimeError):
+            paddle.tanh_(w)
