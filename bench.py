@@ -70,10 +70,24 @@ def _param_count(model):
 
 
 def _apply_dtype(model):
-    if os.environ.get("BENCH_DTYPE", "bf16") == "bf16":
+    """bf16: params+compute bf16 (TPU-native regime).
+    amp:  f32 master params, bf16 compute via auto_cast (the regime the
+          A100 fp16+fp32-master baselines use).
+    f32:  everything f32."""
+    mode = os.environ.get("BENCH_DTYPE", "bf16")
+    if mode == "bf16":
         model.bfloat16()
         return "bf16"
-    return "f32"
+    return "amp" if mode == "amp" else "f32"
+
+
+def _fwd_ctx(precision):
+    import contextlib
+
+    import paddle_tpu as paddle
+    if precision == "amp":
+        return paddle.amp.auto_cast(dtype="bfloat16")
+    return contextlib.nullcontext()
 
 
 def _timed_steps(step, args, steps, warmup=5):
@@ -170,7 +184,8 @@ def bench_bert():
 
     @paddle.jit.to_static
     def step(xx, yy):
-        loss = model(xx, labels=yy)
+        with _fwd_ctx(precision):
+            loss = model(xx, labels=yy)
         loss.backward()
         opt.step()
         opt.clear_grad()
@@ -212,7 +227,9 @@ def bench_resnet50():
 
     @paddle.jit.to_static
     def step(xx, yy):
-        loss = F.cross_entropy(model(xx).astype("float32"), yy)
+        with _fwd_ctx(precision):
+            out = model(xx)
+        loss = F.cross_entropy(out.astype("float32"), yy)
         loss.backward()
         opt.step()
         opt.clear_grad()
@@ -261,7 +278,8 @@ def bench_gpt():
 
     @paddle.jit.to_static
     def step(xx, yy):
-        loss = model(xx, labels=yy)
+        with _fwd_ctx(precision):
+            loss = model(xx, labels=yy)
         loss.backward()
         opt.step()
         opt.clear_grad()

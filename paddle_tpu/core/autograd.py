@@ -69,13 +69,18 @@ class GradNode:
     multi_output: whether forward returned a tuple (vjp cotangent structure)
     """
 
-    __slots__ = ("vjp_fn", "inputs", "out_meta", "multi_output", "name")
+    __slots__ = ("vjp_fn", "inputs", "out_meta", "multi_output", "name",
+                 "input_versions")
 
     def __init__(self, vjp_fn, inputs, out_meta, multi_output, name):
         self.vjp_fn = vjp_fn
         self.inputs = inputs
         self.out_meta = out_meta
         self.multi_output = multi_output
+        # snapshot of each input's in-place version (tensor_version check
+        # parity: backward must fail loudly if an input was later mutated
+        # in place, instead of silently differentiating the wrong graph)
+        self.input_versions = [getattr(t, "_version", 0) for t in inputs]
         self.name = name
 
     def release(self):
@@ -181,6 +186,13 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             c = slots.get(i)
             cots.append(c if c is not None else jnp.zeros(shape, dtype=dtype))
         cot = tuple(cots) if node.multi_output else cots[0]
+        for t, ver in zip(node.inputs, node.input_versions):
+            if getattr(t, "_version", 0) != ver:
+                raise RuntimeError(
+                    f"tensor used by operator '{node.name}' was modified by "
+                    f"an in-place operation before backward ran (version "
+                    f"{getattr(t, '_version', 0)} != {ver}); clone() the "
+                    f"tensor before the in-place op")
         in_grads = node.vjp_fn(cot)
         for t, g in zip(node.inputs, in_grads):
             nxt = t._grad_node

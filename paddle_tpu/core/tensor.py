@@ -83,6 +83,7 @@ class Tensor:
         "trainable",
         "_hooks",
         "dist_attr",   # auto_parallel annotation (DistAttr), set lazily
+        "_version",    # in-place mutation counter (tensor_version parity)
         "__weakref__",
     )
 
@@ -110,6 +111,7 @@ class Tensor:
         self.persistable = False
         self.trainable = True
         self._hooks = None
+        self._version = 0
         if _TraceHooks.on_create is not None:
             _TraceHooks.on_create(self)
 
@@ -404,6 +406,12 @@ def inplace_assign(x, out):
         snap._grad_node = x._grad_node
         snap._out_index = x._out_index
         node.inputs = [snap if t is x else t for t in node.inputs]
+        if hasattr(node, "input_versions"):
+            node.input_versions = [getattr(t, "_version", 0)
+                                   for t in node.inputs]
+    # bump the version: any EARLIER op that captured x as a tape input will
+    # refuse to backprop through the mutated value (tensor_version check)
+    x._version += 1
     x._value = out._val
     x._grad_node = node
     x._out_index = getattr(out, "_out_index", None)

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from ..core.dispatch import apply, unwrap
 from ..core.tensor import Tensor
 
-__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+__all__ = ["Decoder", "BeamSearchDecoder",
+           "TransformerBeamSearchDecoder", "dynamic_decode"]
 
 
 class Decoder:
@@ -36,6 +37,20 @@ class Decoder:
     @property
     def tracks_own_finished(self):
         return False
+
+
+def _backtrack(tk, pr):
+    """Parent-pointer walk shared by BeamSearchDecoder.finalize and
+    F.gather_tree: (T, B, beam) token/parent arrays -> (T, B, beam) full
+    sequences in final beam order."""
+    T, batch, beam = tk.shape
+    cur = jnp.broadcast_to(jnp.arange(beam, dtype=pr.dtype)[None],
+                           (batch, beam))
+    seqs = []
+    for t in range(T - 1, -1, -1):
+        seqs.append(jnp.take_along_axis(tk[t], cur, axis=1))
+        cur = jnp.take_along_axis(pr[t], cur, axis=1)
+    return jnp.stack(seqs[::-1])
 
 
 def _tile_beam(v, beam_size):
@@ -160,16 +175,7 @@ class BeamSearchDecoder(Decoder):
 
         def prim(*flat):
             t = len(flat) // 2
-            tk = jnp.stack(flat[:t])          # (T, B, beam)
-            pr = jnp.stack(flat[t:])
-            T, batch, beam = tk.shape
-            # walk parents backwards from the final beam order
-            cur = jnp.broadcast_to(jnp.arange(beam)[None], (batch, beam))
-            seqs = []
-            for step_i in range(T - 1, -1, -1):
-                seqs.append(jnp.take_along_axis(tk[step_i], cur, axis=1))
-                cur = jnp.take_along_axis(pr[step_i], cur, axis=1)
-            out = jnp.stack(seqs[::-1])       # (T, B, beam)
+            out = _backtrack(jnp.stack(flat[:t]), jnp.stack(flat[t:]))
             return jnp.transpose(out, (1, 0, 2))
 
         return apply(prim, *toks, *parents, name="beam_finalize"), final_states
@@ -201,3 +207,92 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=Fal
     if return_length:
         return preds, final_states, final_states["lengths"]
     return preds, final_states
+
+
+class TransformerBeamSearchDecoder(BeamSearchDecoder):
+    """Beam search over a transformer decode step (reference
+    fluid/layers/rnn.py + paddle.nn TransformerBeamSearchDecoder wrapper):
+    the "cell" is `fn(token_ids, caches) -> (logits, new_caches)` where
+    caches is the nested [layer][Cache(k, v)] structure produced by
+    TransformerDecoder.gen_cache. Cache tensors carry a leading batch axis
+    that this decoder tiles/gathers per beam (var_dim_in_state parity)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 var_dim_in_state=2):
+        # var_dim_in_state is accepted for reference-API compatibility; the
+        # cache layout here keeps batch*beam on the leading axis, so no
+        # per-dim transposition is needed
+        super().__init__(cell, start_token, end_token, beam_size)
+
+    @staticmethod
+    def _flatten_caches(caches):
+        flat, spec = [], []
+        for layer_cache in caches:
+            if isinstance(layer_cache, (tuple, list)) and not hasattr(
+                    layer_cache, "_fields"):
+                entry = []
+                for c in layer_cache:
+                    entry.append(type(c))
+                    flat.extend([c.k, c.v])
+                spec.append(entry)
+            else:
+                spec.append([type(layer_cache)])
+                flat.extend([layer_cache.k, layer_cache.v])
+        return flat, spec
+
+    @staticmethod
+    def _rebuild_caches(flat, spec):
+        out = []
+        i = 0
+        for entry in spec:
+            rebuilt = []
+            for ctype in entry:
+                rebuilt.append(ctype(flat[i], flat[i + 1]))
+                i += 2
+            out.append(rebuilt if len(rebuilt) > 1 else rebuilt[0])
+        return out
+
+    def initialize(self, initial_caches):
+        """Caches arrive ALREADY beam-tiled (the caller built them from
+        tile_beam_merge_with_batch'd memory, the reference flow) — so unlike
+        the RNN path, no re-tiling happens here."""
+        flat, self._spec = self._flatten_caches(initial_caches)
+        self._single_state = False
+        beam = self.beam_size
+        batch_beam = int(unwrap(flat[0]).shape[0])
+        if batch_beam % beam:
+            raise ValueError(
+                f"cache leading dim {batch_beam} is not a multiple of "
+                f"beam_size {beam}; tile memory with "
+                f"tile_beam_merge_with_batch before gen_cache")
+        batch = batch_beam // beam
+        lp0 = np.full((batch, beam), -1e9, np.float32)
+        lp0[:, 0] = 0.0
+        init = {
+            "cell_states": tuple(flat),
+            "log_probs": Tensor(jnp.asarray(lp0)),
+            "finished": Tensor(jnp.zeros((batch, beam), jnp.bool_)),
+            "lengths": Tensor(jnp.zeros((batch, beam), jnp.int32)),
+        }
+        ids = Tensor(jnp.full((batch_beam,), self.start_token, jnp.int32))
+        return ids, init
+
+    def step(self, time, inputs, states, **kwargs):
+        beam = self.beam_size
+        caches = self._rebuild_caches(list(states["cell_states"]), self._spec)
+        logits, new_caches = self.cell(inputs, caches)
+        flat_new, _ = self._flatten_caches(new_caches)
+
+        # reuse the parent's beam-search arithmetic by faking a cell whose
+        # states are the flattened cache tensors (embedding_fn/output_fn are
+        # None by construction, so the parent applies logits directly)
+        saved_cell = self.cell
+
+        def fake_cell(_inputs, _states):
+            return logits, tuple(flat_new)
+
+        self.cell = fake_cell
+        try:
+            return super().step(time, inputs, states, **kwargs)
+        finally:
+            self.cell = saved_cell

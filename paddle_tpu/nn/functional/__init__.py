@@ -71,13 +71,5 @@ def gather_tree(ids, parents):
     """Beam-search backtrack (reference operators/gather_tree_op.*): walk
     parent pointers from the last step to recover full sequences.
     ids/parents: (max_time, batch, beam) int tensors."""
-    def prim(tk, pr):
-        T, batch, beam = tk.shape
-        cur = jnp.broadcast_to(jnp.arange(beam, dtype=pr.dtype)[None],
-                               (batch, beam))
-        seqs = []
-        for t in range(T - 1, -1, -1):
-            seqs.append(jnp.take_along_axis(tk[t], cur, axis=1))
-            cur = jnp.take_along_axis(pr[t], cur, axis=1)
-        return jnp.stack(seqs[::-1])
-    return apply(prim, ids, parents, name="gather_tree")
+    from ..decode import _backtrack
+    return apply(_backtrack, ids, parents, name="gather_tree")

@@ -213,14 +213,21 @@ class SpectralNorm(Layer):
         dim, iters, eps = self._dim, self._power_iters, self._eps
 
         def prim(wt, u, v):
+            import jax
             perm = (dim,) + tuple(i for i in range(wt.ndim) if i != dim)
             mat = jnp.transpose(wt, perm).reshape(wt.shape[dim], -1)
+            # power iteration runs OUTSIDE the grad path: the reference op
+            # treats the saved u/v as constants when differentiating
+            # sigma = u^T W v (spectral_norm_op grad kernel)
+            mat_sg = jax.lax.stop_gradient(mat)
             uu, vv = u, v
             for _ in range(iters):
-                vv = mat.T @ uu
+                vv = mat_sg.T @ uu
                 vv = vv / (jnp.linalg.norm(vv) + eps)
-                uu = mat @ vv
+                uu = mat_sg @ vv
                 uu = uu / (jnp.linalg.norm(uu) + eps)
+            uu = jax.lax.stop_gradient(uu)
+            vv = jax.lax.stop_gradient(vv)
             sigma = uu @ mat @ vv
             return wt / sigma, uu, vv
 

@@ -897,3 +897,75 @@ class TestCollectiveOracles:
         out = _shard_run(lambda t: dist.send(t), self.x,
                          P("data", None), P("data", None))
         np.testing.assert_allclose(out, np.roll(self.x, 1, axis=0), rtol=1e-6)
+
+
+class TestMpAllreduceAndIdentity:
+    """TP helper collectives (mp_ops parity): _mp_allreduce must be
+    sum-forward / identity-backward; _c_identity the transpose. VERDICT r1
+    flagged the stop_gradient emulation as untested."""
+
+    def test_mp_allreduce_forward_sum_backward_identity(self, mesh_guard):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        build_mesh({"model": 8})
+        from paddle_tpu.distributed import collective as C
+        mesh = get_mesh()
+        g = C.new_group(axis="model")
+
+        def per_shard(x):
+            # forward via the traced _mp_allreduce path; grad wrt x must be
+            # identity (NOT multiplied by world size)
+            def fwd(v):
+                t = paddle.Tensor(v)
+                t.stop_gradient = False
+                out = C._mp_allreduce(t, group=g)
+                return (out * out).sum()._val if hasattr(
+                    (out * out).sum(), "_val") else (out * out).sum()
+
+            val, grad = jax.value_and_grad(fwd)(x)
+            return val.reshape(1), grad
+
+        xs = jnp.arange(8.0, dtype=jnp.float32).reshape(8, 1)
+        vals, grads = shard_map(
+            per_shard, mesh=mesh,
+            in_specs=P("model", None),
+            out_specs=(P("model"), P("model", None)))(xs)
+        s = float(jnp.arange(8.0).sum())          # 28
+        np.testing.assert_allclose(np.asarray(vals), s * s, rtol=1e-6)
+        # d/dx_i of (psum x)^2 with identity backward = 2 * psum(x)
+        np.testing.assert_allclose(
+            np.asarray(grads).ravel(), [2 * s] * 8, rtol=1e-6)
+
+    def test_c_identity_backward_allreduces(self, mesh_guard):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        build_mesh({"model": 8})
+        from paddle_tpu.distributed import collective as C
+        mesh = get_mesh()
+        g = C.new_group(axis="model")
+
+        def per_shard(x, w):
+            def fwd(wv):
+                t = paddle.Tensor(wv)
+                t.stop_gradient = False
+                ident = C._c_identity(t, group=g)
+                # per-shard loss uses a DIFFERENT input slice
+                return (ident * x).sum() if not hasattr(
+                    (ident * x).sum(), "_val") else (ident * x).sum()._val
+
+            return jax.grad(fwd)(w)
+
+        xs = jnp.arange(8.0, dtype=jnp.float32).reshape(8, 1)
+        w = jnp.ones((1,), jnp.float32)
+        grads = shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P("model", None), P(None)),
+            out_specs=P(None))(xs, w)
+        # backward all-reduce: every shard's grad = sum over shards of x_i
+        np.testing.assert_allclose(np.asarray(grads), [28.0], rtol=1e-6)

@@ -642,3 +642,58 @@ class TestApiSweepAdditions:
         w.stop_gradient = False
         with pytest.raises(RuntimeError):
             paddle.tanh_(w)
+
+
+class TestTransformerBeamSearch:
+    def _setup(self, beam):
+        paddle.seed(0)
+        D, V, B = 16, 11, 2
+        emb = nn.Embedding(V, D)
+        dec_layer = nn.TransformerDecoderLayer(D, 2, 32, dropout=0.0)
+        decoder = nn.TransformerDecoder(dec_layer, 2)
+        proj = nn.Linear(D, V)
+        memory = paddle.to_tensor(
+            np.random.RandomState(0).randn(B, 5, D).astype("float32"))
+
+        def cell(ids, caches):
+            x = emb(ids).unsqueeze(1)
+            out, new_caches = decoder(x, cell.memory, cache=caches)
+            return proj(out[:, 0]), new_caches
+
+        if beam > 1:
+            mem = nn.BeamSearchDecoder.tile_beam_merge_with_batch(memory,
+                                                                  beam)
+        else:
+            mem = memory
+        cell.memory = mem
+        return cell, decoder, memory, mem, B
+
+    def test_shapes_and_beam1_greedy_parity(self):
+        cell, decoder, memory, mem, B = self._setup(3)
+        tbd = nn.TransformerBeamSearchDecoder(cell, 1, 0, 3)
+        preds, _ = nn.dynamic_decode(tbd, inits=decoder.gen_cache(mem),
+                                     max_step_num=6)
+        assert preds.shape[0] == B and preds.shape[2] == 3
+
+        cell1, decoder1, memory1, mem1, _ = self._setup(1)
+        tbd1 = nn.TransformerBeamSearchDecoder(cell1, 1, 0, 1)
+        preds1, _ = nn.dynamic_decode(tbd1, inits=decoder1.gen_cache(mem1),
+                                      max_step_num=6)
+        caches = decoder1.gen_cache(memory1)
+        tok = paddle.to_tensor(np.full((B,), 1, "int32"))
+        greedy = []
+        for _ in range(6):
+            logits, caches = cell1(tok, caches)
+            tok = paddle.to_tensor(
+                np.argmax(logits.numpy(), -1).astype("int32"))
+            greedy.append(int(tok.numpy()[0]))
+            if greedy[-1] == 0:
+                break
+        assert preds1.numpy()[0, :len(greedy), 0].tolist() == greedy
+
+    def test_untiled_cache_raises(self):
+        cell, decoder, memory, _, _ = self._setup(1)
+        tbd = nn.TransformerBeamSearchDecoder(cell, 1, 0, 3)
+        with pytest.raises(ValueError):
+            nn.dynamic_decode(tbd, inits=decoder.gen_cache(memory),
+                              max_step_num=2)
