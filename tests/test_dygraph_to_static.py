@@ -225,3 +225,20 @@ class TestRunSteps:
             step.run_steps(
                 paddle.to_tensor(np.zeros((3, 4, 3, 8, 8), "float32")),
                 paddle.to_tensor(np.zeros((5, 4), "int64")))
+
+    def test_run_steps_threads_rng_state(self):
+        """Dropout inside a scanned step must draw a fresh mask per step
+        (the RNG key is mutated state threading through the scan carry)."""
+        import paddle_tpu.nn.functional as F
+        paddle.seed(7)
+        drop = nn.Dropout(0.5)
+        drop.train()
+
+        @paddle.jit.to_static
+        def step(x):
+            return drop(x).sum()
+
+        X = paddle.to_tensor(np.ones((8, 1, 64), "float32"))
+        sums = step.run_steps(X).numpy()
+        # masks differ across steps: the per-step sums are not all equal
+        assert len(set(np.round(np.asarray(sums, np.float64), 4))) > 1, sums
