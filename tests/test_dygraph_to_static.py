@@ -229,7 +229,6 @@ class TestRunSteps:
     def test_run_steps_threads_rng_state(self):
         """Dropout inside a scanned step must draw a fresh mask per step
         (the RNG key is mutated state threading through the scan carry)."""
-        import paddle_tpu.nn.functional as F
         paddle.seed(7)
         drop = nn.Dropout(0.5)
         drop.train()
@@ -239,6 +238,8 @@ class TestRunSteps:
             return drop(x).sum()
 
         X = paddle.to_tensor(np.ones((8, 1, 64), "float32"))
-        sums = step.run_steps(X).numpy()
-        # masks differ across steps: the per-step sums are not all equal
-        assert len(set(np.round(np.asarray(sums, np.float64), 4))) > 1, sums
+        sums = np.asarray(step.run_steps(X).numpy(), np.float64)
+        # steps 0-1 run eagerly (discovery); ONLY the scanned region proves
+        # the carry threads the key — assert within sums[2:]
+        scanned = np.round(sums[2:], 4)
+        assert len(set(scanned)) > 1, sums
