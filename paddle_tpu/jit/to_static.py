@@ -244,6 +244,13 @@ class pause_donation:
         return False
 
 
+def _discovery_passes():
+    """1 (default): one eager pass + traced set-extension fixpoint.
+    2 (PADDLE_TPU_TWO_PASS_DISCOVERY=1): legacy two eager passes."""
+    import os
+    return 2 if os.environ.get("PADDLE_TPU_TWO_PASS_DISCOVERY") == "1" else 1
+
+
 class StaticFunction:
     """Callable wrapper (program_translator.py:234 StaticFunction parity)."""
 
@@ -287,9 +294,11 @@ class StaticFunction:
         The program's mutated state (parameters, optimizer moments, BN stats,
         RNG keys) is threaded step-to-step through `lax.scan`, so the result
         is bit-identical to calling the function K times — minus K-1 host
-        round-trips. Returns the function's outputs stacked on a leading K
-        axis (outputs are non-differentiable; split train/eval phases into
-        separate to_static functions if you need outer gradients).
+        round-trips. The first invocation runs the discovery pass(es)
+        eagerly (one by default; see _discovery_passes) and scans the rest.
+        Returns the function's outputs stacked on a leading K axis (outputs
+        are non-differentiable; split train/eval phases into separate
+        to_static functions if you need outer gradients).
 
         TPU rationale: host→device dispatch latency dominates small/medium
         step times (SURVEY.md §2.8 names the per-op interpreter loop as the
@@ -371,7 +380,7 @@ class StaticFunction:
         i = 0
         while i < k:
             prog = self._programs.get(key)
-            if prog is not None and prog.stage >= 2:
+            if prog is not None and prog.stage >= _discovery_passes():
                 break
             ai, kwi = step_slice(i)
             eager_outs.append(self(*ai, **kwi))
@@ -472,7 +481,8 @@ class StaticFunction:
             return self._fn(*args, **kwargs)
         key = (_sig_of(args), _sig_of(kwargs), autograd.is_grad_enabled())
         prog = self._programs.get(key)
-        if prog is not None and prog.stage >= 2 and prog.jitted is not None:
+        if (prog is not None and prog.stage >= _discovery_passes()
+                and prog.jitted is not None):
             if _enter_fast_path():
                 try:
                     return self._run(prog, args, kwargs)
@@ -480,11 +490,13 @@ class StaticFunction:
                     _exit_fast_path()
         with _compile_guard():
             prog = self._programs.get(key)
-            # Two eager discovery calls: the first warms lazily-created
-            # state (optimizer accumulators, RNG splits); the second
-            # records the steady-state capture/mutation sets. Compile on
-            # the third call.
-            if prog is None or prog.stage < 2:
+            # ONE eager discovery call warms lazily-created state (optimizer
+            # accumulators, RNG splits) and records a first capture/mutation
+            # guess; _build then closes the sets with a ZERO-FLOP traced
+            # fixpoint (jax.eval_shape probes catch state the eager pass
+            # classified as created-inside). PADDLE_TPU_TWO_PASS_DISCOVERY=1
+            # restores the old two-eager-pass scheme.
+            if prog is None or prog.stage < _discovery_passes():
                 return self._discover(key, args, kwargs)
             if prog.jitted is None:
                 self._build(prog, args, kwargs)
@@ -518,31 +530,56 @@ class StaticFunction:
         return out
 
     # -- phase B ---------------------------------------------------------------
-    def _build(self, prog, args, kwargs):
+    def _make_pure_fn(self, prog, args, kwargs, probe=None):
+        """Build pure_fn over prog's CURRENT capture sets.
+
+        probe: optional dict with "reads"/"writes"/"promote" sets — when
+        given, the traced run records stray reads (tensors touched but not
+        inputs), stray writes, and writes to read-only inputs, so the
+        discovery fixpoint can extend the sets (zero FLOPs: only used under
+        jax.eval_shape).
+        """
         fn = self._fn
-        mutated, ro = prog.mutated, prog.ro
+        mutated, ro = list(prog.mutated), list(prog.ro)
         arg_tensors = _flatten_tensors((args, kwargs), [])
-        n_outs = prog.n_outs
 
         def pure_fn(mut_vals, ro_vals, arg_vals):
             all_t = mutated + ro + arg_tensors
             all_ids = {id(t) for t in all_t}
+            ro_ids = {id(t) for t in ro}
             saved = [t._val for t in all_t]
+            created = set()
             # safety net: the trace may write tensors the discovery pass did
             # not see (rare dynamic state); snapshot-before-write and restore,
             # so no tracer ever leaks out of the trace.
             stray = {}
 
-            def track_write(t, new_value=None):
+            def track_create(t):
+                created.add(id(t))
+
+            def track_read(t):
+                if t._trace_transparent:
+                    return
                 i = id(t)
-                if i not in all_ids and i not in stray:
+                if i not in all_ids and i not in created:
+                    probe["reads"][i] = t
+
+            def track_write(t, new_value=None):
+                if t._trace_transparent:
+                    return  # static-graph Variables are never jit state
+                i = id(t)
+                if i not in all_ids and i not in created and i not in stray:
                     stray[i] = (t, t._val)
+                    if probe is not None:
+                        probe["writes"][i] = t
+                elif probe is not None and i in ro_ids:
+                    probe["promote"][i] = t
 
             prev_hooks = (_TraceHooks.on_read, _TraceHooks.on_write,
                           _TraceHooks.on_create)
-            _TraceHooks.on_read = None
+            _TraceHooks.on_read = track_read if probe is not None else None
             _TraceHooks.on_write = track_write
-            _TraceHooks.on_create = None
+            _TraceHooks.on_create = track_create if probe is not None else None
             try:
                 for t, v in zip(mutated, mut_vals):
                     t._val = v
@@ -562,6 +599,44 @@ class StaticFunction:
                 for t, v in stray.values():
                     t._val = v
 
+        return pure_fn
+
+    def _build(self, prog, args, kwargs):
+        arg_tensors = _flatten_tensors((args, kwargs), [])
+
+        def aval(t):
+            return jax.ShapeDtypeStruct(tuple(t._val.shape), t._val.dtype)
+
+        if _discovery_passes() < 2:
+            # traced set-extension fixpoint: the single eager pass classified
+            # lazily-created state (optimizer moments, grad accumulators
+            # surviving across steps) as created-inside; abstract probes
+            # (no FLOPs, no compile) surface them as stray reads/writes
+            for _ in range(5):
+                probe = {"reads": {}, "writes": {}, "promote": {}}
+                probe_fn = self._make_pure_fn(prog, args, kwargs, probe=probe)
+                jax.eval_shape(probe_fn,
+                               tuple(aval(t) for t in prog.mutated),
+                               tuple(aval(t) for t in prog.ro),
+                               tuple(aval(t) for t in arg_tensors))
+                if not (probe["reads"] or probe["writes"]
+                        or probe["promote"]):
+                    break
+                written = set(probe["writes"]) | set(probe["promote"])
+                prog.mutated = prog.mutated + [
+                    t for i, t in {**probe["writes"],
+                                   **probe["promote"]}.items()]
+                prog.ro = ([t for t in prog.ro if id(t) not in written]
+                           + [t for i, t in probe["reads"].items()
+                              if i not in written])
+            else:
+                raise RuntimeError(
+                    "to_static discovery did not converge: the traced "
+                    "probes kept finding new state; set "
+                    "PADDLE_TPU_TWO_PASS_DISCOVERY=1 to fall back to "
+                    "eager discovery")
+
+        pure_fn = self._make_pure_fn(prog, args, kwargs)
         prog.pure_fn = pure_fn
         prog.jitted = jax.jit(pure_fn)
         from ..framework.flags import get_flag

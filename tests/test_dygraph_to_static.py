@@ -239,7 +239,8 @@ class TestRunSteps:
 
         X = paddle.to_tensor(np.ones((8, 1, 64), "float32"))
         sums = np.asarray(step.run_steps(X).numpy(), np.float64)
-        # steps 0-1 run eagerly (discovery); ONLY the scanned region proves
-        # the carry threads the key — assert within sums[2:]
+        # leading steps run eagerly (discovery; count depends on the
+        # discovery mode) — ONLY the scanned region proves the carry
+        # threads the key, so assert within sums[2:]
         scanned = np.round(sums[2:], 4)
         assert len(set(scanned)) > 1, sums
