@@ -191,7 +191,16 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
     init = attr.initializer or default_initializer
     if init is None:
         init = I.Constant(0.0) if is_bias else I.XavierNormal()
-    value = init(list(shape), convert_dtype(dtype))
+    # parameters materialize eagerly even under enable_static(): they are
+    # startup-program state, not main-program ops (fluid runs initializers
+    # in the startup program)
+    from .core import dispatch as _dispatch
+    b = _dispatch.get_static_builder()
+    _dispatch.set_static_builder(None)
+    try:
+        value = init(list(shape), convert_dtype(dtype))
+    finally:
+        _dispatch.set_static_builder(b)
     prm = Parameter(value, name=name or attr.name, trainable=attr.trainable)
     return prm
 

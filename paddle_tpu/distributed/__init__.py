@@ -53,3 +53,93 @@ from . import launch_utils  # noqa: F401,E402
 from . import fleet_executor  # noqa: F401,E402  (fleet_executor actor runtime)
 from . import ps  # noqa: F401,E402  (parameter-server stack)
 from . import transpiler  # noqa: F401,E402  (legacy DistributeTranspiler shim)
+
+
+class ParallelEnv:
+    """fluid/dygraph/parallel.py ParallelEnv parity: read-only view of the
+    process's distributed context."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        import jax
+        try:
+            return jax.local_devices()[0].id
+        except RuntimeError:
+            return 0
+
+    @property
+    def current_endpoint(self):
+        import os
+        eps = os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+        return eps
+
+    @property
+    def trainer_endpoints(self):
+        import os
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    # reference aliases
+    local_rank = rank
+    nranks = world_size
+    dev_id = device_id
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Reference gloo bootstrap for CPU collectives; the single-controller
+    runtime uses jax.distributed instead — delegate to init_parallel_env."""
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """c_wait_* parity: XLA orders collectives by data dependence, so wait
+    is a host-side completion barrier on the tensor's buffer."""
+    import jax
+    from ..core.dispatch import unwrap
+    v = unwrap(tensor)
+    jax.block_until_ready(v)
+    return tensor
+
+
+class CountFilterEntry:
+    """PS sparse-table admission policy (reference entry configs): admit a
+    feature after `count` occurrences."""
+
+    def __init__(self, count=1):
+        if count < 1:
+            raise ValueError("count must be >= 1")
+        self._count = int(count)
+
+    def __str__(self):
+        return f"count_filter_entry:{self._count}"
+
+
+class ProbabilityEntry:
+    """PS sparse-table admission policy: admit with probability p."""
+
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self._probability = float(probability)
+
+    def __str__(self):
+        return f"probability_entry:{self._probability}"
+
+
+__all__ += ["ParallelEnv", "gloo_init_parallel_env", "gloo_barrier",
+            "gloo_release", "wait", "CountFilterEntry", "ProbabilityEntry"]

@@ -7,7 +7,8 @@ queries; hybrid meta_parallel layers land in .meta_parallel.
 from __future__ import annotations
 
 from .base import (  # noqa: F401
-    DistributedStrategy, Fleet, PaddleCloudRoleMaker, UserDefinedRoleMaker,
+    CommunicateTopology, DistributedStrategy, Fleet, HybridCommunicateGroup,
+    PaddleCloudRoleMaker, UserDefinedRoleMaker,
 )
 
 _fleet = Fleet()
@@ -35,3 +36,87 @@ from . import meta_optimizers  # noqa: F401,E402
 from ..checkpoint import (  # noqa: F401,E402  (hybrid save/load parity)
     load_hybrid_checkpoint, save_hybrid_checkpoint,
 )
+
+
+class Role:
+    """RoleMaker role enum parity (role_maker.py Role)."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class UtilBase:
+    """fleet.UtilBase parity: small cross-rank helpers over the collective
+    API (reference fleet/utils/fs.py + util_factory.py)."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        import numpy as np
+
+        from .. import ReduceOp, all_reduce as _ar, get_world_size
+        from ...core.tensor import Tensor
+        if get_world_size() <= 1:
+            return input
+        ops = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+               "min": ReduceOp.MIN}
+        t = Tensor(np.asarray(input))
+        _ar(t, op=ops.get(mode, ReduceOp.SUM))
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        from .. import barrier as _barrier
+        _barrier()
+
+    def all_gather(self, input, comm_world="worker"):  # noqa: A002
+        import numpy as np
+
+        from .. import all_gather as _ag, get_world_size
+        from ...core.tensor import Tensor
+        if get_world_size() <= 1:
+            return [input]
+        out = []
+        _ag(out, Tensor(np.asarray(input)))
+        return [o.numpy() for o in out]
+
+    def get_file_shard(self, files):
+        from .. import get_rank, get_world_size
+        n, r = get_world_size(), get_rank()
+        return [f for i, f in enumerate(files) if i % n == r]
+
+
+class MultiSlotDataGenerator:
+    """PS data generator parity (fleet/data_generator): subclass and
+    implement generate_sample(line) yielding [(slot_name, [ints/floats])];
+    run() streams stdin lines to slot-formatted stdout."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            for sample in self.generate_sample(line.rstrip("\n")):
+                sys.stdout.write(self._format(sample) + "\n")
+
+    # reference naming
+    run = run_from_stdin
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant: values are emitted verbatim."""
+
+
+__all__ = ["Role", "UtilBase", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator", "Fleet", "DistributedStrategy",
+           "CommunicateTopology", "HybridCommunicateGroup",
+           "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+           "init", "is_first_worker", "worker_index", "worker_num",
+           "is_worker", "worker_endpoints", "distributed_model",
+           "distributed_optimizer"]

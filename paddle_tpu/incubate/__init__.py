@@ -7,3 +7,61 @@ from .. import sparsity as asp  # noqa: F401  (fluid.contrib.sparsity parity)
 from . import checkpoint  # noqa: F401  (fluid.incubate.checkpoint parity)
 
 __all__ = ["LookAhead", "ModelAverage", "MoELayer", "nn", "asp", "checkpoint"]
+
+
+def _segment_reduce(data, segment_ids, mode):
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply, unwrap
+
+    ids_val = unwrap(segment_ids)
+    try:
+        n_seg = int(jnp.max(ids_val)) + 1 if ids_val.size else 0
+    except (jax.errors.ConcretizationTypeError, TypeError) as e:
+        raise TypeError(
+            "segment_* ops need concrete segment_ids (the output row count "
+            "max(ids)+1 is data-dependent, which jit's static shapes cannot "
+            "express); compute segments eagerly outside to_static/"
+            "enable_static") from e
+
+    def prim(d, s):
+        s = s.astype(jnp.int32)
+        if mode == "sum":
+            return jax.ops.segment_sum(d, s, num_segments=n_seg)
+        if mode == "mean":
+            tot = jax.ops.segment_sum(d, s, num_segments=n_seg)
+            cnt = jax.ops.segment_sum(jnp.ones_like(s, d.dtype), s,
+                                      num_segments=n_seg)
+            shape = (-1,) + (1,) * (d.ndim - 1)
+            return tot / jnp.maximum(cnt.reshape(shape), 1)
+        if mode == "max":
+            return jax.ops.segment_max(d, s, num_segments=n_seg)
+        return jax.ops.segment_min(d, s, num_segments=n_seg)
+
+    return apply(prim, data, segment_ids, name=f"segment_{mode}")
+
+
+def segment_sum(data, segment_ids, name=None):
+    """paddle.incubate.segment_sum parity (operators/segment_ops)."""
+    return _segment_reduce(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "min")
+
+
+from .nn import (  # noqa: F401,E402
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+)
+
+__all__ += ["segment_sum", "segment_mean", "segment_max", "segment_min",
+            "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]

