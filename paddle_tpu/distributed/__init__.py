@@ -84,7 +84,8 @@ class ParallelEnv:
     @property
     def trainer_endpoints(self):
         import os
-        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
 
     # reference aliases
     local_rank = rank

@@ -120,3 +120,8 @@ __all__ = ["Role", "UtilBase", "MultiSlotDataGenerator",
            "init", "is_first_worker", "worker_index", "worker_num",
            "is_worker", "worker_endpoints", "distributed_model",
            "distributed_optimizer"]
+# every other module-level public name stays exported (the module predates
+# __all__; narrowing the star surface would break existing imports)
+import sys as _sys
+__all__ += [n for n in dir(_sys.modules[__name__])
+            if not n.startswith("_") and n not in __all__]

@@ -20,7 +20,7 @@ from ..core.random import next_key
 from ..core.tensor import Tensor
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
-           "Beta", "Multinomial", "kl_divergence"]
+           "Beta", "Multinomial", "kl_divergence", "MultivariateNormalDiag", "sampling_id"]
 
 
 def _keep(x):
@@ -272,3 +272,64 @@ def kl_divergence(p, q):
         return apply(prim, p.low, p.high, q.low, q.high, name="kl_uniform")
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+class MultivariateNormalDiag(Distribution):
+    """fluid.layers.distributions MultivariateNormalDiag parity: Normal with
+    diagonal covariance (loc vector + diag scale vector)."""
+
+    def __init__(self, loc, scale):
+        super().__init__()
+        self._n = Normal(loc, scale)
+        self.loc = self._n.loc
+        self.scale = self._n.scale
+
+    def sample(self, shape=()):
+        return self._n.sample(shape)
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        from ..core.dispatch import apply
+        per = self._n.log_prob(value)
+        return apply(lambda v: jnp.sum(v, axis=-1), per,
+                     name="mvn_diag_logprob")
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        from ..core.dispatch import apply
+        per = self._n.entropy()
+        return apply(lambda v: jnp.sum(v, axis=-1), per,
+                     name="mvn_diag_entropy")
+
+    def kl_divergence(self, other):
+        import jax.numpy as jnp
+
+        from ..core.dispatch import apply
+        per = self._n.kl_divergence(other._n if isinstance(
+            other, MultivariateNormalDiag) else other)
+        return apply(lambda v: jnp.sum(v, axis=-1), per, name="mvn_diag_kl")
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):  # noqa: A002
+    """fluid.layers.sampling_id parity: sample a category index per row from
+    the given probability matrix."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+    from ..core.random import next_key_data
+
+    key_data = next_key_data()
+
+    def prim(p, kd):
+        if hasattr(jax.random, "wrap_key_data"):
+            key = jax.random.wrap_key_data(kd)
+        else:  # derive a key from the data so repeated calls still vary
+            key = jax.random.PRNGKey(
+                jnp.asarray(kd).ravel()[0].astype(jnp.uint32))
+        logits = jnp.log(jnp.maximum(p, 1e-12))
+        return jax.random.categorical(key, logits, axis=-1).astype(dtype)
+
+    return apply(prim, x, key_data, name="sampling_id")

@@ -10,7 +10,7 @@ from .core.tensor import Tensor
 __all__ = [
     "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
     "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "hfft2", "ihfft2",
-    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift", "hfftn", "ihfftn",
 ]
 
 
@@ -89,3 +89,39 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), x,
                  name="ifftshift")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-d FFT of a Hermitian-symmetric signal (reference fft.hfftn)."""
+    import jax.numpy as jnp
+
+    from .core.dispatch import apply
+
+    def prim(v):
+        ax = tuple(axes) if axes is not None else tuple(range(v.ndim))
+        out = v
+        for a in ax[:-1]:
+            out = jnp.fft.fft(out, axis=a,
+                              n=None if s is None else s[ax.index(a)])
+        n_last = None if s is None else s[-1]
+        out = jnp.fft.hfft(out, axis=ax[-1], n=n_last, norm=norm)
+        return out
+
+    return apply(prim, x, name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    import jax.numpy as jnp
+
+    from .core.dispatch import apply
+
+    def prim(v):
+        ax = tuple(axes) if axes is not None else tuple(range(v.ndim))
+        out = jnp.fft.ihfft(v, axis=ax[-1],
+                            n=None if s is None else s[-1], norm=norm)
+        for a in ax[:-1]:
+            out = jnp.fft.ifft(out, axis=a,
+                               n=None if s is None else s[ax.index(a)])
+        return out
+
+    return apply(prim, x, name="ihfftn")

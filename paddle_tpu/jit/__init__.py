@@ -2,3 +2,45 @@ from .to_static import TracedLayer, functionalized_call, not_to_static, to_stati
 from .save_load import load, save  # noqa: F401
 
 __all__ = ["to_static", "not_to_static", "TracedLayer", "save", "load"]
+
+
+class ProgramTranslator:
+    """dygraph_to_static ProgramTranslator parity: global switch for
+    to_static conversion (singleton, enable(False) makes decorated
+    functions run eagerly)."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self.enable_to_static = True
+
+    def enable(self, enable_to_static):
+        self.enable_to_static = bool(enable_to_static)
+        from .to_static import StaticFunction
+        StaticFunction._default_enabled = bool(enable_to_static)
+
+
+def enable_to_static(enable=True):
+    ProgramTranslator.get_instance().enable(enable)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Transformed-code logging verbosity (dygraph_to_static logging_utils
+    parity) — recorded; the functionalizer does no AST codegen to dump."""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(logging.DEBUG)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level else logging.WARNING)
+
+
+from .save_load import TranslatedLayer  # noqa: E402,F401

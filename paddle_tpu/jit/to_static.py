@@ -263,7 +263,10 @@ class StaticFunction:
         self._fn = apply_ast_transforms(fn)
         self._input_spec = input_spec
         self._programs = {}
-        self._enabled = True
+        self._enabled = True  # per-function; see also _default_enabled
+
+    # global to_static switch (ProgramTranslator.enable parity)
+    _default_enabled = True
 
     def __get__(self, instance, owner):
         if instance is None:
@@ -477,7 +480,7 @@ class StaticFunction:
             prog.scanned_donate = prog.scanned
 
     def __call__(self, *args, **kwargs):
-        if not self._enabled:
+        if not (self._enabled and StaticFunction._default_enabled):
             return self._fn(*args, **kwargs)
         key = (_sig_of(args), _sig_of(kwargs), autograd.is_grad_enabled())
         prog = self._programs.get(key)

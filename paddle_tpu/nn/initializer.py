@@ -13,7 +13,7 @@ from ..core.random import next_key
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "calculate_gain",
+    "Assign", "calculate_gain", "Bilinear", "set_global_initializer",
 ]
 
 
@@ -148,3 +148,38 @@ class Assign(Initializer):
         if tuple(arr.shape) != tuple(shape):
             arr = arr.reshape(tuple(shape))
         return arr
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (reference initializer.py BilinearInitializer)."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as np
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        c_out, c_in, kh, kw = shape
+        f = np.ceil(kw / 2.0)
+        center = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - abs(og[0] / f - center))
+                * (1 - abs(og[1] / f - center))).astype(dtype)
+        w = np.zeros(shape, dtype=dtype)
+        for i in range(c_out):
+            w[i, i % c_in] = filt
+        import jax.numpy as jnp
+        return jnp.asarray(w)
+
+
+_GLOBAL_INITIALIZER = [None, None]  # (weight_init, bias_init)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """reference set_global_initializer: default initializers consulted by
+    Layer.create_parameter when no per-param initializer is given."""
+    _GLOBAL_INITIALIZER[0] = weight_init
+    _GLOBAL_INITIALIZER[1] = bias_init
+
+
+def _global_initializer(is_bias):
+    return _GLOBAL_INITIALIZER[1 if is_bias else 0]

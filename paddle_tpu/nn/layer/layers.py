@@ -49,7 +49,11 @@ class Layer:
         if attr is False:
             return None
         dtype = convert_dtype(dtype) or self._dtype
-        init = attr.initializer or default_initializer
+        # precedence (reference layer_helper_base.py:324-330): ParamAttr's
+        # initializer wins; else a set_global_initializer overrides the
+        # layer's default; else the layer default; else framework default
+        g = I._global_initializer(is_bias)
+        init = attr.initializer or g or default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         value = init(shape, dtype)

@@ -297,7 +297,7 @@ def serialize_program(feed_vars=None, fetch_vars=None, program=None):
 
 
 def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
-                           executor=None):
+                           executor=None, protocol=2):
     import pickle
 
     import numpy as np
@@ -317,7 +317,7 @@ def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
                     state[name] = np.asarray(a.numpy())
                 except TypeError:
                     continue  # non-concrete value: not a persistable param
-    return pickle.dumps(state, protocol=2)
+    return pickle.dumps(state, protocol=protocol)
 
 
 def save_to_file(path, content):
@@ -348,7 +348,7 @@ def normalize_program(program, feed_vars, fetch_vars):
 def save(program, model_path, protocol=4):
     """paddle.static.save parity: persists the program's parameter state
     (.pdparams) + program IR (.pdmodel)."""
-    content = serialize_persistables(program=program)
+    content = serialize_persistables(program=program, protocol=protocol)
     save_to_file(model_path + ".pdparams", content)
     try:
         save_to_file(model_path + ".pdmodel", serialize_program(

@@ -73,3 +73,26 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     from ..hapi.dynamic_flops import flops as _flops
     return _flops(net, input_size, custom_ops=custom_ops,
                   print_detail=print_detail)
+
+
+def require_version(min_version, max_version=None):
+    """paddle.utils.require_version parity against this package's version."""
+    from .. import __version__
+
+    def parse(v):
+        import re as _re
+        out = []
+        for seg in str(v).split(".")[:3]:
+            m = _re.match(r"\d+", seg)
+            out.append(int(m.group(0)) if m else 0)
+        while len(out) < 3:
+            out.append(0)
+        return tuple(out)
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > maximum {max_version}")

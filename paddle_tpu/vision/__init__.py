@@ -1,1 +1,21 @@
 from . import datasets, models, ops, transforms  # noqa: F401
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """reference vision/image.py: pil|cv2 (cv2 unavailable here -> pil)."""
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (PIL host-side, the TPU input-pipeline decode)."""
+    from PIL import Image
+    return Image.open(path)

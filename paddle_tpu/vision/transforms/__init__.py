@@ -155,3 +155,305 @@ def hflip(img):
     arr = np.asarray(img)
     axis = 2 if _chw(arr) else 1
     return np.flip(arr, axis=axis).copy()
+
+
+class BaseTransform:
+    """transforms.BaseTransform parity: keys-aware transform base; subclasses
+    implement _apply_image (and optionally _apply_* per key)."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            return self._apply_image(inputs)
+        outs = []
+        for key, data in zip(self.keys, inputs):
+            fn = getattr(self, f"_apply_{key}", None)
+            outs.append(fn(data) if fn else data)
+        return tuple(outs)
+
+
+def _hwc_view(arr):
+    """Return (hwc_array, was_chw): transforms operate in HWC internally."""
+    if _chw(arr):
+        return np.transpose(arr, (1, 2, 0)), True
+    return arr, False
+
+
+def _restore(arr, was_chw):
+    return np.transpose(arr, (2, 0, 1)) if was_chw else arr
+
+
+def crop(img, top, left, height, width):
+    arr = np.asarray(img)
+    a, was = _hwc_view(arr)
+    out = a[top:top + height, left:left + width]
+    return _restore(out, was)
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    arr = np.asarray(img)
+    a, was = _hwc_view(arr)
+    h, w = a.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return _restore(a[top:top + th, left:left + tw], was)
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    a, was = _hwc_view(arr)
+    return _restore(a[::-1].copy(), was)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    a, was = _hwc_view(arr)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    out = np.pad(a, ((pt, pb), (pl, pr), (0, 0)) if a.ndim == 3
+                 else ((pt, pb), (pl, pr)), mode=mode, **kw)
+    return _restore(out, was)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotation via inverse affine sampling (host-side numpy)."""
+    orig_dtype = np.asarray(img).dtype
+    arr = np.asarray(img, np.float32)
+    a, was = _hwc_view(arr)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    h, w = a.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    xs = cos * (xx - cx) + sin * (yy - cy) + cx
+    ys = -sin * (xx - cx) + cos * (yy - cy) + cy
+    xi = np.round(xs).astype(np.int64)
+    yi = np.round(ys).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full_like(a, fill, dtype=a.dtype)
+    out[valid] = a[yi[valid], xi[valid]]
+    if out.shape[-1] == 1 and arr.ndim == 2:
+        out = out[:, :, 0]
+    return _restore(out.astype(orig_dtype), was)
+
+
+def to_grayscale(img, num_output_channels=1):
+    orig_dtype = np.asarray(img).dtype
+    arr = np.asarray(img, np.float32)
+    a, was = _hwc_view(arr)
+    if a.ndim == 3 and a.shape[-1] >= 3:
+        g = (0.299 * a[..., 0] + 0.587 * a[..., 1] + 0.114 * a[..., 2])
+    else:
+        g = a[..., 0] if a.ndim == 3 else a
+    out = np.repeat(g[..., None], num_output_channels, axis=-1)
+    return _restore(out.astype(orig_dtype), was)
+
+
+def adjust_brightness(img, brightness_factor):
+    orig_dtype = np.asarray(img).dtype
+    arr = np.asarray(img, np.float32)
+    hi = 255.0 if arr.max() > 2.0 else 1.0
+    return np.clip(arr * brightness_factor, 0, hi).astype(orig_dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    orig_dtype = np.asarray(img).dtype
+    arr = np.asarray(img, np.float32)
+    hi = 255.0 if arr.max() > 2.0 else 1.0
+    mean = arr.mean()
+    return np.clip((arr - mean) * contrast_factor + mean, 0,
+                   hi).astype(orig_dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    orig_dtype = np.asarray(img).dtype
+    arr = np.asarray(img, np.float32)
+    a, was = _hwc_view(arr)
+    hi = 255.0 if arr.max() > 2.0 else 1.0
+    gray = to_grayscale(a, 3) if not was else _hwc_view(
+        to_grayscale(_restore(a, was), 3))[0]
+    out = np.clip(a * saturation_factor + gray * (1 - saturation_factor),
+                  0, hi)
+    return _restore(out.astype(orig_dtype), was)
+
+
+def adjust_hue(img, hue_factor):
+    """Hue shift in HSV space (|hue_factor| <= 0.5)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    orig_dtype = np.asarray(img).dtype
+    arr = np.asarray(img, np.float32)
+    a, was = _hwc_view(arr)
+    hi = 255.0 if arr.max() > 2.0 else 1.0
+    x = a / hi
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx, mn = x.max(-1), x.min(-1)
+    diff = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    m = mx == r
+    h[m] = ((g - b) / diff)[m] % 6
+    m = mx == g
+    h[m] = ((b - r) / diff + 2)[m]
+    m = mx == b
+    h[m] = ((r - g) / diff + 4)[m]
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(np.int64) % 6
+    out = np.zeros_like(x)
+    for idx, (rr, gg, bb) in enumerate(
+            [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v),
+             (v, p, q)]):
+        m = i == idx
+        out[..., 0][m] = rr[m]
+        out[..., 1][m] = gg[m]
+        out[..., 2][m] = bb[m]
+    return _restore((out * hi).astype(orig_dtype), was)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _factor(self):
+        v = self.value
+        lo, hi = (max(0, 1 - v), 1 + v) if np.isscalar(v) else v
+        return np.random.uniform(lo, hi)
+
+    def _apply_image(self, img):
+        return adjust_brightness(img, self._factor())
+
+
+class ContrastTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        return adjust_contrast(img, self._factor())
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        return adjust_saturation(img, self._factor())
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        v = self.value
+        lo, hi = (-v, v) if np.isscalar(v) else v
+        return adjust_hue(img, np.random.uniform(lo, hi))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self._ts = []
+        if brightness:
+            self._ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self._ts.append(ContrastTransform(contrast))
+        if saturation:
+            self._ts.append(SaturationTransform(saturation))
+        if hue:
+            self._ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self._ts))
+        for i in order:
+            img = self._ts[i]._apply_image(img)
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self._args = (padding, fill, padding_mode)
+
+    def _apply_image(self, img):
+        return pad(img, *self._args)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self._kw = dict(interpolation=interpolation, expand=expand,
+                        center=center, fill=fill)
+
+    def _apply_image(self, img):
+        return rotate(img, np.random.uniform(*self.degrees), **self._kw)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.n)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        a, was = _hwc_view(arr)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                patch = a[top:top + ch, left:left + cw]
+                return self._resize(_restore(patch, was))
+        return self._resize(center_crop(_restore(a, was), min(h, w)))
+
+
+__all__ += ["BaseTransform", "RandomResizedCrop", "BrightnessTransform",
+            "SaturationTransform", "ContrastTransform", "HueTransform",
+            "ColorJitter", "Pad", "RandomRotation", "Grayscale", "vflip",
+            "pad", "rotate", "to_grayscale", "crop", "center_crop",
+            "adjust_brightness", "adjust_contrast", "adjust_saturation",
+            "adjust_hue"]

@@ -537,6 +537,20 @@ class TestApiSweepAdditions:
             ("io/__init__.py", paddle.io),
             ("metric/__init__.py", paddle.metric),
             ("amp/__init__.py", paddle.amp),
+            ("vision/__init__.py", paddle.vision),
+            ("vision/transforms/__init__.py", paddle.vision.transforms),
+            ("vision/models/__init__.py", paddle.vision.models),
+            ("vision/datasets/__init__.py", paddle.vision.datasets),
+            ("text/__init__.py", paddle.text),
+            ("utils/__init__.py", paddle.utils),
+            ("jit/__init__.py", paddle.jit),
+            ("onnx/__init__.py", paddle.onnx),
+            ("autograd/__init__.py", paddle.autograd),
+            ("distribution.py", paddle.distribution),
+            ("optimizer/__init__.py", paddle.optimizer),
+            ("optimizer/lr.py", paddle.optimizer.lr),
+            ("nn/initializer/__init__.py", paddle.nn.initializer),
+            ("fft.py", paddle.fft),
         ]
         problems = {}
         skipped = True
@@ -786,3 +800,91 @@ class TestNamespaceShims:
 
         g = Gen()
         assert g._format([("s", [1, 2])]) == "2 1 2"
+
+
+class TestTransformsFamily:
+    def _img(self):
+        return (np.random.RandomState(0).rand(3, 10, 12) * 255
+                ).astype("float32")
+
+    def test_color_ops_match_shapes_and_ranges(self):
+        T = paddle.vision.transforms
+        img = self._img()
+        for fn, arg in [(T.adjust_brightness, 1.5), (T.adjust_contrast, 0.5),
+                        (T.adjust_saturation, 2.0), (T.adjust_hue, 0.25)]:
+            out = np.asarray(fn(img, arg))
+            assert out.shape == img.shape
+            assert out.min() >= 0 and out.max() <= 255.0 + 1e-3
+        # identity factors are no-ops
+        np.testing.assert_allclose(T.adjust_brightness(img, 1.0), img)
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=1e-2)
+
+    def test_geometry_ops(self):
+        T = paddle.vision.transforms
+        img = self._img()
+        assert np.asarray(T.pad(img, 2)).shape == (3, 14, 16)
+        assert np.asarray(T.crop(img, 1, 2, 5, 6)).shape == (3, 5, 6)
+        assert np.asarray(T.center_crop(img, 6)).shape == (3, 6, 6)
+        np.testing.assert_allclose(
+            np.asarray(T.vflip(img)), img[:, ::-1])
+        # 0-degree rotation is identity (nearest sampling)
+        np.testing.assert_allclose(np.asarray(T.rotate(img, 0.0)), img)
+        assert np.asarray(T.rotate(img, 90)).shape == img.shape
+
+    def test_random_transforms_compose(self):
+        T = paddle.vision.transforms
+        np.random.seed(0)
+        t = T.Compose([T.ColorJitter(0.2, 0.2, 0.2, 0.1),
+                       T.RandomRotation(10), T.Grayscale(3),
+                       T.RandomResizedCrop(8)])
+        out = np.asarray(t(self._img()))
+        assert out.shape == (3, 8, 8)
+
+    def test_bilinear_initializer_upsamples(self):
+        w = np.asarray(paddle.nn.initializer.Bilinear()([2, 2, 4, 4]))
+        assert w.shape == (2, 2, 4, 4)
+        assert w[0, 0].max() > 0 and np.allclose(w[0, 1], 0)
+
+    def test_set_global_initializer(self):
+        from paddle_tpu.nn import initializer as I
+        I.set_global_initializer(I.Constant(0.5), I.Constant(0.1))
+        try:
+            lin = nn.Linear(3, 2)
+            np.testing.assert_allclose(lin.weight.numpy(), 0.5)
+            np.testing.assert_allclose(lin.bias.numpy(), 0.1)
+        finally:
+            I.set_global_initializer(None, None)
+
+    def test_program_translator_toggle(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x):
+            calls.append(1)
+            return x * 2
+
+        x = paddle.to_tensor(np.ones(2, "float32"))
+        paddle.jit.ProgramTranslator.get_instance().enable(False)
+        try:
+            for _ in range(4):
+                f(x)
+            assert len(calls) == 4  # ran eagerly every time
+        finally:
+            paddle.jit.ProgramTranslator.get_instance().enable(True)
+
+    def test_hfftn_ihfftn_match_scipy(self):
+        scipy_fft = pytest.importorskip("scipy.fft")
+        import paddle_tpu.fft as fft
+        x = np.random.RandomState(0).randn(4, 6).astype("float32")
+        np.testing.assert_allclose(fft.hfftn(paddle.to_tensor(x)).numpy(),
+                                   scipy_fft.hfftn(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(fft.ihfftn(paddle.to_tensor(x)).numpy(),
+                                   scipy_fft.ihfftn(x), rtol=1e-4, atol=1e-5)
+
+    def test_transforms_preserve_uint8(self):
+        T = paddle.vision.transforms
+        u8 = (np.random.RandomState(0).rand(3, 8, 8) * 255).astype("uint8")
+        for out in (T.adjust_brightness(u8, 1.2), T.adjust_contrast(u8, 0.8),
+                    T.adjust_saturation(u8, 1.5), T.adjust_hue(u8, 0.1),
+                    T.rotate(u8, 10), T.to_grayscale(u8, 3)):
+            assert np.asarray(out).dtype == np.uint8
