@@ -93,6 +93,7 @@ from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import cost_model  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
 from . import slim  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import utils  # noqa: F401,E402

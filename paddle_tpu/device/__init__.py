@@ -160,3 +160,28 @@ cuda = _DeviceNS()
 tpu = _DeviceNS()
 __all__ += ["memory_stats", "reset_max_memory_allocated",
             "set_allocator_strategy"]
+
+
+def get_cudnn_version():
+    """Reference get_cudnn_version: no cuDNN on this stack — None, matching
+    the reference's CPU-only return."""
+    return None
+
+
+XPUPlace = TPUPlace  # accelerator aliases (reference multi-vendor places)
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+__all__ += ["get_cudnn_version", "XPUPlace", "is_compiled_with_xpu",
+            "is_compiled_with_rocm", "is_compiled_with_npu"]

@@ -120,8 +120,14 @@ __all__ = ["Role", "UtilBase", "MultiSlotDataGenerator",
            "init", "is_first_worker", "worker_index", "worker_num",
            "is_worker", "worker_endpoints", "distributed_model",
            "distributed_optimizer"]
-# every other module-level public name stays exported (the module predates
-# __all__; narrowing the star surface would break existing imports)
+# every other module-level public CALLABLE/class stays exported (the module
+# predates __all__; narrowing the star surface would break existing
+# imports) — submodules and imported feature objects are not API
 import sys as _sys
-__all__ += [n for n in dir(_sys.modules[__name__])
-            if not n.startswith("_") and n not in __all__]
+import types as _types
+__all__ += [
+    n for n in dir(_sys.modules[__name__])
+    if not n.startswith("_") and n not in __all__
+    and n != "annotations"
+    and not isinstance(getattr(_sys.modules[__name__], n),
+                       _types.ModuleType)]

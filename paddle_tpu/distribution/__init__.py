@@ -321,6 +321,13 @@ def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):  # noqa: A002
     from ..core.dispatch import apply
     from ..core.random import next_key_data
 
+    if seed:  # reference contract: fixed nonzero seed -> deterministic
+        def prim_seeded(p):
+            key = jax.random.PRNGKey(seed)
+            logits = jnp.log(jnp.maximum(p, 1e-12))
+            return jax.random.categorical(key, logits, axis=-1).astype(dtype)
+        return apply(prim_seeded, x, name="sampling_id")
+
     key_data = next_key_data()
 
     def prim(p, kd):

@@ -98,13 +98,17 @@ def hfftn(x, s=None, axes=None, norm="backward", name=None):
     from .core.dispatch import apply
 
     def prim(v):
-        ax = tuple(axes) if axes is not None else tuple(range(v.ndim))
+        if axes is not None:
+            ax = tuple(axes)
+        elif s is not None:
+            ax = tuple(range(v.ndim - len(s), v.ndim))  # numpy: last len(s)
+        else:
+            ax = tuple(range(v.ndim))
+        sizes = {a: s[i] for i, a in enumerate(ax)} if s is not None else {}
         out = v
         for a in ax[:-1]:
-            out = jnp.fft.fft(out, axis=a,
-                              n=None if s is None else s[ax.index(a)])
-        n_last = None if s is None else s[-1]
-        out = jnp.fft.hfft(out, axis=ax[-1], n=n_last, norm=norm)
+            out = jnp.fft.fft(out, axis=a, n=sizes.get(a))
+        out = jnp.fft.hfft(out, axis=ax[-1], n=sizes.get(ax[-1]), norm=norm)
         return out
 
     return apply(prim, x, name="hfftn")
@@ -116,12 +120,16 @@ def ihfftn(x, s=None, axes=None, norm="backward", name=None):
     from .core.dispatch import apply
 
     def prim(v):
-        ax = tuple(axes) if axes is not None else tuple(range(v.ndim))
-        out = jnp.fft.ihfft(v, axis=ax[-1],
-                            n=None if s is None else s[-1], norm=norm)
+        if axes is not None:
+            ax = tuple(axes)
+        elif s is not None:
+            ax = tuple(range(v.ndim - len(s), v.ndim))
+        else:
+            ax = tuple(range(v.ndim))
+        sizes = {a: s[i] for i, a in enumerate(ax)} if s is not None else {}
+        out = jnp.fft.ihfft(v, axis=ax[-1], n=sizes.get(ax[-1]), norm=norm)
         for a in ax[:-1]:
-            out = jnp.fft.ifft(out, axis=a,
-                               n=None if s is None else s[ax.index(a)])
+            out = jnp.fft.ifft(out, axis=a, n=sizes.get(a))
         return out
 
     return apply(prim, x, name="ihfftn")

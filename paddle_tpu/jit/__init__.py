@@ -1,7 +1,7 @@
 from .to_static import TracedLayer, functionalized_call, not_to_static, to_static  # noqa: F401
 from .save_load import load, save  # noqa: F401
 
-__all__ = ["to_static", "not_to_static", "TracedLayer", "save", "load"]
+__all__ = ["to_static", "not_to_static", "TracedLayer", "save", "load", "ProgramTranslator", "enable_to_static", "set_code_level", "set_verbosity", "TranslatedLayer"]
 
 
 class ProgramTranslator:

@@ -14,6 +14,7 @@ from .layer.norm import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
+from . import quant, utils  # noqa: F401
 from .decode import (  # noqa: F401
     BeamSearchDecoder, Decoder, TransformerBeamSearchDecoder, dynamic_decode,
 )

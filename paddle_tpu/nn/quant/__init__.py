@@ -1,0 +1,3 @@
+"""paddle.nn.quant parity (reference exports nothing public at this
+snapshot; quant-aware training lives in paddle_tpu.slim)."""
+__all__ = []

@@ -1,0 +1,100 @@
+"""paddle.nn.utils parity (python/paddle/nn/utils): weight/spectral norm
+reparameterization hooks over Layer forward-pre hooks."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except(w, dim):
+    import jax.numpy as jnp
+    if dim is None:
+        return jnp.sqrt(jnp.sum(w * w))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `layer.<name>` as g * v / ||v|| (reference
+    weight_norm_hook.py): adds <name>_g and <name>_v parameters and
+    recomputes the weight before every forward."""
+    from ...core.dispatch import apply
+    from ...core.tensor import Parameter
+
+    w = getattr(layer, name)
+    import jax.numpy as jnp
+    g0 = np.asarray(_norm_except(w._val, dim))
+    v0 = np.asarray(w.numpy())
+    g = Parameter(g0)
+    v = Parameter(v0)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def compute():
+        def prim(gv, vv):
+            return gv * vv / jnp.maximum(_norm_except(vv, dim), 1e-12)
+        return apply(prim, g, v, name="weight_norm")
+
+    def pre_hook(lyr, inputs):
+        setattr(lyr, name, compute())
+        return None
+
+    handle = layer.register_forward_pre_hook(pre_hook)
+    layer._weight_norm_state = (name, dim, handle)
+    setattr(layer, name, compute())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None:
+        return layer
+    _, dim, handle = state
+    handle.remove()
+    from ...core.tensor import Parameter
+    # recompute the weight from the CONCRETE g/v parameters — the cached
+    # `layer.<name>` attribute may hold a trace-time value (the pre-hook
+    # also runs inside to_static traces)
+    g = np.asarray(layer._parameters[name + "_g"].numpy(), np.float64)
+    v = np.asarray(layer._parameters[name + "_v"].numpy(), np.float64)
+    if dim is None:
+        norm = np.sqrt((v * v).sum())
+    else:
+        axes = tuple(i for i in range(v.ndim) if i != dim)
+        norm = np.sqrt((v * v).sum(axis=axes, keepdims=True))
+    w = (g * v / np.maximum(norm, 1e-12)).astype(
+        layer._parameters[name + "_v"].numpy().dtype)
+    # drop the instance attribute the pre-hook wrote (it may hold a
+    # trace-time value and would shadow the restored parameter)
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(w))
+    for suffix in ("_g", "_v"):
+        layer._parameters.pop(name + suffix, None)
+    del layer._weight_norm_state
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization hook (reference nn/utils/spectral_norm_hook.py)
+    — wraps the SpectralNorm layer's power iteration around the weight."""
+    from ..layer.norm import SpectralNorm
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(list(w.shape), dim=dim, power_iters=n_power_iterations,
+                      eps=eps)
+    layer.add_sublayer(name + "_spectral_norm", sn)
+    orig = w
+
+    def pre_hook(lyr, inputs):
+        setattr(lyr, name, sn(orig))
+        return None
+
+    layer.register_forward_pre_hook(pre_hook)
+    setattr(layer, name, sn(orig))
+    return layer

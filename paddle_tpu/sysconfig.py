@@ -1,0 +1,14 @@
+"""paddle.sysconfig parity: include/lib dirs of the native runtime."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def get_include():
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib():
+    return os.path.join(_ROOT, "csrc", "build")

@@ -1,4 +1,8 @@
 """paddle.text parity (python/paddle/text: NLP datasets + viterbi_decode)."""
+# load the viterbi_decode SUBMODULE first, then rebind the name to the
+# function below — later `import paddle_tpu.text.viterbi_decode` is then a
+# sys.modules no-op and the function binding survives
+from . import viterbi_decode as _viterbi_decode_module  # noqa: F401
 from . import models  # noqa: F401
 from .datasets import (  # noqa: F401
     Conll05st, Imdb, Imikolov, Movielens, UCIHousing, ViterbiDecoder, WMT14,

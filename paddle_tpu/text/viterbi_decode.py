@@ -1,0 +1,5 @@
+"""paddle.text.viterbi_decode module path parity — the implementations live
+in text/datasets.py (re-exported here)."""
+from .datasets import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]

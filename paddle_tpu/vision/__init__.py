@@ -4,10 +4,13 @@ _image_backend = "pil"
 
 
 def set_image_backend(backend):
-    """reference vision/image.py: pil|cv2 (cv2 unavailable here -> pil)."""
+    """reference vision/image.py backend switch; only 'pil' is available in
+    this environment (no cv2), so anything else is rejected loudly."""
     global _image_backend
-    if backend not in ("pil", "cv2"):
-        raise ValueError(f"unsupported image backend {backend!r}")
+    if backend != "pil":
+        raise ValueError(
+            f"unsupported image backend {backend!r}: only 'pil' is "
+            f"available (cv2 is not shipped)")
     _image_backend = backend
 
 

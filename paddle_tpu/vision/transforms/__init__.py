@@ -233,7 +233,9 @@ def pad(img, padding, fill=0, padding_mode="constant"):
 
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
-    """Rotation via inverse affine sampling (host-side numpy)."""
+    """Rotation via inverse affine sampling (host-side numpy). expand=True
+    enlarges the canvas to the rotated bounding box (reference semantics;
+    expand requires rotation about the image center)."""
     orig_dtype = np.asarray(img).dtype
     arr = np.asarray(img, np.float32)
     a, was = _hwc_view(arr)
@@ -244,13 +246,21 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
         else (center[1], center[0])
     rad = np.deg2rad(angle)
     cos, sin = np.cos(rad), np.sin(rad)
-    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
-    xs = cos * (xx - cx) + sin * (yy - cy) + cx
-    ys = -sin * (xx - cx) + cos * (yy - cy) + cy
+    if expand:
+        # epsilon guards against float noise (90deg: cos ~ 6e-17)
+        oh = int(np.ceil(abs(h * cos) + abs(w * sin) - 1e-6))
+        ow = int(np.ceil(abs(w * cos) + abs(h * sin) - 1e-6))
+        ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+    else:
+        oh, ow, ocy, ocx = h, w, cy, cx
+    yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    xs = cos * (xx - ocx) + sin * (yy - ocy) + cx
+    ys = -sin * (xx - ocx) + cos * (yy - ocy) + cy
     xi = np.round(xs).astype(np.int64)
     yi = np.round(ys).astype(np.int64)
     valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
-    out = np.full_like(a, fill, dtype=a.dtype)
+    out = np.full((yy.shape[0], yy.shape[1], a.shape[2]), fill,
+                  dtype=a.dtype)
     out[valid] = a[yi[valid], xi[valid]]
     if out.shape[-1] == 1 and arr.ndim == 2:
         out = out[:, :, 0]
