@@ -94,6 +94,8 @@ from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import cost_model  # noqa: F401,E402
 from . import sysconfig  # noqa: F401,E402
+from . import callbacks  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
 from . import slim  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import utils  # noqa: F401,E402

@@ -6,7 +6,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-           "LRScheduler", "EarlyStopping", "VisualDL"]
+           "LRScheduler", "EarlyStopping", "VisualDL", "ReduceLROnPlateau"]
 
 
 class Callback:
@@ -136,14 +136,12 @@ class LRScheduler(Callback):
             s.step()
 
 
-class EarlyStopping(Callback):
-    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
-                 min_delta=0, baseline=None, save_best_model=True):
-        super().__init__()
+class _MonitorCallback(Callback):
+    """Shared best/patience machinery for monitor-driven callbacks."""
+
+    def _init_monitor(self, monitor, mode, min_delta):
         self.monitor = monitor
-        self.patience = patience
         self.min_delta = abs(min_delta)
-        self.baseline = baseline
         self.wait = 0
         self.best = None
         if mode == "auto":
@@ -156,6 +154,15 @@ class EarlyStopping(Callback):
         if self.mode == "min":
             return cur < self.best - self.min_delta
         return cur > self.best + self.min_delta
+
+
+class EarlyStopping(_MonitorCallback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self._init_monitor(monitor, mode, min_delta)
+        self.patience = patience
+        self.baseline = baseline
 
     def on_epoch_end(self, epoch, logs=None):
         logs = logs or {}
@@ -192,3 +199,53 @@ class VisualDL(Callback):
                                    if isinstance(v, (int, float, list))}})
                     + "\n")
         self._step += 1
+
+
+class ReduceLROnPlateau(_MonitorCallback):
+    """hapi/callbacks.py ReduceLROnPlateau parity: scale the optimizer LR by
+    `factor` after `patience` epochs without improvement on `monitor`."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self._init_monitor(monitor, mode, min_delta)
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.cooldown_counter = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = self.model._optimizer
+                if getattr(opt, "_lr_scheduler", None) is not None:
+                    import warnings
+                    warnings.warn(
+                        "ReduceLROnPlateau: optimizer uses an LRScheduler; "
+                        "set_lr would be ignored — use "
+                        "optimizer.lr.ReduceOnPlateau instead")
+                    self.wait = 0
+                    return
+                old = float(opt.get_lr())
+                new = max(old * self.factor, self.min_lr)
+                if new < old:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {old:g} -> {new:g}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0

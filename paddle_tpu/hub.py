@@ -1,0 +1,44 @@
+"""paddle.hub parity (python/paddle/hapi/hub.py): load models from a local
+directory or github-style repo via its hubconf.py. Network fetch is not
+available in this environment, so only `source="local"` works; remote
+sources raise with a clear message."""
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source != "local":
+        raise RuntimeError(
+            "paddle.hub: only source='local' is available in this "
+            "environment (no network egress for github/gitee sources)")
+    return repo_dir
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return getattr(mod, model)(**kwargs)

@@ -33,6 +33,8 @@ __all__ = [
     "Config", "Predictor", "Tensor", "create_predictor", "PredictorPool",
     "save_predictor_model", "get_version", "PlaceType", "DataType",
     "convert_to_mixed_precision",
+    "PrecisionType", "get_trt_compile_version", "get_trt_runtime_version",
+    "get_num_bytes_of_data_type",
 ]
 
 
@@ -476,3 +478,34 @@ def convert_to_mixed_precision(src_prefix, dst_prefix, mixed_precision="bf16",
             import shutil
             shutil.copyfile(src_prefix + ext, dst_prefix + ext)
     return dst_prefix
+
+
+class PrecisionType:
+    """analysis_config precision enum parity."""
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+def get_trt_compile_version():
+    """TensorRT is not part of this stack (README scope: XLA is the single
+    inference backend)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype):
+    """Accepts a DataType enum value or a dtype name string (PaddleDType
+    parity); sizes come from the module's canonical _NP_OF table."""
+    if isinstance(dtype, int):
+        return int(np.dtype(_NP_OF[dtype]).itemsize)
+    name = str(dtype)
+    for enum_val, np_name in _NP_OF.items():
+        if name == np_name or (name == "bfloat16"
+                               and np_name in ("uint16", "bfloat16")):
+            return int(np.dtype(np_name).itemsize)
+    return int(np.dtype({"bfloat16": "uint16"}.get(name, name)).itemsize)

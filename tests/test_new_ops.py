@@ -946,3 +946,32 @@ class TestTransformsFamily:
         assert "annotations" not in fleet.__all__
         for n in fleet.__all__:
             assert not isinstance(getattr(fleet, n), types.ModuleType), n
+
+    def test_callbacks_hub_inference_namespaces(self, tmp_path):
+        assert all(hasattr(paddle.callbacks, n)
+                   for n in paddle.callbacks.__all__)
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(n=4):\n"
+            "    '''tiny'''\n"
+            "    import paddle_tpu.nn as nn\n"
+            "    return nn.Linear(n, n)\n")
+        assert paddle.hub.list(str(tmp_path)) == ["tiny"]
+        m = paddle.hub.load(str(tmp_path), "tiny", n=3)
+        assert m(paddle.to_tensor(np.zeros((1, 3), "float32"))).shape == [1, 3]
+        with pytest.raises(RuntimeError):
+            paddle.hub.load("some/repo", "x", source="github")
+        assert paddle.inference.get_num_bytes_of_data_type("bfloat16") == 2
+
+    def test_reduce_lr_on_plateau_callback(self):
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=net.parameters())
+        m = paddle.Model(net)
+        m.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               verbose=0)
+        cb.set_model(m)
+        cb.on_epoch_end(0, {"loss": 1.0})
+        cb.on_epoch_end(1, {"loss": 1.0})   # no improvement -> wait 1
+        assert abs(opt.get_lr() - 0.5) < 1e-6
