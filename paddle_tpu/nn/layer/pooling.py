@@ -12,49 +12,59 @@ __all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
 
 class _PoolNd(Layer):
     def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
-                 **kw):
+                 data_format=None, **kw):
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
         self.ceil_mode = ceil_mode
+        self.data_format = data_format
         self.kw = kw
+
+    def _fmt(self, default):
+        return self.data_format or default
 
 
 class MaxPool1D(_PoolNd):
     def forward(self, x):
         return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self._fmt("NCL"))
 
 
 class MaxPool2D(_PoolNd):
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self._fmt("NCHW"))
 
 
 class MaxPool3D(_PoolNd):
     def forward(self, x):
         return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self._fmt("NCDHW"))
 
 
 class AvgPool1D(_PoolNd):
     def forward(self, x):
         return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self._fmt("NCL"))
 
 
 class AvgPool2D(_PoolNd):
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self._fmt("NCHW"))
 
 
 class AvgPool3D(_PoolNd):
     def forward(self, x):
         return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self._fmt("NCDHW"))
 
 
 class AdaptiveAvgPool1D(Layer):

@@ -11,16 +11,19 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         if norm_layer is None:
             norm_layer = nn.BatchNorm2D
+        fmt = data_format
         self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride,
-                               bias_attr=False)
-        self.bn1 = norm_layer(planes)
+                               bias_attr=False, data_format=fmt)
+        self.bn1 = norm_layer(planes, data_format=fmt)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               data_format=fmt)
+        self.bn2 = norm_layer(planes, data_format=fmt)
         self.downsample = downsample
         self.stride = stride
 
@@ -41,20 +44,23 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         if norm_layer is None:
             norm_layer = nn.BatchNorm2D
+        fmt = data_format
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
+                               data_format=fmt)
+        self.bn1 = norm_layer(width, data_format=fmt)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation,
                                stride=stride, groups=groups, dilation=dilation,
-                               bias_attr=False)
-        self.bn2 = norm_layer(width)
+                               bias_attr=False, data_format=fmt)
+        self.bn2 = norm_layer(width, data_format=fmt)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
-                               bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+                               bias_attr=False, data_format=fmt)
+        self.bn3 = norm_layer(planes * self.expansion, data_format=fmt)
         self.relu = nn.ReLU()
         self.downsample = downsample
         self.stride = stride
@@ -71,14 +77,25 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
+    """data_format="NHWC" runs the whole network channels-last — the layout
+    the TPU conv emitter prefers (the reference reaches the same effect via
+    per-op layout transforms, paddle/fluid/framework/data_layout_transform.cc).
+    Input must match data_format."""
+
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, data_format="NCHW", stem="conv"):
         super().__init__()
         layer_cfg = {
             18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
             101: [3, 4, 23, 3], 152: [3, 8, 36, 3],
         }
         layers = layer_cfg[depth]
+        fmt = data_format
+        self.data_format = fmt
+        if stem not in ("conv", "space_to_depth"):
+            raise ValueError(f"stem must be 'conv' or 'space_to_depth', "
+                             f"got {stem!r}")
+        self.stem = stem
         self.groups = groups
         self.base_width = width
         self.num_classes = num_classes
@@ -87,39 +104,81 @@ class ResNet(nn.Layer):
         self.inplanes = 64
         self.dilation = 1
         self.conv1 = nn.Conv2D(3, self.inplanes, kernel_size=7, stride=2,
-                               padding=3, bias_attr=False)
-        self.bn1 = self._norm_layer(self.inplanes)
+                               padding=3, bias_attr=False, data_format=fmt)
+        self.bn1 = self._norm_layer(self.inplanes, data_format=fmt)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1,
+                                    data_format=fmt)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), data_format=fmt)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1, dilate=False):
         norm_layer = self._norm_layer
+        fmt = self.data_format
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                norm_layer(planes * block.expansion),
+                          stride=stride, bias_attr=False, data_format=fmt),
+                norm_layer(planes * block.expansion, data_format=fmt),
             )
         layers = [block(self.inplanes, planes, stride, downsample, self.groups,
-                        self.base_width, self.dilation, norm_layer)]
+                        self.base_width, self.dilation, norm_layer,
+                        data_format=fmt)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
                                 base_width=self.base_width,
-                                norm_layer=norm_layer))
+                                norm_layer=norm_layer, data_format=fmt))
         return nn.Sequential(*layers)
 
+    def _stem_space_to_depth(self, x):
+        """conv1 (7x7/s2, pad 3) computed as the exactly-equivalent 4x4/s1
+        convolution over 2x2 space-to-depth input — the TPU-idiomatic stem:
+        a 3-channel 7x7 conv leaves the MXU's 128-lane contraction dimension
+        mostly idle, and the rearrangement quadruples it (12 channels x 16
+        taps). Zero-pads H,W by (4,2), folds each 2x2 block into channels
+        (order: block-row, block-col, channel), and applies conv1's weights
+        zero-padded 7->8 and folded the same way. Identical math up to fp
+        reassociation; conv1.weight stays in its canonical (O,I,7,7) layout
+        so checkpoints are interchangeable with stem="conv".
+        """
+        import paddle_tpu.nn.functional as F
+        w = self.conv1.weight
+        fmt = self.data_format
+        if fmt == "NHWC":
+            n, h, ww, c = x.shape
+            xp = F.pad(x, [4, 2, 4, 2], data_format="NHWC")
+            hh, wh = (h + 6) // 2, (ww + 6) // 2
+            xs = xp.reshape([n, hh, 2, wh, 2, c]) \
+                   .transpose([0, 1, 3, 2, 4, 5]) \
+                   .reshape([n, hh, wh, 4 * c])
+        else:
+            n, c, h, ww = x.shape
+            xp = F.pad(x, [4, 2, 4, 2], data_format="NCHW")
+            hh, wh = (h + 6) // 2, (ww + 6) // 2
+            xs = xp.reshape([n, c, hh, 2, wh, 2]) \
+                   .transpose([0, 3, 5, 1, 2, 4]) \
+                   .reshape([n, 4 * c, hh, wh])
+        o, ci, kh, kw = w.shape
+        wp = F.pad(w, [1, 0, 1, 0], data_format="NCHW")  # (o, ci, 8, 8)
+        ws = wp.reshape([o, ci, 4, 2, 4, 2]) \
+               .transpose([0, 3, 5, 1, 2, 4]) \
+               .reshape([o, 4 * ci, 4, 4])
+        return F.conv2d(xs, ws, None, stride=1, padding=0, data_format=fmt)
+
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        if self.stem == "space_to_depth":
+            x = self._stem_space_to_depth(x)
+        else:
+            x = self.conv1(x)
+        x = self.relu(self.bn1(x))
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

@@ -72,3 +72,60 @@ def test_small_model_trains():
 def test_pretrained_raises():
     with pytest.raises(NotImplementedError):
         paddle.vision.models.alexnet(pretrained=True)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """data_format="NHWC" must be numerically identical to NCHW (the TPU
+    bench runs channels-last; reference reaches the same layout via
+    data_layout_transform.cc)."""
+    paddle.seed(0)
+    m_nchw = paddle.vision.models.resnet18(num_classes=7)
+    paddle.seed(0)
+    m_nhwc = paddle.vision.models.resnet18(num_classes=7,
+                                           data_format="NHWC")
+    m_nhwc.set_state_dict(m_nchw.state_dict())
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 7, (2,)).astype("int64")
+
+    def train_step(model, xin):
+        xt = paddle.to_tensor(xin)
+        yt = paddle.to_tensor(y)
+        loss = F.cross_entropy(model(xt), yt)
+        loss.backward()
+        return loss
+
+    l1 = train_step(m_nchw, x)
+    l2 = train_step(m_nhwc, np.transpose(x, (0, 2, 3, 1)))
+    # layouts reassociate conv reductions; only identical up to fp32
+    # accumulation order (amplified through 18 train-mode BN backwards)
+    np.testing.assert_allclose(l1.numpy(), l2.numpy(), rtol=5e-4, atol=5e-4)
+    def rel_l2(a, b):
+        a, b = a.ravel(), b.ravel()
+        return np.linalg.norm(a - b) / (np.linalg.norm(a) + 1e-12)
+
+    assert rel_l2(m_nchw.fc.weight.grad.numpy(),
+                  m_nhwc.fc.weight.grad.numpy()) < 0.01
+    assert rel_l2(m_nchw.conv1.weight.grad.numpy(),
+                  m_nhwc.conv1.weight.grad.numpy()) < 0.05
+
+
+@pytest.mark.parametrize("fmt", ["NCHW", "NHWC"])
+def test_resnet_space_to_depth_stem_exact(fmt):
+    """stem="space_to_depth" is the same conv1 re-tiled for the MXU; output
+    must match the plain stem bit-for-bit up to fp32 reassociation."""
+    paddle.seed(0)
+    m1 = paddle.vision.models.resnet18(num_classes=5, data_format=fmt)
+    paddle.seed(0)
+    m2 = paddle.vision.models.resnet18(num_classes=5, data_format=fmt,
+                                       stem="space_to_depth")
+    m2.set_state_dict(m1.state_dict())
+    m1.eval()
+    m2.eval()
+    shape = (2, 3, 64, 64) if fmt == "NCHW" else (2, 64, 64, 3)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(*shape).astype("float32"))
+    with paddle.no_grad():
+        a, b = m1(x), m2(x)
+    np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-5, atol=1e-6)
