@@ -47,9 +47,52 @@ _ALIASES = {
 FLOAT_DTYPES = (float16, bfloat16, float32, float64)
 INT_DTYPES = (uint8, int8, int16, int32, int64)
 
+# x64 policy (TPU-native, documented in README §Scope): JAX x64 stays OFF —
+# the MXU/VPU have no 64-bit lanes and XLA:TPU software-emulates i64/f64.
+# The reference is int64-everywhere (SURVEY §7 hard part 2); here 64-bit
+# dtype REQUESTS narrow to their 32-bit devices dtypes at every ingestion
+# point, and 64-bit host DATA is narrowed with a real range check
+# (narrow_host_array) instead of jax's silent truncate-and-warn.
+_DEVICE_NARROW = {
+    int64: int32,
+    np.dtype(np.uint64): np.dtype(np.uint32),
+    float64: float32,
+    complex128: complex64,
+}
+
+
+def narrow_host_array(arr):
+    """Narrow a 64-bit-integer host array to int32/uint32, raising
+    OverflowError when values do not fit (instead of wrapping silently).
+    Floats are not handled here — callers route them through
+    get_default_dtype so bf16-default stays in force."""
+    if arr.dtype == np.int64:
+        if arr.size and (int(arr.max()) > 2**31 - 1 or int(arr.min()) < -2**31):
+            raise OverflowError(
+                "int64 value out of int32 range: TPU tensors store integer "
+                "data as int32 (x64 disabled; README §Scope)")
+        return arr.astype(np.int32)
+    if arr.dtype == np.uint64:
+        if arr.size and int(arr.max()) > 2**32 - 1:
+            raise OverflowError(
+                "uint64 value out of uint32 range: TPU tensors store "
+                "integer data as uint32 (x64 disabled; README §Scope)")
+        return arr.astype(np.uint32)
+    return arr
+
 
 def convert_dtype(dtype):
-    """Normalize any dtype spec (str, np.dtype, jnp type, Tensor dtype) to np.dtype."""
+    """Normalize any dtype spec (str, np.dtype, jnp type, Tensor dtype) to
+    np.dtype. 64-bit specs narrow to their device dtypes (x64 policy
+    above) — an explicit dtype="float64" request yields float32, never the
+    bf16 default (which only applies to dtype-less float64 DATA)."""
+    dt = _convert_dtype_raw(dtype)
+    if dt is not None and dt in _DEVICE_NARROW:
+        return _DEVICE_NARROW[dt]
+    return dt
+
+
+def _convert_dtype_raw(dtype):
     if dtype is None:
         return None
     if isinstance(dtype, str):
@@ -88,9 +131,18 @@ _DEFAULT_DTYPE = [float32]
 
 
 def set_default_dtype(dtype):
-    """paddle.set_default_dtype parity (python/paddle/framework/framework.py)."""
+    """paddle.set_default_dtype parity (python/paddle/framework/framework.py).
+
+    "float64" is accepted for API parity but installs float32 (x64 policy
+    above) — warned once so the narrowing is visible, not implicit."""
+    raw = _convert_dtype_raw(dtype)
+    if raw == float64:
+        import warnings
+        warnings.warn("set_default_dtype('float64'): TPU tensors store "
+                      "floats at most at float32 (x64 disabled; README "
+                      "§Scope) — using float32", stacklevel=2)
     d = convert_dtype(dtype)
-    if d not in (float16, bfloat16, float32, float64):
+    if d not in (float16, bfloat16, float32):
         raise TypeError(f"default dtype must be floating, got {d}")
     _DEFAULT_DTYPE[0] = d
 

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd
-from .dtypes import convert_dtype, get_default_dtype
+from .dtypes import convert_dtype, get_default_dtype, narrow_host_array
 
 __all__ = ["Tensor", "Parameter", "to_tensor"]
 
@@ -96,6 +96,12 @@ class Tensor:
             arr = np.asarray(value)
             if dtype is None and arr.dtype == np.float64:
                 dtype = get_default_dtype()
+            # x64 policy: 64-bit int host data destined for integer storage
+            # narrows to 32-bit with a range check instead of jax's
+            # truncate-and-warn (dtypes.py); an explicit float dtype request
+            # keeps the plain cast (the int32 range is irrelevant there)
+            if dtype is None or dtype.kind in "iu":
+                arr = narrow_host_array(arr)
             value = jnp.asarray(arr, dtype=dtype)
         elif dtype is not None and value.dtype != dtype:
             value = value.astype(dtype)

@@ -52,7 +52,7 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
     acc0 = jnp.zeros((b, h, s_local, d), dtype=jnp.float32)
     # mark the (replicated-initialized) carry as device-varying so the scan
     # carry type stays consistent across iterations under shard_map
-    m0, l0, acc0 = jax.lax.pvary((m0, l0, acc0), axis_name)
+    m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), axis_name, to="varying")
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, i):

@@ -109,8 +109,9 @@ class PipelineStageStack(Layer):
                 b = x_loc.shape[0]
                 mb = b // m
                 micro = x_loc.reshape((m, mb) + x_loc.shape[1:])
-                act0 = jax.lax.pvary(
-                    jnp.zeros((mb,) + x_loc.shape[1:], x_loc.dtype), axis)
+                act0 = jax.lax.pcast(
+                    jnp.zeros((mb,) + x_loc.shape[1:], x_loc.dtype), axis,
+                    to="varying")
 
                 def tick(act, t):
                     t_in = jnp.minimum(t, m - 1)

@@ -166,7 +166,7 @@ class Categorical(Distribution):
         log_p = self._log_p
         out = jax.random.categorical(next_key(), log_p,
                                      shape=shape + log_p.shape[:-1])
-        return Tensor(out.astype(jnp.int64))
+        return Tensor(out.astype(jnp.int32))
 
     def log_prob(self, value):
         idx = unwrap(value).astype(jnp.int32)

@@ -72,7 +72,8 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
         if any(isinstance(v, float) for v in (start, end, step)):
             dtype = get_default_dtype()
         else:
-            dtype = np.dtype(np.int64)
+            # x64 policy: integer arange is int32 on device (README §Scope)
+            dtype = np.dtype(np.int32)
     return Tensor(jnp.arange(start, end, step, dtype=dtype))
 
 

@@ -166,7 +166,7 @@ def matrix_power(x, n, name=None):
 
 def matrix_rank(x, tol=None, hermitian=False, name=None):
     r = jnp.linalg.matrix_rank(unwrap(x), rtol=tol)
-    return Tensor(r.astype(jnp.int64))
+    return Tensor(r.astype(jnp.int32))
 
 
 def slogdet(x, name=None):
@@ -188,7 +188,7 @@ def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002,A001
     v = unwrap(input)
     lo, hi = (min, max) if (min != 0 or max != 0) else (float(jnp.min(v)), float(jnp.max(v)))
     h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
-    return Tensor(h.astype(jnp.int64))
+    return Tensor(h.astype(jnp.int32))
 
 
 def bincount(x, weights=None, minlength=0, name=None):

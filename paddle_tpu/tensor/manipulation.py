@@ -282,11 +282,11 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
     rets = [Tensor(jnp.asarray(out))]
     if return_inverse:
         inv = np.cumsum(keep) - 1
-        rets.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+        rets.append(Tensor(jnp.asarray(inv.astype(np.int32))))
     if return_counts:
         idx = np.flatnonzero(keep)
         counts = np.diff(np.append(idx, len(v)))
-        rets.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+        rets.append(Tensor(jnp.asarray(counts.astype(np.int32))))
     return rets[0] if len(rets) == 1 else tuple(rets)
 
 

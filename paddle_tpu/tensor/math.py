@@ -227,7 +227,7 @@ def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
 
 def count_nonzero(x, axis=None, keepdim=False, name=None):
     return Tensor(jnp.count_nonzero(unwrap(x), axis=_norm_axis(axis),
-                                    keepdims=keepdim).astype(jnp.int64))
+                                    keepdims=keepdim).astype(jnp.int32))
 
 
 def cumsum(x, axis=None, dtype=None, name=None):

@@ -71,12 +71,12 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
         out = jax.random.categorical(next_key(), logits, axis=-1,
                                      shape=(num_samples,) + v.shape[:-1])
         if v.ndim == 1:
-            return Tensor(out.astype(jnp.int64))
-        return Tensor(jnp.moveaxis(out, 0, -1).astype(jnp.int64))
+            return Tensor(out.astype(jnp.int32))
+        return Tensor(jnp.moveaxis(out, 0, -1).astype(jnp.int32))
     # without replacement: Gumbel top-k trick
     g = jax.random.gumbel(next_key(), v.shape, dtype=logits.dtype)
     _, idx = jax.lax.top_k(logits + g, num_samples)
-    return Tensor(idx.astype(jnp.int64))
+    return Tensor(idx.astype(jnp.int32))
 
 
 def bernoulli(x, name=None):

@@ -29,7 +29,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
 def argsort(x, axis=-1, descending=False, name=None):
     v = unwrap(x)
     idx = jnp.argsort(-v if descending else v, axis=axis, kind="stable")
-    return Tensor(idx.astype(jnp.int64))
+    return Tensor(idx.astype(jnp.int32))
 
 
 def sort(x, axis=-1, descending=False, name=None):
@@ -50,15 +50,15 @@ def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
             vals = -vals
         return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
     vals, idx = apply(prim, x, name="topk")
-    return vals, Tensor(idx._value.astype(jnp.int64))
+    return vals, Tensor(idx._value.astype(jnp.int32))
 
 
 def nonzero(x, as_tuple=False):
     v = np.asarray(unwrap(x))
     nz = np.nonzero(v)
     if as_tuple:
-        return tuple(Tensor(jnp.asarray(n.astype(np.int64))[:, None]) for n in nz)
-    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+        return tuple(Tensor(jnp.asarray(n.astype(np.int32))[:, None]) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int32)))
 
 
 def index_of_max(x):
@@ -68,7 +68,7 @@ def index_of_max(x):
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
     side = "right" if right else "left"
     r = jnp.searchsorted(unwrap(sorted_sequence), unwrap(values), side=side)
-    return Tensor(r.astype(jnp.int32 if out_int32 else jnp.int64))
+    return Tensor(r.astype(jnp.int32))  # int64 narrows (README §Scope)
 
 
 def masked_select(x, mask, name=None):
@@ -87,7 +87,7 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
             idxs = jnp.expand_dims(idxs, axis)
         return vals, idxs
     vals, idx = apply(prim, x, name="kthvalue")
-    return vals, Tensor(idx._value.astype(jnp.int64))
+    return vals, Tensor(idx._value.astype(jnp.int32))
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
@@ -95,7 +95,7 @@ def mode(x, axis=-1, keepdim=False, name=None):
     mv = np.moveaxis(v, axis, -1)
     flat = mv.reshape(-1, mv.shape[-1])
     vals = np.empty(flat.shape[0], dtype=v.dtype)
-    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    idxs = np.empty(flat.shape[0], dtype=np.int32)
     for i, row in enumerate(flat):
         uniq, counts = np.unique(row, return_counts=True)
         best = uniq[np.argmax(counts)]

@@ -46,4 +46,4 @@ def nanquantile(x, q, axis=None, keepdim=False, name=None):
 
 
 def numel(x, name=None):
-    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int32))

@@ -265,7 +265,7 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         first_tag, path_rev = jax.lax.scan(backtrace, last_tag, backps[::-1])
         paths = jnp.concatenate(
             [first_tag[:, None], path_rev[::-1].T], axis=1)  # (B, S)
-        return scores.astype(p.dtype), paths.astype(jnp.int64)
+        return scores.astype(p.dtype), paths.astype(jnp.int32)
 
     return apply(prim, potentials, transition_params, lengths,
                  name="viterbi_decode")

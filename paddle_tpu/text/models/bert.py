@@ -46,9 +46,9 @@ class BertEmbeddings(nn.Layer):
         import jax.numpy as jnp
         b, s = input_ids.shape
         if position_ids is None:
-            position_ids = Tensor(jnp.arange(s, dtype=jnp.int64)[None, :])
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
         if token_type_ids is None:
-            token_type_ids = Tensor(jnp.zeros((b, s), dtype=jnp.int64))
+            token_type_ids = Tensor(jnp.zeros((b, s), dtype=jnp.int32))
         x = (self.word_embeddings(input_ids)
              + self.position_embeddings(position_ids)
              + self.token_type_embeddings(token_type_ids))

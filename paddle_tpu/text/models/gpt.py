@@ -141,7 +141,7 @@ class GPTModel(nn.Layer):
         b, s = input_ids.shape
         if position_ids is None:
             import jax.numpy as jnp
-            position_ids = Tensor(jnp.arange(s, dtype=jnp.int64)[None, :])
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         for block in self.h:

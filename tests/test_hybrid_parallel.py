@@ -908,7 +908,7 @@ class TestMpAllreduceAndIdentity:
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         build_mesh({"model": 8})
         from paddle_tpu.distributed import collective as C
@@ -943,7 +943,7 @@ class TestMpAllreduceAndIdentity:
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         build_mesh({"model": 8})
         from paddle_tpu.distributed import collective as C

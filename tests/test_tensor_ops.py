@@ -268,3 +268,40 @@ class TestAutogradEngine:
         x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
         h = hessian(lambda t: (t * t * t).sum(), x)
         np.testing.assert_allclose(np.diag(h.numpy()), [6.0, 12.0], atol=1e-4)
+
+
+class TestIndexDtypePolicy:
+    """x64 policy (README §Scope): 64-bit dtypes narrow to 32-bit at every
+    ingestion point — silently for in-range data, OverflowError past the
+    32-bit range (never jax's truncate-and-warn)."""
+
+    def test_int64_ingestion_narrow_and_silent(self):
+        import warnings
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            t1 = paddle.to_tensor(np.array([1, 2, 3], dtype="int64"))
+            t2 = paddle.zeros([2], dtype="int64")
+            t3 = paddle.arange(4, dtype="int64")
+            t4 = t1.astype("int64")
+        assert t1.dtype == np.int32
+        assert t2.dtype == np.int32
+        assert t3.dtype == np.int32
+        assert t4.dtype == np.int32
+        bad = [str(x.message) for x in w
+               if "truncat" in str(x.message) or "int64" in str(x.message)]
+        assert not bad, bad
+
+    def test_int64_out_of_range_raises(self):
+        with pytest.raises(OverflowError):
+            paddle.to_tensor(np.array([2 ** 40], dtype="int64"))
+
+    def test_int32_embedding_lookup_works(self):
+        emb = paddle.nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([[0, 9], [3, 3]], dtype="int64"))
+        out = emb(idx)
+        assert list(out.shape) == [2, 2, 4]
+
+    def test_float64_request_becomes_float32(self):
+        t = paddle.to_tensor(np.array([1.0], dtype="float64"),
+                             dtype="float64")
+        assert t.dtype == np.float32
