@@ -141,7 +141,7 @@ def set_default_dtype(dtype):
         warnings.warn("set_default_dtype('float64'): TPU tensors store "
                       "floats at most at float32 (x64 disabled; README "
                       "§Scope) — using float32", stacklevel=2)
-    d = convert_dtype(dtype)
+    d = _DEVICE_NARROW.get(raw, raw)
     if d not in (float16, bfloat16, float32):
         raise TypeError(f"default dtype must be floating, got {d}")
     _DEFAULT_DTYPE[0] = d

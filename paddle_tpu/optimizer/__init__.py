@@ -65,15 +65,21 @@ class Adam(Optimizer):
     def _apply_update(self, p, g):
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
-        b1p = self._get_accumulator("beta1_pow", p, init=1.0, shape=())
-        b2p = self._get_accumulator("beta2_pow", p, init=1.0, shape=())
+        # beta pows + bias correction stay float32 for ALL param dtypes:
+        # bf16's 8 mantissa bits round beta2=0.999 to 1.0, collapsing
+        # 1-beta2^t to 0 (0/0 updates). Reference MPType policy,
+        # operators/optimizers/adam_op.h
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, shape=(),
+                                    dtype=jnp.float32)
+        b2p = self._get_accumulator("beta2_pow", p, init=1.0, shape=(),
+                                    dtype=jnp.float32)
         dtype = p._val.dtype
         g = g.astype(dtype)
         lr_ = self._lr.astype(jnp.float32)
         b1 = self._beta1
         b2 = self._beta2
-        b1p_new = b1p._value * b1
-        b2p_new = b2p._value * b2
+        b1p_new = b1p._value.astype(jnp.float32) * b1
+        b2p_new = b2p._value.astype(jnp.float32) * b2
         b1p._value = b1p_new
         b2p._value = b2p_new
         m_new = b1 * m._value + (1 - b1) * g
@@ -83,7 +89,8 @@ class Adam(Optimizer):
         # reference adam_op.h: lr_t = lr * sqrt(1-beta2^t)/(1-beta1^t);
         # update = lr_t * m / (sqrt(v) + eps*sqrt(1-beta2^t))
         lr_t = (lr_ * jnp.sqrt(1 - b2p_new) / (1 - b1p_new)).astype(dtype)
-        denom = jnp.sqrt(v_new) + self._epsilon * jnp.sqrt(1 - b2p_new).astype(dtype)
+        eps_t = (self._epsilon * jnp.sqrt(1 - b2p_new)).astype(dtype)
+        denom = jnp.sqrt(v_new) + eps_t
         p._value = p._value - lr_t * (m_new / denom)
 
     def _apply_sparse_update(self, p, sr, _merged=False):
@@ -96,14 +103,17 @@ class Adam(Optimizer):
         rows = sr.rows
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
-        b1p = self._get_accumulator("beta1_pow", p, init=1.0, shape=())
-        b2p = self._get_accumulator("beta2_pow", p, init=1.0, shape=())
+        # float32 beta pows / bias correction — see _apply_update
+        b1p = self._get_accumulator("beta1_pow", p, init=1.0, shape=(),
+                                    dtype=jnp.float32)
+        b2p = self._get_accumulator("beta2_pow", p, init=1.0, shape=(),
+                                    dtype=jnp.float32)
         dtype = p._val.dtype
         g = sr.value.astype(dtype)
         lr_ = self._lr.astype(jnp.float32)
         b1, b2 = self._beta1, self._beta2
-        b1p_new = b1p._value * b1
-        b2p_new = b2p._value * b2
+        b1p_new = b1p._value.astype(jnp.float32) * b1
+        b2p_new = b2p._value.astype(jnp.float32) * b2
         b1p._value = b1p_new
         b2p._value = b2p_new
         m_rows = b1 * m._value[rows] + (1 - b1) * g
@@ -111,8 +121,8 @@ class Adam(Optimizer):
         m._value = m._value.at[rows].set(m_rows)
         v._value = v._value.at[rows].set(v_rows)
         lr_t = (lr_ * jnp.sqrt(1 - b2p_new) / (1 - b1p_new)).astype(dtype)
-        denom = jnp.sqrt(v_rows) + \
-            self._epsilon * jnp.sqrt(1 - b2p_new).astype(dtype)
+        eps_t = (self._epsilon * jnp.sqrt(1 - b2p_new)).astype(dtype)
+        denom = jnp.sqrt(v_rows) + eps_t
         p._value = p._value.at[rows].add(-lr_t * (m_rows / denom))
 
 

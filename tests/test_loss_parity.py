@@ -1,0 +1,136 @@
+"""Loss-curve parity across precision regimes (BASELINE "loss parity").
+
+The reference's distributed tests assert loss parity, never throughput
+(test_dist_base.py:778) — the same standard applies to precision regimes
+here: bf16 (TPU-native) and amp (fp32 master + bf16 compute, the regime the
+A100 baselines use) must track the fp32 curve step-for-step on the SAME
+data stream, and the curve must actually descend (training happens).
+
+Default-lane tests use small models (LeNet, 2-layer BERT) so 50 steps
+compile+run in seconds on the CPU CI mesh; bench.py emits the
+full-size curves on real hardware (LOSS_CURVES.json + a digest in the
+bench JSON line; disable with BENCH_LOSS_CURVES=0).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+STEPS = 50
+
+
+def _curve(model_fn, data_fn, regime, lr=1e-3, steps=STEPS):
+    """Train `steps` steps; returns the per-step loss curve (fp32 numpy)."""
+    paddle.seed(0)
+    model = model_fn()
+    if regime == "bf16":
+        model.bfloat16()
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=model.parameters())
+    xs, ys = data_fn()
+    if regime == "bf16":
+        xs = xs.astype("bfloat16") if xs.dtype == np.float32 else xs
+
+    @paddle.jit.to_static
+    def step(x, y):
+        if regime == "amp":
+            with paddle.amp.auto_cast(dtype="bfloat16"):
+                out = model(x)
+        else:
+            out = model(x)
+        loss = F.cross_entropy(out.astype("float32"), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = step.run_steps(paddle.to_tensor(xs), paddle.to_tensor(ys))
+    return np.asarray(losses.numpy(), np.float64)
+
+
+def _assert_parity(ref, other, rel_tol, name, floor=0.25):
+    """Pointwise relative tracking over the DESCENT region (ref >= floor).
+
+    Past the floor the fp32 run has overfit the synthetic stream to ~0 loss
+    and relative deviation of a reduced-precision run is dominated by the
+    precision floor, not by curve divergence — the regime no real training
+    run operates in. The reduced-precision run must also itself descend.
+    """
+    mask = ref >= floor
+    assert mask.sum() >= 10, f"{name}: too few descent steps ({mask.sum()})"
+    rel = np.abs(other - ref)[mask] / np.abs(ref)[mask]
+    assert rel.mean() < rel_tol, (
+        f"{name}: mean relative curve deviation {rel.mean():.4f} "
+        f">= {rel_tol} over {mask.sum()} steps\n"
+        f"ref={ref[:8]}...\nother={other[:8]}...")
+    assert other[-5:].mean() < 0.7 * other[:5].mean(), (
+        f"{name}: reduced-precision curve did not descend: {other}")
+
+
+class TestLeNetLossParity:
+    def _data(self):
+        # learnable stream: class prototypes + noise (random labels would
+        # pin the curve at ln(10) and prove nothing)
+        rng = np.random.RandomState(0)
+        protos = rng.randn(10, 1, 28, 28).astype("float32")
+        ys = rng.randint(0, 10, (STEPS, 32))
+        xs = (protos[ys] + 0.3 * rng.randn(STEPS, 32, 1, 28, 28)
+              ).astype("float32")
+        return xs, ys.astype("int64")
+
+    def _model(self):
+        return paddle.vision.models.LeNet()
+
+    def test_fp32_curve_descends(self):
+        c = _curve(self._model, self._data, "f32")
+        assert c[-5:].mean() < 0.7 * c[:5].mean(), c
+
+    def test_bf16_tracks_fp32(self):
+        ref = _curve(self._model, self._data, "f32")
+        bf = _curve(self._model, self._data, "bf16")
+        _assert_parity(ref, bf, 0.08, "lenet bf16")
+
+    def test_amp_tracks_fp32(self):
+        ref = _curve(self._model, self._data, "f32")
+        amp = _curve(self._model, self._data, "amp")
+        _assert_parity(ref, amp, 0.05, "lenet amp")
+
+
+class TestBertLossParity:
+    """2-layer/64-hidden BERT — the transformer stack (embeddings, MHA,
+    layernorm, pooler, classifier) at CI scale; BASELINE config 3's parity
+    evidence at full scale comes from bench.py's loss-curve artifact."""
+
+    def _data(self):
+        # label = a deterministic function of the tokens, so the curve can
+        # descend: class 1 iff the first (pooled) token is in the upper
+        # vocab half. Vocab is small (16) so every embedding row is seen
+        # ~50 times in 50 steps — with a big vocab each row trains ~once
+        # and no curve descends.
+        rng = np.random.RandomState(1)
+        xs = rng.randint(0, 16, (STEPS, 16, 32)).astype("int64")
+        ys = (xs[:, :, 0] >= 8).astype("int64")
+        return xs, ys
+
+    def _model(self):
+        from paddle_tpu.text.models import BertForSequenceClassification
+        from paddle_tpu.text.models.bert import BertConfig
+        cfg = BertConfig(vocab_size=16, hidden_size=64, num_layers=2,
+                         num_heads=4, intermediate_size=128,
+                         max_position=64, dropout=0.0)
+        return BertForSequenceClassification(cfg, num_classes=2)
+
+    def test_fp32_curve_descends(self):
+        c = _curve(self._model, self._data, "f32", lr=2e-3)
+        assert c[-5:].mean() < 0.95 * c[:5].mean(), c
+
+    def test_bf16_tracks_fp32(self):
+        ref = _curve(self._model, self._data, "f32", lr=2e-3)
+        bf = _curve(self._model, self._data, "bf16", lr=2e-3)
+        _assert_parity(ref, bf, 0.08, "bert bf16")
+
+    def test_amp_tracks_fp32(self):
+        ref = _curve(self._model, self._data, "f32", lr=2e-3)
+        amp = _curve(self._model, self._data, "amp", lr=2e-3)
+        _assert_parity(ref, amp, 0.05, "bert amp")
