@@ -129,10 +129,13 @@ def _timed_steps(step, args, steps, warmup=5, curve_key=None):
         for i in range(warmup):
             loss = step(*rolled(i))
         loss.item()
+        # pre-compute the rolled arg tuples: the roll dispatches must not
+        # sit inside the timed region (mirrors the spe>1 staging)
+        staged = [rolled(i) for i in range(steps)]
         curve = []
         t0 = time.time()
-        for i in range(steps):
-            loss = step(*rolled(i))
+        for args_i in staged:
+            loss = step(*args_i)
             curve.append(loss)
         _ = loss.item()  # sync
         dt = time.time() - t0

@@ -46,6 +46,8 @@ class Group:
 
     @property
     def nranks(self):
+        if self.ranks is not None:
+            return len(self.ranks)
         from .mesh import axis_degree
         return axis_degree(self.axis)
 
@@ -60,16 +62,21 @@ class Group:
             return self.ranks.index(g) if g in self.ranks else -1
         return g
 
-    def _check_eager_subgroup(self, opname):
-        """Eager DCN collectives run over ALL processes
-        (multihost_utils); proper rank subsets would need a split
-        coordination service — fail loudly rather than mis-slice."""
+    def _eager_subgroup(self):
+        """Rank subset for the eager DCN path, or None when the op covers
+        every process (whole-world ops use jax multihost_utils; proper
+        subsets go point-to-point over the wire channel, distributed/p2p.py
+        — the reference reaches the same split via per-ring NCCL comms,
+        collective_helper.cc:92)."""
         import jax as _jax
         if self.ranks is not None and \
                 len(self.ranks) != _jax.process_count():
-            raise NotImplementedError(
-                f"eager {opname} over a rank subgroup {self.ranks}; use the "
-                "in-trace path (shard_map on a mesh axis) for subgroups")
+            return list(self.ranks)
+        return None
+
+    def _member(self):
+        import jax as _jax
+        return self.ranks is None or _jax.process_index() in self.ranks
 
     def __repr__(self):
         return f"Group(axis={self.axis}, nranks={self.nranks})"
@@ -131,7 +138,15 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return tensor
     if get_world_size() <= 1:
         return tensor
-    g._check_eager_subgroup("all_reduce")
+    sub = g._eager_subgroup()
+    if sub is not None:
+        if not g._member():
+            return tensor
+        from . import p2p
+        import numpy as _np
+        tensor._value = jnp.asarray(
+            p2p.group_all_reduce(_np.asarray(v), sub, op=op))
+        return tensor
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(v)
     tensor._value = _EAGER_REDUCE[op](gathered, axis=0)
@@ -153,6 +168,17 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     if get_world_size() <= 1:
         tensor_list.clear()
         tensor_list.append(Tensor(v))
+        return tensor_list
+    sub = g._eager_subgroup()
+    if sub is not None:
+        if not g._member():
+            return tensor_list
+        from . import p2p
+        import numpy as _np
+        stacked = p2p.group_all_gather(_np.asarray(v), sub)
+        tensor_list.clear()
+        tensor_list.extend(Tensor(jnp.asarray(stacked[i]))
+                           for i in range(len(sub)))
         return tensor_list
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(v)
@@ -177,7 +203,25 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         out = apply(prim, tensor, name="c_broadcast")
         tensor._value = out._value
         return tensor
-    return tensor  # single-controller SPMD: host arrays are already replicated
+    if get_world_size() <= 1:
+        return tensor
+    sub = g._eager_subgroup()
+    if sub is not None:
+        if not g._member():
+            return tensor
+        from . import p2p
+        import numpy as _np
+        tensor._value = jnp.asarray(
+            p2p.group_broadcast(_np.asarray(v), sub, src=src))
+        return tensor
+    # eager DCN broadcast (c_broadcast_op parity): host state may have
+    # diverged across processes — ship src's value only (an allgather here
+    # would move world x nbytes per host)
+    from jax.experimental import multihost_utils
+    import jax as _jax
+    tensor._value = multihost_utils.broadcast_one_to_all(
+        v, is_source=_jax.process_index() == src)
+    return tensor
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -228,7 +272,15 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     if world <= 1:
         tensor._value = v
         return tensor
-    g._check_eager_subgroup("reduce_scatter")
+    sub = g._eager_subgroup()
+    if sub is not None:
+        if not g._member():
+            return tensor
+        from . import p2p
+        import numpy as _np
+        tensor._value = jnp.asarray(
+            p2p.group_reduce_scatter(_np.asarray(v), sub, op=op))
+        return tensor
     # eager DCN path (c_reducescatter parity): gather every process's
     # contribution, reduce, keep this rank's chunk
     from jax.experimental import multihost_utils
@@ -273,7 +325,19 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
                 in_tensor_list if isinstance(in_tensor_list, list) else [x])
             return out_tensor_list
         return x
-    g._check_eager_subgroup("alltoall")
+    sub = g._eager_subgroup()
+    if sub is not None:
+        if not g._member():
+            return x
+        from . import p2p
+        import numpy as _np
+        mine_sub = p2p.group_alltoall(_np.asarray(v), sub)
+        if out_tensor_list is not None:
+            out_tensor_list.clear()
+            out_tensor_list.extend(
+                Tensor(jnp.asarray(mine_sub[i])) for i in range(len(sub)))
+            return out_tensor_list
+        return Tensor(jnp.asarray(mine_sub))
     # eager DCN path (alltoall_op parity): chunk i of rank j goes to rank i.
     # gathered[j, i] = rank j's chunk i; this rank r receives gathered[:, r].
     if v.shape[0] != world:
@@ -290,34 +354,63 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    """send_v2 parity — meaningful inside pipeline shard_map regions, where it
-    lowers to ppermute (see fleet.meta_parallel pipeline implementation)."""
+    """send_v2 parity. In-trace it lowers to ppermute on the group axis
+    (fleet.meta_parallel pipeline); eagerly it ships the host array to
+    `dst` over the DCN wire channel (distributed/p2p.py) like the
+    reference's NCCL send_v2 (operators/collective/send_v2_op.cc:1)."""
     g = group or _default_group()
     v = unwrap(tensor)
     if _is_traced(v):
-        n = g.nranks
+        from .mesh import axis_degree
+        n = axis_degree(g.axis)  # ring over DEVICES on the axis, not
+        # the process-level g.nranks (a rank-subset group would otherwise
+        # shrink the ppermute ring and zero out the remaining devices)
         perm = [(i, (i + 1) % n) for i in range(n)]
         out = apply(lambda x: jax.lax.ppermute(x, g.axis, perm), tensor,
                     name="send_v2")
         return out
+    if get_world_size() <= 1:
+        return tensor
+    from . import p2p
+    import numpy as _np
+    p2p.send_array(_np.asarray(v), dst, tag=f"sr.{g.id}")
     return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    """recv_v2 parity. Only meaningful paired with send inside an SPMD
-    trace (where send lowers to ppermute and its result IS the received
-    value). Eagerly there is no p2p channel to pull from — fail loudly
-    instead of silently returning the input unchanged."""
+    """recv_v2 parity (operators/collective/recv_v2_op.cc:1). In-trace the
+    paired send's ppermute result IS the received value; eagerly the value
+    arrives over the DCN wire channel and is written in-place (shape and
+    dtype must match the reference's recv_v2 out-shape contract)."""
+    g = group or _default_group()
     v = unwrap(tensor)
     if _is_traced(v) or get_world_size() <= 1:
         return tensor
-    raise NotImplementedError(
-        "eager cross-process recv has no DCN channel; restructure as an "
-        "in-trace ppermute (see fleet pipeline) or use all_gather")
+    from . import p2p
+    arr = p2p.recv_array(src, tag=f"sr.{g.id}")
+    if tuple(arr.shape) != tuple(v.shape):
+        raise ValueError(
+            f"recv shape mismatch: got {tuple(arr.shape)} from rank {src}, "
+            f"expected {tuple(v.shape)} (recv_v2 out_shape contract)")
+    got = jnp.asarray(arr)
+    if got.dtype != v.dtype:
+        raise ValueError(
+            f"recv dtype mismatch: got {got.dtype} from rank {src}, "
+            f"expected {v.dtype} (recv_v2 dtype contract; cast explicitly "
+            "on the sender)")
+    tensor._value = got
+    return tensor
 
 
 def barrier(group=None):
     if get_world_size() <= 1:
+        return
+    g = group or _default_group()
+    sub = g._eager_subgroup()
+    if sub is not None:
+        if g._member():
+            from . import p2p
+            p2p.group_barrier(sub)
         return
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices("paddle_tpu_barrier")
