@@ -129,9 +129,12 @@ def _timed_steps(step, args, steps, warmup=5, curve_key=None):
         for i in range(warmup):
             loss = step(*rolled(i))
         loss.item()
-        # pre-compute the rolled arg tuples: the roll dispatches must not
-        # sit inside the timed region (mirrors the spe>1 staging)
+        # pre-compute the rolled arg tuples: the roll dispatches AND their
+        # device compute must not sit inside the timed region (mirrors the
+        # spe>1 staging); block so async rolls finish before t0
+        import jax as _jax
         staged = [rolled(i) for i in range(steps)]
+        _jax.block_until_ready([a._val for tup in staged for a in tup])
         curve = []
         t0 = time.time()
         for args_i in staged:

@@ -103,6 +103,23 @@ def _is_traced(v):
     return isinstance(v, jax_core.Tracer)
 
 
+def _eager_subgroup_call(g, v, opname, **kw):
+    """Dispatch an eager collective over g's rank subset via the wire
+    channel (distributed/p2p.py). Returns (handled, result):
+    handled=False -> whole-world op, caller takes the multihost path;
+    result=None  -> this process is NOT a member: the caller must return
+    with its tensors untouched (the one rule every subgroup op shares).
+    """
+    sub = g._eager_subgroup()
+    if sub is None:
+        return False, None
+    if not g._member():
+        return True, None
+    from . import p2p
+    import numpy as _np
+    return True, getattr(p2p, opname)(_np.asarray(v), sub, **kw)
+
+
 def _axis_in_scope(axis):
     """True if `axis` is a bound axis name in the current trace (shard_map)."""
     try:
@@ -138,14 +155,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return tensor
     if get_world_size() <= 1:
         return tensor
-    sub = g._eager_subgroup()
-    if sub is not None:
-        if not g._member():
-            return tensor
-        from . import p2p
-        import numpy as _np
-        tensor._value = jnp.asarray(
-            p2p.group_all_reduce(_np.asarray(v), sub, op=op))
+    handled, res = _eager_subgroup_call(g, v, "group_all_reduce", op=op)
+    if handled:
+        if res is not None:
+            tensor._value = jnp.asarray(res)
         return tensor
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(v)
@@ -169,16 +182,12 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         tensor_list.clear()
         tensor_list.append(Tensor(v))
         return tensor_list
-    sub = g._eager_subgroup()
-    if sub is not None:
-        if not g._member():
-            return tensor_list
-        from . import p2p
-        import numpy as _np
-        stacked = p2p.group_all_gather(_np.asarray(v), sub)
-        tensor_list.clear()
-        tensor_list.extend(Tensor(jnp.asarray(stacked[i]))
-                           for i in range(len(sub)))
+    handled, res = _eager_subgroup_call(g, v, "group_all_gather")
+    if handled:
+        if res is not None:
+            tensor_list.clear()
+            tensor_list.extend(Tensor(jnp.asarray(res[i]))
+                               for i in range(res.shape[0]))
         return tensor_list
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(v)
@@ -205,14 +214,10 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         return tensor
     if get_world_size() <= 1:
         return tensor
-    sub = g._eager_subgroup()
-    if sub is not None:
-        if not g._member():
-            return tensor
-        from . import p2p
-        import numpy as _np
-        tensor._value = jnp.asarray(
-            p2p.group_broadcast(_np.asarray(v), sub, src=src))
+    handled, res = _eager_subgroup_call(g, v, "group_broadcast", src=src)
+    if handled:
+        if res is not None:
+            tensor._value = jnp.asarray(res)
         return tensor
     # eager DCN broadcast (c_broadcast_op parity): host state may have
     # diverged across processes — ship src's value only (an allgather here
@@ -272,14 +277,10 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     if world <= 1:
         tensor._value = v
         return tensor
-    sub = g._eager_subgroup()
-    if sub is not None:
-        if not g._member():
-            return tensor
-        from . import p2p
-        import numpy as _np
-        tensor._value = jnp.asarray(
-            p2p.group_reduce_scatter(_np.asarray(v), sub, op=op))
+    handled, res = _eager_subgroup_call(g, v, "group_reduce_scatter", op=op)
+    if handled:
+        if res is not None:
+            tensor._value = jnp.asarray(res)
         return tensor
     # eager DCN path (c_reducescatter parity): gather every process's
     # contribution, reduce, keep this rank's chunk
@@ -325,19 +326,16 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
                 in_tensor_list if isinstance(in_tensor_list, list) else [x])
             return out_tensor_list
         return x
-    sub = g._eager_subgroup()
-    if sub is not None:
-        if not g._member():
-            return x
-        from . import p2p
-        import numpy as _np
-        mine_sub = p2p.group_alltoall(_np.asarray(v), sub)
+    handled, res = _eager_subgroup_call(g, v, "group_alltoall")
+    if handled:
+        if res is None:
+            return out_tensor_list if out_tensor_list is not None else x
         if out_tensor_list is not None:
             out_tensor_list.clear()
             out_tensor_list.extend(
-                Tensor(jnp.asarray(mine_sub[i])) for i in range(len(sub)))
+                Tensor(jnp.asarray(res[i])) for i in range(res.shape[0]))
             return out_tensor_list
-        return Tensor(jnp.asarray(mine_sub))
+        return Tensor(jnp.asarray(res))
     # eager DCN path (alltoall_op parity): chunk i of rank j goes to rank i.
     # gathered[j, i] = rank j's chunk i; this rank r receives gathered[:, r].
     if v.shape[0] != world:
@@ -392,13 +390,15 @@ def recv(tensor, src=0, group=None, sync_op=True):
         raise ValueError(
             f"recv shape mismatch: got {tuple(arr.shape)} from rank {src}, "
             f"expected {tuple(v.shape)} (recv_v2 out_shape contract)")
-    got = jnp.asarray(arr)
-    if got.dtype != v.dtype:
+    # compare the wire-preserved numpy dtype BEFORE jnp.asarray — with x64
+    # off jnp would silently downcast 64-bit arrivals and mask the mismatch
+    import numpy as _np
+    if _np.dtype(arr.dtype) != _np.dtype(v.dtype):
         raise ValueError(
-            f"recv dtype mismatch: got {got.dtype} from rank {src}, "
+            f"recv dtype mismatch: got {arr.dtype} from rank {src}, "
             f"expected {v.dtype} (recv_v2 dtype contract; cast explicitly "
             "on the sender)")
-    tensor._value = got
+    tensor._value = jnp.asarray(arr)
     return tensor
 
 

@@ -69,8 +69,13 @@ def endpoints():
         for e in tr.split(","):
             host, port = e.strip().rsplit(":", 1)
             eps.append(f"{host}:{int(port) + off}")
-        if len(eps) == world:
-            return eps
+        if len(eps) != world:
+            # a silent localhost fallback here would cross-wire peers on a
+            # multi-host job with a stale endpoint list (elastic resize)
+            raise ValueError(
+                f"PADDLE_TRAINER_ENDPOINTS has {len(eps)} entries for "
+                f"{world} processes; set PADDLE_TPU_P2P_ENDPOINTS explicitly")
+        return eps
     base = int(os.environ.get("PADDLE_TPU_P2P_BASE_PORT", "29610"))
     return [f"127.0.0.1:{base + r}" for r in range(world)]
 
@@ -212,7 +217,13 @@ def send_obj(payload, dst, tag="p2p"):
 
 def recv_obj(src, tag="p2p", timeout=None):
     seq = _next_seq(("r", src, tag))
-    return _channel().recv(src, (tag, seq), timeout=timeout)
+    try:
+        return _channel().recv(src, (tag, seq), timeout=timeout)
+    except TimeoutError:
+        # roll the counter back so a retry waits on the SAME slot — a
+        # consumed seq would desynchronize the (src, tag) stream forever
+        _SEQ[("r", src, tag)] -= 1
+        raise
 
 
 def send_array(arr, dst, tag="p2p"):
