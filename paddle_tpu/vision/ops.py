@@ -14,28 +14,94 @@ import jax.numpy as jnp
 from ..core.dispatch import apply, unwrap
 from ..core.tensor import Tensor
 
-__all__ = ["nms", "box_iou", "deform_conv2d", "DeformConv2D",
+__all__ = ["nms", "nms_padded", "box_iou", "deform_conv2d", "DeformConv2D",
            "roi_align", "RoIAlign", "roi_pool", "RoIPool",
            "psroi_pool", "PSRoIPool", "yolo_box", "yolo_loss", "read_file", "decode_jpeg"]
 
 
+def _pairwise_iou(b1, b2, eps=0.0):
+    """(N,4)x(M,4) -> (N,M) IoU — the one copy of the formula (box_iou,
+    nms_padded)."""
+    area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+    rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area1[:, None] + area2[None, :] - inter + eps)
+
+
 def box_iou(boxes1, boxes2):
-    def prim(b1, b2):
-        area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
-        area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
-        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
-        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
-        wh = jnp.clip(rb - lt, 0, None)
-        inter = wh[..., 0] * wh[..., 1]
-        return inter / (area1[:, None] + area2[None, :] - inter)
-    return apply(prim, boxes1, boxes2, name="box_iou")
+    return apply(_pairwise_iou, boxes1, boxes2, name="box_iou")
+
+
+def nms_padded(boxes, scores, iou_threshold=0.3, max_output_size=None,
+               category_idxs=None):
+    """Traceable fixed-size NMS (reference capability:
+    operators/detection/multiclass_nms_op.cc run in-graph).
+
+    TPU-native formulation — static shapes end to end, so a detection head
+    can keep NMS inside one jitted program: sort by score, build the O(N^2)
+    IoU matrix (an MXU-friendly dense pairwise computation), run the greedy
+    suppression as a `lax.scan` over sorted rows, then pack the kept
+    indices into a fixed-size (max_output_size,) slot array via argsort
+    priority (no dynamic shapes anywhere).
+
+    Returns (indices, num_valid): `indices` has exactly `max_output_size`
+    entries (default N), kept-box original indices in score order, -1 past
+    `num_valid`. `category_idxs` makes it class-aware by shifting each
+    class into a disjoint coordinate range (boxes of different classes
+    never suppress each other — multiclass_nms semantics).
+    """
+    n = int(unwrap(boxes).shape[0])
+    k = int(max_output_size) if max_output_size is not None else n
+    thr = float(iou_threshold)
+
+    def prim(b, s, *maybe_cat):
+        if maybe_cat:
+            cat = maybe_cat[0].astype(b.dtype)
+            span = jnp.max(jnp.abs(b)) + 1.0
+            b = b + (cat * 2.0 * span)[:, None]
+        order = jnp.argsort(-s)
+        bs = b[order]
+        iou = _pairwise_iou(bs, bs, eps=1e-12)
+        idx = jnp.arange(n)
+
+        def body(keep, i):
+            # suppressed iff a higher-scored KEPT box overlaps past thr
+            sup = jnp.any((iou[i] > thr) & keep & (idx < i))
+            return keep.at[i].set(~sup), ()
+
+        keep, _ = jax.lax.scan(body, jnp.zeros((n,), bool), idx)
+        # pack kept slots first (score order), then -1 padding
+        priority = jnp.where(keep, n - idx, -1)
+        slots = jnp.argsort(-priority)[:k]
+        valid = keep[slots]
+        out_idx = jnp.where(valid, order[slots], -1)
+        num_valid = jnp.minimum(jnp.sum(keep.astype(jnp.int32)), k)
+        return out_idx.astype(jnp.int32), num_valid
+
+    args = [boxes, scores] + ([category_idxs]
+                              if category_idxs is not None else [])
+    return apply(prim, *args, name="nms_padded")
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         categories=None, top_k=None):
+    if isinstance(unwrap(boxes), jax.core.Tracer):
+        raise TypeError(
+            "nms returns a dynamic-length index list and cannot run inside "
+            "jit; use paddle.vision.ops.nms_padded (fixed-size, traceable) "
+            "in compiled detection pipelines")
     b = np.asarray(unwrap(boxes))
     s = np.asarray(unwrap(scores)) if scores is not None else np.arange(
         len(b), 0, -1, dtype=np.float32)
+    if category_idxs is not None:
+        # class-aware (multiclass_nms semantics): shift each class into a
+        # disjoint coordinate range so cross-class boxes never suppress
+        cat = np.asarray(unwrap(category_idxs)).astype(b.dtype)
+        span = float(np.abs(b).max()) + 1.0
+        b = b + (cat * 2.0 * span)[:, None]
     order = np.argsort(-s)
     keep = []
     area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])

@@ -289,3 +289,95 @@ class TestYolo:
         loss = ops.yolo_loss(_t(x), _t(gtb), _t(gtl), anchors, mask, cls,
                              ignore_thresh=0.7, downsample_ratio=32).numpy()
         assert loss[0] < 3.0  # xy BCE at exact match is ln2-scale, wh ~0
+
+
+class TestNmsPadded:
+    """Traceable fixed-size NMS == host greedy NMS, and it jit-compiles
+    (reference capability: multiclass_nms_op in-graph)."""
+
+    def _boxes(self, n=24, seed=0):
+        rng = np.random.RandomState(seed)
+        xy = rng.rand(n, 2).astype("float32") * 8
+        wh = rng.rand(n, 2).astype("float32") * 4 + 0.2
+        boxes = np.concatenate([xy, xy + wh], axis=1)
+        scores = rng.rand(n).astype("float32")
+        return boxes, scores
+
+    def test_matches_host_nms(self):
+        from paddle_tpu.vision.ops import nms, nms_padded
+        boxes, scores = self._boxes()
+        host = np.asarray(
+            nms(paddle.to_tensor(boxes), 0.4,
+                paddle.to_tensor(scores)).numpy())
+        idx, nvalid = nms_padded(paddle.to_tensor(boxes),
+                                 paddle.to_tensor(scores),
+                                 iou_threshold=0.4)
+        nv = int(nvalid.numpy())
+        got = np.asarray(idx.numpy())[:nv]
+        np.testing.assert_array_equal(got, host)
+        assert (np.asarray(idx.numpy())[nv:] == -1).all()
+
+    def test_max_output_size_truncates(self):
+        from paddle_tpu.vision.ops import nms, nms_padded
+        boxes, scores = self._boxes(seed=3)
+        host = np.asarray(
+            nms(paddle.to_tensor(boxes), 0.5,
+                paddle.to_tensor(scores)).numpy())
+        idx, nvalid = nms_padded(paddle.to_tensor(boxes),
+                                 paddle.to_tensor(scores),
+                                 iou_threshold=0.5, max_output_size=3)
+        got = np.asarray(idx.numpy())
+        assert got.shape == (3,)
+        np.testing.assert_array_equal(got, host[:3])
+        assert int(nvalid.numpy()) <= 3  # clamped to max_output_size
+
+    def test_class_aware(self):
+        """Boxes of different categories must never suppress each other."""
+        from paddle_tpu.vision.ops import nms_padded
+        boxes = np.asarray([[0, 0, 4, 4], [0.1, 0.1, 4.1, 4.1]], "float32")
+        scores = np.asarray([0.9, 0.8], "float32")
+        cats = np.asarray([0, 1], "int32")
+        idx, nvalid = nms_padded(paddle.to_tensor(boxes),
+                                 paddle.to_tensor(scores),
+                                 iou_threshold=0.3,
+                                 category_idxs=paddle.to_tensor(cats))
+        assert int(nvalid.numpy()) == 2  # same class would suppress box 1
+        # host nms agrees on class-aware semantics
+        from paddle_tpu.vision.ops import nms
+        host = np.asarray(nms(paddle.to_tensor(boxes), 0.3,
+                              paddle.to_tensor(scores),
+                              category_idxs=paddle.to_tensor(cats)).numpy())
+        assert len(host) == 2
+
+    def test_jit_compiles_in_graph(self):
+        """The whole selection runs inside one jitted program."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.vision.ops import nms_padded
+        boxes, scores = self._boxes(seed=5)
+
+        @paddle.jit.to_static
+        def select(b, s):
+            idx, nv = nms_padded(b, s, iou_threshold=0.4, max_output_size=8)
+            return idx, nv
+
+        for _ in range(3):  # through discovery into the compiled program
+            idx, nv = select(paddle.to_tensor(boxes),
+                             paddle.to_tensor(scores))
+        from paddle_tpu.vision.ops import nms
+        host = np.asarray(nms(paddle.to_tensor(boxes), 0.4,
+                              paddle.to_tensor(scores)).numpy())
+        got = np.asarray(idx.numpy())[:int(nv.numpy())]
+        np.testing.assert_array_equal(got, host[:8])
+
+    def test_plain_nms_raises_under_trace(self):
+        from paddle_tpu.vision.ops import nms
+        boxes, scores = self._boxes(seed=7)
+
+        @paddle.jit.to_static
+        def bad(b, s):
+            return nms(b, 0.4, s)
+
+        with pytest.raises(TypeError, match="nms_padded"):
+            for _ in range(3):
+                bad(paddle.to_tensor(boxes), paddle.to_tensor(scores))
