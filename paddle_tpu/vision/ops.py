@@ -56,6 +56,10 @@ def nms_padded(boxes, scores, iou_threshold=0.3, max_output_size=None,
     n = int(unwrap(boxes).shape[0])
     k = int(max_output_size) if max_output_size is not None else n
     thr = float(iou_threshold)
+    if n == 0:
+        # empty proposal set: all-padding result, same contract
+        return (Tensor(jnp.full((k,), -1, jnp.int32)),
+                Tensor(jnp.zeros((), jnp.int32)))
 
     def prim(b, s, *maybe_cat):
         if maybe_cat:
@@ -73,13 +77,16 @@ def nms_padded(boxes, scores, iou_threshold=0.3, max_output_size=None,
             return keep.at[i].set(~sup), ()
 
         keep, _ = jax.lax.scan(body, jnp.zeros((n,), bool), idx)
-        # pack kept slots first (score order), then -1 padding
+        # pack kept slots first (score order), -1 padding out to exactly k
         priority = jnp.where(keep, n - idx, -1)
-        slots = jnp.argsort(-priority)[:k]
+        slots = jnp.argsort(-priority)[:min(k, n)]
         valid = keep[slots]
-        out_idx = jnp.where(valid, order[slots], -1)
+        out_idx = jnp.where(valid, order[slots], -1).astype(jnp.int32)
+        if k > n:  # fixed-size contract even past the proposal count
+            out_idx = jnp.concatenate(
+                [out_idx, jnp.full((k - n,), -1, jnp.int32)])
         num_valid = jnp.minimum(jnp.sum(keep.astype(jnp.int32)), k)
-        return out_idx.astype(jnp.int32), num_valid
+        return out_idx, num_valid
 
     args = [boxes, scores] + ([category_idxs]
                               if category_idxs is not None else [])

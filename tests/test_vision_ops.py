@@ -381,3 +381,20 @@ class TestNmsPadded:
         with pytest.raises(TypeError, match="nms_padded"):
             for _ in range(3):
                 bad(paddle.to_tensor(boxes), paddle.to_tensor(scores))
+
+    def test_padded_contract_edge_cases(self):
+        from paddle_tpu.vision.ops import nms_padded
+        # k > n: fixed size is honored with -1 padding
+        boxes, scores = self._boxes(n=2, seed=9)
+        idx, nv = nms_padded(paddle.to_tensor(boxes),
+                             paddle.to_tensor(scores),
+                             iou_threshold=0.5, max_output_size=5)
+        assert np.asarray(idx.numpy()).shape == (5,)
+        assert (np.asarray(idx.numpy())[int(nv.numpy()):] == -1).all()
+        # zero boxes: all padding, num_valid 0
+        idx0, nv0 = nms_padded(
+            paddle.to_tensor(np.zeros((0, 4), "float32")),
+            paddle.to_tensor(np.zeros((0,), "float32")),
+            max_output_size=4)
+        assert np.asarray(idx0.numpy()).tolist() == [-1, -1, -1, -1]
+        assert int(nv0.numpy()) == 0
