@@ -99,12 +99,19 @@ class AmpScaler:
         # accumulators created lazily DURING this step (first call) also need
         # masking back to their init values — they were not in the snapshot
         seen = {id(t) for t, _ in snapshot}
+        params_by_id = {id(p): p for p, _ in pairs}
         for name, by_param in optimizer._accumulators.items():
             init = optimizer._acc_inits.get(name, 0.0)
-            for t in by_param.values():
+            for pid, t in by_param.items():
                 if id(t) not in seen:
-                    t._value = jnp.where(found, jnp.full_like(t._val, init),
-                                         t._val)
+                    if name == "master_weight" and pid in params_by_id:
+                        # a master created THIS step was initialized from
+                        # the param — the rolled-back param IS its pre-step
+                        # value (a scalar init would zero the model)
+                        restore = params_by_id[pid]._val.astype(t._val.dtype)
+                    else:
+                        restore = jnp.full_like(t._val, init)
+                    t._value = jnp.where(found, restore, t._val)
 
     def update(self):
         if not (self._enable and self._use_dynamic):

@@ -34,21 +34,32 @@ class SGD(Optimizer):
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 name=None):
+                 multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+        self._multi_precision = multi_precision
 
     def _apply_update(self, p, g):
-        vel = self._get_accumulator("velocity", p)
-        lr_ = self._lr.astype(p._val.dtype)
-        g = g.astype(p._val.dtype)
+        mp = self._mp_active(p)
+        vel = self._get_accumulator("velocity", p,
+                                    dtype=jnp.float32 if mp else None)
+        master = self._get_master(p) if mp else None
+        work = master._value if mp else p._value
+        dtype = jnp.float32 if mp else p._val.dtype
+        lr_ = self._lr.astype(dtype)
+        g = g.astype(dtype)
         v_new = self._momentum * vel._value + g
         vel._value = v_new
         if self._use_nesterov:
-            p._value = p._value - lr_ * (g + self._momentum * v_new)
+            new_w = work - lr_ * (g + self._momentum * v_new)
         else:
-            p._value = p._value - lr_ * v_new
+            new_w = work - lr_ * v_new
+        if mp:
+            master._value = new_w
+            p._value = new_w.astype(p._val.dtype)
+        else:
+            p._value = new_w
 
 
 class Adam(Optimizer):
@@ -61,10 +72,13 @@ class Adam(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
         self._lazy_mode = lazy_mode
+        self._multi_precision = multi_precision
 
     def _apply_update(self, p, g):
-        m = self._get_accumulator("moment1", p)
-        v = self._get_accumulator("moment2", p)
+        mp = self._mp_active(p)
+        acc_dtype = jnp.float32 if mp else None
+        m = self._get_accumulator("moment1", p, dtype=acc_dtype)
+        v = self._get_accumulator("moment2", p, dtype=acc_dtype)
         # beta pows + bias correction stay float32 for ALL param dtypes:
         # bf16's 8 mantissa bits round beta2=0.999 to 1.0, collapsing
         # 1-beta2^t to 0 (0/0 updates). Reference MPType policy,
@@ -73,7 +87,9 @@ class Adam(Optimizer):
                                     dtype=jnp.float32)
         b2p = self._get_accumulator("beta2_pow", p, init=1.0, shape=(),
                                     dtype=jnp.float32)
-        dtype = p._val.dtype
+        master = self._get_master(p) if mp else None
+        work = master._value if mp else p._value
+        dtype = jnp.float32 if mp else p._val.dtype
         g = g.astype(dtype)
         lr_ = self._lr.astype(jnp.float32)
         b1 = self._beta1
@@ -91,12 +107,17 @@ class Adam(Optimizer):
         lr_t = (lr_ * jnp.sqrt(1 - b2p_new) / (1 - b1p_new)).astype(dtype)
         eps_t = (self._epsilon * jnp.sqrt(1 - b2p_new)).astype(dtype)
         denom = jnp.sqrt(v_new) + eps_t
-        p._value = p._value - lr_t * (m_new / denom)
+        new_w = work - lr_t * (m_new / denom)
+        if mp:
+            master._value = new_w
+            p._value = new_w.astype(p._val.dtype)
+        else:
+            p._value = new_w
 
     def _apply_sparse_update(self, p, sr, _merged=False):
         """adam_op.h lazy_mode parity: moments decay + param update touch only
         the (merged) grad rows; without lazy_mode the dense rule applies."""
-        if not self._lazy_mode:
+        if not self._lazy_mode or self._mp_active(p):
             return self._apply_update(p, sr.to_dense())
         if not _merged:
             sr = sr.merge()
@@ -135,19 +156,27 @@ class AdamW(Adam):
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, lazy_mode=lazy_mode)
+                         None, grad_clip, lazy_mode=lazy_mode,
+                         multi_precision=multi_precision)
         self._coeff = float(weight_decay) if weight_decay is not None else 0.0
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _apply_update(self, p, g):
         if self._coeff and (self._apply_decay_param_fun is None
                             or self._apply_decay_param_fun(p.name)):
-            lr_ = self._lr.astype(p._val.dtype)
-            p._value = p._value * (1.0 - lr_ * self._coeff)
+            if self._mp_active(p):
+                mw = self._get_master(p)
+                lr_ = self._lr.astype(jnp.float32)
+                mw._value = mw._value * (1.0 - lr_ * self._coeff)
+            else:
+                lr_ = self._lr.astype(p._val.dtype)
+                p._value = p._value * (1.0 - lr_ * self._coeff)
         super()._apply_update(p, g)
 
     def _apply_sparse_update(self, p, sr):
-        if not self._lazy_mode:
+        if not self._lazy_mode or self._mp_active(p):
+            # mp: the dense path decays the MASTER; row-decaying the bf16
+            # param here would be discarded by the master writeback
             return self._apply_update(p, sr.to_dense())
         # lazy decoupled decay: only the touched (merged) rows decay —
         # reference sparse AdamW row semantics

@@ -65,6 +65,24 @@ class Optimizer:
         return self._learning_rate._value
 
     # -- accumulators -----------------------------------------------------------
+    # -- multi-precision support (reference adam_op.h MPDType path:
+    # fp32 master weights + fp32 accumulators for fp16/bf16 params) --------
+    _multi_precision = False  # optimizers with the flag set it in __init__
+
+    def _mp_active(self, p):
+        return self._multi_precision and p._val.dtype in (
+            jnp.bfloat16.dtype, jnp.float16.dtype)
+
+    def _get_master(self, p):
+        accs = self._accumulators["master_weight"]
+        mw = accs.get(id(p))
+        if mw is None:
+            mw = Tensor(unwrap(p._value).astype(jnp.float32))
+            mw.persistable = True
+            accs[id(p)] = mw
+            self._acc_inits["master_weight"] = 0.0
+        return mw
+
     def _get_accumulator(self, name, param, init=0.0, dtype=None, shape=None):
         key = id(param)
         self._acc_inits[name] = init

@@ -134,3 +134,114 @@ class TestBertLossParity:
         ref = _curve(self._model, self._data, "f32", lr=2e-3)
         amp = _curve(self._model, self._data, "amp", lr=2e-3)
         _assert_parity(ref, amp, 0.05, "bert amp")
+
+
+class TestMultiPrecision:
+    """multi_precision=True: fp32 master weights + fp32 accumulators for
+    bf16 params (reference adam_op.h MPDType path). The mp curve must track
+    fp32 TIGHTER than pure-bf16 state, params stay bf16, and the master
+    weights live in the optimizer state dict."""
+
+    def _data(self):
+        rng = np.random.RandomState(0)
+        protos = rng.randn(10, 1, 28, 28).astype("float32")
+        ys = rng.randint(0, 10, (STEPS, 32))
+        xs = (protos[ys] + 0.3 * rng.randn(STEPS, 32, 1, 28, 28)
+              ).astype("float32")
+        return xs, ys.astype("int64")
+
+    def _curve_opt(self, opt_factory, bf16):
+        paddle.seed(0)
+        model = paddle.vision.models.LeNet()
+        if bf16:
+            model.bfloat16()
+        opt = opt_factory(model)
+        xs, ys = self._data()
+        if bf16:
+            xs = xs.astype("bfloat16")
+
+        @paddle.jit.to_static
+        def step(x, y):
+            loss = F.cross_entropy(model(x).astype("float32"), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        losses = step.run_steps(paddle.to_tensor(xs), paddle.to_tensor(ys))
+        return (np.asarray(losses.numpy(), np.float64), model, opt)
+
+    def test_adam_mp_tracks_fp32_tighter_than_bf16(self):
+        mk = lambda mp: (lambda m: paddle.optimizer.Adam(
+            learning_rate=1e-3, parameters=m.parameters(),
+            multi_precision=mp))
+        ref, _, _ = self._curve_opt(mk(False), bf16=False)
+        bf, _, _ = self._curve_opt(mk(False), bf16=True)
+        mp, model, opt = self._curve_opt(mk(True), bf16=True)
+        mask = ref >= 0.25
+        rel_bf = (np.abs(bf - ref)[mask] / ref[mask]).mean()
+        rel_mp = (np.abs(mp - ref)[mask] / ref[mask]).mean()
+        assert rel_mp < rel_bf, (rel_mp, rel_bf)
+        assert rel_mp < 0.02, rel_mp
+        # params stay bf16; masters are fp32 and in the state dict
+        p0 = next(iter(model.parameters()))
+        assert str(p0.dtype) == "bfloat16"
+        mw = opt._accumulators["master_weight"]
+        assert mw and all(str(t._val.dtype) == "float32"
+                          for t in mw.values())
+
+    def test_momentum_mp_tracks_fp32(self):
+        mk = lambda mp: (lambda m: paddle.optimizer.Momentum(
+            learning_rate=0.02, momentum=0.9, parameters=m.parameters(),
+            multi_precision=mp))
+        ref, _, _ = self._curve_opt(mk(False), bf16=False)
+        mp, _, _ = self._curve_opt(mk(True), bf16=True)
+        mask = ref >= 0.25
+        rel = (np.abs(mp - ref)[mask] / ref[mask]).mean()
+        assert rel < 0.02, rel
+
+    def test_state_dict_roundtrip_preserves_master(self):
+        mk = lambda m: paddle.optimizer.Adam(
+            learning_rate=1e-3, parameters=m.parameters(),
+            multi_precision=True)
+        _, model, opt = self._curve_opt(mk, bf16=True)
+        sd = opt.state_dict()
+        assert any("master" in str(k) for k in sd), list(sd)[:5]
+        paddle.seed(0)
+        m2 = paddle.vision.models.LeNet()
+        m2.bfloat16()
+        o2 = paddle.optimizer.Adam(learning_rate=1e-3,
+                                   parameters=m2.parameters(),
+                                   multi_precision=True)
+        o2.set_state_dict(sd)
+
+    def test_grad_scaler_inf_on_first_step_preserves_masters(self):
+        """An inf gradient on the step that lazily CREATES the fp32
+        masters must roll them back to the param values, not zeros."""
+        paddle.seed(0)
+        model = paddle.vision.models.LeNet()
+        model.bfloat16()
+        before = {k: np.asarray(v._val, np.float32)
+                  for k, v in model.state_dict().items()}
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters(),
+                                    multi_precision=True)
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+        x = paddle.to_tensor(
+            np.full((4, 1, 28, 28), np.inf, "float32").astype("float32")
+        ).astype("bfloat16")
+        y = paddle.to_tensor(np.zeros((4,), "int64"))
+        loss = F.cross_entropy(model(x).astype("float32"), y)
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        # inf step: params unchanged AND masters == params (not zeros)
+        for k, v in model.state_dict().items():
+            np.testing.assert_array_equal(np.asarray(v._val, np.float32),
+                                          before[k], err_msg=k)
+        params_by_id = {id(p): p for p in model.parameters()}
+        for pid, mw in opt._accumulators["master_weight"].items():
+            np.testing.assert_array_equal(
+                np.asarray(mw._val),
+                np.asarray(params_by_id[pid]._val, np.float32))
