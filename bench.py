@@ -212,7 +212,7 @@ def _transformer_flops_per_token(n_params, n_layers, seq, hidden):
     return 6.0 * n_params + 12.0 * n_layers * seq * hidden
 
 
-def bench_bert():
+def bench_bert(arch=None):
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F  # noqa: F401
     from paddle_tpu.text.models import BertForSequenceClassification
@@ -223,9 +223,19 @@ def bench_bert():
     steps = int(os.environ.get("BENCH_STEPS", 192))
 
     paddle.seed(0)
-    cfg = BertConfig.base()
-    cfg.dropout = 0.0  # determinism for throughput measurement
-    model = BertForSequenceClassification(cfg, num_classes=2)
+    if arch == "ernie":
+        # ERNIE-base (BASELINE config 3 names it explicitly): BERT
+        # architecture with ERNIE's vocab/type geometry
+        from paddle_tpu.text.models.ernie import (
+            ErnieConfig, ErnieForSequenceClassification,
+        )
+        cfg = ErnieConfig()
+        cfg.dropout = 0.0
+        model = ErnieForSequenceClassification(cfg, num_classes=2)
+    else:
+        cfg = BertConfig.base()
+        cfg.dropout = 0.0  # determinism for throughput measurement
+        model = BertForSequenceClassification(cfg, num_classes=2)
     precision = _apply_dtype(model)
     opt = paddle.optimizer.AdamW(learning_rate=5e-5,
                                  parameters=model.parameters())
@@ -246,14 +256,14 @@ def bench_bert():
 
     # 64-step scans amortize relay dispatch latency (155k -> 172k tok/s
     # over spe=16 on v5e)
-    dt = _timed_steps(step, (x, y), steps, curve_key="bert",
+    dt = _timed_steps(step, (x, y), steps, curve_key=arch or "bert",
                       spe_default=64)
     tokens = batch * seq * steps
     tps = tokens / dt
     fpt = _transformer_flops_per_token(
         _param_count(model), cfg.num_layers, seq, cfg.hidden_size)
     return {
-        "metric": "bert_base_train_tokens_per_sec_per_chip",
+        "metric": f"{arch or 'bert'}_base_train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 3),
@@ -407,7 +417,8 @@ def bench_lenet():
 
 
 _BENCHES = {"bert": bench_bert, "resnet50": bench_resnet50,
-            "gpt": bench_gpt, "lenet": bench_lenet}
+            "gpt": bench_gpt, "lenet": bench_lenet,
+            "ernie": lambda: bench_bert(arch="ernie")}
 
 
 def main():

@@ -71,7 +71,9 @@ amp_guard = auto_cast
 
 def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
              master_weight=None, save_dtype=None):
-    """paddle.amp.decorate parity: O2 casts model params to the low dtype."""
+    """paddle.amp.decorate parity: O2 casts model params to the low dtype
+    and (reference default master_weight=None => True at O2) flips the
+    optimizers to multi_precision so fp32 masters back the cast params."""
     d = convert_dtype(dtype)
     single = not isinstance(models, (list, tuple))
     model_list = [models] if single else list(models)
@@ -80,4 +82,8 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
             m.to(dtype=d)
     if optimizers is None:
         return models if single else model_list
+    if level == "O2" and (master_weight is None or master_weight):
+        opt_single = not isinstance(optimizers, (list, tuple))
+        for o in ([optimizers] if opt_single else optimizers):
+            o._multi_precision = True
     return (models if single else model_list), optimizers

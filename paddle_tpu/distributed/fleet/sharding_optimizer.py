@@ -60,17 +60,34 @@ class ShardingOptimizerWrapper:
             orig = optimizer._get_accumulator
             mesh = get_mesh()
 
-            def sharded_get(name, param, init=0.0, dtype=None, shape=None):
-                existed = id(param) in optimizer._accumulators[name]
-                acc = orig(name, param, init=init, dtype=dtype, shape=shape)
+            def _shard_new(acc, existed):
                 if not existed:
-                    spec = _shard_spec_for(tuple(acc._val.shape), degree, axis)
+                    spec = _shard_spec_for(tuple(acc._val.shape), degree,
+                                           axis)
                     if spec is not None:
                         acc._value = jax.device_put(
                             acc._val, NamedSharding(mesh, spec))
                 return acc
 
+            def sharded_get(name, param, init=0.0, dtype=None, shape=None):
+                existed = id(param) in optimizer._accumulators[name]
+                return _shard_new(
+                    orig(name, param, init=init, dtype=dtype, shape=shape),
+                    existed)
+
             optimizer._get_accumulator = sharded_get
+
+            # multi-precision masters are created outside _get_accumulator
+            # (Optimizer._get_master, initialized FROM the param) — born
+            # sharded the same way
+            orig_master = optimizer._get_master
+
+            def sharded_master(param):
+                existed = id(param) in optimizer._accumulators[
+                    "master_weight"]
+                return _shard_new(orig_master(param), existed)
+
+            optimizer._get_master = sharded_master
             if shard_params and optimizer._parameter_list:
                 for p in optimizer._parameter_list:
                     spec = _shard_spec_for(tuple(p._val.shape), degree, axis)

@@ -133,7 +133,15 @@ class Optimizer:
                 g = Tensor(unwrap(g) + reg.grad_term(p._value),
                            stop_gradient=True)
             elif coeff:
-                g = Tensor(unwrap(g) + coeff * p._value, stop_gradient=True)
+                if self._mp_active(p):
+                    # fp32 decay against the master: a bf16 decay term can
+                    # round away entirely (ulp at |g|=0.1 is ~4e-4)
+                    mw = self._get_master(p)
+                    g = Tensor(unwrap(g).astype(jnp.float32)
+                               + coeff * mw._value, stop_gradient=True)
+                else:
+                    g = Tensor(unwrap(g) + coeff * p._value,
+                               stop_gradient=True)
             out.append((p, g))
         return out
 

@@ -295,6 +295,51 @@ class TestShardingZeRO1:
                     sharded.append(acc)
         assert sharded, "no optimizer accumulator was ZeRO-sharded"
 
+    def test_multi_precision_masters_sharded_and_parity(self, mesh_guard):
+        """ZeRO + multi_precision: the fp32 masters are born sharded over
+        the 'sharding' axis and training matches an unsharded mp run."""
+        fleet, _ = _fresh_fleet({"dp_degree": 2, "sharding_degree": 4})
+
+        def mk():
+            m = _mlp(seed=9, din=8, dh=32, dout=4)
+            m.bfloat16()
+            return m
+
+        model, ref = mk(), mk()
+        _clone_weights(model, ref)
+        dist = fleet.distributed_model(model)
+        opt = fleet.distributed_optimizer(paddle.optimizer.Adam(
+            learning_rate=1e-2, parameters=model.parameters(),
+            multi_precision=True))
+        opt_ref = paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=ref.parameters(),
+                                        multi_precision=True)
+        rng = np.random.RandomState(2)
+        for _ in range(3):
+            x = paddle.to_tensor(rng.randn(8, 8).astype("f4")
+                                 .astype("float32")).astype("bfloat16")
+            y = paddle.to_tensor(rng.randint(0, 4, (8, 1)).astype("int64"))
+            loss = F.cross_entropy(dist(x).astype("float32"), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            loss_r = F.cross_entropy(ref(x).astype("float32"), y)
+            loss_r.backward()
+            opt_ref.step()
+            opt_ref.clear_grad()
+        for (k, p), (_, pr) in zip(model.state_dict().items(),
+                                   ref.state_dict().items()):
+            np.testing.assert_allclose(
+                np.asarray(p._val, np.float32),
+                np.asarray(pr._val, np.float32),
+                rtol=1e-2, atol=1e-3, err_msg=k)
+        masters = opt._inner._accumulators["master_weight"]
+        assert masters
+        sharded = [mw for mw in masters.values()
+                   if isinstance(mw._val.sharding, NamedSharding)
+                   and "sharding" in (mw._val.sharding.spec or ())]
+        assert sharded, "no fp32 master was ZeRO-sharded"
+
 
 class TestPipelineParallel:
     """Real 1F1B pipeline (pp=2 x dp=4) vs serial grad-accumulation run.
