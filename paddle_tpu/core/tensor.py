@@ -270,12 +270,16 @@ class Tensor:
                 if out is not None:
                     g = out._val if isinstance(out, Tensor) else jnp.asarray(out)
         if self.grad is None:
-            self.grad = Tensor(g, stop_gradient=True)
-        else:
-            # accumulate IN PLACE on the existing grad tensor (hooked write):
-            # gradient-merge/no-clear flows keep `.grad` alive across compiled
-            # programs, so the object must stay stable for state capture
-            self.grad._value = self.grad._value + g
+            # create NEUTRAL (zeros) and land the first gradient via the
+            # hooked write below: the tensor's creation value must mean
+            # "no gradient yet" so trace/discovery rollback (to_static
+            # batch-1 throwaway) restores an empty accumulator, not the
+            # first gradient it happened to see
+            self.grad = Tensor(jnp.zeros_like(g), stop_gradient=True)
+        # accumulate IN PLACE on the existing grad tensor (hooked write):
+        # gradient-merge/no-clear flows keep `.grad` alive across compiled
+        # programs, so the object must stay stable for state capture
+        self.grad._value = self.grad._value + g
 
     def register_hook(self, hook):
         """Gradient hook on a leaf (imperative/hooks.h parity)."""

@@ -124,7 +124,11 @@ class Config:
                                min_subgraph_size=3, precision_mode=None,
                                use_static=False, use_calib_mode=False):
         # TRT subgraph capture has no analog: XLA compiles the whole graph.
-        if precision_mode in (DataType.FLOAT16, DataType.BFLOAT16):
+        # precision accepted in either enum spelling (DataType / the
+        # analysis_config PrecisionType the real API uses)
+        low = (DataType.FLOAT16, DataType.BFLOAT16,
+               PrecisionType.Half, PrecisionType.Bfloat16)
+        if precision_mode in low:
             self._precision = DataType.BFLOAT16
             warnings.warn(
                 "enable_tensorrt_engine: no TRT subgraphs under XLA — only "

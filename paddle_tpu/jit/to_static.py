@@ -513,9 +513,25 @@ class StaticFunction:
         ctx = _DiscoveryCtx([id(t) for t in arg_tensors])
         snaps = []
         snap_ids = set()
+        grad_snaps = []
+        grad_ids = set()
+
+        def _note_grad(t):
+            # SelectedRows gradients rebind `.grad` without a hooked _value
+            # write, so value-rollback alone would leave the throwaway's
+            # batch-1 sparse grad attached; remember the pre-pass attribute
+            i = id(t)
+            if i not in grad_ids:
+                grad_ids.add(i)
+                grad_snaps.append((t, t.grad))
+
+        def on_read(t):
+            _note_grad(t)
+            ctx.on_read(t)
 
         def on_write(t, new_value=None):
             i = id(t)
+            _note_grad(t)
             if i not in snap_ids:
                 snap_ids.add(i)
                 snaps.append((t, t._val))
@@ -523,7 +539,7 @@ class StaticFunction:
 
         prev = (_TraceHooks.on_read, _TraceHooks.on_write,
                 _TraceHooks.on_create)
-        _TraceHooks.on_read = ctx.on_read
+        _TraceHooks.on_read = on_read
         _TraceHooks.on_write = on_write
         _TraceHooks.on_create = ctx.on_create
         bwd_before = autograd.backward_run_counter[0]
@@ -539,6 +555,13 @@ class StaticFunction:
              _TraceHooks.on_create) = prev
             for t, v in snaps:
                 t._val = v
+            from ..core.selected_rows import SelectedRows
+            for t, g_old in grad_snaps:
+                # dense grads roll back via the hooked-write snapshot (and
+                # stay attached as zeroed state); sparse ones must have the
+                # ATTRIBUTE restored
+                if isinstance(t.grad, SelectedRows) and t.grad is not g_old:
+                    t.grad = g_old
         if not ok:
             return False
         prog = self._programs.get(key) or _Program()

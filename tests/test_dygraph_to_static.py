@@ -244,3 +244,61 @@ class TestRunSteps:
         # threads the key, so assert within sums[2:]
         scanned = np.round(sums[2:], 4)
         assert len(set(scanned)) > 1, sums
+
+
+class TestFastDiscoveryGradAccumulation:
+    """Batch-1 throwaway discovery must leave accumulation-pattern state
+    (p.grad persisting ACROSS steps, cleared only every k steps) exactly
+    as if no discovery pass ever ran: grad tensors created during the
+    throwaway roll back to their creation value (zeros == absent)."""
+
+    def test_parity_with_serial_accumulation(self):
+        import paddle_tpu.nn.functional as F
+
+        def make():
+            paddle.seed(4)
+            m = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 3))
+            o = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=m.parameters())
+            return m, o
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(8, 4, 6).astype("float32")
+        Y = rng.randint(0, 3, (8, 4)).astype("int64")
+
+        # serial: eager accumulation reference
+        m1, o1 = make()
+        serial = []
+        for i in range(8):
+            loss = paddle.nn.functional.cross_entropy(
+                m1(paddle.to_tensor(X[i])), paddle.to_tensor(Y[i]))
+            loss.backward()
+            serial.append(float(loss.numpy()))
+            if i % 2 == 1:
+                o1.step()
+                o1.clear_grad()
+
+        # scanned: same pattern, grads live across scanned steps; the
+        # update runs OUTSIDE run_steps every 2 steps
+        m2, o2 = make()
+
+        @paddle.jit.to_static
+        def accum2(x, y):
+            loss = F.cross_entropy(m2(x), y)
+            loss.backward()
+            return loss
+
+        scanned = []
+        for i in range(0, 8, 2):
+            ls = accum2.run_steps(paddle.to_tensor(X[i:i + 2]),
+                                  paddle.to_tensor(Y[i:i + 2]))
+            scanned.extend(float(v) for v in np.asarray(ls.numpy()))
+            o2.step()
+            # contract: state mutated BETWEEN compiled calls must go
+            # through the captured tensors — set_to_zero writes zeros into
+            # the captured grad buffers; plain clear_grad() would DETACH
+            # p.grad and leave the program reading stale accumulation
+            # state (see run_steps docstring)
+            o2.clear_grad(set_to_zero=True)
+
+        np.testing.assert_allclose(scanned, serial, rtol=2e-5, atol=1e-6)
