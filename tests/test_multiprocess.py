@@ -184,6 +184,8 @@ def _spawn(world, mode):
             "PADDLE_TPU_P2P_ENDPOINTS": ",".join(
                 f"127.0.0.1:{p}" for p in ports[1:1 + world]),
             "PADDLE_TPU_P2P_RECV_TIMEOUT": "120",
+            # every frame HMAC-authenticated end-to-end (wire.py)
+            "PADDLE_TPU_WIRE_SECRET": "mp-test-secret",
         })
         procs.append(subprocess.Popen(
             [sys.executable, "-c", WORKER], env=env,
