@@ -84,7 +84,50 @@ def apply(prim, *args, name=None, **kwargs):
     return _apply_impl(prim, args, kwargs, name)
 
 
+_AMP_MODULE = None
+
+
+def _amp_module():
+    """The amp.auto_cast MODULE (the package re-exports a same-named
+    function, so a plain `from ..amp import auto_cast` grabs the function);
+    imported lazily to avoid a core<->amp import cycle."""
+    global _AMP_MODULE
+    if _AMP_MODULE is None:
+        import importlib
+        _AMP_MODULE = importlib.import_module("paddle_tpu.amp.auto_cast")
+    return _AMP_MODULE
+
+
+def _amp_cast_prim(prim, target):
+    """Fold AMP input casts INSIDE the differentiated function so jax.vjp
+    routes cotangents back through the cast — grads for f32 params arrive in
+    f32 even when the op computed in bf16 (imperative/amp_auto_cast.cc
+    CastToFP16/NeedCast parity)."""
+    import numpy as np
+
+    target = np.dtype(target)
+
+    def run(*vals, **kw):
+        cast = [v.astype(target)
+                if _is_diff_value(v) and v.dtype != target else v
+                for v in vals]
+        return prim(*cast, **kw)
+
+    run.__name__ = getattr(prim, "__name__", "op")
+    return run
+
+
 def _apply_impl(prim, args, kwargs, name):
+    # AMP O1/O2: white-list ops compute in the low dtype, black-list ops are
+    # promoted to f32 (softmax/norm/loss numerics) — consulted per-op at this
+    # single dispatch seam, the tracer.cc AmpOperators analog
+    _amp = _amp_module()
+    if _amp.is_enabled() and name is not None:
+        if _amp.should_cast_to_low(name):
+            prim = _amp_cast_prim(prim, _amp.amp_dtype())
+        elif _amp.should_cast_to_high(name):
+            from .dtypes import float32
+            prim = _amp_cast_prim(prim, float32)
     # NOTE: unwrap() reads Tensor._value, which (under host staging) pulls
     # accelerator-resident state back to the host before eager execution —
     # see core/tensor.py _pull_host_value.

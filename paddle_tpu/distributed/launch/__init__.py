@@ -50,11 +50,37 @@ def launch(argv=None):
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     attempts = args.elastic_retries + 1
     last_err = None
+    # wire-channel authentication (README §Security): the p2p/PS TCP frames
+    # run unauthenticated only on single-host loopback; a multi-host job gets
+    # an auto-generated HMAC secret injected into every trainer unless the
+    # operator already set one. Reference trust-model seam:
+    # platform/gen_comm_id_helper.cc:333 (comm bootstrap over trusted net).
+    # empty string counts as unset (wire.py's _secret() treats '' as none)
+    wire_secret = os.environ.get("PADDLE_TPU_WIRE_SECRET") or None
+    # multi-host = more than one ip: with a single ip (loopback OR a real
+    # address) this launcher owns every rank and one generated secret
+    # reaches them all through the child env
+    multi_host = len([ip for ip in args.ips.split(",") if ip.strip()]) > 1
+    if wire_secret is None:
+        if multi_host:
+            # can't auto-generate here: each host runs its own launcher and
+            # independently generated secrets would reject each other's
+            # frames — the operator must distribute one
+            print("[launch] WARNING: multi-host job without "
+                  "PADDLE_TPU_WIRE_SECRET — p2p/PS wire frames run "
+                  "unauthenticated. Set the same secret on every host.",
+                  file=sys.stderr)
+        else:
+            # single launcher owns every rank: children inherit one secret
+            import secrets
+            wire_secret = secrets.token_hex(32)
     for attempt in range(attempts):
         cluster, pod = get_cluster_from_args(
             ips=args.ips, nproc_per_node=args.nproc_per_node,
             start_port=args.start_port)
         envs = {}
+        if wire_secret is not None:
+            envs["PADDLE_TPU_WIRE_SECRET"] = wire_secret
         if args.cpu_sim:
             envs["JAX_PLATFORMS"] = "cpu"
         procs = start_local_trainers(

@@ -319,7 +319,13 @@ def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):  # noqa: A002
     import jax.numpy as jnp
 
     from ..core.dispatch import apply
+    from ..core.dtypes import convert_dtype
     from ..core.random import next_key_data
+
+    # narrow the requested dtype through the x64 policy (int64 -> int32,
+    # README §Scope) BEFORE astype, so jax never sees — and warns about —
+    # an unavailable 64-bit request
+    dtype = convert_dtype(dtype)
 
     if seed:  # reference contract: fixed nonzero seed -> deterministic
         def prim_seeded(p):

@@ -1,11 +1,22 @@
-"""Flash attention (Pallas/TPU).
+"""Flash attention (Pallas/TPU) — forward AND backward kernels.
 
 Reference analog: operators/fused/fused_attention_op.cu + fmha_ref.h (cuDNN
-FMHA). TPU-native: online-softmax tiled attention in VMEM — O(S) memory
-instead of the O(S^2) probability matrix; the MXU does the q@k^T and p@v
-matmuls per tile. Causal masking skips fully-masked k-tiles via the grid.
+FMHA fwd/bwd). TPU-native: online-softmax tiled attention in VMEM — O(S)
+memory instead of the O(S^2) probability matrix; the MXU does the q@k^T and
+p@v matmuls per tile. Causal masking skips fully-masked k-tiles via the grid.
 
-Layout: inputs (B, S, H, D) paddle convention; kernel works on (B*H, S, D).
+Backward follows the FlashAttention-2 recompute scheme: the forward saves
+only the per-row logsumexp L; the backward re-forms each P tile from
+(q, k, L) in VMEM and accumulates
+    dV_j += P_ij^T dO_i
+    dS_ij = P_ij * (dO_i V_j^T - D_i),   D = rowsum(dO * O)
+    dK_j += dS_ij^T (q_i * scale)
+    dQ_i += dS_ij (k_j * scale)
+in two kernels (dkv over k-tiles, dq over q-tiles) so no tile ever needs
+atomics. Head dims of 64 are supported (VMEM pads the lane dim; the
+s^2-materializing XLA fallback costs far more than the padding).
+
+Layout: inputs (B, S, H, D) paddle convention; kernels work on (B*H, S, D).
 """
 from __future__ import annotations
 
@@ -15,13 +26,42 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 512-blocks measured 2.7x faster than 128-blocks on v5e (0.66 vs 1.78
+# ms/iter fwd+bwd at b4/s1024/h16/d64): bigger MXU matmuls, fewer inner-loop
+# trips. Public entry points clamp to the sequence length, so short-seq
+# callers (BERT s=128) degrade gracefully to seq-sized blocks.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
-                 seq_k):
-    # q_ref: (block_q, d); k_ref/v_ref: (seq_k, d); o_ref: (block_q, d)
+def _interpret(x=None):
+    # off-TPU (CPU CI) the Mosaic backend is unavailable: run the same
+    # kernels under the pallas interpreter so numerics/tests cover this
+    # path everywhere. The decision must be PER CALL, from the concrete
+    # input's placement when available: under host staging (axon relay) the
+    # default backend is the TPU but eager discovery passes execute on the
+    # host CPU — pallas would otherwise lower Mosaic for a CPU computation
+    # and fail.
+    if x is not None:
+        try:
+            return all(d.platform not in ("tpu", "axon")
+                       for d in x.devices())
+        except Exception:
+            pass  # tracer: placement decided by the outer jit
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal,
+                     block_k, seq_k):
+    # q_ref: (block_q, d); k_ref/v_ref: (seq_k, d); o_ref: (block_q, d);
+    # l_ref: (block_q, 128) logsumexp rows broadcast across the lane dim —
+    # Mosaic requires the last two block dims to be (8k, 128), so per-row
+    # scalars ride in a 128-wide lane (the official TPU flash kernels use
+    # the same MIN_BLOCK_SIZE padding)
     block_q = q_ref.shape[0]
     d = q_ref.shape[1]
     q_idx = pl.program_id(1)
@@ -37,7 +77,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
         m_prev, l_prev, acc = carry
         k_tile = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
         v_tile = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k_tile.T  # (block_q, block_k) on the MXU
+        s = jnp.dot(q, k_tile.T, preferred_element_type=jnp.float32)
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -49,7 +89,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
         p = jnp.exp(s - m_new[:, None])
         correction = jnp.exp(m_prev - m_new)
         l_new = l_prev * correction + jnp.sum(p, axis=1)
-        acc = acc * correction[:, None] + p @ v_tile
+        acc = acc * correction[:, None] + jnp.dot(
+            p, v_tile, preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
     if causal:
@@ -60,21 +101,21 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
     else:
         m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
 
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse = m + jnp.log(l_safe)
+    l_ref[:] = jnp.broadcast_to(lse[:, None], (block_q, 128))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k"))
-def _flash_bh(q, k, v, causal, scale, block_q, block_k):
-    # q,k,v: (BH, S, D)
+                                             "block_k", "interpret"))
+def _flash_fwd_bh(q, k, v, causal, scale, block_q, block_k, interpret):
+    # q,k,v: (BH, S, D) -> out (BH, S, D), lse (BH, S)
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     grid = (bh, seq_q // block_q)
-    # off-TPU (CPU CI) the Mosaic backend is unavailable: run the same kernel
-    # under the pallas interpreter so numerics/tests cover this path everywhere
-    interpret = jax.default_backend() not in ("tpu", "axon")
-    out = pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale, causal=causal,
+    out, lse = pl.pallas_call(
+        functools.partial(_attn_fwd_kernel, scale=scale, causal=causal,
                           block_k=block_k, seq_k=seq_k),
         grid=grid,
         interpret=interpret,
@@ -83,26 +124,224 @@ def _flash_bh(q, k, v, causal, scale, block_q, block_k):
             pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 128), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q, 128), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _attn_bwd_dkv_kernel(q_ref, do_ref, l_ref, dd_ref, k_ref, v_ref,
+                         dk_ref, dv_ref, *, scale, causal, block_q, seq_q):
+    # k_ref/v_ref: (block_k, d) this k-tile; q_ref/do_ref: (seq_q, d);
+    # l_ref/dd_ref: (seq_q, 128) lane-broadcast rows; dk/dv: (block_k, d)
+    block_k = k_ref.shape[0]
+    d = k_ref.shape[1]
+    k_idx = pl.program_id(1)
+    k_tile = k_ref[:].astype(jnp.float32)
+    v_tile = v_ref[:].astype(jnp.float32)
+
+    dk0 = jnp.zeros((block_k, d), dtype=jnp.float32)
+    dv0 = jnp.zeros((block_k, d), dtype=jnp.float32)
+    num_q_blocks = seq_q // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_tile = (q_ref[pl.dslice(qb * block_q, block_q), :]
+                  .astype(jnp.float32) * scale)
+        do_tile = do_ref[pl.dslice(qb * block_q, block_q), :].astype(
+            jnp.float32)
+        l_col = l_ref[pl.dslice(qb * block_q, block_q), :][:, :1]
+        d_col = dd_ref[pl.dslice(qb * block_q, block_q), :][:, :1]
+        s = jnp.dot(q_tile, k_tile.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - l_col)  # (block_q, block_k)
+        dv = dv + jnp.dot(p.T, do_tile, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_tile, v_tile.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - d_col)
+        dk = dk + jnp.dot(ds.T, q_tile, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # only q-blocks at/below the diagonal see this k-tile
+        start_qb = (k_idx * block_k) // block_q
+        dk, dv = jax.lax.fori_loop(start_qb, num_q_blocks, body, (dk0, dv0))
+    else:
+        dk, dv = jax.lax.fori_loop(0, num_q_blocks, body, (dk0, dv0))
+
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _attn_bwd_dq_kernel(q_ref, do_ref, l_ref, dd_ref, k_ref, v_ref, dq_ref,
+                        *, scale, causal, block_k, seq_k):
+    # q_ref/do_ref/dq_ref: (block_q, d); k_ref/v_ref: (seq_k, d);
+    # l_ref/dd_ref: (block_q, 128) lane-broadcast rows
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q_idx = pl.program_id(1)
+    q_tile = q_ref[:].astype(jnp.float32) * scale
+    do_tile = do_ref[:].astype(jnp.float32)
+    l_col = l_ref[:][:, :1]
+    d_col = dd_ref[:][:, :1]
+
+    dq0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+    num_k_blocks = seq_k // block_k
+
+    def body(kb, dq):
+        k_tile = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q_tile, k_tile.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - l_col)
+        dp = jnp.dot(do_tile, v_tile.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - d_col)
+        return dq + jnp.dot(ds, k_tile, preferred_element_type=jnp.float32)
+
+    if causal:
+        last_kb = jnp.minimum(
+            ((q_idx + 1) * block_q + block_k - 1) // block_k, num_k_blocks)
+        dq = jax.lax.fori_loop(0, last_kb, body, dq0)
+    else:
+        dq = jax.lax.fori_loop(0, num_k_blocks, body, dq0)
+
+    # dS was formed against q*scale, so the q cotangent carries the scale
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def _flash_bwd_bh(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                  interpret):
+    # all (BH, S, D) except lse (BH, S); returns dq, dk, dv
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    # D = rowsum(dO * O): one fused elementwise+reduce pass, reads dO/O once.
+    # lse/delta ride in (bh, seq, 128) lane-broadcast form (Mosaic block
+    # constraint — see _attn_fwd_kernel note).
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse3 = jnp.broadcast_to(lse[:, :, None], (bh, seq_q, 128))
+    delta3 = jnp.broadcast_to(delta[:, :, None], (bh, seq_q, 128))
+
+    dkv = pl.pallas_call(
+        functools.partial(_attn_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_q=seq_q),
+        grid=(bh, seq_k // block_k),
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((None, seq_q, d), lambda b, j: (b, 0, 0)),    # q
+            pl.BlockSpec((None, seq_q, d), lambda b, j: (b, 0, 0)),    # do
+            pl.BlockSpec((None, seq_q, 128), lambda b, j: (b, 0, 0)),  # lse
+            pl.BlockSpec((None, seq_q, 128), lambda b, j: (b, 0, 0)),  # delta
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),  # k
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),  # v
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
+        ],
+    )(q, do, lse3, delta3, k, v)
+    dk, dv = dkv
+
+    dq = pl.pallas_call(
+        functools.partial(_attn_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_k=seq_k),
+        grid=(bh, seq_q // block_q),
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),   # q
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),   # do
+            pl.BlockSpec((None, block_q, 128), lambda b, i: (b, i, 0)),  # lse
+            pl.BlockSpec((None, block_q, 128), lambda b, i: (b, i, 0)),  # dlt
+            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),     # k
+            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),     # v
+        ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
-    )(q, k, v)
-    return out
+    )(q, do, lse3, delta3, k, v)
+    return dq, dk, dv
 
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
 
 def supports(q_shape, k_shape):
     b, s_q, h, d = q_shape
     s_k = k_shape[1]
-    return (s_q % DEFAULT_BLOCK_Q == 0 and s_k % DEFAULT_BLOCK_K == 0
-            and d % 128 == 0 and s_q == s_k)
+    return (s_q % 128 == 0 and s_k % 128 == 0
+            and d % 64 == 0 and s_q == s_k)
+
+
+def _clamp(block, seq):
+    """Largest block <= `block` that DIVIDES seq — the grids/inner loops use
+    integer division, so a non-dividing block would silently truncate the
+    trailing rows (supports() admits any s % 128 == 0, e.g. 768)."""
+    b = min(block, seq)
+    while seq % b:
+        b //= 2
+    return b
+
+
+def _to_bh(x):
+    b, s, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+
+
+def _from_bh(x, b, h):
+    bh, s, d = x.shape
+    return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
 
 
 def flash_attention(q, k, v, causal=False, scale=1.0,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """q,k,v: (B, S, H, D) -> (B, S, H, D). Forward only (jax.custom_vjp with
-    the standard recompute backward is wired in attention.py when selected)."""
+    """q,k,v: (B, S, H, D) -> (B, S, H, D). Forward only; use
+    flash_attention_vjp for the Pallas-backward pair (attention.py wires it
+    through jax.custom_vjp)."""
+    out, _ = flash_attention_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=1.0,
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Returns (out, lse) with lse (B, H, S) float32 — the residual the
+    Pallas backward needs."""
     b, s, h, d = q.shape
-    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
-    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, k.shape[1], d)
-    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, v.shape[1], d)
-    out = _flash_bh(qt, kt, vt, causal, scale, block_q, block_k)
-    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
+    out, lse = _flash_fwd_bh(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,
+                             _clamp(block_q, s), _clamp(block_k, k.shape[1]),
+                             _interpret(q))
+    return _from_bh(out, b, h), lse.reshape(b, h, s)
+
+
+def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=1.0,
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """FlashAttention-2 backward: (dq, dk, dv), all (B, S, H, D)."""
+    b, s, h, d = q.shape
+    dq, dk, dv = _flash_bwd_bh(
+        _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(out),
+        lse.reshape(b * h, s), _to_bh(do), causal, scale,
+        _clamp(block_q, s), _clamp(block_k, k.shape[1]), _interpret(q))
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))

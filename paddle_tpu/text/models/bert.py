@@ -94,5 +94,8 @@ class BertForSequenceClassification(nn.Layer):
         _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
         logits = self.classifier(self.dropout(pooled))
         if labels is not None:
-            return F.cross_entropy(logits, labels)
+            # f32 softmax-CE regardless of compute dtype: bf16 loss values
+            # quantize in ~0.004 steps, too coarse for loss-curve evidence,
+            # and the f32 logit upcast fuses into the softmax under XLA
+            return F.cross_entropy(logits.astype("float32"), labels)
         return logits

@@ -1,0 +1,78 @@
+"""Perf regression gate (tools/check_bench_regression.py) — VERDICT r3
+missing #4; reference precedent tools/check_op_benchmark_result.py:1."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from check_bench_regression import compare, _flat_metrics  # noqa: E402
+
+
+def _doc(value=100.0, mfu=0.5, resnet=2500.0, gpt=40000.0):
+    return {"metric": "bert_base_train_tokens_per_sec_per_chip",
+            "value": value, "mfu": mfu,
+            "extra": {"resnet50_images_per_sec_per_chip": resnet,
+                      "gpt_tokens_per_sec_per_chip": gpt,
+                      "loss_curves": {"bert": {"first5": [1], "last5": [0]}}}}
+
+
+class TestCompare:
+    def test_clean_pass(self):
+        regs, waived, imps = compare(_doc(), _doc())
+        assert regs == [] and waived == []
+
+    def test_regression_detected(self):
+        regs, _, _ = compare(_doc(resnet=2500.0), _doc(resnet=2300.0))
+        assert len(regs) == 1
+        assert regs[0]["metric"] == "resnet50_images_per_sec_per_chip"
+        assert regs[0]["ratio"] < 0.97
+
+    def test_within_tolerance_passes(self):
+        regs, _, _ = compare(_doc(value=100.0), _doc(value=97.5))
+        assert regs == []
+
+    def test_improvement_reported_not_failed(self):
+        regs, _, imps = compare(_doc(gpt=40000.0), _doc(gpt=50000.0))
+        assert regs == []
+        assert any(r["metric"] == "gpt_tokens_per_sec_per_chip" for r in imps)
+
+    def test_waiver_consumes_regression(self):
+        waivers = [{"metric": "bert_base_train_tokens_per_sec_per_chip",
+                    "reason": "honest-regime reset"}]
+        regs, waived, _ = compare(_doc(value=170000.0), _doc(value=150000.0),
+                                  waivers=waivers)
+        assert regs == []
+        assert waived and waived[0]["waiver"] == "honest-regime reset"
+
+    def test_loss_curves_not_treated_as_metrics(self):
+        keys = _flat_metrics(_doc())
+        assert not any("loss" in k for k in keys)
+
+    def test_missing_metric_in_new_is_not_a_crash(self):
+        new = _doc()
+        del new["extra"]["gpt_tokens_per_sec_per_chip"]
+        regs, _, _ = compare(_doc(), new)
+        assert all(r["metric"] != "gpt_tokens_per_sec_per_chip" for r in regs)
+
+
+class TestCLI:
+    def test_exit_codes_and_driver_wrapper_form(self, tmp_path):
+        old = tmp_path / "BENCH_r01.json"
+        new = tmp_path / "BENCH_r02.json"
+        # driver wraps the bench line under "parsed"
+        old.write_text(json.dumps({"n": 1, "parsed": _doc(value=100.0)}))
+        new.write_text(json.dumps({"n": 2, "parsed": _doc(value=90.0)}))
+        p = subprocess.run(
+            [sys.executable, str(REPO / "tools/check_bench_regression.py"),
+             str(old), str(new)], capture_output=True, text=True)
+        assert p.returncode == 1
+        report = json.loads(p.stdout)
+        assert report["status"] == "fail"
+        new.write_text(json.dumps({"n": 2, "parsed": _doc(value=101.0)}))
+        p = subprocess.run(
+            [sys.executable, str(REPO / "tools/check_bench_regression.py"),
+             str(old), str(new)], capture_output=True, text=True)
+        assert p.returncode == 0

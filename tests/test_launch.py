@@ -101,6 +101,26 @@ class TestLocalLaunch:
             env=env, capture_output=True, text=True, timeout=120)
         assert r.returncode == 0, r.stderr
 
+    def test_launcher_injects_shared_wire_secret(self, tmp_path):
+        """Single-host job: every rank gets the SAME auto-generated
+        PADDLE_TPU_WIRE_SECRET (README §Security)."""
+        worker = tmp_path / "w.py"
+        worker.write_text(
+            "import os, sys\n"
+            "p = sys.argv[1] + '/sec' + os.environ['PADDLE_TRAINER_ID']\n"
+            "open(p, 'w').write(os.environ.get("
+            "'PADDLE_TPU_WIRE_SECRET', ''))\n")
+        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+        env.pop("PADDLE_TPU_WIRE_SECRET", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", str(worker), str(tmp_path)],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        s0 = (tmp_path / "sec0").read_text()
+        s1 = (tmp_path / "sec1").read_text()
+        assert s0 and s0 == s1 and len(s0) == 64
+
 
 class TestElastic:
     def test_register_heartbeat_membership(self, tmp_path):

@@ -245,3 +245,121 @@ class TestMultiPrecision:
             np.testing.assert_array_equal(
                 np.asarray(mw._val),
                 np.asarray(params_by_id[pid]._val, np.float32))
+
+
+@pytest.mark.slow
+class TestBenchRegimeParity:
+    """The regime the bench RECORDS, at the scale the bench runs it
+    (VERDICT r3 next #4): BERT-base full geometry, AdamW lr=5e-5 — the
+    exact regime where r3's pure-bf16 updates silently rounded to zero
+    (ulp(0.02)_bf16 ~ 1.6e-4 vs 5e-5-scale updates). The small-model tests
+    above run at lr>=1e-3 where every regime's updates clear the ulp, so
+    only this test guards the production operating point.
+
+    One shared data stream (learnable: [CLS]-token parity over a 64-token
+    sub-vocab, mirroring bench.py), 50 steps, three regimes:
+      f32      — reference curve
+      amp      — auto_cast bf16 compute, f32 params (A100-baseline regime)
+      bf16+mp  — bf16 params + fp32 masters (the regime bench.py records)
+    """
+
+    STEPS = 50
+    _cache = {}
+
+    @classmethod
+    def _data(cls, cfg):
+        # batch 2 keeps the three 50-step full-geometry runs inside the
+        # slow-lane budget on the 1-core CI box; batch size does not change
+        # the ulp arithmetic this test guards
+        rng = np.random.RandomState(0)
+        xs = rng.randint(0, cfg.vocab_size, (cls.STEPS, 2, 128))
+        xs[:, :, 0] = rng.randint(0, 64, (cls.STEPS, 2))
+        ys = (xs[:, :, 0] % 2).astype("int64")
+        return xs.astype("int64"), ys
+
+    @classmethod
+    def _curve(cls, regime):
+        if regime in cls._cache:
+            return cls._cache[regime]
+        from paddle_tpu.text.models import BertForSequenceClassification
+        from paddle_tpu.text.models.bert import BertConfig
+        paddle.seed(0)
+        cfg = BertConfig.base()
+        cfg.dropout = 0.0
+        model = BertForSequenceClassification(cfg, num_classes=2)
+        mp = False
+        if regime == "bf16_mp":
+            model.bfloat16()
+            mp = True
+        opt = paddle.optimizer.AdamW(learning_rate=5e-5, multi_precision=mp,
+                                     parameters=model.parameters())
+        xs, ys = cls._data(cfg)
+
+        @paddle.jit.to_static
+        def step(x, y):
+            if regime == "amp":
+                with paddle.amp.auto_cast(dtype="bfloat16"):
+                    loss = model(x, labels=y)
+            else:
+                loss = model(x, labels=y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss.astype("float32")
+
+        losses = step.run_steps(paddle.to_tensor(xs), paddle.to_tensor(ys))
+        c = np.asarray(losses.numpy(), np.float64)
+        cls._cache[regime] = c
+        return c
+
+    def test_recorded_regime_descends(self):
+        """bf16+fp32-masters (what bench.py records) must actually train —
+        the r3 headline failure mode."""
+        c = self._curve("bf16_mp")
+        assert c[-5:].mean() < 0.9 * c[:5].mean(), c
+
+    def test_fp32_descends(self):
+        c = self._curve("f32")
+        assert c[-5:].mean() < 0.9 * c[:5].mean(), c
+
+    def test_amp_tracks_fp32(self):
+        ref = self._curve("f32")
+        amp = self._curve("amp")
+        # same data stream; deviation only from bf16 matmul rounding
+        rel = np.abs(amp - ref) / np.abs(ref)
+        assert rel.mean() < 0.10, (rel.mean(), ref[:8], amp[:8])
+        assert amp[-5:].mean() < 0.9 * amp[:5].mean(), amp
+
+    def test_recorded_regime_tracks_fp32(self):
+        ref = self._curve("f32")
+        mp = self._curve("bf16_mp")
+        rel = np.abs(mp - ref) / np.abs(ref)
+        # bf16 params quantize every read: looser band than amp, but the
+        # curves must share the trend (measured meanrel ~0.10 on this box)
+        assert rel.mean() < 0.20, (rel.mean(), ref[:8], mp[:8])
+
+    def test_masters_accumulate_below_bf16_ulp(self):
+        """The mechanism itself: repeated sub-ulp updates reach the bf16
+        param through the fp32 master (r3's failure: without masters,
+        0.02 - 5e-5 == 0.02 in bf16 forever)."""
+        import jax.numpy as jnp
+        p = paddle.to_tensor(np.full((8,), 0.02, "float32")).astype("bfloat16")
+        p.stop_gradient = False
+        opt = paddle.optimizer.Momentum(learning_rate=5e-5, momentum=0.0,
+                                        parameters=[p],
+                                        multi_precision=True)
+        g = paddle.to_tensor(np.ones((8,), "float32")).astype("bfloat16")
+        for _ in range(8):
+            p.grad = g
+            opt.step()
+            opt.clear_grad()
+        master = opt._accumulators["master_weight"][id(p)]
+        # the master accumulated all 8 sub-ulp updates exactly (init is the
+        # bf16-rounded param value 0.02001953..., not the f32 0.02)
+        import jax.numpy as _jnp
+        init = float(_jnp.asarray(0.02, _jnp.bfloat16))
+        np.testing.assert_allclose(np.asarray(master._val, np.float32),
+                                   init - 8 * 5e-5, rtol=1e-5)
+        # ...and single-update bf16 rounding alone would have frozen p:
+        a = jnp.asarray(0.02, jnp.bfloat16)
+        assert float(a - jnp.asarray(5e-5, jnp.bfloat16)) == float(a)

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Per-round perf regression gate (VERDICT r3 missing #4).
+
+Compares two bench artifacts (BENCH_r{N-1}.json vs BENCH_r{N}.json — either
+the driver's wrapped form with a "parsed" key or a raw bench.py JSON line)
+metric by metric and FAILS (exit 1) when any throughput metric regressed by
+more than --tol (default 3%).
+
+Reference precedent: tools/check_op_benchmark_result.py:1 +
+tools/ci_model_benchmark.sh:1 in the reference repo fetch a stored baseline
+and fail CI on regression; this is the same contract round-over-round.
+
+Known, justified regressions (e.g. a measurement-honesty fix that trades
+headline throughput for training that actually learns) are waived explicitly
+in BENCH_WAIVERS.json next to this script's invocation:
+    {"waivers": [{"metric": "...", "reason": "..."}]}
+A waiver is consumed by the NEXT comparison only — delete entries once the
+new baseline is recorded.
+
+Usage:
+    python tools/check_bench_regression.py OLD.json NEW.json \
+        [--tol 0.03] [--waivers BENCH_WAIVERS.json]
+
+Also usable without arguments from the repo root: picks the two
+highest-numbered BENCH_r*.json present.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# metrics where HIGHER is better and a drop is a regression; everything else
+# (loss curves, params, precision tags) is advisory
+_THROUGHPUT_KEYS = (
+    "value", "mfu",
+    "resnet50_images_per_sec_per_chip", "resnet50_mfu",
+    "gpt_tokens_per_sec_per_chip", "gpt_mfu",
+)
+
+
+def _load(path):
+    with open(path) as f:
+        doc = json.load(f)
+    return doc.get("parsed", doc)
+
+
+def _flat_metrics(doc):
+    out = {}
+    name = doc.get("metric", "value")
+    for k in ("value", "mfu"):
+        v = doc.get(k)
+        if isinstance(v, (int, float)):
+            out[f"{name}.{k}" if k != "value" else name] = float(v)
+    for k, v in (doc.get("extra") or {}).items():
+        if k in _THROUGHPUT_KEYS and isinstance(v, (int, float)):
+            out[k] = float(v)
+    return out
+
+
+def compare(old_doc, new_doc, tol=0.03, waivers=()):
+    """Returns (regressions, waived, improvements) lists of dicts."""
+    old_m = _flat_metrics(old_doc)
+    new_m = _flat_metrics(new_doc)
+    waived_metrics = {w["metric"]: w.get("reason", "") for w in waivers}
+    regressions, waived, improvements = [], [], []
+    for k, old_v in sorted(old_m.items()):
+        new_v = new_m.get(k)
+        if new_v is None or old_v <= 0:
+            continue
+        ratio = new_v / old_v
+        row = {"metric": k, "old": old_v, "new": new_v,
+               "ratio": round(ratio, 4)}
+        if ratio < 1.0 - tol:
+            if k in waived_metrics:
+                row["waiver"] = waived_metrics[k]
+                waived.append(row)
+            else:
+                regressions.append(row)
+        elif ratio > 1.0 + tol:
+            improvements.append(row)
+    return regressions, waived, improvements
+
+
+def _latest_pair():
+    files = sorted(glob.glob("BENCH_r*.json"),
+                   key=lambda p: int(re.search(r"r(\d+)", p).group(1)))
+    if len(files) < 2:
+        return None
+    return files[-2], files[-1]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("old", nargs="?")
+    ap.add_argument("new", nargs="?")
+    ap.add_argument("--tol", type=float, default=0.03)
+    ap.add_argument("--waivers", default="BENCH_WAIVERS.json")
+    ns = ap.parse_args(argv)
+    if not ns.old or not ns.new:
+        pair = _latest_pair()
+        if pair is None:
+            print(json.dumps({"status": "skip",
+                              "why": "fewer than two BENCH_r*.json found"}))
+            return 0
+        ns.old, ns.new = pair
+    waivers = []
+    if os.path.exists(ns.waivers):
+        with open(ns.waivers) as f:
+            waivers = json.load(f).get("waivers", [])
+    regressions, waived, improvements = compare(
+        _load(ns.old), _load(ns.new), ns.tol, waivers)
+    report = {"status": "fail" if regressions else "ok",
+              "old": ns.old, "new": ns.new, "tol": ns.tol,
+              "regressions": regressions, "waived": waived,
+              "improvements": improvements}
+    print(json.dumps(report, indent=2))
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
