@@ -92,15 +92,16 @@ def _fwd_ctx(precision):
 
 _LAST_CURVE = {}  # model-name -> per-step loss curve of the last timed run
 _LAST_SPE = {}    # model-name -> steps-per-execution the curve was run with
+_LAST_DISTINCT = {}  # model-name -> number of DISTINCT batches in the run
 
 
 def _timed_steps(step, data_fn, steps, warmup=5, curve_key=None,
-                 spe_default=32):
+                 spe_default=32, distinct_data=True):
     """Time `steps` optimizer steps; returns wall seconds (normalized to
     per-`steps` wall time).
 
     BENCH_SPE (steps-per-execution; default = the caller's `spe_default`:
-    64 for bert, 128 for resnet50, 32 otherwise) batches that many steps
+    64 for bert, 32 for resnet50 and otherwise) batches that many steps
     into one compiled `lax.scan` dispatch via StaticFunction.run_steps —
     the idiomatic TPU loop (host dispatch latency otherwise dominates
     sub-100ms steps). BENCH_SPE=1 falls back to one dispatch per step.
@@ -143,6 +144,8 @@ def _timed_steps(step, data_fn, steps, warmup=5, curve_key=None,
 
     if spe == 1:
         arrays = data_fn(warmup + steps)
+        if curve_key:
+            _LAST_DISTINCT[curve_key] = warmup + steps
         staged = [tuple(stage(a[i]) for a in arrays)
                   for i in range(warmup + steps)]
         for args_i in staged[:warmup]:
@@ -158,7 +161,21 @@ def _timed_steps(step, data_fn, steps, warmup=5, curve_key=None,
                 float(np.asarray(l.numpy(), np.float32)) for l in curve]
         return dt
 
-    stacked = tuple(stage(a) for a in data_fn(spe))
+    n_exec = max(1, steps // spe)
+    # distinct_data: every executed step (2*spe warm-up + steps timed) trains
+    # on its OWN batch, so the recorded curve is evidence of learning a
+    # stream, not of memorizing one staged stack. Token workloads stage all
+    # of it for ~MBs. The resnet50 bench opts out (images at b128/spe=32 are
+    # ~1.2 GB per stack; staging 10 stacks would blow HBM) — it cycles one
+    # stack and its LOSS_CURVES entry carries distinct_batches=spe.
+    if distinct_data:
+        stacks = [tuple(stage(a) for a in data_fn(spe))
+                  for _ in range(2 + n_exec)]
+    else:
+        stacks = [tuple(stage(a) for a in data_fn(spe))] * (2 + n_exec)
+    if curve_key:
+        _LAST_DISTINCT[curve_key] = (spe * (2 + n_exec) if distinct_data
+                                     else spe)
     dbg = os.environ.get("BENCH_DEBUG") == "1"
 
     def _mark(label, t0):
@@ -168,18 +185,17 @@ def _timed_steps(step, data_fn, steps, warmup=5, curve_key=None,
         return time.time()
 
     t = time.time()
-    losses = step.run_steps(*stacked)  # warm: discovery + step + scan compile
+    losses = step.run_steps(*stacks[0])  # warm: discovery + scan compile
     losses[-1].item()
     record(losses)
     t = _mark("warm1 (discovery + scan compile + exec)", t)
-    losses = step.run_steps(*stacked)
+    losses = step.run_steps(*stacks[1])
     losses[-1].item()
     record(losses)
     t = _mark("warm2 (steady exec)", t)
-    n_exec = max(1, steps // spe)
     t0 = time.time()
-    for _ in range(n_exec):
-        record(step.run_steps(*stacked))
+    for i in range(n_exec):
+        record(step.run_steps(*stacks[2 + i]))
     _ = curve[-1][-1].item()  # sync
     dt = time.time() - t0
     _mark(f"timed ({n_exec} exec x {spe} steps)", t0)
@@ -333,7 +349,7 @@ def bench_resnet50():
         return loss
 
     dt = _timed_steps(step, data, steps, curve_key="resnet50",
-                      spe_default=32)
+                      spe_default=32, distinct_data=False)
     imgs = batch * steps
     ips = imgs / dt
     # ResNet-50 forward ~4.09 GFLOPs @224; train ~3x fwd; scales with area
@@ -572,6 +588,9 @@ def main():
                            "loss_dtype": "float32",
                            "spe": dict(_LAST_SPE),  # per curve (warm-up =
                                                     # 2*spe leading steps)
+                           # distinct batches trained on; if < steps the run
+                           # cycled one staged stack (see _timed_steps)
+                           "distinct_batches": dict(_LAST_DISTINCT),
                            "curves": _LAST_CURVE}, f)
         except OSError as e:
             sys.stderr.write(f"loss curve artifact write failed: {e}\n")

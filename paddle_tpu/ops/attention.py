@@ -90,8 +90,8 @@ def _flash_attention_diff(q, k, v, is_causal, scale):
     The forward saves only (q, k, v, out, lse); the backward re-forms each
     probability tile in VMEM (FlashAttention-2 recompute scheme,
     ops/pallas/flash_attention.py) — neither direction ever materializes the
-    S x S matrix in HBM. Parity vs the XLA path is asserted in
-    tests/test_flash_attention.py for both directions."""
+    S x S matrix in HBM. Parity vs the XLA path is asserted for both
+    directions in tests/test_tpu_native.py (TestFlashAttentionBackward)."""
     from .pallas.flash_attention import flash_attention
     return flash_attention(q, k, v, causal=is_causal, scale=scale)
 

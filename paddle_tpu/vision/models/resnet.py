@@ -1,10 +1,51 @@
 """ResNet (python/paddle/vision/models/resnet.py parity) — BASELINE configs 2/4."""
 from __future__ import annotations
 
+import os
+
 from ... import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "resnet152", "wide_resnet50_2", "wide_resnet101_2"]
+
+
+def _fuse_default():
+    return os.environ.get("PADDLE_TPU_FUSED_CONV_BN", "1") == "1"
+
+
+def _fcb_raw(x, w, bn, act_in, *, stride, padding, dilation=1, groups=1,
+             data_format="NCHW"):
+    """[relu ->] conv2d(w) -> bn through the fused op whose backward stores
+    one activation tensor per layer (ops/fused_conv_bn.py; reference analog
+    operators/fused/conv_fusion_op.cc). Returns the PRE-activation output —
+    the next layer fuses the ReLU via act_input=True."""
+    from ...ops.fused_conv_bn import fused_conv_bn
+    return fused_conv_bn(
+        x, w, bn.weight, bn.bias, bn._mean, bn._variance,
+        training=bn.training, momentum=bn._momentum, epsilon=bn._epsilon,
+        stride=stride, padding=padding, dilation=dilation, groups=groups,
+        data_format=data_format, act_input=act_in)
+
+
+def _fcb(x, conv, bn, act_in):
+    return _fcb_raw(x, conv.weight, bn, act_in, stride=conv._stride,
+                    padding=conv._padding, dilation=conv._dilation,
+                    groups=conv._groups, data_format=conv._data_format)
+
+
+def _fusable(*pairs):
+    """All (conv, bn) pairs of a block must qualify — the fused data flow
+    hands PRE-activation tensors between layers, so fusion is all-or-nothing
+    per block."""
+    return all(isinstance(bn, nn.BatchNorm2D) and bn.weight is not None
+               and conv.bias is None for conv, bn in pairs)
+
+
+def _ds_fusable(ds):
+    return (isinstance(ds, nn.Sequential) and len(ds) == 2
+            and isinstance(ds[0], nn.Conv2D)
+            and isinstance(ds[1], nn.BatchNorm2D)
+            and _fusable((ds[0], ds[1])))
 
 
 class BasicBlock(nn.Layer):
@@ -12,11 +53,12 @@ class BasicBlock(nn.Layer):
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
                  base_width=64, dilation=1, norm_layer=None,
-                 data_format="NCHW"):
+                 data_format="NCHW", fused=False):
         super().__init__()
         if norm_layer is None:
             norm_layer = nn.BatchNorm2D
         fmt = data_format
+        self._fused = fused
         self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride,
                                bias_attr=False, data_format=fmt)
         self.bn1 = norm_layer(planes, data_format=fmt)
@@ -28,14 +70,22 @@ class BasicBlock(nn.Layer):
         self.stride = stride
 
     def forward(self, x):
+        fused = (self._fused
+                 and _fusable((self.conv1, self.bn1), (self.conv2, self.bn2))
+                 and (self.downsample is None
+                      or _ds_fusable(self.downsample)))
         identity = x
-        out = self.conv1(x)
-        out = self.bn1(out)
-        out = self.relu(out)
-        out = self.conv2(out)
-        out = self.bn2(out)
-        if self.downsample is not None:
-            identity = self.downsample(x)
+        if fused:
+            p = _fcb(x, self.conv1, self.bn1, False)
+            out = _fcb(p, self.conv2, self.bn2, True)
+            if self.downsample is not None:
+                identity = _fcb(x, self.downsample[0], self.downsample[1],
+                                False)
+        else:
+            out = self.relu(self.bn1(self.conv1(x)))
+            out = self.bn2(self.conv2(out))
+            if self.downsample is not None:
+                identity = self.downsample(x)
         out = out + identity
         return self.relu(out)
 
@@ -45,11 +95,12 @@ class BottleneckBlock(nn.Layer):
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
                  base_width=64, dilation=1, norm_layer=None,
-                 data_format="NCHW"):
+                 data_format="NCHW", fused=False):
         super().__init__()
         if norm_layer is None:
             norm_layer = nn.BatchNorm2D
         fmt = data_format
+        self._fused = fused
         width = int(planes * (base_width / 64.0)) * groups
         self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
                                data_format=fmt)
@@ -66,12 +117,25 @@ class BottleneckBlock(nn.Layer):
         self.stride = stride
 
     def forward(self, x):
+        fused = (self._fused
+                 and _fusable((self.conv1, self.bn1), (self.conv2, self.bn2),
+                              (self.conv3, self.bn3))
+                 and (self.downsample is None
+                      or _ds_fusable(self.downsample)))
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.relu(self.bn2(self.conv2(out)))
-        out = self.bn3(self.conv3(out))
-        if self.downsample is not None:
-            identity = self.downsample(x)
+        if fused:
+            p = _fcb(x, self.conv1, self.bn1, False)
+            p = _fcb(p, self.conv2, self.bn2, True)
+            out = _fcb(p, self.conv3, self.bn3, True)
+            if self.downsample is not None:
+                identity = _fcb(x, self.downsample[0], self.downsample[1],
+                                False)
+        else:
+            out = self.relu(self.bn1(self.conv1(x)))
+            out = self.relu(self.bn2(self.conv2(out)))
+            out = self.bn3(self.conv3(out))
+            if self.downsample is not None:
+                identity = self.downsample(x)
         out = out + identity
         return self.relu(out)
 
@@ -83,8 +147,15 @@ class ResNet(nn.Layer):
     Input must match data_format."""
 
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1, data_format="NCHW", stem="conv"):
+                 with_pool=True, groups=1, data_format="NCHW", stem="conv",
+                 fused_conv_bn=None):
         super().__init__()
+        # fused conv+BN(+ReLU) training op (ops/fused_conv_bn.py): on by
+        # default (PADDLE_TPU_FUSED_CONV_BN=0 or fused_conv_bn=False opts
+        # out) — same math, but the backward never saves the pre-BN conv
+        # outputs (~2.4 GB fewer residuals @ b128 bf16)
+        self._fused = (_fuse_default() if fused_conv_bn is None
+                       else bool(fused_conv_bn))
         layer_cfg = {
             18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
             101: [3, 4, 23, 3], 152: [3, 8, 36, 3],
@@ -130,12 +201,13 @@ class ResNet(nn.Layer):
             )
         layers = [block(self.inplanes, planes, stride, downsample, self.groups,
                         self.base_width, self.dilation, norm_layer,
-                        data_format=fmt)]
+                        data_format=fmt, fused=self._fused)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
                                 base_width=self.base_width,
-                                norm_layer=norm_layer, data_format=fmt))
+                                norm_layer=norm_layer, data_format=fmt,
+                                fused=self._fused))
         return nn.Sequential(*layers)
 
     def _stem_space_to_depth(self, x):
@@ -171,14 +243,25 @@ class ResNet(nn.Layer):
         ws = wp.reshape([o, ci, 4, 2, 4, 2]) \
                .transpose([0, 3, 5, 1, 2, 4]) \
                .reshape([o, 4 * ci, 4, 4])
-        return F.conv2d(xs, ws, None, stride=1, padding=0, data_format=fmt)
+        return xs, ws
 
     def forward(self, x):
+        fused = self._fused and _fusable((self.conv1, self.bn1))
         if self.stem == "space_to_depth":
-            x = self._stem_space_to_depth(x)
+            xs, ws = self._stem_space_to_depth(x)
+            if fused:
+                x = self.relu(_fcb_raw(xs, ws, self.bn1, False, stride=1,
+                                       padding=0,
+                                       data_format=self.data_format))
+            else:
+                import paddle_tpu.nn.functional as F
+                x = F.conv2d(xs, ws, None, stride=1, padding=0,
+                             data_format=self.data_format)
+                x = self.relu(self.bn1(x))
+        elif fused:
+            x = self.relu(_fcb(x, self.conv1, self.bn1, False))
         else:
-            x = self.conv1(x)
-        x = self.relu(self.bn1(x))
+            x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

@@ -51,11 +51,24 @@ class TestCompare:
         keys = _flat_metrics(_doc())
         assert not any("loss" in k for k in keys)
 
-    def test_missing_metric_in_new_is_not_a_crash(self):
+    def test_vanished_metric_is_a_regression(self):
+        """A metric that disappears (bench.py records extra['<model>_error']
+        when a model crashes) is the hardest regression and must FAIL the
+        gate, not silently pass."""
         new = _doc()
         del new["extra"]["gpt_tokens_per_sec_per_chip"]
         regs, _, _ = compare(_doc(), new)
-        assert all(r["metric"] != "gpt_tokens_per_sec_per_chip" for r in regs)
+        gone = [r for r in regs
+                if r["metric"] == "gpt_tokens_per_sec_per_chip"]
+        assert gone and gone[0]["new"] is None and gone[0]["ratio"] == 0.0
+
+    def test_vanished_metric_can_be_waived(self):
+        new = _doc()
+        del new["extra"]["gpt_tokens_per_sec_per_chip"]
+        waivers = [{"metric": "gpt_tokens_per_sec_per_chip",
+                    "reason": "bench split into its own artifact"}]
+        regs, waived, _ = compare(_doc(), new, waivers=waivers)
+        assert regs == [] and waived
 
 
 class TestCLI:

@@ -69,7 +69,18 @@ def compare(old_doc, new_doc, tol=0.03, waivers=()):
     regressions, waived, improvements = [], [], []
     for k, old_v in sorted(old_m.items()):
         new_v = new_m.get(k)
-        if new_v is None or old_v <= 0:
+        if old_v <= 0:
+            continue
+        if new_v is None:
+            # a metric that vanished is the hardest regression there is
+            # (bench.py records per-model errors instead of throughput when a
+            # model crashes) — it must not silently pass the gate
+            row = {"metric": k, "old": old_v, "new": None, "ratio": 0.0}
+            if k in waived_metrics:
+                row["waiver"] = waived_metrics[k]
+                waived.append(row)
+            else:
+                regressions.append(row)
             continue
         ratio = new_v / old_v
         row = {"metric": k, "old": old_v, "new": new_v,
