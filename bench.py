@@ -397,7 +397,8 @@ def bench_gpt(slice_1p3b=False):
     cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
                     num_heads=hidden // 128 if slice_1p3b else hidden // 64,
                     max_position_embeddings=seq,
-                    dropout=0.0)
+                    dropout=0.0,
+                    recompute=os.environ.get("BENCH_GPT_RECOMPUTE") == "1")
     model = GPTForCausalLM(cfg)
     precision = _apply_dtype(model)
     # fp32 masters for the same reason as bench_bert (lr=1e-4 updates also

@@ -82,6 +82,24 @@ def recompute(function, *args, **kwargs):
 
     n_args = len(tensor_args)
 
+    # Pallas placement hint, decided ONCE per recompute() call: inside the
+    # checkpoint trace every value is a tracer, so the flash-attention
+    # kernel's per-call placement inference cannot see where this region
+    # executes. Here we can: concrete (eager) inputs mean the region runs
+    # where they live — under host staging that is the CPU, where only the
+    # pallas interpreter works. The hint must be applied INSIDE pure(),
+    # because jax.checkpoint re-traces pure() at BACKWARD time (that is the
+    # whole point of remat) — a hint scoped around the forward apply() alone
+    # would have expired by then. Under the to_static compile pass the
+    # inputs are outer-jit tracers: no hint, Mosaic lowering for the
+    # accelerator holds.
+    from ...ops.pallas import flash_attention as _fa
+    _vals = [unwrap(t) for t in tensor_args]
+    _force = None
+    if _vals and not any(isinstance(v, jax.core.Tracer) for v in _vals):
+        if _fa._interpret(_vals[0]):
+            _force = True
+
     def pure(*vals):
         saved = [(t, t._val) for t in closure_reads]
         # writes during the traced run (BN running stats, RNG keys) would
@@ -99,12 +117,23 @@ def recompute(function, *args, **kwargs):
                 prev_write(t, new_value)
 
         _TraceHooks.on_write = on_write
+        prev_force = _fa._FORCE_INTERPRET[0]
+        if _force is not None:
+            _fa._FORCE_INTERPRET[0] = _force
         try:
             for t, v in zip(closure_reads, vals[n_args:]):
                 t._val = v
-            out = function(*rebuild(vals[:n_args]), **kwargs)
+            # no_grad: inner per-op GradNodes are useless here (the outer
+            # apply() differentiates the whole checkpointed region), and an
+            # inner eager jax.vjp would UNWRAP custom_vjp ops (e.g. Pallas
+            # flash attention) into raw pallas_calls that jax.checkpoint's
+            # linearization cannot jvp — with the custom_vjp primitive kept
+            # intact, remat uses its rule as designed
+            with _autograd.no_grad():
+                out = function(*rebuild(vals[:n_args]), **kwargs)
             return unwrap(out)
         finally:
+            _fa._FORCE_INTERPRET[0] = prev_force
             _TraceHooks.on_write = prev_write
             for t, old in written.values():
                 t._val = old

@@ -12,8 +12,130 @@ from .. import nn
 from ..ops.attention import scaled_dot_product_attention
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer", "softmax_mask_fuse",
-           "softmax_mask_fuse_upper_triangle"]
+           "FusedTransformerEncoderLayer", "FusedBiasDropoutResidualLayerNorm",
+           "fused_feedforward", "fused_bias_dropout_residual_layer_norm",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, epsilon=1e-5,
+                                           training=True, name=None):
+    """incubate.nn.functional.fused_bias_dropout_residual_layer_norm parity
+    (operators/fused/fused_bias_dropout_residual_layer_norm_op.cu):
+        out = layer_norm(residual + dropout(x + bias))
+    One apply() seam -> one XLA fusion region (the reference needs a
+    dedicated CUDA kernel; XLA fuses bias-add, mask, scale, residual-add and
+    the norm reductions into the surrounding computation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    dropout_kd = None
+    if training and dropout_rate > 0.0:
+        from ..core.random import next_key_data
+        dropout_kd = next_key_data()
+
+    def prim(xv, rv, *rest):
+        rest = list(rest)
+        kd = rest.pop() if dropout_kd is not None else None
+        i = 0
+        h = xv
+        if bias is not None:
+            h = h + rest[i]
+            i += 1
+        if kd is not None:
+            key = jax.random.wrap_key_data(kd)
+            keep = jax.random.bernoulli(key, 1.0 - dropout_rate, h.shape)
+            h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0).astype(h.dtype)
+        h = rv + h
+        hf = h.astype(jnp.float32)
+        mean = jnp.mean(hf, axis=-1, keepdims=True)
+        var = jnp.var(hf, axis=-1, keepdims=True)
+        out = (hf - mean) * jax.lax.rsqrt(var + epsilon)
+        if ln_scale is not None:
+            out = out * rest[i].astype(jnp.float32)
+            i += 1
+        if ln_bias is not None:
+            out = out + rest[i].astype(jnp.float32)
+        return out.astype(h.dtype)
+
+    extra = [a for a in (bias, ln_scale, ln_bias) if a is not None]
+    if dropout_kd is not None:
+        extra.append(dropout_kd)
+    return apply(prim, x, residual, *extra,
+                 name="fused_bias_dropout_residual_layer_norm")
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """incubate.nn.FusedBiasDropoutResidualLayerNorm parity."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        from ..nn import initializer as I
+        self.linear_bias = self.create_parameter(
+            shape=[embed_dim], attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            shape=[embed_dim], attr=None, is_bias=True)
+
+    def forward(self, x, residual):
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self.dropout_rate, epsilon=self._epsilon,
+            training=self.training)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, activation="relu", ln1_scale=None,
+                      ln1_bias=None, ln2_scale=None, ln2_bias=None,
+                      dropout1_rate=0.0, dropout2_rate=0.0,
+                      normalize_before=False, epsilon=1e-5, training=True,
+                      name=None):
+    """incubate.nn.functional.fused_feedforward parity
+    (operators/fused/fused_feedforward_op.cc):
+        out = residual + dropout2(linear2(dropout1(act(linear1(ln(x))))))
+    with the LayerNorm before (normalize_before) or after the residual add.
+
+    The linear1->act->linear2 core runs through ops/fused_ffn.py (backward
+    recomputes the activation instead of saving it) whenever both biases are
+    present and the dropout between the matmuls is inactive; otherwise it
+    falls back to the composed ops."""
+    from .. import nn as _nn
+    from ..nn import functional as F
+    from ..ops.fused_ffn import fused_ffn
+
+    residual = x
+    if normalize_before:
+        x = F.layer_norm(x, x.shape[-1], ln1_scale, ln1_bias, epsilon)
+    act = {"gelu": "gelu_tanh"}.get(activation, activation)
+    drop1_active = training and dropout1_rate > 0.0
+    if (linear1_bias is not None and linear2_bias is not None
+            and not drop1_active and act in ("gelu_tanh", "relu")):
+        out = fused_ffn(x, linear1_weight, linear1_bias, linear2_weight,
+                        linear2_bias, activation=act)
+    else:
+        h = F.linear(x, linear1_weight, linear1_bias)
+        h = getattr(F, "gelu" if activation == "gelu" else activation)(h)
+        if drop1_active:
+            h = F.dropout(h, p=dropout1_rate, training=True)
+        out = F.linear(h, linear2_weight, linear2_bias)
+    if training and dropout2_rate > 0.0:
+        out = F.dropout(out, p=dropout2_rate, training=True)
+    out = residual + out
+    if not normalize_before:
+        out = F.layer_norm(out, out.shape[-1], ln2_scale if ln2_scale is not None
+                           else ln1_scale,
+                           ln2_bias if ln2_bias is not None else ln1_bias,
+                           epsilon)
+    return out
 
 
 class FusedMultiHeadAttention(nn.Layer):
@@ -65,18 +187,18 @@ class FusedFeedForward(nn.Layer):
         self.dropout = nn.Dropout(dropout_rate)
         self.act_dropout = nn.Dropout(
             dropout_rate if act_dropout_rate is None else act_dropout_rate)
+        self._activation = activation
         self.act = getattr(nn.functional, activation)
 
     def forward(self, x):
-        residual = x
-        if self.normalize_before:
-            x = self.norm(x)
-        x = self.act_dropout(self.act(self.linear1(x)))
-        x = self.dropout(self.linear2(x))
-        x = residual + x
-        if not self.normalize_before:
-            x = self.norm(x)
-        return x
+        return fused_feedforward(
+            x, self.linear1.weight, self.linear2.weight,
+            self.linear1.bias, self.linear2.bias,
+            activation=self._activation,
+            ln1_scale=self.norm.weight, ln1_bias=self.norm.bias,
+            dropout1_rate=self.act_dropout.p, dropout2_rate=self.dropout.p,
+            normalize_before=self.normalize_before,
+            epsilon=self.norm._epsilon, training=self.training)
 
 
 class FusedTransformerEncoderLayer(nn.Layer):

@@ -115,7 +115,23 @@ class TransformerEncoderLayer(Layer):
         self.norm2 = LayerNorm(d_model)
         self.dropout1 = Dropout(dropout)
         self.dropout2 = Dropout(dropout)
+        self._activation_name = activation
         self.activation = getattr(F, activation)
+
+    def _ffn(self, src):
+        """linear1 -> act -> (dropout) -> linear2; routed through the fused
+        FFN op (ops/fused_ffn.py — backward recomputes the 4h-wide
+        activation instead of saving it) whenever the inner dropout is
+        inactive and the activation is relu/gelu."""
+        drop_active = self.training and self.dropout.p > 0.0
+        if (not drop_active and self._activation_name in ("relu", "gelu")
+                and self.linear1.bias is not None
+                and self.linear2.bias is not None):
+            from ...ops.fused_ffn import fused_ffn
+            return fused_ffn(src, self.linear1.weight, self.linear1.bias,
+                             self.linear2.weight, self.linear2.bias,
+                             activation=self._activation_name)
+        return self.linear2(self.dropout(self.activation(self.linear1(src))))
 
     def forward(self, src, src_mask=None, cache=None):
         residual = src
@@ -131,7 +147,7 @@ class TransformerEncoderLayer(Layer):
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
-        src = self.linear2(self.dropout(self.activation(self.linear1(src))))
+        src = self._ffn(src)
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)

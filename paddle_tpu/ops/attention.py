@@ -66,8 +66,20 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             "use_pallas=True is incompatible with attn_mask/dropout_p: the "
             "flash kernel computes plain (optionally causal) attention")
     if use_pallas:
+        # resolve the interpret decision HERE, from the still-unwrapped
+        # value: concrete in eager (host staging pulls it to CPU ->
+        # interpreter), an outer-jit tracer under the to_static compile
+        # (default accelerator -> Mosaic), a checkpoint tracer inside
+        # fleet.utils.recompute (ambient hint -> interpreter when the
+        # region executes eagerly on the host). Baked through the
+        # custom_vjp as a STATIC arg because jax re-invokes the custom
+        # fwd/bwd rules later (e.g. while differentiating a jax.checkpoint
+        # region), outside any dynamic-scoped hint.
+        from .pallas.flash_attention import _interpret
+        interp = _interpret(qv)
+
         def prim(q, k, v):
-            return _flash_attention_diff(q, k, v, is_causal, scale)
+            return _flash_attention_diff(q, k, v, is_causal, scale, interp)
         return apply(prim, query, key, value, name="flash_attention")
 
     def prim(q, k, v, *rest):
@@ -83,8 +95,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return apply(prim, query, key, value, *extra, name="sdpa")
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_diff(q, k, v, is_causal, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention_diff(q, k, v, is_causal, scale, interpret):
     """Pallas flash attention, forward AND backward.
 
     The forward saves only (q, k, v, out, lse); the backward re-forms each
@@ -93,20 +105,22 @@ def _flash_attention_diff(q, k, v, is_causal, scale):
     S x S matrix in HBM. Parity vs the XLA path is asserted for both
     directions in tests/test_tpu_native.py (TestFlashAttentionBackward)."""
     from .pallas.flash_attention import flash_attention
-    return flash_attention(q, k, v, causal=is_causal, scale=scale)
+    return flash_attention(q, k, v, causal=is_causal, scale=scale,
+                           interpret=interpret)
 
 
-def _flash_fwd(q, k, v, is_causal, scale):
+def _flash_fwd(q, k, v, is_causal, scale, interpret):
     from .pallas.flash_attention import flash_attention_fwd
-    out, lse = flash_attention_fwd(q, k, v, causal=is_causal, scale=scale)
+    out, lse = flash_attention_fwd(q, k, v, causal=is_causal, scale=scale,
+                                   interpret=interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(is_causal, scale, res, g):
+def _flash_bwd(is_causal, scale, interpret, res, g):
     from .pallas.flash_attention import flash_attention_bwd
     q, k, v, out, lse = res
     return flash_attention_bwd(q, k, v, out, lse, g, causal=is_causal,
-                               scale=scale)
+                               scale=scale, interpret=interpret)
 
 
 _flash_attention_diff.defvjp(_flash_fwd, _flash_bwd)

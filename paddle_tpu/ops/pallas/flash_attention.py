@@ -34,6 +34,14 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
 
+# Ambient interpret override for contexts where the input is a tracer but
+# the caller KNOWS where execution will land (fleet.utils.recompute sets it
+# around its eagerly-executed jax.checkpoint region under host staging —
+# there the inputs are tracers of the checkpoint trace, yet the computation
+# runs on the host CPU, so Mosaic lowering would fail).
+_FORCE_INTERPRET = [None]
+
+
 def _interpret(x=None):
     # off-TPU (CPU CI) the Mosaic backend is unavailable: run the same
     # kernels under the pallas interpreter so numerics/tests cover this
@@ -42,6 +50,8 @@ def _interpret(x=None):
     # default backend is the TPU but eager discovery passes execute on the
     # host CPU — pallas would otherwise lower Mosaic for a CPU computation
     # and fail.
+    if _FORCE_INTERPRET[0] is not None:
+        return _FORCE_INTERPRET[0]
     if x is not None:
         try:
             return all(d.platform not in ("tpu", "axon")
@@ -317,31 +327,38 @@ def _from_bh(x, b, h):
 
 
 def flash_attention(q, k, v, causal=False, scale=1.0,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=None):
     """q,k,v: (B, S, H, D) -> (B, S, H, D). Forward only; use
     flash_attention_vjp for the Pallas-backward pair (attention.py wires it
-    through jax.custom_vjp)."""
-    out, _ = flash_attention_fwd(q, k, v, causal, scale, block_q, block_k)
+    through jax.custom_vjp). interpret=None resolves per call from placement
+    (_interpret); pass an explicit bool when the caller already resolved it
+    (attention.py bakes it through the custom_vjp static args)."""
+    out, _ = flash_attention_fwd(q, k, v, causal, scale, block_q, block_k,
+                                 interpret)
     return out
 
 
 def flash_attention_fwd(q, k, v, causal=False, scale=1.0,
-                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                        interpret=None):
     """Returns (out, lse) with lse (B, H, S) float32 — the residual the
     Pallas backward needs."""
     b, s, h, d = q.shape
     out, lse = _flash_fwd_bh(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,
                              _clamp(block_q, s), _clamp(block_k, k.shape[1]),
-                             _interpret(q))
+                             _interpret(q) if interpret is None else interpret)
     return _from_bh(out, b, h), lse.reshape(b, h, s)
 
 
 def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=1.0,
-                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                        interpret=None):
     """FlashAttention-2 backward: (dq, dk, dv), all (B, S, H, D)."""
     b, s, h, d = q.shape
     dq, dk, dv = _flash_bwd_bh(
         _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(out),
         lse.reshape(b * h, s), _to_bh(do), causal, scale,
-        _clamp(block_q, s), _clamp(block_k, k.shape[1]), _interpret(q))
+        _clamp(block_q, s), _clamp(block_k, k.shape[1]),
+        _interpret(q) if interpret is None else interpret)
     return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))

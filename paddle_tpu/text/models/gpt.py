@@ -34,7 +34,7 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, max_position_embeddings=1024,
                  intermediate_size=None, dropout=0.1, tensor_parallel=False,
-                 use_flash_attention=True):
+                 use_flash_attention=True, recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -44,6 +44,11 @@ class GPTConfig:
         self.dropout = dropout
         self.tensor_parallel = tensor_parallel
         self.use_flash_attention = use_flash_attention
+        # rematerialize each block in backward (fleet.utils.recompute =
+        # jax.checkpoint): activations per layer shrink to the block inputs,
+        # buying batch size on one chip. Use with dropout=0 (state writes
+        # inside a checkpointed region are dropped — utils.py note).
+        self.recompute = recompute
 
     @classmethod
     def gpt3_1p3b(cls, **kw):
@@ -125,6 +130,16 @@ class GPTMLP(nn.Layer):
         self.dropout = nn.Dropout(cfg.dropout)
 
     def forward(self, x):
+        if (isinstance(self.fc1, nn.Linear) and self.fc1.bias is not None
+                and self.fc2.bias is not None):
+            # fused FFN: backward recomputes gelu instead of saving the
+            # 4h-wide activation (ops/fused_ffn.py; reference analog
+            # operators/fused/fused_feedforward_op.cc)
+            from ...ops.fused_ffn import fused_ffn
+            out = fused_ffn(x, self.fc1.weight, self.fc1.bias,
+                            self.fc2.weight, self.fc2.bias,
+                            activation="gelu_tanh")
+            return self.dropout(out)
         return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
 
 
@@ -170,8 +185,13 @@ class GPTModel(nn.Layer):
             position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
-        for block in self.h:
-            x = block(x)
+        if self.config.recompute and self.training:
+            from ...distributed.fleet.utils import recompute as _ckpt
+            for block in self.h:
+                x = _ckpt(block, x)
+        else:
+            for block in self.h:
+                x = block(x)
         return self.ln_f(x)
 
 

@@ -178,6 +178,34 @@ def test_resnet18_fused_matches_unfused(fmt):
     np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
+def test_resnet50_bottleneck_fused_matches_unfused():
+    """BottleneckBlock (1x1/3x3/1x1 + downsample) through the fused path:
+    bitwise forward, grads within backward-reassociation noise."""
+    paddle.seed(0)
+    m1 = paddle.vision.models.resnet50(num_classes=3, fused_conv_bn=False)
+    paddle.seed(0)
+    m2 = paddle.vision.models.resnet50(num_classes=3, fused_conv_bn=True)
+    m2.set_state_dict(m1.state_dict())
+    x_np = np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32")
+    y_np = np.array([0, 2], "int64")
+    losses, grads = [], []
+    for m in (m1, m2):
+        m.train()
+        loss = F.cross_entropy(m(paddle.to_tensor(x_np)),
+                               paddle.to_tensor(y_np))
+        loss.backward()
+        losses.append(float(loss.numpy()))
+        grads.append({n: p.grad.numpy() for n, p in m.named_parameters()
+                      if p.grad is not None})
+    assert losses[0] == losses[1], losses
+    for kk, a in grads[0].items():
+        b = grads[1][kk]
+        rel = np.linalg.norm((a - b).ravel()) / (np.linalg.norm(a.ravel())
+                                                 + 1e-12)
+        assert rel < 0.05, (kk, rel)
+
+
 def test_resnet_fused_trains_under_to_static():
     """The fused custom_vjp must trace through jit.to_static + run_steps
     (the bench path) and the loss must descend on a learnable stream."""

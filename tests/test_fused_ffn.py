@@ -1,0 +1,167 @@
+"""Fused feed-forward op (ops/fused_ffn.py) — parity fwd+bwd vs the unfused
+composition. Reference analog: operators/fused/fused_feedforward_op.cc."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops.fused_ffn import fused_ffn
+
+
+def _params(rng, d, dff):
+    return (
+        (rng.randn(2, 6, d) * 0.5).astype("float32"),
+        (rng.randn(d, dff) * 0.2).astype("float32"),
+        (rng.randn(dff) * 0.1).astype("float32"),
+        (rng.randn(dff, d) * 0.2).astype("float32"),
+        (rng.randn(d) * 0.1).astype("float32"),
+    )
+
+
+def _run(np_args, activation, fused, dtype="float32"):
+    x_np, w1_np, b1_np, w2_np, b2_np = np_args
+    ts = []
+    for a in np_args:
+        t = paddle.to_tensor(a.astype(dtype) if a.ndim > 1 or True else a)
+        t.stop_gradient = False
+        ts.append(t)
+    x, w1, b1, w2, b2 = ts
+    if fused:
+        y = fused_ffn(x, w1, b1, w2, b2, activation=activation)
+    else:
+        h = F.linear(x, w1, b1)
+        if activation == "gelu":
+            h = F.gelu(h, approximate=False)
+        elif activation == "gelu_tanh":
+            h = F.gelu(h, approximate=True)
+        else:
+            h = F.relu(h)
+        y = F.linear(h, w2, b2)
+    (y.astype("float32").tanh().sum()).backward()
+    return ([np.asarray(y.numpy(), np.float32)]
+            + [np.asarray(t.grad.numpy(), np.float32) for t in ts])
+
+
+@pytest.mark.parametrize("activation", ["gelu", "gelu_tanh", "relu"])
+def test_parity_fwd_bwd(activation):
+    rng = np.random.RandomState(0)
+    args = _params(rng, 16, 32)
+    ref = _run(args, activation, fused=False)
+    fus = _run(args, activation, fused=True)
+    names = ["y", "dx", "dw1", "db1", "dw2", "db2"]
+    for n, a, b in zip(names, ref, fus):
+        denom = np.max(np.abs(a)) + 1e-8
+        assert np.max(np.abs(a - b)) / denom < 5e-5, (activation, n)
+
+
+def test_bf16_parity():
+    rng = np.random.RandomState(1)
+    args = _params(rng, 16, 32)
+    ref = _run(args, "gelu_tanh", fused=False, dtype="bfloat16")
+    fus = _run(args, "gelu_tanh", fused=True, dtype="bfloat16")
+    for n, a, b in zip(["y", "dx", "dw1", "db1", "dw2", "db2"], ref, fus):
+        denom = np.max(np.abs(a)) + 1e-6
+        assert np.max(np.abs(a - b)) / denom < 0.03, n
+
+
+def test_gpt_mlp_uses_fused_and_matches_manual():
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTMLP
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                    dropout=0.0)
+    mlp = GPTMLP(cfg)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 8, 32).astype("float32"))
+    x.stop_gradient = False
+    y = mlp(x)
+    ref = F.linear(F.gelu(F.linear(x, mlp.fc1.weight, mlp.fc1.bias),
+                          approximate=True), mlp.fc2.weight, mlp.fc2.bias)
+    np.testing.assert_allclose(y.numpy(), ref.numpy(), rtol=2e-5, atol=2e-5)
+    (y.sum()).backward()
+    assert mlp.fc1.weight.grad is not None
+    assert np.all(np.isfinite(mlp.fc1.weight.grad.numpy()))
+
+
+def test_incubate_fused_feedforward_functional():
+    """incubate.nn.fused_feedforward: residual + pre/post LN wiring parity
+    with the composed ops."""
+    import paddle_tpu.incubate.nn as inn
+    rng = np.random.RandomState(2)
+    d, dff = 16, 32
+    x_np = rng.randn(2, 5, d).astype("float32")
+    w1 = paddle.to_tensor((rng.randn(d, dff) * 0.2).astype("float32"))
+    b1 = paddle.to_tensor((rng.randn(dff) * 0.1).astype("float32"))
+    w2 = paddle.to_tensor((rng.randn(dff, d) * 0.2).astype("float32"))
+    b2 = paddle.to_tensor((rng.randn(d) * 0.1).astype("float32"))
+    g = paddle.to_tensor((rng.rand(d) + 0.5).astype("float32"))
+    be = paddle.to_tensor((rng.randn(d) * 0.1).astype("float32"))
+    for normalize_before in (True, False):
+        x = paddle.to_tensor(x_np)
+        out = inn.fused_feedforward(
+            x, w1, w2, b1, b2, activation="gelu", ln1_scale=g, ln1_bias=be,
+            normalize_before=normalize_before, training=False)
+        xin = F.layer_norm(x, d, g, be) if normalize_before else x
+        core = F.linear(F.gelu(F.linear(xin, w1, b1), approximate=True),
+                        w2, b2)
+        ref = x + core
+        if not normalize_before:
+            ref = F.layer_norm(ref, d, g, be)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_fused_bias_dropout_residual_layer_norm():
+    """out = layer_norm(residual + dropout(x + bias)); eval/no-dropout path
+    must match the composed ops, grads must flow."""
+    import paddle_tpu.incubate.nn as inn
+    rng = np.random.RandomState(3)
+    d = 16
+    x = paddle.to_tensor(rng.randn(2, 5, d).astype("float32"))
+    x.stop_gradient = False
+    res = paddle.to_tensor(rng.randn(2, 5, d).astype("float32"))
+    bias = paddle.to_tensor((rng.randn(d) * 0.1).astype("float32"))
+    g = paddle.to_tensor((rng.rand(d) + 0.5).astype("float32"))
+    be = paddle.to_tensor((rng.randn(d) * 0.1).astype("float32"))
+    out = inn.fused_bias_dropout_residual_layer_norm(
+        x, res, bias, g, be, dropout_rate=0.0, training=True)
+    ref = F.layer_norm(res + (x + bias), d, g, be)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-5, atol=2e-5)
+    out.sum().backward()
+    assert np.all(np.isfinite(x.grad.numpy()))
+    # train-mode dropout actually drops (statistics, not exact values)
+    paddle.seed(7)
+    out_d = inn.fused_bias_dropout_residual_layer_norm(
+        x, res, bias, g, be, dropout_rate=0.5, training=True)
+    assert not np.allclose(out_d.numpy(), ref.numpy())
+
+
+def test_fused_bias_dropout_residual_ln_layer():
+    import paddle_tpu.incubate.nn as inn
+    paddle.seed(0)
+    layer = inn.FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 4, 16).astype("float32"))
+    res = paddle.to_tensor(
+        np.random.RandomState(1).randn(2, 4, 16).astype("float32"))
+    out = layer(x, res)
+    assert tuple(out.shape) == (2, 4, 16)
+    ref = F.layer_norm(res + (x + layer.linear_bias), 16,
+                       layer.ln_scale, layer.ln_bias)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_incubate_fused_feedforward_layer():
+    """FusedFeedForward layer routes through the functional; train-mode
+    dropout=0 output must match eval output (determinism check)."""
+    import paddle_tpu.incubate.nn as inn
+    paddle.seed(0)
+    layer = inn.FusedFeedForward(16, 32, dropout_rate=0.0,
+                                 activation="relu", normalize_before=True)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 4, 16).astype("float32"))
+    layer.train()
+    a = layer(x).numpy()
+    layer.eval()
+    b = layer(x).numpy()
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)

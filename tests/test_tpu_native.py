@@ -218,7 +218,7 @@ class TestFlashAttentionBackward:
 
         out_p, vjp_p = jax.vjp(
             lambda q_, k_, v_: _flash_attention_diff(q_, k_, v_, causal,
-                                                     scale), q, k, v)
+                                                     scale, True), q, k, v)
         out_x, vjp_x = jax.vjp(
             lambda q_, k_, v_: _xla_attention(q_, k_, v_, None, scale,
                                               causal, 0.0, None), q, k, v)

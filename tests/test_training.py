@@ -270,3 +270,39 @@ class TestMetric:
         correct = m.compute(logits, labels)
         m.update(correct)
         assert abs(m.accumulate() - 0.5) < 1e-6
+
+
+class TestGPTRecompute:
+    """cfg.recompute: blocks rematerialize in backward (fleet.utils.recompute
+    = jax.checkpoint). The recompute curve must MATCH the plain curve —
+    remat changes memory, never math."""
+
+    def _curve(self, recompute):
+        import paddle_tpu.nn.functional as F  # noqa: F401
+        from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_position_embeddings=32,
+                        dropout=0.0, recompute=recompute)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        xs = rng.randint(0, 128, (6, 2, 32)).astype("int32")
+        ys = np.roll(xs, -1, axis=2).astype("int64")
+
+        @paddle.jit.to_static
+        def step(x, y):
+            loss = model(x, labels=y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss.astype("float32")
+
+        losses = step.run_steps(paddle.to_tensor(xs), paddle.to_tensor(ys))
+        return np.asarray(losses.numpy(), np.float64)
+
+    def test_recompute_matches_plain(self):
+        plain = self._curve(False)
+        remat = self._curve(True)
+        np.testing.assert_allclose(remat, plain, rtol=2e-4, atol=2e-4)
