@@ -31,11 +31,24 @@ import time
 
 import numpy as np
 
-# Per-chip parity proxies (recorded constants — the reference repo publishes
-# no numbers, BASELINE.md): A100 fp16 throughputs.
-BASELINE_TOKENS_PER_SEC = 23000.0      # ERNIE/BERT-base fine-tune, seq128
+# Per-chip parity proxies (the reference repo publishes no numbers,
+# BASELINE.md §derivations). vs_baseline for every transformer lane uses ONE
+# convention: achieved model FLOP/s vs BASELINE_A100_TFLOPS.
+#
+# BASELINE_A100_TFLOPS = 140e12: Megatron-class achieved fp16 FLOP/s on one
+#   A100 — 0.45 x the 312 TF/s fp16 peak, consistent with NVIDIA's published
+#   BERT-large A100 pretrain rate (~126 seq/s @ s512 -> ~137 TF/s achieved
+#   under the same 6P+12Lsd FLOP count). Dividing by BERT-base's flops/token
+#   at s128 (~0.67 GF) this implies ~208k tok/s — the r1-r4 constant of 23k
+#   tok/s carried no derivation and was ~5x low (VERDICT r4 weak #4).
+# BASELINE_RESNET_IMGS = 2800: MLPerf-magnitude A100 ResNet-50 AMP train
+#   rate (NGC results cluster at 2.5-3k img/s; = 34 TF/s achieved on the
+#   12.3 GF/img train cost — convnets run far below matmul peak).
+# BASELINE_LENET_IMGS = 60000: nominal smoke-lane constant (no published
+#   LeNet baseline exists; the lane exists to exercise config 1 end-to-end).
+BASELINE_A100_TFLOPS = 140.0e12        # achieved FLOP/s per A100 (all
+                                       # transformer lanes: bert/ernie/gpt)
 BASELINE_RESNET_IMGS = 2800.0          # ResNet-50 AMP train, per A100
-BASELINE_GPT_TFLOPS = 140.0e12         # Megatron-class achieved FLOP/s/A100
 BASELINE_LENET_IMGS = 60000.0
 
 _PEAK_TFLOPS_BY_KIND = {
@@ -96,7 +109,7 @@ _LAST_DISTINCT = {}  # model-name -> number of DISTINCT batches in the run
 
 
 def _timed_steps(step, data_fn, steps, warmup=5, curve_key=None,
-                 spe_default=32, distinct_data=True):
+                 spe_default=32, distinct_data=True, distinct_stacks=None):
     """Time `steps` optimizer steps; returns wall seconds (normalized to
     per-`steps` wall time).
 
@@ -143,11 +156,20 @@ def _timed_steps(step, data_fn, steps, warmup=5, curve_key=None,
         curve.append(losses)
 
     if spe == 1:
-        arrays = data_fn(warmup + steps)
+        n_total = warmup + steps
+        # honor the distinct-data contract here too: BENCH_SPE=1 on the
+        # resnet lane must not stage warmup+steps distinct image batches
+        # (~10 GB) when the scanned path deliberately bounds staging to
+        # distinct_stacks stacks
+        if distinct_data:
+            n_pool = n_total
+        else:
+            n_pool = min(max(1, int(distinct_stacks or 1)), n_total)
+        arrays = data_fn(n_pool)
         if curve_key:
-            _LAST_DISTINCT[curve_key] = warmup + steps
-        staged = [tuple(stage(a[i]) for a in arrays)
-                  for i in range(warmup + steps)]
+            _LAST_DISTINCT[curve_key] = n_pool
+        pool = [tuple(stage(a[i]) for a in arrays) for i in range(n_pool)]
+        staged = [pool[i % n_pool] for i in range(n_total)]
         for args_i in staged[:warmup]:
             record(step(*args_i))
         curve[-1].item()  # sync warm-up
@@ -165,17 +187,23 @@ def _timed_steps(step, data_fn, steps, warmup=5, curve_key=None,
     # distinct_data: every executed step (2*spe warm-up + steps timed) trains
     # on its OWN batch, so the recorded curve is evidence of learning a
     # stream, not of memorizing one staged stack. Token workloads stage all
-    # of it for ~MBs. The resnet50 bench opts out (images at b128/spe=32 are
-    # ~1.2 GB per stack; staging 10 stacks would blow HBM) — it cycles one
-    # stack and its LOSS_CURVES entry carries distinct_batches=spe.
+    # of it for ~MBs. The resnet50 bench instead rotates `distinct_stacks`
+    # staged stacks (images at b128/spe=32 are ~1.2 GB per stack; staging 10
+    # stacks would blow HBM, 3 fit) — its LOSS_CURVES entry carries
+    # distinct_batches = spe * distinct_stacks.
     if distinct_data:
         stacks = [tuple(stage(a) for a in data_fn(spe))
                   for _ in range(2 + n_exec)]
+        n_distinct = spe * (2 + n_exec)
     else:
-        stacks = [tuple(stage(a) for a in data_fn(spe))] * (2 + n_exec)
+        # cap at the execution count: staging stacks no execution will
+        # train on would waste HBM and overstate distinct_batches
+        k_stacks = min(max(1, int(distinct_stacks or 1)), 2 + n_exec)
+        base = [tuple(stage(a) for a in data_fn(spe)) for _ in range(k_stacks)]
+        stacks = [base[i % k_stacks] for i in range(2 + n_exec)]
+        n_distinct = spe * k_stacks
     if curve_key:
-        _LAST_DISTINCT[curve_key] = (spe * (2 + n_exec) if distinct_data
-                                     else spe)
+        _LAST_DISTINCT[curve_key] = n_distinct
     dbg = os.environ.get("BENCH_DEBUG") == "1"
 
     def _mark(label, t0):
@@ -211,7 +239,7 @@ def _transformer_flops_per_token(n_params, n_layers, seq, hidden):
     return 6.0 * n_params + 12.0 * n_layers * seq * hidden
 
 
-def bench_bert(arch=None):
+def bench_bert(arch=None, short=False):
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F  # noqa: F401
     from paddle_tpu.text.models import BertForSequenceClassification
@@ -219,10 +247,11 @@ def bench_bert(arch=None):
 
     batch = int(os.environ.get("BENCH_BATCH", 16))
     seq = int(os.environ.get("BENCH_SEQ", 128))
-    # 384 steps: at the fine-tune lr (5e-5) the [CLS]-parity signal needs
-    # ~300 steps to clear the ln(2) plateau unambiguously; the timed region
-    # costs ~2.6s per 192 steps so the evidence is nearly free
-    steps = int(os.environ.get("BENCH_STEPS", 384))
+    # short=True: abbreviated evidence lane appended to the default bench
+    # line (VERDICT r4 missing #2) — same geometry/regime, FIXED small step
+    # budget (deliberately not BENCH_STEPS: overriding the flagship budget
+    # must not multiply the bounded legs' wall time)
+    steps = 64 if short else int(os.environ.get("BENCH_STEPS", 384))
 
     paddle.seed(0)
     if arch == "ernie":
@@ -240,26 +269,41 @@ def bench_bert(arch=None):
         model = BertForSequenceClassification(cfg, num_classes=2)
     precision = _apply_dtype(model)
     # fp32 master weights in the recorded regime: a pure-bf16 AdamW update at
-    # lr=5e-5 rounds to zero against bf16 weights (ulp(0.02)~1.6e-4), so the
-    # run would measure training that makes no progress (VERDICT r3 weak #1).
-    # Mirrors reference AMP O2 (contrib/mixed_precision/decorator.py keeps
-    # fp32 masters by construction).
-    opt = paddle.optimizer.AdamW(learning_rate=5e-5, multi_precision=True,
-                                 parameters=model.parameters())
+    # fine-tune lr rounds to zero against bf16 weights (ulp(0.02)~1.6e-4), so
+    # the run would measure training that makes no progress (VERDICT r3 weak
+    # #1). Mirrors reference AMP O2 (contrib/mixed_precision/decorator.py
+    # keeps fp32 masters by construction). lr=1e-4 with the reference
+    # N(0,0.02) BERT init (bert.py _reference_init): at lr=5e-5 with the old
+    # default init (N(0,1) embeddings) the r4 run never left the ln(2)
+    # chance plateau inside the bench budget (VERDICT r4 weak #1 — its own
+    # LOSS_CURVES refuted the claimed descent). Measured r5 probes, same
+    # regime otherwise: old init lr=1e-4 last32 = 0.703 (flat, gate fails);
+    # ref init lr=1e-4 last32 = 0.0001 at full 161.7k tok/s (gate passes).
+    # BENCH_CLIP=1 adds the BERT paper's global-norm clip 1.0 — it also
+    # fixes learning (last32 = 0.0000) but costs ~12% throughput (141.5k)
+    # for no extra evidence value, so the recorded regime leaves it off.
+    clip = (paddle.nn.ClipGradByGlobalNorm(1.0)
+            if os.environ.get("BENCH_CLIP", "0") == "1" else None)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
+                                 parameters=model.parameters(),
+                                 grad_clip=clip)
 
     rng = np.random.RandomState(0)
 
     def data(k):
         # one distinct batch per step; the label is a deterministic function
-        # of the input ([CLS]-position token parity), so the curve can only
-        # descend if the optimizer is genuinely learning the mapping. The
-        # [CLS] token is drawn from a 16-token sub-vocab so each token's
-        # embedding row is visited hundreds of times inside the bench
-        # budget — drawn from the full 30k vocab each row would train ~once
-        # and nothing could be learned at lr=5e-5 (measured: flat curve).
+        # of the input, so the curve can only descend if the optimizer is
+        # genuinely learning the mapping. The signal: positions 0..7 each
+        # carry a token from a 16-token sub-vocab whose PARITY equals the
+        # label (ids[p] = 2*r_p + y), so the label is linearly readable from
+        # any of eight token embeddings (VERDICT r4 item 1 — the single-
+        # position r4 variant at lr=5e-5 never cleared chance in-budget).
+        # The sub-vocab keeps each signal embedding row visited hundreds of
+        # times inside the bench budget — drawn from the full 30k vocab each
+        # row would train ~once and nothing could be learned.
         ids = rng.randint(0, cfg.vocab_size, (k, batch, seq))
-        ids[:, :, 0] = rng.randint(0, 16, (k, batch))
-        labels = (ids[:, :, 0] % 2).astype("int64")
+        labels = rng.randint(0, 2, (k, batch)).astype("int64")
+        ids[:, :, :8] = 2 * rng.randint(0, 8, (k, batch, 8)) + labels[..., None]
         return ids.astype("int64"), labels
 
     @paddle.jit.to_static
@@ -275,17 +319,21 @@ def bench_bert(arch=None):
 
     # 64-step scans amortize relay dispatch latency (155k -> 172k tok/s
     # over spe=16 on v5e)
-    dt = _timed_steps(step, data, steps, curve_key=arch or "bert",
-                      spe_default=64)
+    key = arch or "bert"
+    dt = _timed_steps(step, data, steps, curve_key=key,
+                      spe_default=32 if short else 64)
     tokens = batch * seq * steps
     tps = tokens / dt
     fpt = _transformer_flops_per_token(
         _param_count(model), cfg.num_layers, seq, cfg.hidden_size)
     return {
-        "metric": f"{arch or 'bert'}_base_train_tokens_per_sec_per_chip",
+        "metric": f"{key}_base_train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 3),
+        # achieved-FLOP/s convention, same as the GPT lane (BASELINE.md
+        # §derivations; the old 23k tok/s constant was underived and ~5x
+        # low — VERDICT r4 weak #4)
+        "vs_baseline": round(tps * fpt / BASELINE_A100_TFLOPS, 3),
         "mfu": _mfu(tps * fpt),
         "precision": precision,
     }
@@ -314,12 +362,11 @@ def bench_resnet50():
     rng = np.random.RandomState(0)
 
     # Learnable stream: class-prototype + noise images (like the LeNet
-    # parity test's stream), one DISTINCT batch per scanned step, staged to
-    # the device once. spe=32 keeps the staged stack at ~1.2 GB bf16
-    # (spe=128 would stage 4.8 GB); the known cost vs spe=128 is ~1%
-    # (profiled 2472 vs 2500 img/s). An in-step pool-gather variant was
-    # measured at -60% throughput (gather broke XLA's conv layout
-    # pipelining) and reverted.
+    # parity test's stream), rotating THREE staged 32-step stacks (~3.6 GB
+    # bf16 total; distinct_batches = 96 bounds memorization — VERDICT r4
+    # item 7; staging one stack per exec would need ~12 GB and blow HBM).
+    # An in-step pool-gather variant was measured at -60% throughput
+    # (gather broke XLA's conv layout pipelining) and reverted.
     protos = rng.randn(1000, hw, hw, 3).astype("float32")
     img_dtype = "bfloat16" if precision == "bf16" else "float32"
 
@@ -349,7 +396,8 @@ def bench_resnet50():
         return loss
 
     dt = _timed_steps(step, data, steps, curve_key="resnet50",
-                      spe_default=32, distinct_data=False)
+                      spe_default=32, distinct_data=False,
+                      distinct_stacks=int(os.environ.get("BENCH_STACKS", 3)))
     imgs = batch * steps
     ips = imgs / dt
     # ResNet-50 forward ~4.09 GFLOPs @224; train ~3x fwd; scales with area
@@ -364,7 +412,7 @@ def bench_resnet50():
     }
 
 
-def bench_gpt(slice_1p3b=False):
+def bench_gpt(slice_1p3b=False, short=False):
     import paddle_tpu as paddle
     from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
 
@@ -381,7 +429,8 @@ def bench_gpt(slice_1p3b=False):
     if slice_1p3b:
         batch = int(os.environ.get("BENCH_BATCH", 2))
         seq = int(os.environ.get("BENCH_SEQ", 1024))
-        steps = int(os.environ.get("BENCH_STEPS", 32))
+        # short: fixed budget, see bench_bert note
+        steps = 32 if short else int(os.environ.get("BENCH_STEPS", 32))
         layers = int(os.environ.get("BENCH_GPT_LAYERS", 6))
         hidden = int(os.environ.get("BENCH_GPT_HIDDEN", 2048))
         vocab = int(os.environ.get("BENCH_GPT_VOCAB", 50304))
@@ -441,7 +490,7 @@ def bench_gpt(slice_1p3b=False):
                    else "gpt_small_train_tokens_per_sec_per_chip"),
         "value": round(tps, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(tps * fpt / BASELINE_GPT_TFLOPS, 3),
+        "vs_baseline": round(tps * fpt / BASELINE_A100_TFLOPS, 3),
         "mfu": _mfu(tps * fpt),
         "precision": precision,
         "params": n_params,
@@ -509,30 +558,60 @@ def _release_bench_state():
     # (measured 63s -> 1110s warm1)
 
 
-# Curves that MUST descend for the numbers to be honest (the data for these
-# benches is constructed learnable). A flat curve means the measured
-# throughput is an upper bound on training that makes no progress — the
-# exact failure VERDICT r3 found — so the bench run itself fails.
-_DESCENT_GATED = ("bert", "ernie", "gpt", "gpt1p3b_slice", "resnet50",
-                  "lenet")
+# Chance-floor gate (VERDICT r4 item 1b). The data for these benches is
+# CONSTRUCTED learnable, so honest training must end SUSTAINED below the
+# task's chance-level loss — ln(n_classes) for the classification lanes,
+# ln(sub_vocab) for the permutation-LM lanes — by at least the stated
+# margin. The r4 descent gate (last5 < 0.9 x first5) was satisfiable by any
+# init transient: the r4 BERT run spiked to 3.36 at step 2, sat at chance
+# ln 2 from step ~32 to 512, and passed. A chance floor on the last-32 mean
+# cannot be passed by a curve that never learns, regardless of transients.
+_CHANCE_FLOORS = {
+    # lane: (floor, min recorded steps to judge, rationale). The minimum is
+    # each lane's own default recorded budget (2 warm-up scans + timed
+    # region): a curve shorter than the lane's design budget cannot support
+    # the sustained-sub-chance claim and FAILS rather than passes.
+    "bert": (0.62, 256, "binary parity task: ln(2)=0.693 is chance; -0.073"),
+    "ernie": (0.62, 128, "same task/geometry as bert"),
+    "lenet": (1.80, 64, "10-class prototypes: ln(10)=2.303 is chance; -0.5"),
+    "resnet50": (6.71, 256, "1000-class prototypes: ln(1000)=6.908 is "
+                            "chance; -0.2 (96 HBM-bounded distinct batches "
+                            "across 3 staged stacks descend slowly at "
+                            "lr=0.1 — honest but shallow)"),
+    "gpt": (5.24, 128, "512-token permutation stream: ln(512)=6.238 is the "
+                       "no-structure CE; -1.0"),
+    "gpt1p3b_slice": (5.24, 96, "same stream as gpt; 96 = its default "
+                                "recorded budget (2x32 warm + 32 timed)"),
+}
+_GATE_WINDOW = 32
+# Lanes exempted from the floor gate for this run (reported as "exempt" in
+# the loss_curves extra, never silently). EMPTY in every shipped
+# configuration: the abbreviated default-line ernie/gpt1p3b legs were
+# measured clearing their floors inside their fixed budgets (r5 probes:
+# gpt1p3b last32 = 0.12 vs floor 5.24 at 96 recorded steps; ernie 0.0001
+# vs 0.62), so they are gated like every other lane. The mechanism stays
+# for future lanes whose budget genuinely cannot support the sustained
+# claim (tests/test_chance_floor_gate.py covers it).
+_GATE_SHORT_LANES = set()
 
 
-def _descent_gate():
-    """last5 mean must sit below 0.9x first5 mean (VERDICT r4 item 1).
-
-    Returns a dict of failures: curve -> (first5_mean, last5_mean)."""
+def chance_floor_failures(curves, short_lanes=()):
+    """Pure gate core (unit-tested against the r4 flat BERT curve): for each
+    gated lane, the mean of the last `_GATE_WINDOW` recorded losses must sit
+    below the lane's chance floor. Returns {lane: failure-info}."""
     failures = {}
-    for key in _DESCENT_GATED:
-        curve = _LAST_CURVE.get(key)
-        if not curve or len(curve) < 10:
+    for key, (floor, min_steps, why) in _CHANCE_FLOORS.items():
+        curve = curves.get(key)
+        if not curve or key in short_lanes:
             continue
-        first5 = float(np.mean(curve[:5]))
-        last5 = float(np.mean(curve[-5:]))
-        # a curve that is already converged near zero when the timed region
-        # starts (warmup trains 2*spe steps first) cannot fall another 10%
-        if not (last5 < 0.9 * first5 or last5 < 0.05):
-            failures[key] = {"first5_mean": round(first5, 4),
-                             "last5_mean": round(last5, 4)}
+        if len(curve) < min_steps:
+            failures[key] = {"error": f"curve too short to judge "
+                                      f"({len(curve)} < {min_steps})"}
+            continue
+        tail_mean = float(np.mean(curve[-_GATE_WINDOW:]))
+        if not tail_mean < floor:
+            failures[key] = {"last32_mean": round(tail_mean, 4),
+                             "floor": floor, "chance": why}
     return failures
 
 
@@ -569,6 +648,33 @@ def main():
             except Exception as e3:
                 sys.stderr.write(f"gpt bench failed: {e3!r}\n")
                 result["extra"]["gpt_error"] = repr(e3)[:200]
+            # abbreviated evidence lanes for BASELINE configs 3 (ERNIE) and
+            # 5 (GPT-3 1.3B single-chip slice) — VERDICT r4 missing #2: the
+            # capability without a driver-recorded number is a claim, not
+            # evidence. Bounded runtime: 32/64-step legs.
+            _release_bench_state()
+            try:
+                r4 = bench_gpt(slice_1p3b=True, short=True)
+                result["extra"].update({
+                    "gpt1p3b_slice_tokens_per_sec_per_chip": r4["value"],
+                    "gpt1p3b_slice_vs_baseline": r4["vs_baseline"],
+                    "gpt1p3b_slice_mfu": r4["mfu"],
+                    "gpt1p3b_slice_params": r4["params"],
+                })
+            except Exception as e4:
+                sys.stderr.write(f"gpt1p3b bench failed: {e4!r}\n")
+                result["extra"]["gpt1p3b_slice_error"] = repr(e4)[:200]
+            _release_bench_state()
+            try:
+                r5 = bench_bert(arch="ernie", short=True)
+                result["extra"].update({
+                    "ernie_tokens_per_sec_per_chip": r5["value"],
+                    "ernie_vs_baseline": r5["vs_baseline"],
+                    "ernie_mfu": r5["mfu"],
+                })
+            except Exception as e5:
+                sys.stderr.write(f"ernie bench failed: {e5!r}\n")
+                result["extra"]["ernie_error"] = repr(e5)[:200]
     except Exception as e:
         # no silent workload switching: report the failure itself
         sys.stderr.write(f"bench {which or 'bert'} failed: {e!r}\n")
@@ -597,15 +703,21 @@ def main():
             sys.stderr.write(f"loss curve artifact write failed: {e}\n")
         result.setdefault("extra", {})["loss_curves"] = {
             k: {"first5": [round(x, 4) for x in v[:5]],
+                "last32_mean": round(float(np.mean(v[-_GATE_WINDOW:])), 4),
                 "last5": [round(x, 4) for x in v[-5:]],
+                "chance_floor": (None if k in _GATE_SHORT_LANES
+                                 else _CHANCE_FLOORS.get(k, (None, 0))[0]),
+                "floor_gate": ("exempt (abbreviated evidence lane)"
+                               if k in _GATE_SHORT_LANES else "gated"),
                 "steps": len(v)}
             for k, v in _LAST_CURVE.items()}
-        failures = _descent_gate()
+        failures = chance_floor_failures(_LAST_CURVE, _GATE_SHORT_LANES)
         if failures and os.environ.get("BENCH_DESCENT_GATE", "1") != "0":
-            result["descent_gate_failed"] = failures
+            result["chance_floor_gate_failed"] = failures
             sys.stderr.write(
-                f"descent gate FAILED (flat loss curve = throughput of "
-                f"training that learns nothing): {failures}\n")
+                f"chance-floor gate FAILED (loss never sustained below "
+                f"chance = throughput of training that learns nothing): "
+                f"{failures}\n")
             print(json.dumps(result))
             sys.exit(1)
     print(json.dumps(result))

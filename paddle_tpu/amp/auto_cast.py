@@ -17,7 +17,12 @@ WHITE_LIST = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm",
               "einsum", "sdpa", "flash_attention"}
 BLACK_LIST = {"exp", "log", "softmax", "log_softmax", "cross_entropy",
               "mean", "sum", "layer_norm", "batch_norm", "norm",
-              "softmax_with_cross_entropy", "cumsum", "logsumexp"}
+              "softmax_with_cross_entropy", "cumsum", "logsumexp",
+              # norm-family fused op: promoted to f32 under AMP exactly
+              # like layer_norm (its cotangents then arrive in f32 too —
+              # a bf16 primal here would reject the f32 cotangents the
+              # promoted consumers send back)
+              "fused_residual_ln"}
 
 _state = {"enabled": False, "dtype": bfloat16, "level": "O1",
           "custom_white": set(), "custom_black": set()}

@@ -84,6 +84,8 @@ class Tensor:
         "_hooks",
         "dist_attr",   # auto_parallel annotation (DistAttr), set lazily
         "_version",    # in-place mutation counter (tensor_version parity)
+        "_degen_cache",  # fused-op degenerate-weight check memo
+                         # (ops/fused_conv_bn.py, ops/fused_residual_ln.py)
         "__weakref__",
     )
 
@@ -303,6 +305,9 @@ class Tensor:
             raise InvalidArgumentError(
                 f"set_value shape mismatch: {value.shape} vs {self._val.shape}")
         self._value = value
+        # explicit re-initialization may move the value into/out of the
+        # fused-op degenerate band (ops/_param_guard.py sticky cache)
+        self._degen_cache = None
 
     def copy_(self, other, blocking=True):
         self.set_value(other)
@@ -314,14 +319,17 @@ class Tensor:
 
     def scale_(self, factor):
         self._value = self._val * factor
+        self._degen_cache = None  # may scale into the degenerate band
         return self
 
     def zero_(self):
         self._value = jnp.zeros_like(self._val)
+        self._degen_cache = None  # zero-init recipes (ops/_param_guard.py)
         return self
 
     def fill_(self, v):
         self._value = jnp.full_like(self._val, v)
+        self._degen_cache = None
         return self
 
     # -- python protocol --------------------------------------------------------

@@ -71,7 +71,8 @@ def recompute(function, *args, **kwargs):
     try:
         with _autograd.no_grad():
             jax.eval_shape(
-                lambda *vals: unwrap(function(*rebuild(vals), **kwargs)),
+                lambda *vals: jax.tree_util.tree_map(
+                    unwrap, function(*rebuild(vals), **kwargs)),
                 *[jax.ShapeDtypeStruct(t._val.shape, t._val.dtype)
                   for t in tensor_args])
     finally:
@@ -131,7 +132,10 @@ def recompute(function, *args, **kwargs):
             # intact, remat uses its rule as designed
             with _autograd.no_grad():
                 out = function(*rebuild(vals[:n_args]), **kwargs)
-            return unwrap(out)
+            # tuple-returning blocks (e.g. GPTBlock's carried-residual
+            # (stream, pending) form) unwrap leaf-wise; jax.checkpoint and
+            # apply() both handle pytree outputs
+            return jax.tree_util.tree_map(unwrap, out)
         finally:
             _fa._FORCE_INTERPRET[0] = prev_force
             _TraceHooks.on_write = prev_write

@@ -93,48 +93,69 @@ class FusedBiasDropoutResidualLayerNorm(nn.Layer):
             training=self.training)
 
 
+def _ffn_act(F, activation):
+    """Unfused-path activation lookup shared with the fused path's naming:
+    'gelu' is erf-gelu (reference GeluFunctor in fused_dropout_act_bias.h is
+    erf-based), 'gelu_tanh' the tanh approximation."""
+    if activation == "gelu":
+        return lambda h: F.gelu(h)
+    if activation == "gelu_tanh":
+        return lambda h: F.gelu(h, approximate=True)
+    return getattr(F, activation)
+
+
 def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
-                      linear2_bias=None, activation="relu", ln1_scale=None,
-                      ln1_bias=None, ln2_scale=None, ln2_bias=None,
-                      dropout1_rate=0.0, dropout2_rate=0.0,
-                      normalize_before=False, epsilon=1e-5, training=True,
-                      name=None):
-    """incubate.nn.functional.fused_feedforward parity
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", name=None):
+    """incubate.nn.functional.fused_feedforward parity — signature and
+    defaults match python/paddle/incubate/nn/functional/fused_transformer.py
     (operators/fused/fused_feedforward_op.cc):
-        out = residual + dropout2(linear2(dropout1(act(linear1(ln(x))))))
-    with the LayerNorm before (normalize_before) or after the residual add.
+        out = residual + dropout2(linear2(dropout1(act(linear1(ln1(x))))))
+    with ln1 applied before when pre_layer_norm, else ln2 after the residual
+    add. activation='gelu' is erf-gelu on BOTH the fused and unfused paths
+    (the reference fused op's GeluFunctor is erf-based).
 
     The linear1->act->linear2 core runs through ops/fused_ffn.py (backward
     recomputes the activation instead of saving it) whenever both biases are
     present and the dropout between the matmuls is inactive; otherwise it
     falls back to the composed ops."""
-    from .. import nn as _nn
     from ..nn import functional as F
     from ..ops.fused_ffn import fused_ffn
 
     residual = x
-    if normalize_before:
-        x = F.layer_norm(x, x.shape[-1], ln1_scale, ln1_bias, epsilon)
-    act = {"gelu": "gelu_tanh"}.get(activation, activation)
-    drop1_active = training and dropout1_rate > 0.0
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1], ln1_scale, ln1_bias, ln1_epsilon)
+
+    # a dropout is an IDENTITY (and the fused no-dropout kernel applies)
+    # only when its rate is 0, or at inference under upscale_in_train;
+    # downscale_in_infer still scales by (1-p) at inference (F.dropout
+    # implements both reference modes)
+    def _drop_identity(rate):
+        return rate == 0.0 or (not training and mode == "upscale_in_train")
+
     if (linear1_bias is not None and linear2_bias is not None
-            and not drop1_active and act in ("gelu_tanh", "relu")):
+            and _drop_identity(dropout1_rate)
+            and activation in ("gelu", "gelu_tanh", "relu")):
         out = fused_ffn(x, linear1_weight, linear1_bias, linear2_weight,
-                        linear2_bias, activation=act)
+                        linear2_bias, activation=activation)
     else:
         h = F.linear(x, linear1_weight, linear1_bias)
-        h = getattr(F, "gelu" if activation == "gelu" else activation)(h)
-        if drop1_active:
-            h = F.dropout(h, p=dropout1_rate, training=True)
+        h = _ffn_act(F, activation)(h)
+        if not _drop_identity(dropout1_rate):
+            h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
         out = F.linear(h, linear2_weight, linear2_bias)
-    if training and dropout2_rate > 0.0:
-        out = F.dropout(out, p=dropout2_rate, training=True)
+    if not _drop_identity(dropout2_rate):
+        out = F.dropout(out, p=dropout2_rate, training=training, mode=mode)
     out = residual + out
-    if not normalize_before:
-        out = F.layer_norm(out, out.shape[-1], ln2_scale if ln2_scale is not None
-                           else ln1_scale,
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1],
+                           ln2_scale if ln2_scale is not None else ln1_scale,
                            ln2_bias if ln2_bias is not None else ln1_bias,
-                           epsilon)
+                           ln2_epsilon)
     return out
 
 
@@ -194,11 +215,12 @@ class FusedFeedForward(nn.Layer):
         return fused_feedforward(
             x, self.linear1.weight, self.linear2.weight,
             self.linear1.bias, self.linear2.bias,
-            activation=self._activation,
             ln1_scale=self.norm.weight, ln1_bias=self.norm.bias,
+            ln2_scale=self.norm.weight, ln2_bias=self.norm.bias,
             dropout1_rate=self.act_dropout.p, dropout2_rate=self.dropout.p,
-            normalize_before=self.normalize_before,
-            epsilon=self.norm._epsilon, training=self.training)
+            activation=self._activation,
+            ln1_epsilon=self.norm._epsilon, ln2_epsilon=self.norm._epsilon,
+            pre_layer_norm=self.normalize_before, training=self.training)
 
 
 class FusedTransformerEncoderLayer(nn.Layer):

@@ -133,6 +133,18 @@ class TransformerEncoderLayer(Layer):
                              activation=self._activation_name)
         return self.linear2(self.dropout(self.activation(self.linear1(src))))
 
+    def _post_residual_ln(self, residual, sub, norm):
+        """Post-LN residual write: norm(residual + sub) through the fused
+        residual+LN op (ops/fused_residual_ln.py — backward recovers x_hat
+        from the LN output, so the summed pre-norm tensor never crosses the
+        fwd->bwd boundary; reference analog
+        operators/fused/fused_bias_dropout_residual_layer_norm_op.cu)."""
+        if norm.weight is None or norm.bias is None:
+            return norm(residual + sub)
+        from ...ops.fused_residual_ln import fused_residual_ln
+        return fused_residual_ln(residual, sub, norm.weight, norm.bias,
+                                 epsilon=norm._epsilon)
+
     def forward(self, src, src_mask=None, cache=None):
         residual = src
         if self.normalize_before:
@@ -141,16 +153,20 @@ class TransformerEncoderLayer(Layer):
             src = self.self_attn(src, src, src, src_mask)
         else:
             src, cache = self.self_attn(src, src, src, src_mask, cache)
-        src = residual + self.dropout1(src)
-        if not self.normalize_before:
-            src = self.norm1(src)
+        if self.normalize_before:
+            src = residual + self.dropout1(src)
+        else:
+            src = self._post_residual_ln(residual, self.dropout1(src),
+                                         self.norm1)
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
         src = self._ffn(src)
-        src = residual + self.dropout2(src)
-        if not self.normalize_before:
-            src = self.norm2(src)
+        if self.normalize_before:
+            src = residual + self.dropout2(src)
+        else:
+            src = self._post_residual_ln(residual, self.dropout2(src),
+                                         self.norm2)
         return src if cache is None else (src, cache)
 
     def gen_cache(self, src):

@@ -1,0 +1,47 @@
+"""Shared degenerate-parameter guard for fused ops with reconstruction
+backwards (fused_conv_bn, fused_residual_ln).
+
+Both ops recover a normalized activation by dividing by a per-channel
+scale; channels with |scale| <= tol are unrecoverable and the custom
+backward freezes them. The eager entry points call this guard to route
+such parameters through plain autodiff instead.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["degenerate_below_tol"]
+
+
+def degenerate_below_tol(param, tol):
+    """True iff `param` (a Tensor or raw array) is concretely inspectable
+    AND some element sits inside the |value| <= tol band.
+
+    The result is STICKY per parameter (cached on the Tensor's
+    `_degen_cache` slot and kept across optimizer updates): the guard
+    exists to catch zero-INITIALIZED parameters, which are set either at
+    construction or via `Tensor.set_value` — and set_value invalidates
+    this cache. Re-checking after every optimizer step would put a
+    blocking device sync on the eager training hot path (one per fused op
+    per step) to detect a measure-zero event (a trained weight landing
+    EXACTLY inside the tol band), so it deliberately does not.
+
+    Tracers (jit/recompute traces) return False — the caller's fused path
+    must be shape-compatible with its fallback so the trace-time choice
+    cannot change program structure."""
+    import jax.core as jax_core
+    value = getattr(param, "_value", param)
+    if isinstance(value, jax_core.Tracer):
+        return False
+    cached = getattr(param, "_degen_cache", None)
+    if cached is not None and cached[0] == tol:
+        return cached[1]
+    try:
+        res = bool(jnp.any(jnp.abs(value) <= tol))
+    except Exception:
+        res = False
+    try:
+        param._degen_cache = (tol, res)
+    except Exception:
+        pass
+    return res

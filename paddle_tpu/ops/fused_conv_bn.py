@@ -59,11 +59,23 @@ def _conv_fn(stride, pad, dilation, groups, dn, act_input):
 # under the rounding error of the saved y, and dividing by a clamped tiny
 # value would produce enormous (finite) garbage gradients instead. The
 # trade-off is explicit: such channels get dgamma = 0 and dz = 0, so a BN
-# gamma EXACTLY zero-initialized (zero_init_residual recipes) stays zero
-# under this op — use the unfused path (fused_conv_bn=False /
-# PADDLE_TPU_FUSED_CONV_BN=0) for that regime. In-tree models initialize
-# gamma = 1.
+# gamma EXACTLY zero-initialized (zero_init_residual recipes) would stay
+# zero under the custom backward. fused_conv_bn guards against that
+# silently biting (ADVICE r4 finding 3): when gamma is concrete (eager
+# mode) and ANY channel sits in the degenerate band, it routes through the
+# plain-autodiff path — same forward math, jax-derived backward, correct
+# dgamma. Under jit tracing gamma is abstract and the guard cannot fire;
+# zero-init-gamma recipes compiled with to_static should pass
+# fused_conv_bn=False / PADDLE_TPU_FUSED_CONV_BN=0. In-tree models
+# initialize gamma = 1.
 _GAMMA_TOL = 1e-6
+
+
+def _gamma_degenerate(bn_weight):
+    """Some channel inside the |gamma| <= _GAMMA_TOL band where the custom
+    backward freezes it (shared guard: ops/_param_guard.py)."""
+    from ._param_guard import degenerate_below_tol
+    return degenerate_below_tol(bn_weight, _GAMMA_TOL)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
@@ -191,9 +203,19 @@ def fused_conv_bn(x, weight, bn_weight, bn_bias, running_mean=None,
         return apply(prim_eval, x, weight, bn_weight, bn_bias,
                      running_mean, running_var, name="fused_conv_bn_eval")
 
-    def prim(xv, wv, gv, bv):
-        return _fused_conv_bn_diff(xv, wv, gv, bv, stride_t, pad_n, dil_t,
-                                   groups, dn, epsilon, act_input)
+    if _gamma_degenerate(bn_weight):
+        # zero/near-zero gamma channels: plain autodiff through the same
+        # forward math (saves the conv output z as a residual, but keeps
+        # dgamma exact where the custom backward would freeze it)
+        def prim(xv, wv, gv, bv):
+            y, mean, var, _ = _fused_fwd_impl(xv, wv, gv, bv, stride_t,
+                                              pad_n, dil_t, groups, dn,
+                                              epsilon, act_input)
+            return y, mean, var
+    else:
+        def prim(xv, wv, gv, bv):
+            return _fused_conv_bn_diff(xv, wv, gv, bv, stride_t, pad_n,
+                                       dil_t, groups, dn, epsilon, act_input)
 
     out, mean_t, var_t = apply(prim, x, weight, bn_weight, bn_bias,
                                name="fused_conv_bn")

@@ -152,10 +152,30 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x):
-        x = x + self.dropout(self.attn(self.ln1(x)))
-        x = x + self.mlp(self.ln2(x))
-        return x
+    def forward(self, x, pending=None):
+        """Carried-residual form: the stream value entering this block is
+        x + pending (pending = the previous block's MLP branch output, not
+        yet added). Each residual add is materialized inside
+        ops/fused_residual_ln.py together with the LayerNorm that consumes
+        it, so the summed (b, s, h) stream tensors never cross the
+        fwd->bwd boundary (reference analog: the residual+LN epilogues of
+        operators/fused/fused_attention_op.cu /
+        fused_bias_dropout_residual_layer_norm_op.cu). Returns
+        (stream, pending_mlp_out) — GPTModel folds the last pending into
+        ln_f the same way."""
+        from ...ops.fused_residual_ln import fused_residual_ln
+        if pending is None:
+            x1, h1 = x, self.ln1(x)
+        else:
+            x1, h1 = fused_residual_ln(x, pending, self.ln1.weight,
+                                       self.ln1.bias,
+                                       epsilon=self.ln1._epsilon,
+                                       return_residual=True)
+        a = self.dropout(self.attn(h1))
+        x2, h2 = fused_residual_ln(x1, a, self.ln2.weight, self.ln2.bias,
+                                   epsilon=self.ln2._epsilon,
+                                   return_residual=True)
+        return x2, self.mlp(h2)
 
 
 class GPTModel(nn.Layer):
@@ -185,14 +205,19 @@ class GPTModel(nn.Layer):
             position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
+        pending = None
         if self.config.recompute and self.training:
             from ...distributed.fleet.utils import recompute as _ckpt
             for block in self.h:
-                x = _ckpt(block, x)
+                x, pending = _ckpt(block, x, pending)
         else:
             for block in self.h:
-                x = block(x)
-        return self.ln_f(x)
+                x, pending = block(x, pending)
+        if pending is None:
+            return self.ln_f(x)
+        from ...ops.fused_residual_ln import fused_residual_ln
+        return fused_residual_ln(x, pending, self.ln_f.weight,
+                                 self.ln_f.bias, epsilon=self.ln_f._epsilon)
 
 
 class GPTForCausalLM(nn.Layer):

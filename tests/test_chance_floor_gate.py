@@ -1,0 +1,99 @@
+"""Chance-floor bench gate (VERDICT r4 item 1b).
+
+The r4 descent gate (last5 < 0.9 x first5) was satisfiable by any init
+transient: the recorded r4 BERT curve spiked to 3.36 at step 2, then sat at
+the binary task's chance level (ln 2 = 0.693) from step ~32 through 512 —
+and passed. The replacement gates on a chance FLOOR: the last-32 mean must
+sit below ln(n_classes) - margin, which a never-learning curve cannot do.
+
+Reference standard: test_dist_base.py:778's loss-parity discipline — a
+recorded training curve is evidence only if it shows the task being learned.
+"""
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+# The flagship failure this gate exists to catch: the EXACT last-32 losses of
+# the r4 recorded BERT run (BENCH_r04.json / LOSS_CURVES.json r4, 512 steps,
+# lr=5e-5) — chance-level throughout, mean 0.6972.
+R4_BERT_LAST32 = [
+    0.73257, 0.71001, 0.69654, 0.69524, 0.68827, 0.70872, 0.68938, 0.69652,
+    0.69254, 0.69862, 0.6923, 0.7063, 0.69351, 0.69149, 0.68635, 0.66534,
+    0.65727, 0.70778, 0.7733, 0.63253, 0.72552, 0.72631, 0.6922, 0.71911,
+    0.68831, 0.70785, 0.73386, 0.6693, 0.69837, 0.69266, 0.70895, 0.69717,
+]
+# ... and the r4 init transient that let the descent gate pass it: first
+# steps spike to 3.36 then recover to chance.
+R4_BERT_HEAD = [0.6907, 3.3599, 2.7287, 0.7479, 0.7363]
+
+
+def test_r4_flat_bert_curve_FAILS_the_gate():
+    """The r4 curve (lr-shock head + chance-level tail) must fail: this is
+    the VERDICT r4 item-1 acceptance test."""
+    curve = R4_BERT_HEAD + [0.70] * 475 + R4_BERT_LAST32
+    failures = bench.chance_floor_failures({"bert": curve})
+    assert "bert" in failures
+    assert failures["bert"]["last32_mean"] == pytest.approx(0.6992, abs=1e-3)
+    assert failures["bert"]["floor"] == 0.62
+
+
+def test_r4_curve_would_have_passed_the_old_descent_gate():
+    """Documents WHY the gate was replaced: first5 mean 1.65 (transient
+    spike), last5 mean 0.70 -> last5 < 0.9*first5 holds despite zero
+    learning."""
+    curve = R4_BERT_HEAD + [0.70] * 475 + R4_BERT_LAST32
+    first5, last5 = np.mean(curve[:5]), np.mean(curve[-5:])
+    assert last5 < 0.9 * first5  # the old criterion — satisfied by a
+    # curve the new gate (above) correctly fails
+
+
+def test_learning_curve_passes():
+    curve = list(np.linspace(0.75, 0.30, 256))
+    assert bench.chance_floor_failures({"bert": curve}) == {}
+
+
+def test_sustained_matters_not_transient_minimum():
+    """A single sub-floor excursion inside a chance-level tail (the r4 curve
+    had min 0.49 at step 31) must NOT pass: the gate judges the last-32
+    MEAN."""
+    curve = [0.70] * 228 + [0.45] + [0.70] * 27
+    failures = bench.chance_floor_failures({"bert": curve})
+    assert "bert" in failures
+
+
+def test_too_short_curve_is_a_failure_not_a_pass():
+    """A curve below the lane's design budget (bert: 256 recorded steps)
+    cannot support the sustained claim — it FAILS even if the values are
+    low (shrinking BENCH_STEPS is not a way around the gate)."""
+    failures = bench.chance_floor_failures({"bert": [0.1] * 128})
+    assert "bert" in failures and "too short" in failures["bert"]["error"]
+
+
+def test_short_evidence_lanes_are_exempt_and_reported():
+    curve = [6.0] * 96  # an abbreviated lane mid-descent
+    assert bench.chance_floor_failures(
+        {"gpt1p3b_slice": curve}, short_lanes={"gpt1p3b_slice"}) == {}
+    # but the SAME curve run as a full lane is judged
+    assert "gpt1p3b_slice" in bench.chance_floor_failures(
+        {"gpt1p3b_slice": curve})
+
+
+def test_ungated_lane_ignored():
+    assert bench.chance_floor_failures({"not_a_lane": [9.9] * 64}) == {}
+
+
+def test_all_floors_sit_below_chance():
+    """Every floor must be strictly below its task's chance level (a floor
+    above chance would pass no-learning runs)."""
+    chance = {"bert": np.log(2), "ernie": np.log(2),
+              "lenet": np.log(10), "resnet50": np.log(1000),
+              "gpt": np.log(512), "gpt1p3b_slice": np.log(512)}
+    for lane, (floor, _min_steps, _why) in bench._CHANCE_FLOORS.items():
+        assert floor < chance[lane] - 0.05, lane

@@ -90,30 +90,71 @@ def test_bf16_more_accurate_than_unfused():
         assert e_fus <= e_ref + 0.01, (key, e_fus, e_ref)
 
 
-def test_gamma_zero_channel_gets_finite_zero_grads():
-    """|gamma| <= _GAMMA_TOL channels: x_hat is unrecoverable from y, so the
-    backward must yield EXACT zeros for dz/dgamma there (true dz is zero
-    when gamma == 0), never the ~1e12-scale garbage a naive clamp produces."""
+def test_gamma_zero_eager_falls_back_to_exact_grads():
+    """ADVICE r4 finding 3: an EXACTLY zero-initialized gamma channel
+    (zero_init_residual recipes) must not be silently frozen. In eager mode
+    the degenerate-gamma guard routes through plain autodiff, so the dead
+    channel's dgamma matches the unfused relu-less conv->BN composition."""
     rng = np.random.RandomState(0)
-    x = paddle.to_tensor(rng.randn(2, 4, 8, 8).astype("float32"))
-    x.stop_gradient = False
-    w = paddle.to_tensor((rng.randn(8, 4, 3, 3) * 0.3).astype("float32"))
-    w.stop_gradient = False
+    x_np = rng.randn(2, 4, 8, 8).astype("float32")
+    w_np = (rng.randn(8, 4, 3, 3) * 0.3).astype("float32")
     g_np = (rng.rand(8) + 0.5).astype("float32")
     g_np[3] = 0.0
-    g = paddle.to_tensor(g_np)
-    g.stop_gradient = False
-    b = paddle.to_tensor(rng.randn(8).astype("float32"))
-    b.stop_gradient = False
-    y = fused_conv_bn(x, w, g, b, training=True, stride=1, padding=1)
-    (y.astype("float32").tanh().sum()).backward()
-    dg = g.grad.numpy()
-    assert np.all(np.isfinite(x.grad.numpy()))
-    assert np.all(np.isfinite(w.grad.numpy()))
-    assert dg[3] == 0.0, dg
-    assert np.max(np.abs(x.grad.numpy())) < 1e3  # no clamp-amplified garbage
-    # dbeta for the dead channel is still the plain sum of cotangents
-    assert np.isfinite(b.grad.numpy()[3])
+    b_np = rng.randn(8).astype("float32")
+
+    def run(fused):
+        x = paddle.to_tensor(x_np)
+        x.stop_gradient = False
+        w = paddle.to_tensor(w_np)
+        w.stop_gradient = False
+        g = paddle.to_tensor(g_np)
+        g.stop_gradient = False
+        b = paddle.to_tensor(b_np)
+        b.stop_gradient = False
+        if fused:
+            y = fused_conv_bn(x, w, g, b, training=True, stride=1, padding=1)
+        else:
+            z = F.conv2d(x, w, stride=1, padding=1)
+            y = F.batch_norm(z, paddle.zeros([8]), paddle.ones([8]), g, b,
+                             training=True)
+        (y.astype("float32").tanh().sum()).backward()
+        return [t.grad.numpy() for t in (x, w, g, b)]
+
+    got, ref = run(True), run(False)
+    for a, b_, name in zip(got, ref, "xwgb"):
+        np.testing.assert_allclose(a, b_, rtol=1e-4, atol=1e-5, err_msg=name)
+    assert got[2][3] != 0.0  # the zero-init channel LEARNS
+
+
+def test_gamma_zero_band_custom_backward_yields_finite_zero_grads():
+    """The custom backward itself (reachable under jit tracing, where the
+    eager guard cannot inspect gamma): |gamma| <= _GAMMA_TOL channels must
+    yield EXACT zeros for dz/dgamma there (true dz is zero when gamma == 0),
+    never the ~1e12-scale garbage a naive clamp produces."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.fused_conv_bn import _fused_conv_bn_diff
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 4, 8, 8).astype("float32"))
+    w = jnp.asarray((rng.randn(8, 4, 3, 3) * 0.3).astype("float32"))
+    g_np = (rng.rand(8) + 0.5).astype("float32")
+    g_np[3] = 0.0
+    g = jnp.asarray(g_np)
+    b = jnp.asarray(rng.randn(8).astype("float32"))
+
+    def loss(xv, wv, gv, bv):
+        y, _, _ = _fused_conv_bn_diff(
+            xv, wv, gv, bv, (1, 1), ((1, 1), (1, 1)), (1, 1), 1,
+            ("NCHW", "OIHW", "NCHW"), 1e-5, False)
+        return jnp.sum(jnp.tanh(y.astype(jnp.float32)))
+
+    dx, dw, dg, db = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))(x, w, g, b)
+    assert np.all(np.isfinite(np.asarray(dx)))
+    assert np.all(np.isfinite(np.asarray(dw)))
+    assert float(dg[3]) == 0.0
+    assert np.max(np.abs(np.asarray(dx))) < 1e3  # no clamp-amplified garbage
+    assert np.isfinite(float(db[3]))
 
 
 def test_eval_mode_folds_running_stats():

@@ -95,19 +95,51 @@ def test_incubate_fused_feedforward_functional():
     b2 = paddle.to_tensor((rng.randn(d) * 0.1).astype("float32"))
     g = paddle.to_tensor((rng.rand(d) + 0.5).astype("float32"))
     be = paddle.to_tensor((rng.randn(d) * 0.1).astype("float32"))
-    for normalize_before in (True, False):
+    for pre_layer_norm in (True, False):
         x = paddle.to_tensor(x_np)
+        # reference positional order: ln scales/biases sit between the
+        # biases and the dropout rates (ADVICE r4: API parity)
         out = inn.fused_feedforward(
-            x, w1, w2, b1, b2, activation="gelu", ln1_scale=g, ln1_bias=be,
-            normalize_before=normalize_before, training=False)
-        xin = F.layer_norm(x, d, g, be) if normalize_before else x
-        core = F.linear(F.gelu(F.linear(xin, w1, b1), approximate=True),
-                        w2, b2)
+            x, w1, w2, b1, b2, g, be, g, be, 0.0, 0.0, "gelu",
+            pre_layer_norm=pre_layer_norm, training=False)
+        xin = F.layer_norm(x, d, g, be) if pre_layer_norm else x
+        # 'gelu' is erf-gelu on both paths (reference GeluFunctor is
+        # erf-based; ADVICE r4 finding 1)
+        core = F.linear(F.gelu(F.linear(xin, w1, b1)), w2, b2)
         ref = x + core
-        if not normalize_before:
+        if not pre_layer_norm:
             ref = F.layer_norm(ref, d, g, be)
         np.testing.assert_allclose(out.numpy(), ref.numpy(),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_incubate_fused_feedforward_fallback_gelu_tanh():
+    """ADVICE r4 finding 4: the unfused fallback (dropout1 active) must
+    support activation='gelu_tanh' instead of raising AttributeError, and
+    'gelu' on the fallback must stay erf-based."""
+    import paddle_tpu.incubate.nn as inn
+    rng = np.random.RandomState(4)
+    d, dff = 8, 16
+    x = paddle.to_tensor(rng.randn(2, 3, d).astype("float32"))
+    w1 = paddle.to_tensor((rng.randn(d, dff) * 0.2).astype("float32"))
+    w2 = paddle.to_tensor((rng.randn(dff, d) * 0.2).astype("float32"))
+    b1 = paddle.to_tensor((rng.randn(dff) * 0.1).astype("float32"))
+    b2 = paddle.to_tensor((rng.randn(d) * 0.1).astype("float32"))
+    g = paddle.to_tensor(np.ones(d, "float32"))
+    be = paddle.to_tensor(np.zeros(d, "float32"))
+    paddle.seed(11)
+    for act, act_fn in (("gelu_tanh",
+                         lambda h: F.gelu(h, approximate=True)),
+                        ("gelu", F.gelu)):
+        # dropout1_rate > 0 in training forces the unfused fallback branch;
+        # rate ~0 keeps values comparable (keep-prob 1 - 1e-9)
+        out = inn.fused_feedforward(
+            x, w1, w2, b1, b2, g, be, g, be, 1e-9, 0.0, act,
+            pre_layer_norm=True, training=True)
+        ref = x + F.linear(act_fn(F.linear(F.layer_norm(x, d, g, be),
+                                           w1, b1)), w2, b2)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=2e-4, atol=2e-4)
 
 
 def test_fused_bias_dropout_residual_layer_norm():

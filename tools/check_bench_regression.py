@@ -12,17 +12,22 @@ and fail CI on regression; this is the same contract round-over-round.
 
 Known, justified regressions (e.g. a measurement-honesty fix that trades
 headline throughput for training that actually learns) are waived explicitly
-in BENCH_WAIVERS.json next to this script's invocation:
-    {"waivers": [{"metric": "...", "reason": "..."}]}
-A waiver is consumed by the NEXT comparison only — delete entries once the
-new baseline is recorded.
+in BENCH_WAIVERS.json:
+    {"waivers": [{"metric": "...", "applies_to": "r05", "reason": "..."}]}
+A waiver is SCOPED to one target round via the required "applies_to" field,
+checked against the NEW artifact's round number (the driver wrapper's "n");
+a waiver whose round does not match is reported as stale and ignored, so a
+forgotten entry can never silently waive a later round's genuine regression
+(VERDICT r4 weak #3). Delete entries once their round's baseline is recorded.
 
 Usage:
     python tools/check_bench_regression.py OLD.json NEW.json \
-        [--tol 0.03] [--waivers BENCH_WAIVERS.json]
-
-Also usable without arguments from the repo root: picks the two
-highest-numbered BENCH_r*.json present.
+        [--tol 0.03] [--waivers BENCH_WAIVERS.json] [--round 5]
+Waivers apply ONLY when passed explicitly via --waivers, or in no-argument
+auto mode (repo root: picks the two highest-numbered BENCH_r*.json and reads
+BENCH_WAIVERS.json from beside them). Explicit OLD/NEW comparisons never
+read an implicit cwd waiver file (that leak let a committed waiver satisfy
+unrelated comparisons run from the repo root — VERDICT r4 weak #3).
 """
 from __future__ import annotations
 
@@ -39,12 +44,25 @@ _THROUGHPUT_KEYS = (
     "value", "mfu",
     "resnet50_images_per_sec_per_chip", "resnet50_mfu",
     "gpt_tokens_per_sec_per_chip", "gpt_mfu",
+    "ernie_tokens_per_sec_per_chip", "ernie_mfu",
+    "gpt1p3b_slice_tokens_per_sec_per_chip", "gpt1p3b_slice_mfu",
 )
 
 
 def _load(path):
     with open(path) as f:
         doc = json.load(f)
+    return doc
+
+
+def _round_of(doc):
+    """Round number of a driver-wrapped artifact ({"n": 5, "parsed": ...}),
+    else None for a raw bench.py line."""
+    n = doc.get("n")
+    return int(n) if isinstance(n, (int, float)) else None
+
+
+def _parsed(doc):
     return doc.get("parsed", doc)
 
 
@@ -61,8 +79,40 @@ def _flat_metrics(doc):
     return out
 
 
+def _waiver_round(w):
+    """Normalize a waiver's applies_to ("r05" / "r5" / 5) to an int, or
+    None when absent/unparseable (such a waiver never applies)."""
+    v = w.get("applies_to")
+    if isinstance(v, (int, float)):
+        return int(v)
+    if isinstance(v, str):
+        m = re.fullmatch(r"r?0*(\d+)", v.strip())
+        if m:
+            return int(m.group(1))
+    return None
+
+
+def split_waivers(waivers, new_round):
+    """(applicable, stale): a waiver applies only when its applies_to round
+    matches the NEW artifact's round; unscoped waivers and round mismatches
+    are stale by construction (auto-expiry, VERDICT r4 item 2)."""
+    applicable, stale = [], []
+    for w in waivers:
+        wr = _waiver_round(w)
+        if wr is not None and new_round is not None and wr == new_round:
+            applicable.append(w)
+        else:
+            stale.append({**w, "stale_because": (
+                "missing/unparseable applies_to" if wr is None
+                else "new artifact has no round number" if new_round is None
+                else f"applies_to r{wr:02d} != new artifact r{new_round:02d}")})
+    return applicable, stale
+
+
 def compare(old_doc, new_doc, tol=0.03, waivers=()):
-    """Returns (regressions, waived, improvements) lists of dicts."""
+    """Returns (regressions, waived, improvements) lists of dicts.
+    `waivers` must already be scoped to the new artifact's round
+    (split_waivers); compare() itself applies them unconditionally."""
     old_m = _flat_metrics(old_doc)
     new_m = _flat_metrics(new_doc)
     waived_metrics = {w["metric"]: w.get("reason", "") for w in waivers}
@@ -109,7 +159,12 @@ def main(argv=None):
     ap.add_argument("old", nargs="?")
     ap.add_argument("new", nargs="?")
     ap.add_argument("--tol", type=float, default=0.03)
-    ap.add_argument("--waivers", default="BENCH_WAIVERS.json")
+    ap.add_argument("--waivers", default=None,
+                    help="waiver file; in explicit OLD/NEW mode waivers are "
+                         "ONLY read when this flag is passed")
+    ap.add_argument("--round", type=int, default=None,
+                    help="round number of NEW (overrides its wrapper 'n'; "
+                         "needed to apply waivers to a raw bench line)")
     ns = ap.parse_args(argv)
     if not ns.old or not ns.new:
         pair = _latest_pair()
@@ -118,16 +173,24 @@ def main(argv=None):
                               "why": "fewer than two BENCH_r*.json found"}))
             return 0
         ns.old, ns.new = pair
+        if ns.waivers is None:  # auto mode: waivers live beside the artifacts
+            ns.waivers = os.path.join(
+                os.path.dirname(os.path.abspath(ns.new)) or ".",
+                "BENCH_WAIVERS.json")
     waivers = []
-    if os.path.exists(ns.waivers):
+    if ns.waivers and os.path.exists(ns.waivers):
         with open(ns.waivers) as f:
             waivers = json.load(f).get("waivers", [])
+    old_raw, new_raw = _load(ns.old), _load(ns.new)
+    new_round = ns.round if ns.round is not None else _round_of(new_raw)
+    applicable, stale = split_waivers(waivers, new_round)
     regressions, waived, improvements = compare(
-        _load(ns.old), _load(ns.new), ns.tol, waivers)
+        _parsed(old_raw), _parsed(new_raw), ns.tol, applicable)
     report = {"status": "fail" if regressions else "ok",
               "old": ns.old, "new": ns.new, "tol": ns.tol,
+              "new_round": new_round,
               "regressions": regressions, "waived": waived,
-              "improvements": improvements}
+              "stale_waivers": stale, "improvements": improvements}
     print(json.dumps(report, indent=2))
     return 1 if regressions else 0
 
