@@ -431,6 +431,7 @@ def inplace_assign(x, out):
     # refuse to backprop through the mutated value (tensor_version check)
     x._version += 1
     x._value = out._val
+    x._degen_cache = None  # in-place op may enter the degenerate band
     x._grad_node = node
     x._out_index = getattr(out, "_out_index", None)
     x.stop_gradient = out.stop_gradient

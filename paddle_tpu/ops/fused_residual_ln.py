@@ -50,6 +50,20 @@ def _stats(zf, eps):
     return (zf - mean) * rstd, rstd
 
 
+def _fwd_impl(x, y, w, b, eps, return_residual, stream_dtype):
+    """The ONE forward (shared by the custom-vjp primal, its fwd rule, and
+    the degenerate-weight fallback — the fused_conv_bn _fused_fwd_impl
+    pattern, so the fallback's 'identical forward math' guarantee cannot
+    drift). Returns (outputs, rstd)."""
+    z = x + y
+    xhat, rstd = _stats(z.astype(jnp.float32), eps)
+    out = (xhat * w.astype(jnp.float32)
+           + b.astype(jnp.float32)).astype(z.dtype)
+    if return_residual:
+        return (z.astype(stream_dtype or z.dtype), out), rstd
+    return out, rstd
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _fused_residual_ln_diff(x, y, w, b, eps, return_residual, stream_dtype):
     """stream_dtype: dtype of the returned residual stream z. Under AMP the
@@ -58,24 +72,14 @@ def _fused_residual_ln_diff(x, y, w, b, eps, return_residual, stream_dtype):
     pre-promotion dtype, else every per-layer (b, s, h) stream tensor
     doubles its bytes on an HBM-bound lane (the unfused composition's
     residual add ran un-promoted)."""
-    z = x + y
-    xhat, _ = _stats(z.astype(jnp.float32), eps)
-    out = (xhat * w.astype(jnp.float32)
-           + b.astype(jnp.float32)).astype(z.dtype)
-    if return_residual:
-        return z.astype(stream_dtype or z.dtype), out
-    return out
+    outs, _ = _fwd_impl(x, y, w, b, eps, return_residual, stream_dtype)
+    return outs
 
 
 def _fwd(x, y, w, b, eps, return_residual, stream_dtype):
-    z = x + y
-    xhat, rstd = _stats(z.astype(jnp.float32), eps)
-    out = (xhat * w.astype(jnp.float32)
-           + b.astype(jnp.float32)).astype(z.dtype)
-    res = (w, b, out, rstd)
-    if return_residual:
-        return (z.astype(stream_dtype or z.dtype), out), res
-    return out, res
+    outs, rstd = _fwd_impl(x, y, w, b, eps, return_residual, stream_dtype)
+    out = outs[1] if return_residual else outs
+    return outs, (w, b, out, rstd)
 
 
 def _bwd(eps, return_residual, stream_dtype, res, cts):
@@ -128,16 +132,12 @@ def fused_residual_ln(x, y, weight, bias, epsilon=1e-5,
 
     if _weight_degenerate(weight):
         # zero/near-zero LN weight channels: plain autodiff through the
-        # same forward math (saves z, keeps dw exact where the custom
+        # IDENTICAL forward (saves z, keeps dw exact where the custom
         # backward's x_hat reconstruction would freeze it)
         def prim(xv, yv, wv, bv):
-            z = xv + yv
-            xhat, _ = _stats(z.astype(jnp.float32), epsilon)
-            out = (xhat * wv.astype(jnp.float32)
-                   + bv.astype(jnp.float32)).astype(z.dtype)
-            if return_residual:
-                return z.astype(stream_dtype or z.dtype), out
-            return out
+            outs, _ = _fwd_impl(xv, yv, wv, bv, epsilon, return_residual,
+                                stream_dtype)
+            return outs
     else:
         def prim(xv, yv, wv, bv):
             return _fused_residual_ln_diff(xv, yv, wv, bv, epsilon,

@@ -79,6 +79,9 @@ def _patch():
         idx2 = _convert_index(idx)
         val = unwrap(value) if isinstance(value, Tensor) else value
         self._value = self._val.at[idx2].set(val)
+        # explicit element writes can move a parameter into/out of the
+        # fused-op degenerate band (ops/_param_guard.py sticky cache)
+        self._degen_cache = None
 
     T.__getitem__ = _getitem
     T.__setitem__ = _setitem

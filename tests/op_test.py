@@ -13,8 +13,12 @@ import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu.core.dispatch import unwrap
 
+from op_accuracy_policy import (DEFAULT_FWD_ATOL, DEFAULT_FWD_RTOL,
+                                DEFAULT_GRAD_ATOL, DEFAULT_GRAD_RTOL)
 
-def check_output(fn, np_fn, inputs, atol=1e-5, rtol=1e-5, jit=True):
+
+def check_output(fn, np_fn, inputs, atol=DEFAULT_FWD_ATOL,
+                 rtol=DEFAULT_FWD_RTOL, jit=True):
     """fn: callable over Tensors; np_fn: numpy oracle over ndarrays."""
     tensors = [paddle.to_tensor(i) for i in inputs]
     expected = np_fn(*[np.asarray(i) for i in inputs])
@@ -31,7 +35,8 @@ def check_output(fn, np_fn, inputs, atol=1e-5, rtol=1e-5, jit=True):
                                    err_msg="jit mismatch")
 
 
-def check_grad(fn, inputs, atol=5e-3, rtol=5e-3, eps=1e-3, loss_reduce=True):
+def check_grad(fn, inputs, atol=DEFAULT_GRAD_ATOL, rtol=DEFAULT_GRAD_RTOL,
+               eps=1e-3, loss_reduce=True):
     """Finite-difference gradient check (op_test.py check_grad parity)."""
     tensors = [paddle.to_tensor(np.asarray(i, dtype=np.float64).astype("float32"),
                                 stop_gradient=False) for i in inputs]

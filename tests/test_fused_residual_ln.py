@@ -177,6 +177,21 @@ def test_zero_weight_via_inplace_mutator_invalidates_guard_cache():
     assert np.any(w.grad.numpy() != 0.0)
 
 
+def test_zero_weight_via_setitem_invalidates_guard_cache():
+    """Element writes (`w[3] = 0.0` — the natural zero-init-residual move)
+    must also invalidate the sticky guard cache (code-review r5)."""
+    rng = np.random.RandomState(9)
+    x, y = _mk(rng, (2, 3, 8)), _mk(rng, (2, 3, 8))
+    w = paddle.to_tensor((rng.rand(8) + 0.5).astype("float32"))
+    b = paddle.to_tensor(np.zeros(8, "float32"))
+    w.stop_gradient = False
+    fused_residual_ln(x, y, w, b)  # caches "not degenerate"
+    w[3] = 0.0
+    out = fused_residual_ln(x, y, w, b)
+    out.tanh().sum().backward()
+    assert w.grad.numpy()[3] != 0.0  # the zeroed channel still learns
+
+
 def test_amp_keeps_stream_dtype_promotes_norm_only():
     """Under amp.auto_cast the op is f32-promoted like layer_norm, but the
     carried residual stream z must stay in the pre-promotion dtype — only
@@ -190,6 +205,9 @@ def test_amp_keeps_stream_dtype_promotes_norm_only():
     with paddle.amp.auto_cast(dtype="bfloat16"):
         z, out = fused_residual_ln(x, y, w, b, return_residual=True)
     assert str(z.dtype).endswith("bfloat16"), z.dtype
+
+
+def test_gpt_block_carried_residual_matches_composition():
     """GPTBlock's (stream, pending) form must equal the plain
     x + attn(ln1(x)); x + mlp(ln2(x)) composition."""
     from paddle_tpu.text.models.gpt import GPTBlock, GPTConfig
