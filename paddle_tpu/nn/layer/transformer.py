@@ -139,9 +139,9 @@ class TransformerEncoderLayer(Layer):
         from the LN output, so the summed pre-norm tensor never crosses the
         fwd->bwd boundary; reference analog
         operators/fused/fused_bias_dropout_residual_layer_norm_op.cu)."""
-        if norm.weight is None or norm.bias is None:
+        from ...ops.fused_residual_ln import fused_residual_ln, fuse_enabled
+        if norm.weight is None or norm.bias is None or not fuse_enabled():
             return norm(residual + sub)
-        from ...ops.fused_residual_ln import fused_residual_ln
         return fused_residual_ln(residual, sub, norm.weight, norm.bias,
                                  epsilon=norm._epsilon)
 

@@ -38,9 +38,21 @@ import jax.numpy as jnp
 
 from ..core.dispatch import apply
 
-__all__ = ["fused_residual_ln"]
+__all__ = ["fused_residual_ln", "fuse_enabled"]
 
 _W_TOL = 1e-6
+
+
+def fuse_enabled():
+    """Escape hatch for the op's hot-path wirings (GPTBlock,
+    TransformerEncoderLayer post-LN): PADDLE_TPU_FUSED_RESIDUAL_LN=0 routes
+    them through the plain residual+norm composition — the regime for
+    zero-init LN-scale recipes compiled under jit, where the eager
+    degenerate-weight guard cannot inspect the traced weight (same
+    contract as fused_conv_bn's PADDLE_TPU_FUSED_CONV_BN=0). Read at
+    trace time, baked into the compiled program."""
+    import os
+    return os.environ.get("PADDLE_TPU_FUSED_RESIDUAL_LN", "1") == "1"
 
 
 def _stats(zf, eps):

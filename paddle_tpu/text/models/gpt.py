@@ -162,8 +162,16 @@ class GPTBlock(nn.Layer):
         operators/fused/fused_attention_op.cu /
         fused_bias_dropout_residual_layer_norm_op.cu). Returns
         (stream, pending_mlp_out) — GPTModel folds the last pending into
-        ln_f the same way."""
-        from ...ops.fused_residual_ln import fused_residual_ln
+        ln_f the same way. PADDLE_TPU_FUSED_RESIDUAL_LN=0 restores the
+        plain composition (zero-init LN-scale recipes under jit — see
+        ops/fused_residual_ln.fuse_enabled)."""
+        from ...ops.fused_residual_ln import fused_residual_ln, fuse_enabled
+        if not fuse_enabled():
+            if pending is not None:
+                x = x + pending
+            x = x + self.dropout(self.attn(self.ln1(x)))
+            x = x + self.mlp(self.ln2(x))
+            return x, None
         if pending is None:
             x1, h1 = x, self.ln1(x)
         else:

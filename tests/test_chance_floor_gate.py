@@ -55,7 +55,7 @@ def test_r4_curve_would_have_passed_the_old_descent_gate():
 
 
 def test_learning_curve_passes():
-    curve = list(np.linspace(0.75, 0.30, 256))
+    curve = list(np.linspace(0.75, 0.30, 512))
     assert bench.chance_floor_failures({"bert": curve}) == {}
 
 
@@ -63,16 +63,16 @@ def test_sustained_matters_not_transient_minimum():
     """A single sub-floor excursion inside a chance-level tail (the r4 curve
     had min 0.49 at step 31) must NOT pass: the gate judges the last-32
     MEAN."""
-    curve = [0.70] * 228 + [0.45] + [0.70] * 27
+    curve = [0.70] * 484 + [0.45] + [0.70] * 27
     failures = bench.chance_floor_failures({"bert": curve})
     assert "bert" in failures
 
 
 def test_too_short_curve_is_a_failure_not_a_pass():
-    """A curve below the lane's design budget (bert: 256 recorded steps)
-    cannot support the sustained claim — it FAILS even if the values are
-    low (shrinking BENCH_STEPS is not a way around the gate)."""
-    failures = bench.chance_floor_failures({"bert": [0.1] * 128})
+    """A curve below the lane's DEFAULT recorded budget (bert: 512) cannot
+    support the sustained claim — it FAILS even if the values are low
+    (shrinking BENCH_STEPS is not a way around the gate)."""
+    failures = bench.chance_floor_failures({"bert": [0.1] * 256})
     assert "bert" in failures and "too short" in failures["bert"]["error"]
 
 

@@ -258,6 +258,29 @@ def test_gpt_model_trains_and_recompute_matches():
     np.testing.assert_allclose(g0, g1, rtol=1e-4, atol=1e-6)
 
 
+def test_kill_switch_restores_plain_composition(monkeypatch):
+    """PADDLE_TPU_FUSED_RESIDUAL_LN=0 must route GPTBlock and the post-LN
+    encoder through the plain residual+norm composition (the documented
+    regime for zero-init LN-scale recipes under jit)."""
+    from paddle_tpu.text.models.gpt import GPTBlock, GPTConfig
+
+    monkeypatch.setenv("PADDLE_TPU_FUSED_RESIDUAL_LN", "0")
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=16, dropout=0.0,
+                    use_flash_attention=False)
+    block = GPTBlock(cfg)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 4, 32).astype("float32"))
+    p = paddle.to_tensor(rng.randn(2, 4, 32).astype("float32"))
+    stream, pending = block(x, p)
+    assert pending is None  # plain composition returns the folded stream
+    z = x + p
+    h = z + block.dropout(block.attn(block.ln1(z)))
+    ref = (h + block.mlp(block.ln2(h))).numpy()
+    np.testing.assert_allclose(stream.numpy(), ref, rtol=2e-5, atol=2e-5)
+
+
 def test_encoder_layer_post_ln_matches_manual():
     """TransformerEncoderLayer post-LN (BERT) path through the fused op
     equals the manual residual + norm composition."""
