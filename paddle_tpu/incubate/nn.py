@@ -190,10 +190,12 @@ class FusedMultiHeadAttention(nn.Layer):
             dropout_p=self.attn_dropout_rate, training=self.training)
         out = out.reshape([b, s, self.embed_dim])
         out = self.dropout(self.out_proj(out))
-        out = residual + out
-        if not self.normalize_before:
-            out = self.norm(out)
-        return out
+        if self.normalize_before:
+            return residual + out
+        # post-LN residual write through the fused residual+LN op (same
+        # wiring as nn.TransformerEncoderLayer)
+        from ..ops.fused_residual_ln import post_residual_ln
+        return post_residual_ln(residual, out, self.norm)
 
 
 class FusedFeedForward(nn.Layer):

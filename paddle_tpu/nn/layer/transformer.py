@@ -23,6 +23,17 @@ __all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
            "TransformerDecoder", "Transformer"]
 
 
+def _post_residual_ln(residual, sub, norm):
+    """Post-LN residual write through the fused residual+LN op (backward
+    recovers x_hat from the LN output, so the summed pre-norm tensor never
+    crosses the fwd->bwd boundary; reference analog
+    operators/fused/fused_bias_dropout_residual_layer_norm_op.cu). Shared
+    by the encoder AND decoder layers; PADDLE_TPU_FUSED_RESIDUAL_LN=0
+    falls back to the plain composition (ops/fused_residual_ln.py)."""
+    from ...ops.fused_residual_ln import post_residual_ln
+    return post_residual_ln(residual, sub, norm)
+
+
 def _convert_attn_mask(attn_mask, dtype):
     if attn_mask is None:
         return None
@@ -133,18 +144,6 @@ class TransformerEncoderLayer(Layer):
                              activation=self._activation_name)
         return self.linear2(self.dropout(self.activation(self.linear1(src))))
 
-    def _post_residual_ln(self, residual, sub, norm):
-        """Post-LN residual write: norm(residual + sub) through the fused
-        residual+LN op (ops/fused_residual_ln.py — backward recovers x_hat
-        from the LN output, so the summed pre-norm tensor never crosses the
-        fwd->bwd boundary; reference analog
-        operators/fused/fused_bias_dropout_residual_layer_norm_op.cu)."""
-        from ...ops.fused_residual_ln import fused_residual_ln, fuse_enabled
-        if norm.weight is None or norm.bias is None or not fuse_enabled():
-            return norm(residual + sub)
-        return fused_residual_ln(residual, sub, norm.weight, norm.bias,
-                                 epsilon=norm._epsilon)
-
     def forward(self, src, src_mask=None, cache=None):
         residual = src
         if self.normalize_before:
@@ -156,8 +155,8 @@ class TransformerEncoderLayer(Layer):
         if self.normalize_before:
             src = residual + self.dropout1(src)
         else:
-            src = self._post_residual_ln(residual, self.dropout1(src),
-                                         self.norm1)
+            src = _post_residual_ln(residual, self.dropout1(src),
+                                    self.norm1)
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
@@ -165,8 +164,8 @@ class TransformerEncoderLayer(Layer):
         if self.normalize_before:
             src = residual + self.dropout2(src)
         else:
-            src = self._post_residual_ln(residual, self.dropout2(src),
-                                         self.norm2)
+            src = _post_residual_ln(residual, self.dropout2(src),
+                                    self.norm2)
         return src if cache is None else (src, cache)
 
     def gen_cache(self, src):
@@ -254,9 +253,10 @@ class TransformerDecoderLayer(Layer):
         else:
             tgt, incremental_cache = self.self_attn(tgt, tgt, tgt, tgt_mask,
                                                     cache[0])
-        tgt = residual + self.dropout1(tgt)
-        if not self.normalize_before:
-            tgt = self.norm1(tgt)
+        if self.normalize_before:
+            tgt = residual + self.dropout1(tgt)
+        else:
+            tgt = _post_residual_ln(residual, self.dropout1(tgt), self.norm1)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
@@ -266,16 +266,18 @@ class TransformerDecoderLayer(Layer):
         else:
             tgt = self.cross_attn(tgt, memory, memory, memory_mask, cache[1])
             static_cache = cache[1]
-        tgt = residual + self.dropout2(tgt)
-        if not self.normalize_before:
-            tgt = self.norm2(tgt)
+        if self.normalize_before:
+            tgt = residual + self.dropout2(tgt)
+        else:
+            tgt = _post_residual_ln(residual, self.dropout2(tgt), self.norm2)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm3(tgt)
         tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
-        tgt = residual + self.dropout3(tgt)
-        if not self.normalize_before:
-            tgt = self.norm3(tgt)
+        if self.normalize_before:
+            tgt = residual + self.dropout3(tgt)
+        else:
+            tgt = _post_residual_ln(residual, self.dropout3(tgt), self.norm3)
         if cache is None:
             return tgt
         return tgt, (incremental_cache, static_cache)

@@ -38,7 +38,18 @@ import jax.numpy as jnp
 
 from ..core.dispatch import apply
 
-__all__ = ["fused_residual_ln", "fuse_enabled"]
+__all__ = ["fused_residual_ln", "fuse_enabled", "post_residual_ln"]
+
+
+def post_residual_ln(residual, sub, norm):
+    """Post-LN residual write: norm(residual + sub) through the fused op —
+    the public seam the transformer layers (nn + incubate) share. Falls
+    back to the plain composition when the norm has no affine params or
+    the fusion is disabled (fuse_enabled)."""
+    if norm.weight is None or norm.bias is None or not fuse_enabled():
+        return norm(residual + sub)
+    return fused_residual_ln(residual, sub, norm.weight, norm.bias,
+                             epsilon=norm._epsilon)
 
 _W_TOL = 1e-6
 

@@ -281,6 +281,28 @@ def test_kill_switch_restores_plain_composition(monkeypatch):
     np.testing.assert_allclose(stream.numpy(), ref, rtol=2e-5, atol=2e-5)
 
 
+def test_decoder_layer_post_ln_matches_manual():
+    """TransformerDecoderLayer's three post-LN residual writes through the
+    fused op equal the manual composition."""
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    layer = nn.TransformerDecoderLayer(32, 4, 64, dropout=0.0,
+                                       activation="relu",
+                                       normalize_before=False)
+    layer.eval()
+    rng = np.random.RandomState(2)
+    tgt = paddle.to_tensor(rng.randn(2, 5, 32).astype("float32"))
+    mem = paddle.to_tensor(rng.randn(2, 7, 32).astype("float32"))
+    got = layer(tgt, mem).numpy()
+
+    h = layer.norm1(tgt + layer.self_attn(tgt, tgt, tgt, None))
+    h2 = layer.norm2(h + layer.cross_attn(h, mem, mem, None))
+    f = layer.linear2(F.relu(layer.linear1(h2)))
+    ref = layer.norm3(h2 + f).numpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
 def test_encoder_layer_post_ln_matches_manual():
     """TransformerEncoderLayer post-LN (BERT) path through the fused op
     equals the manual residual + norm composition."""
