@@ -16,6 +16,7 @@ from jax import core as jax_core
 
 from ..core.dispatch import apply, unwrap
 from ..core.tensor import Tensor
+from ..resilience.faults import maybe_inject
 from .env import get_world_size
 from .mesh import get_mesh
 
@@ -135,6 +136,7 @@ def _axis_in_scope(axis):
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_allreduce_{sum,max,min,prod} parity; in-place like the reference."""
+    maybe_inject("collective.all_reduce")
     g = group or _default_group()
     v = unwrap(tensor)
     if _is_traced(v):
@@ -167,6 +169,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    maybe_inject("collective.all_gather")
     g = group or _default_group()
     v = unwrap(tensor)
     if _is_traced(v):
@@ -197,11 +200,13 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    maybe_inject("collective.reduce")
     # on SPMD every participant holds the result; semantics match dst's view
     return all_reduce(tensor, op=op, group=group)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    maybe_inject("collective.broadcast")
     g = group or _default_group()
     v = unwrap(tensor)
     if _is_traced(v):
@@ -230,6 +235,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    maybe_inject("collective.scatter")
     g = group or _default_group()
     if tensor_list is not None:
         v = unwrap(tensor_list[0] if isinstance(tensor_list, list) else tensor_list)
@@ -260,6 +266,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
+    maybe_inject("collective.reduce_scatter")
     g = group or _default_group()
     src = tensor_list if tensor_list is not None else tensor
     if isinstance(src, list):
@@ -299,6 +306,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     """global all-to-all (reference alltoall_op.cc; MoE global_scatter base)."""
+    maybe_inject("collective.alltoall")
     g = group or _default_group()
     if isinstance(in_tensor_list, list):
         from ..tensor.manipulation import stack
@@ -356,6 +364,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     (fleet.meta_parallel pipeline); eagerly it ships the host array to
     `dst` over the DCN wire channel (distributed/p2p.py) like the
     reference's NCCL send_v2 (operators/collective/send_v2_op.cc:1)."""
+    maybe_inject("collective.send")
     g = group or _default_group()
     v = unwrap(tensor)
     if _is_traced(v):
@@ -380,6 +389,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
     paired send's ppermute result IS the received value; eagerly the value
     arrives over the DCN wire channel and is written in-place (shape and
     dtype must match the reference's recv_v2 out-shape contract)."""
+    maybe_inject("collective.recv")
     g = group or _default_group()
     v = unwrap(tensor)
     if _is_traced(v) or get_world_size() <= 1:
@@ -403,6 +413,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
+    maybe_inject("collective.barrier")
     if get_world_size() <= 1:
         return
     g = group or _default_group()

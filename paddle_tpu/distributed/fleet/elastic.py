@@ -16,6 +16,10 @@ import json
 import os
 import time
 
+from ...resilience.faults import maybe_inject
+from ...resilience.retry import retry_call
+from .fs import ExecuteError
+
 __all__ = ["ElasticStatus", "FileStore", "ElasticManager"]
 
 
@@ -39,10 +43,12 @@ class FileStore:
         return os.path.join(self.root, key.replace("/", "_"))
 
     def put(self, key, value):
+        maybe_inject("store.put", ExecuteError)
         with open(self._path(key), "w") as f:
             json.dump(value, f)
 
     def refresh(self, key):
+        maybe_inject("store.heartbeat", ExecuteError)
         p = self._path(key)
         if os.path.exists(p):
             os.utime(p, None)
@@ -92,16 +98,28 @@ class ElasticManager:
 
     # -- registration / heartbeat ------------------------------------------
     def register(self):
-        self.store.put(self._key, {"rank": self.rank,
-                                   "endpoint": self.endpoint,
-                                   "ts": time.time()})
+        retry_call(self.store.put, self._key,
+                   {"rank": self.rank, "endpoint": self.endpoint,
+                    "ts": time.time()},
+                   retry_on=(ExecuteError, OSError),
+                   max_backoff=self.ttl_guard())
         self._registered = True
         self._last_np = self.np()
 
     def heartbeat(self):
+        """Lease refresh with retry: a transient store hiccup (NFS blip, GCS
+        5xx) must not let the TTL lapse and trigger a spurious scale-in."""
         if not self._registered:
             self.register()
-        self.store.refresh(self._key)
+        retry_call(self.store.refresh, self._key,
+                   retry_on=(ExecuteError, OSError),
+                   max_backoff=self.ttl_guard())
+
+    def ttl_guard(self):
+        """Cap a single retry backoff below the lease TTL so the retry loop
+        itself cannot expire the lease it is trying to keep alive."""
+        ttl = getattr(self.store, "ttl", None)
+        return max(float(ttl) / 4.0, 0.25) if ttl else 2.0
 
     def exit(self):
         if self._registered:

@@ -12,6 +12,9 @@ import os
 import shutil
 import subprocess
 
+from ...resilience.faults import maybe_inject
+from ...resilience.retry import retry_call
+
 __all__ = ["FS", "LocalFS", "HDFSClient", "ExecuteError",
            "FSFileExistsError", "FSFileNotExistsError", "FSTimeOut",
            "FSShellCmdAborted"]
@@ -130,7 +133,31 @@ class LocalFS(FS):
         with open(fs_path, "a"):
             pass
 
+    def upload(self, local_path, fs_path):
+        """Local staging copy (dir or file). Injection site: fs.upload."""
+        def _once():
+            maybe_inject("fs.upload", ExecuteError)
+            self.delete(fs_path)
+            if os.path.isdir(local_path):
+                shutil.copytree(local_path, fs_path)
+            else:
+                shutil.copy2(local_path, fs_path)
+        retry_call(_once, retry_on=(ExecuteError, FSTimeOut, OSError))
+
+    def download(self, fs_path, local_path):
+        def _once():
+            maybe_inject("fs.download", ExecuteError)
+            self.delete(local_path)
+            if os.path.isdir(fs_path):
+                shutil.copytree(fs_path, local_path)
+            else:
+                shutil.copy2(fs_path, local_path)
+        retry_call(_once, retry_on=(ExecuteError, FSTimeOut, OSError))
+
     def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        # injected BEFORE any state change, so a simulated mv fault is
+        # always safely retryable by the caller
+        maybe_inject("fs.mv", ExecuteError)
         if not self.is_exist(src_path):
             raise FSFileNotExistsError(src_path)
         if overwrite and self.is_exist(dst_path):
@@ -177,6 +204,10 @@ class HDFSClient(FS):
             raise ExecuteError(f"{' '.join(argv)}: {proc.stderr}")
         return proc.stdout
 
+    def _injected_run(self, site, argv):
+        maybe_inject(site, ExecuteError)
+        return self._run(argv)
+
     def need_upload_download(self):
         return True
 
@@ -219,10 +250,14 @@ class HDFSClient(FS):
             self._run(["-rm", "-r", fs_path])
 
     def upload(self, local_path, fs_path):
-        self._run(["-put", local_path, fs_path])
+        retry_call(self._injected_run, "fs.upload",
+                   ["-put", "-f", local_path, fs_path],
+                   retry_on=(ExecuteError, FSTimeOut))
 
     def download(self, fs_path, local_path):
-        self._run(["-get", fs_path, local_path])
+        retry_call(self._injected_run, "fs.download",
+                   ["-get", fs_path, local_path],
+                   retry_on=(FSTimeOut,))
 
     def mv(self, fs_src_path, fs_dst_path, overwrite=False,
            test_exists=False):
@@ -233,7 +268,11 @@ class HDFSClient(FS):
                 raise FSFileNotExistsError(fs_src_path)
             if self.is_exist(fs_dst_path):
                 raise FSFileExistsError(fs_dst_path)
-        self._run(["-mv", fs_src_path, fs_dst_path])
+        # only timeouts retry: a repeated -mv after a server-side success
+        # would fail with "src not found" and mask the real outcome
+        retry_call(self._injected_run, "fs.mv",
+                   ["-mv", fs_src_path, fs_dst_path],
+                   retry_on=(FSTimeOut,))
 
     def touch(self, fs_path, exist_ok=True):
         if self.is_exist(fs_path):

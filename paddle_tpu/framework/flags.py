@@ -23,6 +23,16 @@ _FLAGS: dict[str, Any] = {
     "FLAGS_max_cached_programs": 64,
     # donate buffers for jitted train steps (memory optimization)
     "FLAGS_donate_state_buffers": True,
+    # resilience subsystem (paddle_tpu/resilience, docs/resilience.md)
+    # fault-injection spec, e.g. "fs.upload:0.3,collective.all_reduce:0.1"
+    "FLAGS_fault_injection": "",
+    "FLAGS_fault_injection_seed": 0,
+    # retry policy defaults for FS transfers / heartbeat / ckpt staging
+    "FLAGS_retry_max_attempts": 3,
+    "FLAGS_retry_backoff_base": 0.5,
+    # consecutive non-finite steps before StepGuard rolls back to the last
+    # auto-checkpoint
+    "FLAGS_guard_max_bad_steps": 3,
     # inert reference flags accepted for script compatibility
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_allocator_strategy": "auto_growth",
@@ -78,8 +88,15 @@ def set_flags(flags: dict):
             lib.pt_flag_define(k.encode(), ty, str(_FLAGS[k]).encode(), b"")
             lib.pt_flag_set(k.encode(), str(_FLAGS[k]).encode())
     if "FLAGS_check_nan_inf" in flags:
+        # eager coverage (per-op output scan); jitted coverage comes from the
+        # resilience StepGuard, which reads this flag at construction
+        # (hapi.Model.fit builds one automatically when the flag is set)
         from ..core.dispatch import set_debug
         set_debug(check_nan_inf=_FLAGS["FLAGS_check_nan_inf"])
+    if "FLAGS_fault_injection" in flags or \
+            "FLAGS_fault_injection_seed" in flags:
+        from ..resilience import faults
+        faults.reconfigure_from_flags()
 
 
 def get_flags(flags=None):

@@ -37,8 +37,17 @@ class DeviceWorker:
     def train_step(self, feed):
         raise NotImplementedError
 
-    def run(self, dataset, debug=False, print_period=100, fetch_info=None):
+    def run(self, dataset, debug=False, print_period=100, fetch_info=None,
+            stop_event=None):
+        from ..resilience import preempt
         for feed in dataset.batches(self.worker_id, self.num_workers):
+            # cooperative early-exit: a sibling worker's failure (or a
+            # preemption signal) stops this worker between batches instead
+            # of letting it drain its whole shard
+            if stop_event is not None and stop_event.is_set():
+                break
+            if preempt.is_preempted():
+                break
             out = self.train_step(feed)
             self.steps += 1
             if debug and self.steps % print_period == 0:
@@ -69,6 +78,7 @@ class MultiTrainer:
 
     def __init__(self, workers):
         self.workers = workers
+        self.stop_event = threading.Event()
 
     def run(self, dataset, debug=False, print_period=100, fetch_info=None):
         from ..jit.to_static import pause_donation
@@ -100,13 +110,17 @@ class MultiTrainer:
                     pass
 
         errors = []
+        self.stop_event.clear()
 
         def loop(w):
             try:
                 w.run(dataset, debug=debug, print_period=print_period,
-                      fetch_info=fetch_info)
+                      fetch_info=fetch_info, stop_event=self.stop_event)
             except BaseException as e:  # surface the real error from join
                 errors.append((w.worker_id, e))
+                # stop siblings early: draining a full shard after a
+                # correlated fault wastes the whole pass
+                self.stop_event.set()
 
         threads = [threading.Thread(target=loop, args=(w,), daemon=True)
                    for w in self.workers]
@@ -123,8 +137,17 @@ class MultiTrainer:
             if end is not None:
                 end()
         if errors:
-            wid, err = errors[0]
-            raise RuntimeError(f"trainer worker {wid} failed: {err!r}") from err
+            # aggregate EVERY worker failure — correlated multi-worker
+            # faults (OOM storms, poisoned shards) are invisible when only
+            # errors[0] surfaces
+            errors.sort(key=lambda we: we[0])
+            detail = "; ".join(f"worker {wid}: {err!r}"
+                               for wid, err in errors)
+            raise RuntimeError(
+                f"{len(errors)} trainer worker(s) failed: {detail}"
+            ) from errors[0][1]
+        from ..resilience import preempt
+        preempt.check()
 
     @property
     def total_steps(self):

@@ -46,6 +46,7 @@ class Model:
         self.stop_training = False
         self._compiled_train_step = None
         self._compiled_eval_step = None
+        self._step_guard = None  # set by fit() under FLAGS_check_nan_inf
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
@@ -160,9 +161,23 @@ class Model:
         from .callbacks import CallbackList, ProgBarLogger
         loader = self._make_loader(train_data, batch_size, shuffle, drop_last,
                                    num_workers)
-        cbs = CallbackList(_to_list(callbacks) or [ProgBarLogger(log_freq,
-                                                                 verbose)])
+        cb_list = _to_list(callbacks) or [ProgBarLogger(log_freq, verbose)]
+        # preemption contract (docs/resilience.md): when a handler is
+        # installed, fit polls it after every batch and stops resumable
+        from ..resilience import preempt as _preempt
+        if _preempt.installed() and not any(
+                isinstance(c, _preempt.PreemptionCallback) for c in cb_list):
+            cb_list = list(cb_list) + [_preempt.PreemptionCallback()]
+        cbs = CallbackList(cb_list)
         cbs.set_model(self)
+        # FLAGS_check_nan_inf covers compiled steps via the step guard (the
+        # eager per-op scan cannot see inside one XLA launch)
+        from ..framework.flags import get_flag
+        guard = None
+        if get_flag("FLAGS_check_nan_inf"):
+            from ..resilience.guard import StepGuard
+            guard = StepGuard([self.network, self._optimizer])
+            self._step_guard = guard
         try:
             steps = len(loader)
         except TypeError:
@@ -186,8 +201,19 @@ class Model:
                     # single-step path keeps the begin-before-execute
                     # callback contract (timers/profiler regions)
                     cbs.on_train_batch_begin(step0)
-                    loss = self.train_batch(*group[0])
+                    if guard is not None:
+                        guard.before_step()
+                    try:
+                        loss = self.train_batch(*group[0])
+                    except FloatingPointError:
+                        # eager NaN scan (discovery passes) fires before the
+                        # guard can see the loss — same fault, same handling
+                        if guard is None:
+                            raise
+                        loss = [float("nan")]
                     logs = {"loss": loss, "step": step0}
+                    if guard is not None and not guard.after_step(loss):
+                        logs["skipped"] = True
                     cbs.on_train_batch_end(step0, logs)
                     it += 1
                     return
@@ -195,10 +221,23 @@ class Model:
                 # all ends report per-step losses
                 for k in range(len(group)):
                     cbs.on_train_batch_begin(step0 + k)
-                losses = self._train_steps(group)
+                if guard is not None:
+                    # the scan is one launch: the guard can only keep or
+                    # restore the whole group
+                    guard.before_step()
+                try:
+                    losses = self._train_steps(group)
+                except FloatingPointError:
+                    if guard is None:
+                        raise
+                    losses = [[float("nan")]] * len(group)
+                group_skipped = (guard is not None
+                                 and not guard.after_step(losses))
                 for k, loss in enumerate(losses):
                     s = step0 + k
                     logs = {"loss": loss, "step": s}
+                    if group_skipped:
+                        logs["skipped"] = True
                     cbs.on_train_batch_end(s, logs)
                     it += 1
 

@@ -18,8 +18,24 @@ import hashlib
 import json
 import os
 
+from ..resilience.faults import maybe_inject
+from ..resilience.retry import retry_call
+
 __all__ = ["train_epoch_range", "register", "CheckpointSaver",
            "_get_train_epoch_range"]
+
+
+def _file_sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class CorruptSnapshotError(RuntimeError):
+    """A snapshot directory exists but its payload is unreadable or fails
+    the meta.json checksum."""
 
 g_train_epoch_range = None
 _g_registered = []
@@ -53,55 +69,100 @@ class CheckpointSaver:
         stage = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
         try:
             save_obj(state, os.path.join(stage, "state.pdparams"))
+            # checksum lets load_checkpoint detect a torn/corrupted payload
+            # even when meta.json itself survived intact
+            meta = dict(meta)
+            meta["checksum"] = _file_sha256(
+                os.path.join(stage, "state.pdparams"))
             with open(os.path.join(stage, "meta.json"), "w") as f:
                 json.dump(meta, f)
             tmp = self._path + ".tmp"
             old = self._path + ".old"
-            self._fs.delete(tmp)
-            if self._fs.need_upload_download():
-                self._fs.upload(stage, tmp)
-            else:
-                shutil.copytree(stage, tmp)
-            # crash-safe swap: keep the previous snapshot aside until the new
-            # one is in place, so no crash window leaves zero checkpoints
+
+            def _stage_in():
+                self._fs.delete(tmp)
+                maybe_inject("fs.upload")
+                if self._fs.need_upload_download():
+                    self._fs.upload(stage, tmp)
+                else:
+                    shutil.copytree(stage, tmp)
+
+            retry_call(_stage_in, retry_on=Exception)
+            # crash-safe swap: the previous snapshot moves aside and STAYS
+            # there — `.old` doubles as the corruption fallback, so the mv
+            # window AND a torn current snapshot both recover from it
             self._fs.delete(old)
             if self._fs.is_exist(self._path):
-                self._fs.mv(self._path, old)
-            self._fs.mv(tmp, self._path)
-            self._fs.delete(old)
+                retry_call(self._fs.mv, self._path, old,
+                           retry_on=Exception)
+            retry_call(self._fs.mv, tmp, self._path, retry_on=Exception)
         finally:
             shutil.rmtree(stage, ignore_errors=True)
 
-    def load_checkpoint(self):
+    def _read_snapshot(self, fs_path):
+        """Fetch + validate one snapshot dir; raises CorruptSnapshotError on
+        checksum mismatch or an unreadable payload."""
         import shutil
         import tempfile
 
         from ..framework.io_utils import load as load_obj
+        local = fs_path
+        stage = None
+        try:
+            if self._fs.need_upload_download():
+                stage = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
+                retry_call(self._fs.download, fs_path, stage,
+                           retry_on=Exception)
+                local = os.path.join(stage, os.path.basename(fs_path))
+                if not os.path.isdir(local):
+                    local = stage
+            try:
+                with open(os.path.join(local, "meta.json")) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError) as e:
+                raise CorruptSnapshotError(f"{fs_path}: bad meta.json: {e}")
+            payload = os.path.join(local, "state.pdparams")
+            want = meta.get("checksum")
+            if want is not None:
+                try:
+                    got = _file_sha256(payload)
+                except OSError as e:
+                    raise CorruptSnapshotError(f"{fs_path}: {e}")
+                if got != want:
+                    raise CorruptSnapshotError(
+                        f"{fs_path}: state.pdparams checksum mismatch "
+                        f"(got {got[:12]}, want {want[:12]})")
+            try:
+                state = load_obj(payload)
+            except Exception as e:
+                raise CorruptSnapshotError(
+                    f"{fs_path}: unreadable state.pdparams: {e}")
+            return state, meta
+        finally:
+            if stage is not None:
+                shutil.rmtree(stage, ignore_errors=True)
+
+    def load_checkpoint(self):
+        old = self._path + ".old"
         if not self._fs.is_exist(os.path.join(self._path, "meta.json")):
             # crash fell between the swap's mv steps: recover the snapshot
             # that was renamed aside by save_checkpoint
-            old = self._path + ".old"
             if self._fs.is_exist(os.path.join(old, "meta.json")):
                 self._fs.mv(old, self._path)
             else:
                 return None, None
-        if self._fs.need_upload_download():
-            stage = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
-            try:
-                self._fs.download(self._path, stage)
-                local = os.path.join(stage, os.path.basename(self._path))
-                if not os.path.isdir(local):
-                    local = stage
-                with open(os.path.join(local, "meta.json")) as f:
-                    meta = json.load(f)
-                state = load_obj(os.path.join(local, "state.pdparams"))
-                return state, meta
-            finally:
-                shutil.rmtree(stage, ignore_errors=True)
-        with open(os.path.join(self._path, "meta.json")) as f:
-            meta = json.load(f)
-        state = load_obj(os.path.join(self._path, "state.pdparams"))
-        return state, meta
+        try:
+            return self._read_snapshot(self._path)
+        except CorruptSnapshotError:
+            # torn current snapshot (e.g. partial write before a crash):
+            # fall back to the retained previous snapshot and promote it so
+            # the next save swaps against a healthy current
+            if not self._fs.is_exist(os.path.join(old, "meta.json")):
+                return None, None
+            state, meta = self._read_snapshot(old)  # may raise: both torn
+            self._fs.delete(self._path)
+            self._fs.mv(old, self._path)
+            return state, meta
 
     def clean_redundant_epochs(self):
         pass  # single rolling snapshot — nothing to clean
@@ -135,20 +196,37 @@ class TrainEpochRange:
             if sub is not None and hasattr(obj, "set_state_dict"):
                 obj.set_state_dict(sub)
 
-    def _snapshot(self, epoch_no):
+    def _snapshot(self, epoch_no, extra=None):
         state = {str(i): obj.state_dict()
                  for i, obj in enumerate(_g_registered)
                  if hasattr(obj, "state_dict")}
-        self._saver.save_checkpoint(
-            state, {"epoch_no": epoch_no, "max_epoch_num": self.max_epoch_num,
-                    "name": self.name})
+        meta = {"epoch_no": epoch_no, "max_epoch_num": self.max_epoch_num,
+                "name": self.name}
+        if extra:
+            meta.update(extra)
+        self._saver.save_checkpoint(state, meta)
 
     def next(self):
+        from ..resilience import preempt
+        epoch_done = self._start_epoch - 1
         for epoch in range(self._start_epoch, self.max_epoch_num):
+            self._check_preempt(preempt, epoch_done)
             yield epoch
+            epoch_done = epoch
             if (epoch + 1) % self.save_checkpoint_inter == 0 or \
                     epoch == self.max_epoch_num - 1:
                 self._snapshot(epoch)
+            self._check_preempt(preempt, epoch_done)
+
+    def _check_preempt(self, preempt, epoch_done):
+        """Epoch-boundary preemption poll: one emergency snapshot stamped
+        `preempted`, then a resumable SystemExit (preempt.Preempted)."""
+        handler = preempt.get_handler()
+        if handler is None or not handler.is_preempted():
+            return
+        self._snapshot(epoch_done, extra={"preempted": True})
+        handler.drain()
+        raise preempt.Preempted(handler._signum)
 
 
 def _get_train_epoch_range():

@@ -1,0 +1,31 @@
+"""Resilience subsystem: fault injection, retry/backoff, step guard, and
+preemption-safe checkpointing.
+
+The fault model and integration contract live in docs/resilience.md. The
+four modules compose:
+
+- :mod:`.faults` — deterministic, flag-driven fault-injection registry;
+  every storage/collective/checkpoint entry point calls
+  ``maybe_inject("<domain>.<op>")`` (enforced by
+  tools/check_injection_points.py).
+- :mod:`.retry` — exponential-backoff retry shared by FS transfer paths,
+  checkpoint staging, and the elastic heartbeat.
+- :mod:`.guard` — step-boundary NaN/Inf containment for compiled train
+  steps (skip + loss-scale backoff + rollback-to-checkpoint).
+- :mod:`.preempt` — SIGTERM → emergency checkpoint → resumable exit.
+"""
+from __future__ import annotations
+
+from . import faults  # noqa: F401
+from . import guard  # noqa: F401
+from . import preempt  # noqa: F401
+from . import retry  # noqa: F401
+from .faults import FaultInjected, fault_point, maybe_inject  # noqa: F401
+from .guard import BadStepError, StepGuard  # noqa: F401
+from .preempt import Preempted, PreemptionCallback, PreemptionHandler  # noqa: F401
+from .retry import retry_call  # noqa: F401
+
+__all__ = ["faults", "retry", "guard", "preempt", "maybe_inject",
+           "fault_point", "FaultInjected", "StepGuard", "BadStepError",
+           "Preempted", "PreemptionHandler", "PreemptionCallback",
+           "retry_call"]

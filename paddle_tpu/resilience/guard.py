@@ -1,0 +1,180 @@
+"""Step guard: NaN/Inf containment for compiled train steps.
+
+``FLAGS_check_nan_inf`` historically only covered eager dispatch
+(core/dispatch.py scans op outputs) — a `to_static`-compiled train step is
+one opaque XLA launch, so a NaN born inside it lands directly in the
+parameters. The guard closes that hole at the step boundary:
+
+  guard.before_step()          # host snapshot of registered state
+  loss = compiled_step(batch)  # one XLA launch
+  ok = guard.after_step(loss)  # finite? no → restore snapshot (step skipped)
+
+A skipped step leaves parameters bit-identical to the pre-step state and
+backs off the attached loss scaler (update_loss_scaling_op.cc semantics).
+After ``FLAGS_guard_max_bad_steps`` CONSECUTIVE bad steps — loss-scale
+backoff evidently isn't enough — the guard rolls registered state back to
+the last auto-checkpoint (CheckpointSaver) and resets.
+
+hapi.Model.fit constructs one automatically when FLAGS_check_nan_inf is
+set, so the flag now covers jitted execution end to end.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StepGuard", "BadStepError"]
+
+
+class BadStepError(FloatingPointError):
+    """Raised by StepGuard.after_step when raise_on_rollback is set and a
+    rollback target is unavailable."""
+
+
+def _all_finite(x):
+    """Recursive finiteness over loss-like values (Tensor/array/float/
+    list/tuple/dict)."""
+    if x is None:
+        return True
+    if isinstance(x, dict):
+        return all(_all_finite(v) for v in x.values())
+    if isinstance(x, (list, tuple)):
+        return all(_all_finite(v) for v in x)
+    if hasattr(x, "_val"):
+        x = x._val
+    arr = np.asarray(x)
+    if not np.issubdtype(arr.dtype, np.floating) and \
+            not np.issubdtype(arr.dtype, np.complexfloating):
+        return True
+    return bool(np.all(np.isfinite(arr)))
+
+
+class StepGuard:
+    """Guards a train step over a fixed set of stateful objects.
+
+    objs: Layers/Optimizers (anything with state_dict/set_state_dict),
+    declared in the same positional order as incubate.checkpoint.register
+    when a ``saver`` is attached (rollback restores by position).
+    """
+
+    def __init__(self, objs, scaler=None, max_bad_steps=None, saver=None,
+                 on_rollback=None, check_state=True):
+        from ..framework.flags import get_flag
+        self.objs = [o for o in objs if o is not None]
+        self.scaler = scaler
+        self.max_bad_steps = int(
+            max_bad_steps if max_bad_steps is not None
+            else get_flag("FLAGS_guard_max_bad_steps", 3))
+        self.saver = saver
+        self.on_rollback = on_rollback
+        self.check_state = check_state
+        self.bad_steps = 0       # consecutive
+        self.steps = 0           # total steps observed
+        self.skipped = 0         # total skipped
+        self.rollbacks = 0
+        self._pre = None
+
+    # -- state capture ----------------------------------------------------
+    def _capture(self):
+        snap = []
+        for obj in self.objs:
+            sd = obj.state_dict() if hasattr(obj, "state_dict") else {}
+            snap.append(self._copy_tree(sd))
+        return snap
+
+    @staticmethod
+    def _copy_tree(sd):
+        out = {}
+        for k, v in sd.items():
+            if isinstance(v, dict):
+                out[k] = StepGuard._copy_tree(v)
+            elif hasattr(v, "_val"):
+                out[k] = np.asarray(v._val).copy()
+            else:
+                out[k] = v
+        return out
+
+    @staticmethod
+    def _to_tensors(sd):
+        from ..core.tensor import Tensor
+        out = {}
+        for k, v in sd.items():
+            if isinstance(v, dict):
+                out[k] = StepGuard._to_tensors(v)
+            elif isinstance(v, np.ndarray):
+                out[k] = Tensor(v)
+            else:
+                out[k] = v
+        return out
+
+    def _restore(self, snap):
+        for obj, sd in zip(self.objs, snap):
+            if hasattr(obj, "set_state_dict"):
+                obj.set_state_dict(self._to_tensors(sd))
+
+    def _state_finite(self):
+        for obj in self.objs:
+            if not hasattr(obj, "state_dict"):
+                continue
+            if not _all_finite(obj.state_dict()):
+                return False
+        return True
+
+    # -- step protocol ----------------------------------------------------
+    def before_step(self):
+        self._pre = self._capture()
+
+    def after_step(self, loss=None):
+        """Returns True if the step is kept; False if it was skipped (state
+        restored) or a rollback fired."""
+        self.steps += 1
+        good = _all_finite(loss) and (not self.check_state
+                                      or self._state_finite())
+        if good:
+            self.bad_steps = 0
+            self._pre = None
+            return True
+        self.skipped += 1
+        self.bad_steps += 1
+        if self._pre is not None:
+            self._restore(self._pre)
+            self._pre = None
+        self._backoff_scale()
+        if self.bad_steps >= self.max_bad_steps:
+            self.rollback()
+        return False
+
+    def guard(self, step_fn, *args, **kwargs):
+        """Convenience wrapper: snapshot, run, check. Returns (result, ok)."""
+        self.before_step()
+        result = step_fn(*args, **kwargs)
+        return result, self.after_step(result)
+
+    # -- recovery ---------------------------------------------------------
+    def _backoff_scale(self):
+        s = self.scaler
+        if s is None or not getattr(s, "_enable", False):
+            return
+        import jax.numpy as jnp
+        cur = float(np.asarray(s._scale._val))
+        s._scale._value = jnp.asarray(
+            max(cur * s._decr_ratio, 1.0), dtype=jnp.float32)
+
+    def rollback(self):
+        """Restore registered state from the last auto-checkpoint (or the
+        on_rollback hook); resets the consecutive-bad counter."""
+        self.bad_steps = 0
+        self.rollbacks += 1
+        if self.on_rollback is not None:
+            self.on_rollback(self)
+            return
+        if self.saver is not None:
+            state, meta = self.saver.load_checkpoint()
+            if state is not None:
+                for i, obj in enumerate(self.objs):
+                    sub = state.get(str(i))
+                    if sub is not None and hasattr(obj, "set_state_dict"):
+                        obj.set_state_dict(sub)
+                return
+        raise BadStepError(
+            f"{self.max_bad_steps} consecutive non-finite steps and no "
+            "rollback target (attach a CheckpointSaver or on_rollback)")

@@ -1,0 +1,609 @@
+"""Resilience subsystem tests (docs/resilience.md contract).
+
+All chaos is driven by the deterministic fault-injection registry
+(paddle_tpu.resilience.faults) — no monkeypatched I/O, no real sleeps
+(retry tests use an injected sleep/clock; integration paths run with
+FLAGS_retry_backoff_base=0).
+"""
+import json
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.fleet import LocalFS
+from paddle_tpu.distributed.fleet.fs import ExecuteError, FSTimeOut
+from paddle_tpu.incubate import checkpoint as acp
+from paddle_tpu.resilience import faults, guard, preempt
+from paddle_tpu.resilience.retry import retry, retry_call
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    """Every test starts with an idle registry, no preemption handler, and
+    zero retry backoff (so injected-fault retries never really sleep)."""
+    paddle.set_flags({"FLAGS_retry_backoff_base": 0.0})
+    faults.reset()
+    yield
+    faults.reset()
+    preempt.uninstall()
+    paddle.set_flags({"FLAGS_check_nan_inf": False,
+                      "FLAGS_retry_backoff_base": 0.5,
+                      "FLAGS_retry_max_attempts": 3,
+                      "FLAGS_guard_max_bad_steps": 3})
+
+
+def _make(seed=0):
+    paddle.seed(seed)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    return model, opt
+
+
+def _train_epoch(model, opt, seed):
+    rng = np.random.RandomState(seed)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    loss = F.mse_loss(model(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+
+class TestFaultRegistry:
+    def test_deterministic_given_seed(self):
+        faults.configure("x.y:0.5", seed=11)
+        seq1 = [bool(faults._REGISTRY.should_fail("x.y")) for _ in range(32)]
+        faults.configure("x.y:0.5", seed=11)
+        seq2 = [bool(faults._REGISTRY.should_fail("x.y")) for _ in range(32)]
+        assert seq1 == seq2
+        assert any(seq1) and not all(seq1)
+
+    def test_independent_site_streams(self):
+        faults.configure("a.b:0.5,c.d:0.5", seed=7)
+        solo = [bool(faults._REGISTRY.should_fail("a.b")) for _ in range(16)]
+        faults.configure("a.b:0.5,c.d:0.5", seed=7)
+        mixed = []
+        for _ in range(16):
+            mixed.append(bool(faults._REGISTRY.should_fail("a.b")))
+            faults._REGISTRY.should_fail("c.d")  # must not perturb a.b
+        assert solo == mixed
+
+    def test_count_rules_and_prefix_match(self):
+        faults.configure("fs:#2", seed=0)
+        outcomes = []
+        for _ in range(3):
+            try:
+                faults.maybe_inject("fs.upload")
+                outcomes.append("ok")
+            except faults.FaultInjected:
+                outcomes.append("fail")
+        assert outcomes == ["ok", "fail", "ok"]
+        # longest prefix wins
+        faults.configure("fs:0.0,fs.upload:1.0", seed=0)
+        with pytest.raises(faults.FaultInjected):
+            faults.maybe_inject("fs.upload")
+        faults.maybe_inject("fs.download")  # matches fs:0.0 — no fault
+
+    def test_stats_and_custom_exception(self):
+        faults.configure("s.t:1.0", seed=0)
+        with pytest.raises(FSTimeOut):
+            faults.maybe_inject("s.t", FSTimeOut)
+        st = faults.stats()
+        assert st["s.t"] == {"evaluations": 1, "injected": 1}
+
+    def test_flags_route_into_registry(self):
+        paddle.set_flags({"FLAGS_fault_injection": "f.g:1.0",
+                          "FLAGS_fault_injection_seed": 5})
+        assert faults.is_active()
+        with pytest.raises(faults.FaultInjected):
+            faults.maybe_inject("f.g")
+        paddle.set_flags({"FLAGS_fault_injection": ""})
+        assert not faults.is_active()
+
+
+class TestRetry:
+    def test_backoff_schedule_and_exhaustion_raises_fstimeout(self):
+        """(c): exhaustion re-raises the last FSTimeOut; exponential
+        backoff observed through an injected sleep — no real sleeping."""
+        sleeps = []
+        faults.configure("r.op:1.0", seed=0)
+
+        @retry(max_attempts=4, backoff=0.1, jitter=0,
+               retry_on=(FSTimeOut,), sleep=sleeps.append)
+        def op():
+            faults.maybe_inject("r.op", FSTimeOut)
+            return 42
+
+        with pytest.raises(FSTimeOut):
+            op()
+        assert sleeps == [0.1, 0.2, 0.4]
+        assert faults.stats()["r.op"]["evaluations"] == 4
+
+    def test_recovers_after_transient_fault(self):
+        faults.configure("r.t:#1", seed=0)  # only the first call fails
+        sleeps = []
+        out = retry_call(
+            lambda: (faults.maybe_inject("r.t", ExecuteError), 7)[1],
+            max_attempts=3, backoff=0.1, jitter=0, sleep=sleeps.append,
+            retry_on=(ExecuteError,))
+        assert out == 7 and len(sleeps) == 1
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def op():
+            calls.append(1)
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError):
+            retry_call(op, max_attempts=5, backoff=0.0,
+                       retry_on=(FSTimeOut,), sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_timeout_budget_with_injected_clock(self):
+        now = [0.0]
+
+        def clock():
+            return now[0]
+
+        def sleep(s):
+            now[0] += s
+
+        faults.configure("r.b:1.0", seed=0)
+        with pytest.raises(FSTimeOut):
+            retry_call(lambda: faults.maybe_inject("r.b", FSTimeOut),
+                       max_attempts=100, backoff=1.0, jitter=0,
+                       timeout=2.5, retry_on=(FSTimeOut,),
+                       clock=clock, sleep=sleep)
+        # budget cut the loop long before 100 attempts
+        assert faults.stats()["r.b"]["evaluations"] < 6
+
+
+class TestCheckpointHardening:
+    def _saver(self, tmp_path):
+        return acp.CheckpointSaver(LocalFS(), str(tmp_path / "ckpt"))
+
+    def test_kill_between_mv_recovers_old(self, tmp_path):
+        """(a): a crash between the swap's two mv steps leaves only `.old`;
+        the next load recovers it."""
+        saver = self._saver(tmp_path)
+        saver.save_checkpoint({"a": 1}, {"epoch_no": 0})
+        # save #2: mv eval #1 (current→old) passes, eval #2+ (tmp→current)
+        # keeps failing until retries exhaust → simulated mid-swap crash
+        faults.configure("fs.mv:#2+", seed=0)
+        with pytest.raises(ExecuteError):
+            saver.save_checkpoint({"a": 2}, {"epoch_no": 1})
+        assert not os.path.exists(str(tmp_path / "ckpt"))
+        faults.reset()  # "relaunch"
+        state, meta = saver.load_checkpoint()
+        assert state == {"a": 1} and meta["epoch_no"] == 0
+
+    def test_corrupt_payload_falls_back_to_old(self, tmp_path):
+        """(b): torn state.pdparams with intact meta.json must not crash
+        resume — checksum mismatch falls back to `.old`."""
+        saver = self._saver(tmp_path)
+        saver.save_checkpoint({"a": 1}, {"epoch_no": 0})
+        saver.save_checkpoint({"a": 2}, {"epoch_no": 1})
+        payload = str(tmp_path / "ckpt" / "state.pdparams")
+        with open(payload, "wb") as f:
+            f.write(b"torn bytes")
+        state, meta = saver.load_checkpoint()
+        assert state == {"a": 1} and meta["epoch_no"] == 0
+        # fallback was promoted: subsequent loads stay healthy
+        state2, _ = saver.load_checkpoint()
+        assert state2 == {"a": 1}
+
+    def test_checksum_written_and_missing_payload_falls_back(self, tmp_path):
+        saver = self._saver(tmp_path)
+        saver.save_checkpoint({"a": 1}, {"epoch_no": 0})
+        with open(str(tmp_path / "ckpt" / "meta.json")) as f:
+            assert "checksum" in json.load(f)
+        saver.save_checkpoint({"a": 2}, {"epoch_no": 1})
+        os.remove(str(tmp_path / "ckpt" / "state.pdparams"))
+        state, meta = saver.load_checkpoint()
+        assert state == {"a": 1} and meta["epoch_no"] == 0
+
+    def test_both_snapshots_torn_raises(self, tmp_path):
+        saver = self._saver(tmp_path)
+        saver.save_checkpoint({"a": 1}, {"epoch_no": 0})
+        saver.save_checkpoint({"a": 2}, {"epoch_no": 1})
+        for d in ("ckpt", "ckpt.old"):
+            with open(str(tmp_path / d / "state.pdparams"), "wb") as f:
+                f.write(b"x")
+        with pytest.raises(Exception):
+            saver.load_checkpoint()
+
+    def test_upload_faults_retried_then_exhausted(self, tmp_path):
+        """Acceptance: rate<1 with retries completes; rate 1.0 exhausts and
+        fails cleanly, leaving the last good snapshot loadable."""
+        saver = self._saver(tmp_path)
+        paddle.set_flags({"FLAGS_retry_max_attempts": 5})
+        faults.configure("fs.upload:0.5", seed=3)
+        for e in range(4):  # transient faults absorbed by retry
+            saver.save_checkpoint({"a": e}, {"epoch_no": e})
+        state, _ = saver.load_checkpoint()
+        assert state == {"a": 3}
+        faults.configure("fs.upload:1.0", seed=3)
+        with pytest.raises(faults.FaultInjected):
+            saver.save_checkpoint({"a": 99}, {"epoch_no": 99})
+        faults.reset()
+        state, meta = saver.load_checkpoint()
+        assert state == {"a": 3} and meta["epoch_no"] == 3
+
+
+class TestChaoticTrainEpochRange:
+    def test_run_under_faults_matches_fault_free(self, tmp_path,
+                                                 monkeypatch):
+        """Acceptance: a 0.3-rate injected run with retries enabled reaches
+        the same final state as a fault-free run (same seed)."""
+        monkeypatch.setenv("PADDLE_JOB_ID", "job_chaos_parity")
+        paddle.set_flags({"FLAGS_retry_max_attempts": 6})
+
+        model_ref, opt_ref = _make()
+        for e in range(5):
+            _train_epoch(model_ref, opt_ref, e)
+
+        model, opt = _make()
+        acp.register(model, opt)
+        faults.configure("fs.upload:0.3", seed=9)
+        for e in acp.train_epoch_range(5, checkpoint_path=str(tmp_path / "c"),
+                                       name="chaos"):
+            _train_epoch(model, opt, e)
+        np.testing.assert_allclose(model.weight.numpy(),
+                                   model_ref.weight.numpy(), rtol=1e-6)
+
+    def test_exhausted_faults_fail_cleanly_then_resume(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.setenv("PADDLE_JOB_ID", "job_chaos_resume")
+        model, opt = _make()
+        acp.register(model, opt)
+        ck = str(tmp_path / "c2")
+        seen = []
+        with pytest.raises(faults.FaultInjected):
+            for e in acp.train_epoch_range(5, checkpoint_path=ck,
+                                           name="boom"):
+                _train_epoch(model, opt, e)
+                seen.append(e)
+                if e == 1:  # epoch 0 snapshots fine, epoch 1's save dies
+                    faults.configure("fs.upload:1.0", seed=0)
+        assert seen == [0, 1]
+        faults.reset()
+        model2, opt2 = _make()
+        acp.register(model2, opt2)
+        resumed = []
+        for e in acp.train_epoch_range(5, checkpoint_path=ck, name="boom"):
+            _train_epoch(model2, opt2, e)
+            resumed.append(e)
+        assert resumed == [1, 2, 3, 4]  # resumed from epoch 0's snapshot
+
+
+class TestStepGuard:
+    def test_nan_step_skipped_params_unchanged(self):
+        """(d): NaN loss → step counter advances, params restored."""
+        model, opt = _make()
+        g = guard.StepGuard([model, opt], max_bad_steps=5)
+
+        def step(x, y):
+            loss = F.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        _, ok = g.guard(step, x, y)
+        assert ok and g.steps == 1
+        w_good = model.weight.numpy().copy()
+
+        xnan = paddle.to_tensor(np.full((8, 4), np.nan, np.float32))
+        _, ok = g.guard(step, xnan, y)
+        assert not ok and g.steps == 2 and g.skipped == 1
+        np.testing.assert_array_equal(model.weight.numpy(), w_good)
+
+    def test_k_consecutive_bad_steps_roll_back_to_checkpoint(self, tmp_path):
+        model, opt = _make()
+        saver = acp.CheckpointSaver(LocalFS(), str(tmp_path / "g"))
+        state = {str(i): o.state_dict() for i, o in enumerate([model, opt])}
+        saver.save_checkpoint(state, {"epoch_no": 0})
+        ckpt_w = model.weight.numpy().copy()
+
+        # drift away from the checkpoint with one good step
+        _train_epoch(model, opt, 0)
+        assert not np.allclose(model.weight.numpy(), ckpt_w)
+
+        g = guard.StepGuard([model, opt], max_bad_steps=2, saver=saver)
+
+        def bad_step():
+            nan = paddle.to_tensor(np.full((4, 4), np.nan, np.float32))
+            loss = F.mse_loss(model(nan), nan)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        _, ok = g.guard(bad_step)
+        assert not ok and g.bad_steps == 1 and g.rollbacks == 0
+        _, ok = g.guard(bad_step)
+        assert not ok and g.bad_steps == 0 and g.rollbacks == 1
+        np.testing.assert_array_equal(model.weight.numpy(), ckpt_w)
+
+    def test_scaler_backoff_on_bad_step(self):
+        from paddle_tpu.amp.grad_scaler import GradScaler
+        model, _ = _make()
+        sc = GradScaler(init_loss_scaling=1024.0)
+        g = guard.StepGuard([model], scaler=sc, max_bad_steps=100)
+        g.before_step()
+        assert not g.after_step(float("nan"))
+        assert float(np.asarray(sc._scale._val)) == 512.0
+
+    def test_no_rollback_target_raises_bad_step_error(self):
+        model, _ = _make()
+        g = guard.StepGuard([model], max_bad_steps=1)
+        g.before_step()
+        with pytest.raises(guard.BadStepError):
+            g.after_step(float("inf"))
+
+    def test_fit_with_check_nan_inf_survives_nan_batch(self):
+        """FLAGS_check_nan_inf now covers the compiled train step: a NaN
+        batch is skipped and training finishes finite."""
+        from paddle_tpu.hapi.model import Model
+        paddle.seed(0)
+        paddle.set_flags({"FLAGS_check_nan_inf": True,
+                          "FLAGS_guard_max_bad_steps": 10})
+        rng = np.random.RandomState(0)
+        net = nn.Linear(4, 1)
+        m = Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.01, parameters=net.parameters()),
+            loss=F.mse_loss)
+        X = rng.randn(16, 4).astype(np.float32)
+        X[4] = np.nan  # one poisoned batch at batch_size=4
+        Y = rng.randn(16, 1).astype(np.float32)
+        ds = [(X[i], Y[i]) for i in range(16)]
+        m.fit(ds, batch_size=4, epochs=1, verbose=0, shuffle=False)
+        assert np.all(np.isfinite(net.weight.numpy()))
+        assert m._step_guard.skipped >= 1
+        assert m._step_guard.steps == 4
+
+
+class TestPreemption:
+    def test_sigterm_emergency_save_and_resume_roundtrip(self, tmp_path,
+                                                         monkeypatch):
+        """(e): SIGTERM → emergency snapshot (preempted meta flag) →
+        Preempted(SystemExit 143) → relaunch resumes and matches the
+        uninterrupted run."""
+        monkeypatch.setenv("PADDLE_JOB_ID", "job_preempt")
+        ck = str(tmp_path / "p")
+
+        model_ref, opt_ref = _make()
+        for e in range(5):
+            _train_epoch(model_ref, opt_ref, e)
+
+        model, opt = _make()
+        acp.register(model, opt)
+        handler = preempt.install()
+        seen = []
+        with pytest.raises(preempt.Preempted) as exc:
+            for e in acp.train_epoch_range(5, checkpoint_path=ck, name="p",
+                                           save_checkpoint_inter=10):
+                _train_epoch(model, opt, e)
+                seen.append(e)
+                if e == 1:
+                    os.kill(os.getpid(), signal.SIGTERM)
+        assert seen == [0, 1]
+        assert exc.value.code == 128 + signal.SIGTERM
+
+        # emergency snapshot carries the preempted flag for epoch 1 (the
+        # save_checkpoint_inter=10 means ONLY the emergency save wrote it)
+        key = [p for p in os.listdir(ck)
+               if not p.endswith((".old", ".tmp"))][0]
+        with open(os.path.join(ck, key, "meta.json")) as f:
+            meta = json.load(f)
+        assert meta.get("preempted") is True and meta["epoch_no"] == 1
+
+        preempt.uninstall()
+        model2, opt2 = _make()
+        acp.register(model2, opt2)
+        resumed = []
+        for e in acp.train_epoch_range(5, checkpoint_path=ck, name="p"):
+            _train_epoch(model2, opt2, e)
+            resumed.append(e)
+        assert resumed == [2, 3, 4]
+        np.testing.assert_allclose(model2.weight.numpy(),
+                                   model_ref.weight.numpy(), rtol=1e-6)
+
+    def test_signal_handler_install_uninstall(self):
+        prev = signal.getsignal(signal.SIGTERM)
+        handler = preempt.install()
+        assert signal.getsignal(signal.SIGTERM) == handler._on_signal
+        assert preempt.install() is handler  # idempotent
+        preempt.uninstall()
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+    def test_drain_runs_actions_once_and_survives_failures(self):
+        h = preempt.PreemptionHandler()
+        ran = []
+        h.add_action(lambda: ran.append("a"), name="a")
+
+        def broken():
+            raise RuntimeError("saver died")
+        h.add_action(broken, name="b")
+        h.add_action(lambda: ran.append("c"), name="c")
+        h.notify()
+        failures = h.drain()
+        assert ran == ["a", "c"]
+        assert [n for n, _ in failures] == ["b"]
+        assert h.drain() == []  # once only
+
+    def test_fit_stops_resumable_on_preemption(self):
+        from paddle_tpu.hapi.model import Model
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        net = nn.Linear(4, 1)
+        m = Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.01, parameters=net.parameters()),
+            loss=F.mse_loss)
+        ds = [(rng.randn(4).astype(np.float32),
+               rng.randn(1).astype(np.float32)) for _ in range(12)]
+        handler = preempt.install()
+
+        class TriggerAt:
+            """Fires the preemption flag after the second batch."""
+
+            def __init__(self):
+                self.model = None
+                self.params = {}
+
+            def set_model(self, model):
+                self.model = model
+
+            def set_params(self, params):
+                self.params = params
+
+            def __getattr__(self, name):
+                if name.startswith("on_"):
+                    return lambda *a, **k: None
+                raise AttributeError(name)
+
+            def on_train_batch_end(self, step, logs=None):
+                if step == 1:
+                    handler.notify()
+
+        with pytest.raises(preempt.Preempted):
+            m.fit(ds, batch_size=4, epochs=4, verbose=0,
+                  callbacks=[TriggerAt()])
+        assert m.stop_training
+
+
+class TestMultiTrainerFaults:
+    def _worker(self, cls, wid, n, **kw):
+        w = cls(wid, n, **kw)
+
+        class _Prog:  # pre-warmed: skip the single-threaded warmup path
+            _trainer_warmed = True
+            feed_vars = []
+        w._program = _Prog()
+        return w
+
+    def _dataset(self, n_batches):
+        from paddle_tpu.distributed import InMemoryDataset
+        ds = InMemoryDataset()
+        ds.set_batch_size(1)
+        ds.set_use_var(["x"])
+        ds.set_sample_list([(np.float32(i),) for i in range(n_batches)])
+        return ds
+
+    def test_all_worker_failures_aggregated(self):
+        from paddle_tpu.framework.trainer import DeviceWorker, MultiTrainer
+        barrier = threading.Barrier(2, timeout=10)
+
+        class FailingWorker(DeviceWorker):
+            def train_step(self, feed):
+                barrier.wait()  # both workers are mid-step before failing
+                raise ValueError(f"boom{self.worker_id}")
+
+        workers = [self._worker(FailingWorker, i, 2) for i in range(2)]
+        mt = MultiTrainer(workers)
+        with pytest.raises(RuntimeError) as exc:
+            mt._run_inner(self._dataset(8), False, 100, None)
+        msg = str(exc.value)
+        assert "2 trainer worker(s) failed" in msg
+        assert "boom0" in msg and "boom1" in msg
+
+    def test_sibling_failure_stops_survivors_early(self):
+        from paddle_tpu.framework.trainer import DeviceWorker, MultiTrainer
+        # both workers rendezvous inside their FIRST train_step, so the
+        # survivor is already mid-batch when the sibling fails — fully
+        # deterministic: the survivor finishes exactly one step, then the
+        # run loop sees the stop event and exits instead of draining its
+        # remaining 4 shard batches
+        barrier = threading.Barrier(2, timeout=10)
+        trainer_ref = []
+
+        class FailFast(DeviceWorker):
+            def train_step(self, feed):
+                barrier.wait()
+                raise ValueError("boom")
+
+        class Survivor(DeviceWorker):
+            def train_step(self, feed):
+                barrier.wait()
+                assert trainer_ref[0].stop_event.wait(10)
+                return {}
+
+        w0 = self._worker(FailFast, 0, 2)
+        w1 = self._worker(Survivor, 1, 2)
+        mt = MultiTrainer([w0, w1])
+        trainer_ref.append(mt)
+        with pytest.raises(RuntimeError) as exc:
+            mt._run_inner(self._dataset(10), False, 100, None)
+        assert "boom" in str(exc.value)
+        assert w1.steps == 1
+
+    def test_stop_event_preset_skips_all_batches(self):
+        from paddle_tpu.framework.trainer import DeviceWorker
+        ev = threading.Event()
+        ev.set()
+        w = self._worker(DeviceWorker, 0, 1)
+        w.train_step = lambda feed: {}
+        w.run(self._dataset(5), stop_event=ev)
+        assert w.steps == 0
+
+
+class TestElasticHeartbeatRetry:
+    def test_heartbeat_survives_transient_store_faults(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          FileStore)
+        store = FileStore(str(tmp_path / "store"), ttl=60.0)
+        mgr = ElasticManager(store, "job1", rank=0)
+        paddle.set_flags({"FLAGS_retry_max_attempts": 4})
+        faults.configure("store.heartbeat:#1,store.put:#1", seed=0)
+        mgr.heartbeat()  # first put and first refresh fail, retries absorb
+        assert mgr.np() == 1
+        st = faults.stats()
+        assert st["store.heartbeat"]["injected"] == 1
+        assert st["store.put"]["injected"] == 1
+
+    def test_heartbeat_exhaustion_surfaces(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          FileStore)
+        store = FileStore(str(tmp_path / "store"), ttl=60.0)
+        mgr = ElasticManager(store, "job2", rank=0)
+        mgr.heartbeat()
+        faults.configure("store.heartbeat:1.0", seed=0)
+        with pytest.raises(ExecuteError):
+            mgr.heartbeat()
+
+
+class TestCollectiveInjection:
+    def test_all_reduce_fault_injected(self):
+        from paddle_tpu.distributed import collective
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        faults.configure("collective.all_reduce:1.0", seed=0)
+        with pytest.raises(faults.FaultInjected):
+            collective.all_reduce(t)
+        faults.reset()
+        collective.all_reduce(t)  # world_size 1: identity, no fault
+
+    def test_injection_lint_passes(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_injection_points",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+                "tools", "check_injection_points.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.check() == []

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Lint: every FS / collective / checkpoint entry point must carry a
+fault-injection hook.
+
+The resilience subsystem's guarantee — "any storage or collective failure
+mode can be simulated deterministically" — only holds if new entry points
+keep calling ``maybe_inject``. This checker parses the source with ast (no
+imports, no jax) and fails CI when a required entry point has neither a
+``maybe_inject(...)`` call in its body nor a ``@fault_point(...)``
+decorator. Run directly or via tests/test_resilience.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (relative path, scope, names) — scope "class:<Name>" checks methods of that
+# class, "module" checks top-level functions. A name listed for a class is
+# only required if the class defines it (LocalFS has no _run, etc.).
+REQUIRED = [
+    ("paddle_tpu/distributed/fleet/fs.py", "class:LocalFS",
+     ["upload", "download", "mv"]),
+    ("paddle_tpu/distributed/fleet/fs.py", "class:HDFSClient",
+     ["upload", "download", "mv"]),
+    ("paddle_tpu/distributed/collective.py", "module",
+     ["all_reduce", "all_gather", "broadcast", "scatter", "reduce_scatter",
+      "alltoall", "send", "recv", "barrier", "reduce"]),
+    ("paddle_tpu/distributed/fleet/elastic.py", "class:FileStore",
+     ["put", "refresh"]),
+    ("paddle_tpu/incubate/checkpoint.py", "class:CheckpointSaver",
+     ["save_checkpoint"]),
+]
+
+# _injected_run is HDFSClient's hook-carrying chokepoint: routing a call
+# through it counts as hooked (its body holds the maybe_inject)
+HOOK_CALLS = {"maybe_inject", "fault_point", "_injected_run"}
+
+
+def _has_hook(fn_node):
+    for deco in fn_node.decorator_list:
+        call = deco if isinstance(deco, ast.Call) else None
+        name = call.func if call else deco
+        if isinstance(name, ast.Attribute) and name.attr in HOOK_CALLS:
+            return True
+        if isinstance(name, ast.Name) and name.id in HOOK_CALLS:
+            return True
+    for node in ast.walk(fn_node):
+        # direct calls AND hook callables passed to retry_call(...)
+        if isinstance(node, ast.Attribute) and node.attr in HOOK_CALLS:
+            return True
+        if isinstance(node, ast.Name) and node.id in HOOK_CALLS:
+            return True
+    return False
+
+
+def _functions(tree, scope):
+    if scope == "module":
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+        return
+    cls_name = scope.split(":", 1)[1]
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield sub
+
+
+def check(repo=REPO):
+    problems = []
+    for rel, scope, names in REQUIRED:
+        path = os.path.join(repo, rel)
+        if not os.path.exists(path):
+            problems.append(f"{rel}: file missing (lint manifest stale?)")
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        fns = {fn.name: fn for fn in _functions(tree, scope)}
+        for name in names:
+            fn = fns.get(name)
+            if fn is None:
+                continue  # entry point not defined in this scope
+            if not _has_hook(fn):
+                problems.append(
+                    f"{rel}: {scope} {name}() has no fault-injection hook "
+                    "(call resilience.faults.maybe_inject or decorate with "
+                    "@fault_point)")
+    return problems
+
+
+def main():
+    problems = check()
+    if problems:
+        print("fault-injection lint FAILED:")
+        for p in problems:
+            print("  -", p)
+        return 1
+    print("fault-injection lint OK "
+          f"({sum(len(n) for _, _, n in REQUIRED)} entry points checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
