@@ -10,6 +10,8 @@ the reference's use_calc_stream=True semantics (synchronous).
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
+
 import jax
 import jax.numpy as jnp
 from jax import core as jax_core
@@ -121,6 +123,36 @@ def _eager_subgroup_call(g, v, opname, **kw):
     return True, getattr(p2p, opname)(_np.asarray(v), sub, **kw)
 
 
+@contextmanager
+def _watched(op, g, value=None):
+    """Flight-recorder + watchdog wrapper for the eager multi-process tail
+    of a collective. Traced and world_size<=1 paths never reach it — a
+    deadline on an in-trace XLA collective would be meaningless. On failure
+    the recorder is dumped and peers get a best-effort abort broadcast, so
+    a rank dying mid-collective fails its peers in seconds instead of
+    leaving them to idle out the full queue timeout."""
+    from ..resilience.recorder import describe, get_recorder
+    from ..resilience.watchdog import PeerAbort, watch_section
+    rec = get_recorder()
+    shapes, dtypes = describe(value)
+    try:
+        with watch_section(f"collective.{op}"):
+            with rec.record(op, group=getattr(g, "axis", None),
+                            shapes=shapes, dtypes=dtypes):
+                yield
+    except BaseException as err:
+        if not isinstance(err, PeerAbort):
+            # a PeerAbort means someone ELSE already failed and told us;
+            # anything else is OUR failure — tell the peers
+            try:
+                rec.dump(reason=f"failure:collective.{op}")
+                from . import p2p
+                p2p.broadcast_abort(f"collective.{op}", reason=repr(err))
+            except Exception:
+                pass  # diagnostics must not mask the real error
+        raise
+
+
 def _axis_in_scope(axis):
     """True if `axis` is a bound axis name in the current trace (shard_map)."""
     try:
@@ -157,15 +189,16 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return tensor
     if get_world_size() <= 1:
         return tensor
-    handled, res = _eager_subgroup_call(g, v, "group_all_reduce", op=op)
-    if handled:
-        if res is not None:
-            tensor._value = jnp.asarray(res)
+    with _watched("all_reduce", g, v):
+        handled, res = _eager_subgroup_call(g, v, "group_all_reduce", op=op)
+        if handled:
+            if res is not None:
+                tensor._value = jnp.asarray(res)
+            return tensor
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(v)
+        tensor._value = _EAGER_REDUCE[op](gathered, axis=0)
         return tensor
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(v)
-    tensor._value = _EAGER_REDUCE[op](gathered, axis=0)
-    return tensor
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
@@ -185,18 +218,20 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         tensor_list.clear()
         tensor_list.append(Tensor(v))
         return tensor_list
-    handled, res = _eager_subgroup_call(g, v, "group_all_gather")
-    if handled:
-        if res is not None:
-            tensor_list.clear()
-            tensor_list.extend(Tensor(jnp.asarray(res[i]))
-                               for i in range(res.shape[0]))
+    with _watched("all_gather", g, v):
+        handled, res = _eager_subgroup_call(g, v, "group_all_gather")
+        if handled:
+            if res is not None:
+                tensor_list.clear()
+                tensor_list.extend(Tensor(jnp.asarray(res[i]))
+                                   for i in range(res.shape[0]))
+            return tensor_list
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(v)
+        tensor_list.clear()
+        tensor_list.extend(Tensor(gathered[i])
+                           for i in range(gathered.shape[0]))
         return tensor_list
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(v)
-    tensor_list.clear()
-    tensor_list.extend(Tensor(gathered[i]) for i in range(gathered.shape[0]))
-    return tensor_list
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -219,19 +254,20 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         return tensor
     if get_world_size() <= 1:
         return tensor
-    handled, res = _eager_subgroup_call(g, v, "group_broadcast", src=src)
-    if handled:
-        if res is not None:
-            tensor._value = jnp.asarray(res)
+    with _watched("broadcast", g, v):
+        handled, res = _eager_subgroup_call(g, v, "group_broadcast", src=src)
+        if handled:
+            if res is not None:
+                tensor._value = jnp.asarray(res)
+            return tensor
+        # eager DCN broadcast (c_broadcast_op parity): host state may have
+        # diverged across processes — ship src's value only (an allgather
+        # here would move world x nbytes per host)
+        from jax.experimental import multihost_utils
+        import jax as _jax
+        tensor._value = multihost_utils.broadcast_one_to_all(
+            v, is_source=_jax.process_index() == src)
         return tensor
-    # eager DCN broadcast (c_broadcast_op parity): host state may have
-    # diverged across processes — ship src's value only (an allgather here
-    # would move world x nbytes per host)
-    from jax.experimental import multihost_utils
-    import jax as _jax
-    tensor._value = multihost_utils.broadcast_one_to_all(
-        v, is_source=_jax.process_index() == src)
-    return tensor
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -284,24 +320,26 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     if world <= 1:
         tensor._value = v
         return tensor
-    handled, res = _eager_subgroup_call(g, v, "group_reduce_scatter", op=op)
-    if handled:
-        if res is not None:
-            tensor._value = jnp.asarray(res)
+    with _watched("reduce_scatter", g, v):
+        handled, res = _eager_subgroup_call(g, v, "group_reduce_scatter",
+                                            op=op)
+        if handled:
+            if res is not None:
+                tensor._value = jnp.asarray(res)
+            return tensor
+        # eager DCN path (c_reducescatter parity): gather every process's
+        # contribution, reduce, keep this rank's chunk
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(v)  # (world, ...)
+        red = _EAGER_REDUCE[op](gathered, axis=0)
+        if red.shape[0] % world:
+            raise ValueError(
+                f"reduce_scatter dim0 ({red.shape[0]}) not divisible by "
+                f"world size ({world})")
+        chunk = red.shape[0] // world
+        rank = jax.process_index()
+        tensor._value = red[rank * chunk:(rank + 1) * chunk]
         return tensor
-    # eager DCN path (c_reducescatter parity): gather every process's
-    # contribution, reduce, keep this rank's chunk
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(v)  # (world, ...)
-    red = _EAGER_REDUCE[op](gathered, axis=0)
-    if red.shape[0] % world:
-        raise ValueError(
-            f"reduce_scatter dim0 ({red.shape[0]}) not divisible by "
-            f"world size ({world})")
-    chunk = red.shape[0] // world
-    rank = jax.process_index()
-    tensor._value = red[rank * chunk:(rank + 1) * chunk]
-    return tensor
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
@@ -334,29 +372,31 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
                 in_tensor_list if isinstance(in_tensor_list, list) else [x])
             return out_tensor_list
         return x
-    handled, res = _eager_subgroup_call(g, v, "group_alltoall")
-    if handled:
-        if res is None:
-            return out_tensor_list if out_tensor_list is not None else x
+    with _watched("alltoall", g, v):
+        handled, res = _eager_subgroup_call(g, v, "group_alltoall")
+        if handled:
+            if res is None:
+                return out_tensor_list if out_tensor_list is not None else x
+            if out_tensor_list is not None:
+                out_tensor_list.clear()
+                out_tensor_list.extend(
+                    Tensor(jnp.asarray(res[i])) for i in range(res.shape[0]))
+                return out_tensor_list
+            return Tensor(jnp.asarray(res))
+        # eager DCN path (alltoall_op parity): chunk i of rank j goes to rank
+        # i. gathered[j, i] = rank j's chunk i; rank r receives gathered[:, r]
+        if v.shape[0] != world:
+            raise ValueError(
+                f"alltoall needs {world} chunks, got leading dim "
+                f"{v.shape[0]}")
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(v)  # (world, world, ...)
+        mine = gathered[:, jax.process_index()]
         if out_tensor_list is not None:
             out_tensor_list.clear()
-            out_tensor_list.extend(
-                Tensor(jnp.asarray(res[i])) for i in range(res.shape[0]))
+            out_tensor_list.extend(Tensor(mine[i]) for i in range(world))
             return out_tensor_list
-        return Tensor(jnp.asarray(res))
-    # eager DCN path (alltoall_op parity): chunk i of rank j goes to rank i.
-    # gathered[j, i] = rank j's chunk i; this rank r receives gathered[:, r].
-    if v.shape[0] != world:
-        raise ValueError(
-            f"alltoall needs {world} chunks, got leading dim {v.shape[0]}")
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(v)  # (world, world, ...)
-    mine = gathered[:, jax.process_index()]
-    if out_tensor_list is not None:
-        out_tensor_list.clear()
-        out_tensor_list.extend(Tensor(mine[i]) for i in range(world))
-        return out_tensor_list
-    return Tensor(mine)
+        return Tensor(mine)
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
@@ -378,10 +418,11 @@ def send(tensor, dst=0, group=None, sync_op=True):
         return out
     if get_world_size() <= 1:
         return tensor
-    from . import p2p
-    import numpy as _np
-    p2p.send_array(_np.asarray(v), dst, tag=f"sr.{g.id}")
-    return tensor
+    with _watched("send", g, v):
+        from . import p2p
+        import numpy as _np
+        p2p.send_array(_np.asarray(v), dst, tag=f"sr.{g.id}")
+        return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
@@ -394,8 +435,9 @@ def recv(tensor, src=0, group=None, sync_op=True):
     v = unwrap(tensor)
     if _is_traced(v) or get_world_size() <= 1:
         return tensor
-    from . import p2p
-    arr = p2p.recv_array(src, tag=f"sr.{g.id}")
+    with _watched("recv", g, v):
+        from . import p2p
+        arr = p2p.recv_array(src, tag=f"sr.{g.id}")
     if tuple(arr.shape) != tuple(v.shape):
         raise ValueError(
             f"recv shape mismatch: got {tuple(arr.shape)} from rank {src}, "
@@ -417,14 +459,15 @@ def barrier(group=None):
     if get_world_size() <= 1:
         return
     g = group or _default_group()
-    sub = g._eager_subgroup()
-    if sub is not None:
-        if g._member():
-            from . import p2p
-            p2p.group_barrier(sub)
-        return
-    from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    with _watched("barrier", g):
+        sub = g._eager_subgroup()
+        if sub is not None:
+            if g._member():
+                from . import p2p
+                p2p.group_barrier(sub)
+            return
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
 
 
 def split(x, num_or_sections, axis=0, group=None):

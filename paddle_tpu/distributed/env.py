@@ -29,6 +29,10 @@ def init_parallel_env(strategy=None):
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=int(nproc),
                                    process_id=int(pid or 0))
+        # a preempted/killed rank leaves its flight-recorder dump behind so
+        # the survivors' hang reports can be diffed against it
+        from ..resilience.recorder import install_signal_dump
+        install_signal_dump()
     from .mesh import build_mesh
     build_mesh()
     _STATE["initialized"] = True

@@ -44,34 +44,51 @@ class FileStore:
 
     def put(self, key, value):
         maybe_inject("store.put", ExecuteError)
-        with open(self._path(key), "w") as f:
+        # atomic: a reader that races the write must see the old value or
+        # the new one, never a torn JSON prefix (os.replace is atomic on
+        # POSIX; over NFS it is the best available approximation)
+        p = self._path(key)
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(value, f)
+        os.replace(tmp, p)
 
     def refresh(self, key):
         maybe_inject("store.heartbeat", ExecuteError)
         p = self._path(key)
-        if os.path.exists(p):
+        try:
             os.utime(p, None)
+        except FileNotFoundError:
+            pass
 
     def get(self, key):
+        """None for missing, expired, deleted-mid-read, or torn values —
+        a store hiccup must read as 'lease lapsed', not crash the
+        heartbeat/watch loop."""
         p = self._path(key)
-        if not os.path.exists(p):
+        try:
+            if time.time() - os.path.getmtime(p) > self.ttl:
+                return None  # lease expired
+            with open(p) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
             return None
-        if time.time() - os.path.getmtime(p) > self.ttl:
-            return None  # lease expired
-        with open(p) as f:
-            return json.load(f)
 
     def alive_values(self, prefix):
-        """Values of all non-expired keys under prefix."""
+        """Values of all non-expired keys under prefix. Keys deleted between
+        listdir and open, and torn writes, count as expired."""
         out = []
         for name in sorted(os.listdir(self.root)):
-            if not name.startswith(prefix.replace("/", "_")):
+            if not name.startswith(prefix.replace("/", "_")) \
+                    or ".tmp." in name:
                 continue
             p = os.path.join(self.root, name)
-            if time.time() - os.path.getmtime(p) <= self.ttl:
-                with open(p) as f:
-                    out.append(json.load(f))
+            try:
+                if time.time() - os.path.getmtime(p) <= self.ttl:
+                    with open(p) as f:
+                        out.append(json.load(f))
+            except (FileNotFoundError, json.JSONDecodeError):
+                continue
         return out
 
     def delete(self, key):
@@ -105,6 +122,11 @@ class ElasticManager:
                    max_backoff=self.ttl_guard())
         self._registered = True
         self._last_np = self.np()
+        # hang diagnostics: when the watchdog expires a section on this
+        # rank, it writes an unhealthy.<rank> key so the manager (and every
+        # peer) can name the stuck rank instead of just seeing a hang
+        from ...resilience import watchdog as _watchdog
+        _watchdog.set_health_marker(self.mark_unhealthy)
 
     def heartbeat(self):
         """Lease refresh with retry: a transient store hiccup (NFS blip, GCS
@@ -125,6 +147,22 @@ class ElasticManager:
         if self._registered:
             self.store.delete(self._key)
             self._registered = False
+
+    # -- health ------------------------------------------------------------
+    def mark_unhealthy(self, section="", info=None):
+        """Record this rank as unhealthy (watchdog expiry, hang detection).
+        Best-effort: the marker runs on the failure path and must never
+        mask the original diagnosis."""
+        payload = {"rank": self.rank, "endpoint": self.endpoint,
+                   "section": section, "ts": time.time()}
+        payload.update(info or {})
+        try:
+            self.store.put(f"{self.job_id}/unhealthy.{self.rank}", payload)
+        except Exception:
+            pass
+
+    def unhealthy_nodes(self):
+        return self.store.alive_values(f"{self.job_id}/unhealthy.")
 
     # -- membership --------------------------------------------------------
     def alive_nodes(self):
@@ -153,10 +191,17 @@ class ElasticManager:
 
     def watch(self, until=None, on_restart=None):
         """Heartbeat + watch membership until `until()` returns True.
-        Calls on_restart(new_np) on scale events; returns final status."""
+        Calls on_restart(new_np) on scale events; returns final status.
+
+        Each iteration runs under a watchdog section: a store that blocks
+        (NFS stall, GCS outage) dumps diagnostics and fails this loop with
+        DistributedTimeout instead of silently wedging the relaunch logic.
+        """
+        from ...resilience.watchdog import watch_section
         while True:
-            self.heartbeat()
-            cur = self.np()
+            with watch_section("elastic.watch"):
+                self.heartbeat()
+                cur = self.np()
             if self._last_np is not None and cur != self._last_np and \
                     cur >= self.np_min:
                 self._last_np = cur

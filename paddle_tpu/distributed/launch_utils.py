@@ -194,6 +194,27 @@ def terminate_local_procs(procs, timeout=15):
             tp.log_fn = None
 
 
+def _flight_recorder_hint(rank, n=3):
+    """Tail of the failed rank's flight-recorder dump (if it left one in the
+    artifacts dir), so the launcher's error names the suspect collective.
+    Full cross-rank diagnosis: tools/flight_recorder_diff.py <artifacts>."""
+    import json
+    try:
+        from paddle_tpu.resilience.recorder import dump_path_for_rank
+        with open(dump_path_for_rank(rank)) as f:
+            data = json.load(f)
+    except (ImportError, OSError, ValueError):
+        return ""
+    entries = data.get("entries", [])[-n:]
+    if not entries:
+        return ""
+    ops = ", ".join(f"{e.get('op')}#{e.get('seq')}[{e.get('status')}]"
+                    for e in entries)
+    return (f" | rank {rank} flight recorder tail ({data.get('reason')}): "
+            f"{ops} — run tools/flight_recorder_diff.py on the artifacts "
+            "dir to find the first divergent collective")
+
+
 def watch_local_trainers(procs, nranks=None, poll_interval=0.5):
     """launch_utils.py:578 parity: block until all trainers exit cleanly or
     one fails (then terminate the rest). Returns the list of exit codes."""
@@ -208,7 +229,8 @@ def watch_local_trainers(procs, nranks=None, poll_interval=0.5):
                 if ret != 0:
                     raise RuntimeError(
                         f"trainer rank {tp.rank} exited with code {ret} "
-                        f"(cmd: {' '.join(tp.cmd)})")
+                        f"(cmd: {' '.join(tp.cmd)})"
+                        f"{_flight_recorder_hint(tp.rank)}")
             time.sleep(poll_interval)
     except (RuntimeError, KeyboardInterrupt):
         terminate_local_procs(procs)

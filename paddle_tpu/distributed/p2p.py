@@ -34,16 +34,35 @@ import time
 
 import numpy as np
 
+from ..resilience.faults import maybe_inject
+from ..resilience.recorder import get_recorder
+from ..resilience.watchdog import PeerAbort, watch_section
 from . import wire
 
 __all__ = ["send_obj", "recv_obj", "send_array", "recv_array",
            "group_all_reduce", "group_all_gather", "group_broadcast",
            "group_reduce_scatter",
-           "group_alltoall", "group_barrier", "endpoints", "shutdown"]
+           "group_alltoall", "group_barrier", "endpoints", "shutdown",
+           "broadcast_abort", "PeerAbort"]
 
 _CONNECT_TIMEOUT = float(os.environ.get("PADDLE_TPU_P2P_CONNECT_TIMEOUT",
                                         "60"))
-_RECV_TIMEOUT = float(os.environ.get("PADDLE_TPU_P2P_RECV_TIMEOUT", "300"))
+# reader threads wake this often even with no traffic, so a closing channel
+# or an abort can be noticed without a frame arriving
+_READER_TIMEOUT = float(os.environ.get("PADDLE_TPU_P2P_READER_TIMEOUT", "30"))
+
+_ABORT_TAG = "__abort__"
+_ABORT_SENTINEL = object()
+
+
+def _recv_timeout():
+    """Deadline for one blocking recv: env override, else the watchdog's
+    FLAGS_collective_timeout (the old flat 300 s is now just the default)."""
+    v = os.environ.get("PADDLE_TPU_P2P_RECV_TIMEOUT")
+    if v is not None:
+        return float(v)
+    from ..framework.flags import get_flag
+    return float(get_flag("FLAGS_collective_timeout", 300.0))
 
 
 def _rank_world():
@@ -96,6 +115,7 @@ class _Channel:
         self.out = {}
         self.out_lock = threading.Lock()
         self.closing = False
+        self.aborts = {}  # src rank -> {"section", "reason", ...}
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="p2p-accept")
         t.start()
@@ -121,23 +141,52 @@ class _Channel:
     def _reader(self, conn):
         try:
             while True:
-                frame = wire.recv_frame(conn)
+                try:
+                    frame = wire.recv_frame(conn, timeout=_READER_TIMEOUT,
+                                            idle_ok=True)
+                except wire.IdleTimeout:
+                    # no traffic is normal; a timeout MID-frame is not (it
+                    # raises FrameError below and drops the connection)
+                    if self.closing:
+                        return
+                    continue
                 if not (isinstance(frame, dict) and "src" in frame
                         and "tag" in frame):
                     continue  # not ours; drop
+                if frame["tag"] == _ABORT_TAG:
+                    self._on_abort(int(frame["src"]),
+                                   frame.get("payload") or {})
+                    continue
                 self._queue(int(frame["src"]), frame["tag"]).put(
                     frame.get("payload"))
         except (ConnectionError, OSError, wire.FrameError):
             conn.close()
 
+    def _on_abort(self, src, info):
+        """A peer announced its death: remember it and wake every blocked
+        recv so survivors fail in seconds, not at the queue timeout."""
+        self.aborts[src] = info
+        with self.inbox_lock:
+            queues = list(self.inbox.values())
+        for q in queues:
+            q.put(_ABORT_SENTINEL)
+
+    def _raise_abort(self):
+        src = min(self.aborts)
+        info = self.aborts[src]
+        raise PeerAbort(src, section=info.get("section", ""),
+                        reason=info.get("reason", ""))
+
     # -- send side ------------------------------------------------------------
-    def _sock_to(self, dst):
+    def _sock_to(self, dst, connect_timeout=None):
         with self.out_lock:
             s = self.out.get(dst)
             if s is not None:
                 return s
             host, port = self.eps[dst].rsplit(":", 1)
-            deadline = time.time() + _CONNECT_TIMEOUT
+            budget = _CONNECT_TIMEOUT if connect_timeout is None \
+                else connect_timeout
+            deadline = time.time() + budget
             last = None
             while time.time() < deadline:
                 try:
@@ -152,20 +201,47 @@ class _Channel:
             raise ConnectionError(
                 f"p2p connect to rank {dst} ({self.eps[dst]}) failed: {last}")
 
-    def send(self, dst, tag, payload):
+    def _drop_sock(self, dst):
+        with self.out_lock:
+            s = self.out.pop(dst, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def send(self, dst, tag, payload, connect_timeout=None):
         if dst == self.rank:
             self._queue(self.rank, tag).put(payload)
             return
-        s = self._sock_to(dst)
-        wire.send_frame(s, {"src": self.rank, "tag": tag, "payload": payload})
+        frame = {"src": self.rank, "tag": tag, "payload": payload}
+        s = self._sock_to(dst, connect_timeout=connect_timeout)
+        try:
+            wire.send_frame(s, frame)
+        except (ConnectionError, TimeoutError, OSError):
+            # the cached socket died while idle (peer restart, LB reset):
+            # reconnect ONCE and resend — the frame never hit the old wire,
+            # so no duplication is possible. A failure on the fresh socket
+            # means the peer is really gone; let it propagate.
+            self._drop_sock(dst)
+            s = self._sock_to(dst, connect_timeout=connect_timeout)
+            wire.send_frame(s, frame)
 
     def recv(self, src, tag, timeout=None):
+        if self.aborts:
+            self._raise_abort()
+        t = _recv_timeout() if timeout is None else timeout
         try:
-            return self._queue(src, tag).get(
-                timeout=timeout or _RECV_TIMEOUT)
+            v = self._queue(src, tag).get(timeout=t)
         except queue.Empty:
             raise TimeoutError(
-                f"p2p recv from rank {src} tag {tag!r} timed out") from None
+                f"p2p recv from rank {src} tag {tag!r} timed out "
+                f"after {t:.1f}s") from None
+        if v is _ABORT_SENTINEL:
+            if self.aborts:
+                self._raise_abort()
+            raise ConnectionError("p2p channel aborted")
+        return v
 
     def close(self):
         self.closing = True
@@ -199,6 +275,35 @@ def shutdown():
         if _CHAN[0] is not None:
             _CHAN[0].close()
             _CHAN[0] = None
+    _SEQ.clear()
+
+
+def broadcast_abort(section, reason=""):
+    """Announce this rank's failure to every peer (best-effort, bounded).
+
+    Peers blocked in `recv` then fail within seconds with "rank N aborted
+    in <section>" instead of idling out their full collective timeout. Only
+    an EXISTING channel is used — a rank that never opened the p2p channel
+    has no peers waiting on it. Returns how many peers were notified.
+    """
+    with _CHAN_LOCK:
+        chan = _CHAN[0]
+    if chan is None or chan.closing:
+        return 0
+    payload = {"section": section, "reason": reason, "rank": chan.rank}
+    notified = 0
+    for dst in range(chan.world):
+        if dst == chan.rank:
+            continue
+        try:
+            # short connect budget: the exit path must not spend
+            # _CONNECT_TIMEOUT per already-dead peer
+            chan.send(dst, _ABORT_TAG, payload,
+                      connect_timeout=min(5.0, _CONNECT_TIMEOUT))
+            notified += 1
+        except (ConnectionError, TimeoutError, OSError):
+            continue
+    return notified
 
 
 def _next_seq(key):
@@ -211,15 +316,26 @@ def _next_seq(key):
 # -- p2p API -----------------------------------------------------------------
 
 def send_obj(payload, dst, tag="p2p"):
+    maybe_inject("p2p.send", ConnectionError)
+    from ..resilience.recorder import describe
     seq = _next_seq(("s", dst, tag))
-    _channel().send(dst, (tag, seq), payload)
+    shapes, dtypes = describe(payload)
+    with watch_section(f"p2p.send[{tag}->{dst}]"):
+        with get_recorder().record("p2p.send", group=tag, seq=seq, peer=dst,
+                                   shapes=shapes, dtypes=dtypes):
+            _channel().send(dst, (tag, seq), payload)
 
 
 def recv_obj(src, tag="p2p", timeout=None):
+    maybe_inject("p2p.recv", ConnectionError)
+    from ..resilience.watchdog import DistributedTimeout
     seq = _next_seq(("r", src, tag))
     try:
-        return _channel().recv(src, (tag, seq), timeout=timeout)
-    except TimeoutError:
+        with watch_section(f"p2p.recv[{tag}<-{src}]", timeout=timeout):
+            with get_recorder().record("p2p.recv", group=tag, seq=seq,
+                                       peer=src):
+                return _channel().recv(src, (tag, seq), timeout=timeout)
+    except (TimeoutError, DistributedTimeout):
         # roll the counter back so a retry waits on the SAME slot — a
         # consumed seq would desynchronize the (src, tag) stream forever
         _SEQ[("r", src, tag)] -= 1
@@ -248,17 +364,21 @@ def _root_exchange(value, ranks, tag, compute_per_rank):
     me = chan.rank
     root = ranks[0]
     seq = _next_seq(("g", tuple(ranks), tag))
-    if me == root:
-        vals = [None] * len(ranks)
-        vals[0] = np.asarray(value)
-        for i, r in enumerate(ranks[1:], start=1):
-            vals[i] = chan.recv(r, (tag, seq))
-        outs = compute_per_rank(vals)
-        for i, r in enumerate(ranks[1:], start=1):
-            chan.send(r, (tag + ".out", seq), outs[i])
-        return outs[0]
-    chan.send(root, (tag, seq), np.asarray(value))
-    return chan.recv(root, (tag + ".out", seq))
+    from ..resilience.recorder import describe
+    shapes, dtypes = describe(value)
+    with get_recorder().record(f"p2p.group.{tag}", group=str(tuple(ranks)),
+                               seq=seq, shapes=shapes, dtypes=dtypes):
+        if me == root:
+            vals = [None] * len(ranks)
+            vals[0] = np.asarray(value)
+            for i, r in enumerate(ranks[1:], start=1):
+                vals[i] = chan.recv(r, (tag, seq))
+            outs = compute_per_rank(vals)
+            for i, r in enumerate(ranks[1:], start=1):
+                chan.send(r, (tag + ".out", seq), outs[i])
+            return outs[0]
+        chan.send(root, (tag, seq), np.asarray(value))
+        return chan.recv(root, (tag + ".out", seq))
 
 
 _REDUCE_NP = {"sum": lambda a: np.sum(a, axis=0),
@@ -331,6 +451,9 @@ def group_alltoall(value, ranks):
 
 
 def group_barrier(ranks):
+    maybe_inject("p2p.barrier", ConnectionError)
+
     def compute(vals):
         return [np.zeros((), np.int32)] * len(vals)
-    _root_exchange(np.zeros((), np.int32), list(ranks), "bar", compute)
+    with watch_section(f"p2p.barrier{tuple(ranks)}"):
+        _root_exchange(np.zeros((), np.int32), list(ranks), "bar", compute)

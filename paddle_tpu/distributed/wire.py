@@ -19,14 +19,37 @@ import struct
 
 import numpy as np
 
-__all__ = ["encode", "decode", "send_frame", "recv_frame", "FrameError"]
+from ..resilience.faults import maybe_inject
+
+__all__ = ["encode", "decode", "send_frame", "recv_frame", "FrameError",
+           "IdleTimeout"]
 
 _MAX_FRAME = 1 << 33  # 8 GiB sanity bound
 _MAX_DEPTH = 64
 
 
+def _io_timeout():
+    """Default deadline for one framed read/write. Env wins; falls back to
+    FLAGS_collective_timeout so a dead peer can't pin a reader forever."""
+    v = os.environ.get("PADDLE_TPU_WIRE_TIMEOUT")
+    if v is not None:
+        return float(v) or None  # 0 disables (tests, trusted local pipes)
+    try:
+        from ..framework.flags import get_flag
+        return float(get_flag("FLAGS_collective_timeout", 300.0))
+    except ImportError:
+        return 300.0
+
+
 class FrameError(ValueError):
     pass
+
+
+class IdleTimeout(TimeoutError):
+    """recv_frame timed out with ZERO bytes consumed — the stream is still
+    framed; a reader loop may safely keep waiting. A timeout after partial
+    consumption instead raises FrameError: the stream lost sync and the
+    connection must be dropped."""
 
 
 def _secret():
@@ -180,7 +203,19 @@ def _dec(r, depth=0):
             raise FrameError(f"disallowed array dtype {dt}")
         (ndim,) = r.unpack("<B")
         shape = r.unpack(f"<{ndim}q") if ndim else ()
+        # the shape fields are signed (<q): a corrupt/hostile frame can carry
+        # negative dims or a count that disagrees with the payload length —
+        # both must be FrameError, not a confusing numpy error downstream
+        if any(d < 0 for d in shape):
+            raise FrameError(f"negative array dim in {shape}")
         (nraw,) = r.unpack("<Q")
+        count = 1
+        for d in shape:
+            count *= d
+        if count * dt.itemsize != nraw:
+            raise FrameError(
+                f"array payload size mismatch: shape {tuple(shape)} x "
+                f"{dt} needs {count * dt.itemsize} bytes, frame has {nraw}")
         raw = r.take(nraw)
         return np.frombuffer(raw, dtype=dt).reshape(shape).copy()
     raise FrameError(f"bad tag {tag!r}")
@@ -196,11 +231,19 @@ def decode(buf):
 
 # -- framed socket IO --------------------------------------------------------
 
-def _recv_exact(sock, n):
+def _recv_exact(sock, n, idle_ok=False):
     chunks = []
     got = 0
     while got < n:
-        c = sock.recv(min(n - got, 1 << 20))
+        try:
+            c = sock.recv(min(n - got, 1 << 20))
+        except TimeoutError:
+            if idle_ok and got == 0:
+                raise IdleTimeout("no frame within socket timeout") from None
+            # partial frame + timeout = the stream lost sync; the only safe
+            # recovery is dropping the connection
+            raise FrameError(
+                f"socket timed out mid-frame ({got}/{n} bytes)") from None
         if not c:
             raise ConnectionError("peer closed")
         chunks.append(c)
@@ -208,7 +251,15 @@ def _recv_exact(sock, n):
     return b"".join(chunks)
 
 
-def send_frame(sock, obj):
+def send_frame(sock, obj, timeout=...):
+    """Send one frame. timeout: seconds for the whole sendall (None = block
+    forever; default from PADDLE_TPU_WIRE_TIMEOUT / FLAGS_collective_timeout)
+    — a dead peer with a full TCP buffer must not hang the sender."""
+    maybe_inject("wire.send_frame", ConnectionError)
+    if timeout is ...:
+        timeout = _io_timeout()
+    if timeout is not None:
+        sock.settimeout(timeout)
     payload = encode(obj)
     secret = _secret()
     mac = hmac.new(secret, payload, hashlib.sha256).digest() if secret \
@@ -216,8 +267,17 @@ def send_frame(sock, obj):
     sock.sendall(struct.pack("<QB", len(payload), len(mac)) + mac + payload)
 
 
-def recv_frame(sock):
-    n, maclen = struct.unpack("<QB", _recv_exact(sock, 9))
+def recv_frame(sock, timeout=..., idle_ok=False):
+    """Receive one frame. timeout bounds every read (None = block forever;
+    default as in send_frame). With idle_ok=True a timeout BEFORE the first
+    header byte raises IdleTimeout (reader loops keep waiting); a timeout
+    mid-frame always raises FrameError (stream desynced, drop the socket)."""
+    maybe_inject("wire.recv_frame", ConnectionError)
+    if timeout is ...:
+        timeout = _io_timeout()
+    if timeout is not None:
+        sock.settimeout(timeout)
+    n, maclen = struct.unpack("<QB", _recv_exact(sock, 9, idle_ok=idle_ok))
     if n > _MAX_FRAME:
         raise FrameError(f"frame too large ({n})")
     mac = _recv_exact(sock, maclen) if maclen else b""

@@ -33,6 +33,14 @@ _FLAGS: dict[str, Any] = {
     # consecutive non-finite steps before StepGuard rolls back to the last
     # auto-checkpoint
     "FLAGS_guard_max_bad_steps": 3,
+    # hang detection (paddle_tpu/resilience/{watchdog,recorder}.py):
+    # deadline for one eager collective / p2p op / elastic store roundtrip
+    "FLAGS_collective_timeout": 300.0,
+    # how often the watchdog monitor thread checks section deadlines
+    "FLAGS_watchdog_interval": 5.0,
+    # flight-recorder ring size (entries); dumps land in
+    # PADDLE_TPU_ARTIFACTS_DIR as flight_recorder_rank<N>.json
+    "FLAGS_flight_recorder_size": 1024,
     # inert reference flags accepted for script compatibility
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_allocator_strategy": "auto_growth",

@@ -143,11 +143,29 @@ class MultiTrainer:
             errors.sort(key=lambda we: we[0])
             detail = "; ".join(f"worker {wid}: {err!r}"
                                for wid, err in errors)
+            detail += self._hang_diagnostic(errors)
             raise RuntimeError(
                 f"{len(errors)} trainer worker(s) failed: {detail}"
             ) from errors[0][1]
         from ..resilience import preempt
         preempt.check()
+
+    @staticmethod
+    def _hang_diagnostic(errors):
+        """When a worker died of a distributed timeout/abort, fold the
+        flight recorder's tail into the aggregated error so the failing
+        collective is named in the exception itself, not just in a dump
+        file the operator has to know to look for."""
+        from ..resilience.watchdog import DistributedError
+        if not any(isinstance(err, DistributedError) for _, err in errors):
+            return ""
+        from ..resilience.recorder import get_recorder
+        tail = get_recorder().tail(3)
+        if not tail:
+            return ""
+        ops = ", ".join(
+            f"{e['op']}#{e['seq']}[{e['status']}]" for e in tail)
+        return f" | flight recorder tail: {ops}"
 
     @property
     def total_steps(self):

@@ -1,11 +1,11 @@
-"""Resilience subsystem: fault injection, retry/backoff, step guard, and
-preemption-safe checkpointing.
+"""Resilience subsystem: fault injection, retry/backoff, step guard,
+preemption-safe checkpointing, and distributed hang detection.
 
 The fault model and integration contract live in docs/resilience.md. The
-four modules compose:
+modules compose:
 
 - :mod:`.faults` — deterministic, flag-driven fault-injection registry;
-  every storage/collective/checkpoint entry point calls
+  every storage/collective/checkpoint/transport entry point calls
   ``maybe_inject("<domain>.<op>")`` (enforced by
   tools/check_injection_points.py).
 - :mod:`.retry` — exponential-backoff retry shared by FS transfer paths,
@@ -13,19 +13,33 @@ four modules compose:
 - :mod:`.guard` — step-boundary NaN/Inf containment for compiled train
   steps (skip + loss-scale backoff + rollback-to-checkpoint).
 - :mod:`.preempt` — SIGTERM → emergency checkpoint → resumable exit.
+- :mod:`.recorder` — collective flight recorder: per-process ring buffer of
+  eager collective / p2p ops, dumped as JSON for cross-rank hang diagnosis
+  (tools/flight_recorder_diff.py).
+- :mod:`.watchdog` — deadlines on blocking distributed sections; expiry
+  dumps the recorder + thread stacks, marks the rank unhealthy in the
+  elastic store, aborts peers, and raises :class:`DistributedTimeout`.
 """
 from __future__ import annotations
 
 from . import faults  # noqa: F401
 from . import guard  # noqa: F401
 from . import preempt  # noqa: F401
+from . import recorder  # noqa: F401
 from . import retry  # noqa: F401
+from . import watchdog  # noqa: F401
 from .faults import FaultInjected, fault_point, maybe_inject  # noqa: F401
 from .guard import BadStepError, StepGuard  # noqa: F401
 from .preempt import Preempted, PreemptionCallback, PreemptionHandler  # noqa: F401
+from .recorder import FlightRecorder, get_recorder  # noqa: F401
 from .retry import retry_call  # noqa: F401
+from .watchdog import (  # noqa: F401
+    DistributedError, DistributedTimeout, PeerAbort, Watchdog, watch_section,
+)
 
-__all__ = ["faults", "retry", "guard", "preempt", "maybe_inject",
-           "fault_point", "FaultInjected", "StepGuard", "BadStepError",
-           "Preempted", "PreemptionHandler", "PreemptionCallback",
-           "retry_call"]
+__all__ = ["faults", "retry", "guard", "preempt", "recorder", "watchdog",
+           "maybe_inject", "fault_point", "FaultInjected", "StepGuard",
+           "BadStepError", "Preempted", "PreemptionHandler",
+           "PreemptionCallback", "retry_call", "FlightRecorder",
+           "get_recorder", "Watchdog", "watch_section", "DistributedError",
+           "DistributedTimeout", "PeerAbort"]

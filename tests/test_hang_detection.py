@@ -1,0 +1,630 @@
+"""Distributed hang-detection chaos suite (docs/resilience.md runbook).
+
+Covers the flight recorder ring, the watchdog deadline monitor, the
+cross-rank dump diff, the p2p abort-propagation path, and the FileStore
+hardening. Watchdog/recorder chaos is driven by an injected fake clock —
+detection is advanced by calling :meth:`Watchdog.poll` directly, so the
+deadline tests need NO real sleeps. The p2p transport tests use real
+sockets with sub-second timeouts and bounded joins.
+"""
+import importlib.util
+import json
+import os
+import socket
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import p2p
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, FileStore
+from paddle_tpu.distributed.launch_utils import find_free_ports
+from paddle_tpu.resilience import faults, preempt, recorder, watchdog
+from paddle_tpu.resilience.recorder import FlightRecorder, describe
+from paddle_tpu.resilience.watchdog import (
+    DistributedTimeout, PeerAbort, Watchdog, watch_section,
+)
+
+pytestmark = pytest.mark.chaos
+
+REPO = Path(__file__).resolve().parents[1]
+
+_spec = importlib.util.spec_from_file_location(
+    "flight_recorder_diff", str(REPO / "tools" / "flight_recorder_diff.py"))
+frd = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(frd)
+
+
+@pytest.fixture(autouse=True)
+def _clean_hang_state(tmp_path, monkeypatch):
+    """Fresh registry/recorder/watchdog per test, artifacts into tmp_path,
+    zero retry backoff so nothing really sleeps."""
+    monkeypatch.setenv("PADDLE_TPU_ARTIFACTS_DIR", str(tmp_path / "artifacts"))
+    paddle.set_flags({"FLAGS_retry_backoff_base": 0.0})
+    faults.reset()
+    recorder.reset()
+    watchdog.reset()
+    yield
+    faults.reset()
+    recorder.reset()
+    watchdog.reset()
+    preempt.uninstall()
+    p2p.shutdown()
+    paddle.set_flags({"FLAGS_retry_backoff_base": 0.5,
+                      "FLAGS_collective_timeout": 300.0,
+                      "FLAGS_watchdog_interval": 5.0,
+                      "FLAGS_flight_recorder_size": 1024})
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- flight recorder ----------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = FlightRecorder(size=4, rank=0, clock=FakeClock())
+        for _ in range(10):
+            with rec.record("all_reduce", group="data"):
+                pass
+        ents = rec.entries()
+        assert len(ents) == 4
+        assert [e["seq"] for e in ents] == [7, 8, 9, 10]
+
+    def test_record_statuses(self):
+        rec = FlightRecorder(size=8, rank=0, clock=FakeClock())
+        with rec.record("broadcast", group="model"):
+            pass
+        with pytest.raises(ConnectionError):
+            with rec.record("broadcast", group="model"):
+                raise ConnectionError("peer died")
+        entry = rec.start("broadcast", group="model")  # never finished
+        ok, err, hung = rec.entries()
+        assert ok["status"] == "ok" and ok["t_end"] is not None
+        assert err["status"] == "ConnectionError"
+        assert hung["status"] == "started" and hung["t_end"] is None
+        assert entry["seq"] == 3
+
+    def test_seq_streams_are_per_op_group(self):
+        rec = FlightRecorder(size=8, rank=0, clock=FakeClock())
+        a = rec.start("all_reduce", group="data")
+        b = rec.start("all_reduce", group="model")
+        c = rec.start("all_reduce", group="data")
+        assert (a["seq"], b["seq"], c["seq"]) == (1, 1, 2)
+
+    def test_dump_is_atomic_json(self, tmp_path):
+        clock = FakeClock(100.0)
+        rec = FlightRecorder(size=8, rank=3, clock=clock,
+                             artifacts=str(tmp_path))
+        with rec.record("all_gather", group="data",
+                        shapes=[[2, 2]], dtypes=["float32"]):
+            clock.advance(0.5)
+        path = rec.dump(reason="unit-test")
+        assert path == recorder.dump_path_for_rank(3, str(tmp_path))
+        with open(path) as f:
+            d = json.load(f)
+        assert d["rank"] == 3 and d["reason"] == "unit-test"
+        (e,) = d["entries"]
+        assert e["op"] == "all_gather" and e["shapes"] == [[2, 2]]
+        assert e["t_end"] - e["t_start"] == pytest.approx(0.5)
+        # atomic: no temp file left next to the dump
+        assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+        assert rec.dump_count == 1
+
+    def test_size_comes_from_flags(self):
+        paddle.set_flags({"FLAGS_flight_recorder_size": 2})
+        recorder.reset()
+        rec = recorder.get_recorder()
+        assert rec.size == 2
+
+    def test_describe(self):
+        assert describe(None) == (None, None)
+        shapes, dtypes = describe(np.zeros((2, 3), "float32"))
+        assert shapes == [[2, 3]] and dtypes == ["float32"]
+        shapes, dtypes = describe([np.zeros(4, "int32"), 7])
+        assert shapes == [[4], []] and dtypes[0] == "int32"
+
+
+# -- cross-rank diff ----------------------------------------------------------
+
+def _entry(op, seq, status, t, group="data"):
+    return {"op": op, "group": group, "seq": seq, "status": status,
+            "t_start": t, "t_end": None if status == "started" else t + 1}
+
+
+def _dump(rank, entries):
+    return {"version": 1, "rank": rank, "reason": "test", "entries": entries}
+
+
+class TestFlightRecorderDiff:
+    def test_agreeing_streams_have_no_divergence(self):
+        ents = [_entry("all_reduce", 1, "ok", 0.0),
+                _entry("all_reduce", 2, "ok", 1.0)]
+        assert frd.diff_dumps({0: _dump(0, ents), 1: _dump(1, ents)}) is None
+
+    def test_missing_rank_named_first(self):
+        d0 = _dump(0, [_entry("all_reduce", 1, "ok", 0.0),
+                       _entry("all_reduce", 2, "TimeoutError", 1.0)])
+        d1 = _dump(1, [_entry("all_reduce", 1, "ok", 0.0)])
+        div = frd.diff_dumps({0: d0, 1: d1})
+        assert div["kind"] == "missing"
+        assert (div["op"], div["seq"]) == ("all_reduce", 2)
+        assert div["missing_ranks"] == [1]
+
+    def test_hung_rank_named(self):
+        d0 = _dump(0, [_entry("broadcast", 1, "ok", 0.0)])
+        d1 = _dump(1, [_entry("broadcast", 1, "started", 0.0)])
+        div = frd.diff_dumps({0: d0, 1: d1})
+        assert div["kind"] == "hung"
+        assert div["pending_ranks"] == [1]
+        assert "rank" in frd.format_report(div)
+
+    def test_status_divergence(self):
+        d0 = _dump(0, [_entry("barrier", 1, "ok", 0.0)])
+        d1 = _dump(1, [_entry("barrier", 1, "ConnectionError", 0.0)])
+        div = frd.diff_dumps({0: d0, 1: d1})
+        assert div["kind"] == "status"
+        assert div["status_by_rank"] == {0: "ok", 1: "ConnectionError"}
+
+    def test_first_divergence_wins(self):
+        # divergences at seq 2 (hung) and seq 3 (missing): seq 2 reported
+        d0 = _dump(0, [_entry("all_reduce", 1, "ok", 0.0),
+                       _entry("all_reduce", 2, "ok", 1.0),
+                       _entry("all_reduce", 3, "ok", 2.0)])
+        d1 = _dump(1, [_entry("all_reduce", 1, "ok", 0.0),
+                       _entry("all_reduce", 2, "started", 1.0)])
+        div = frd.diff_dumps({0: d0, 1: d1})
+        assert (div["kind"], div["seq"]) == ("hung", 2)
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        ok = [_entry("all_reduce", 1, "ok", 0.0)]
+        bad = [_entry("all_reduce", 1, "started", 0.0)]
+        agree = tmp_path / "agree"
+        agree.mkdir()
+        for r in (0, 1):
+            with open(recorder.dump_path_for_rank(r, str(agree)), "w") as f:
+                json.dump(_dump(r, ok), f)
+        assert frd.main([str(agree)]) == 0
+        diverge = tmp_path / "diverge"
+        diverge.mkdir()
+        for r, ents in ((0, ok), (1, bad)):
+            with open(recorder.dump_path_for_rank(r, str(diverge)), "w") as f:
+                json.dump(_dump(r, ents), f)
+        assert frd.main([str(diverge)]) == 1
+        out = capsys.readouterr().out
+        assert "op='all_reduce' seq=1" in out
+        assert frd.main([]) == 2                       # no input
+        assert frd.main(["--help"]) == 0
+        assert frd.main([str(diverge / "flight_recorder_rank0.json")]) == 2
+        torn = tmp_path / "torn.json"
+        torn.write_text("{not json")
+        assert frd.main([str(torn)]) == 2
+
+
+# -- watchdog -----------------------------------------------------------------
+
+class TestWatchdog:
+    def _mk(self, tmp_path):
+        clock = FakeClock()
+        rec = FlightRecorder(size=32, rank=0, clock=clock,
+                             artifacts=str(tmp_path))
+        wd = Watchdog(clock=clock, recorder=rec, artifacts=str(tmp_path))
+        return clock, rec, wd
+
+    def test_no_expiry_before_deadline(self, tmp_path):
+        clock, rec, wd = self._mk(tmp_path)
+        with watch_section("collective.all_reduce", timeout=60, watchdog=wd):
+            clock.advance(59.0)
+            assert wd.poll() == []
+        assert rec.dump_count == 0
+        assert wd.active_sections() == []
+
+    def test_injected_clock_never_spawns_monitor_thread(self, tmp_path):
+        _, _, wd = self._mk(tmp_path)
+        sec = wd.register("x", timeout=1)
+        assert wd._monitor is None
+        wd.unregister(sec)
+
+    def test_expiry_dumps_marks_and_raises(self, tmp_path):
+        clock, rec, wd = self._mk(tmp_path)
+        marked = []
+        wd.set_health_marker(marked.append)
+        with pytest.raises(DistributedTimeout) as ei:
+            with watch_section("collective.all_reduce", timeout=60,
+                               watchdog=wd):
+                with rec.record("all_reduce", group="data"):
+                    clock.advance(61.0)
+                    expired = wd.poll()
+                    assert [s.name for s in expired] == \
+                        ["collective.all_reduce"]
+                    assert wd.poll() == []  # fires once per section
+        err = ei.value
+        assert err.section == "collective.all_reduce" and err.rank == 0
+        assert err.timeout == 60.0 and err.elapsed == pytest.approx(61.0)
+        assert "exceeded its 60.0s deadline" in str(err)
+        assert err.dump_path and os.path.exists(err.dump_path)
+        # the dump was taken at detection time: the op is still "started"
+        with open(err.dump_path) as f:
+            (e,) = json.load(f)["entries"]
+        assert e["status"] == "started"
+        assert marked == ["collective.all_reduce"]
+        assert os.path.exists(tmp_path / "thread_stacks_rank0.txt")
+
+    def test_transport_timeout_converts_with_diagnostics(self, tmp_path):
+        clock, rec, wd = self._mk(tmp_path)
+        with pytest.raises(DistributedTimeout) as ei:
+            with watch_section("p2p.recv[x<-1]", timeout=60, watchdog=wd):
+                clock.advance(2.0)
+                raise socket.timeout("recv timed out")
+        err = ei.value
+        assert err.section == "p2p.recv[x<-1]"
+        assert err.elapsed == pytest.approx(2.0)
+        assert "recv timed out" in str(err)
+        assert err.dump_path and os.path.exists(err.dump_path)
+
+    def test_peer_abort_passes_through_untouched(self, tmp_path):
+        _, rec, wd = self._mk(tmp_path)
+        with pytest.raises(PeerAbort, match="rank 3 aborted in 'barrier'"):
+            with watch_section("collective.barrier", timeout=60, watchdog=wd):
+                raise PeerAbort(3, section="barrier", reason="died")
+        assert rec.dump_count == 0  # already diagnostic; no extra dumps
+
+    def test_default_deadline_from_flags(self, tmp_path):
+        _, _, wd = self._mk(tmp_path)
+        paddle.set_flags({"FLAGS_collective_timeout": 42.0})
+        sec = wd.register("x")
+        assert sec.timeout == 42.0
+        wd.unregister(sec)
+
+    def test_health_marker_failure_does_not_mask_timeout(self, tmp_path):
+        clock, _, wd = self._mk(tmp_path)
+
+        def bad_marker(section):
+            raise OSError("store is down too")
+
+        wd.set_health_marker(bad_marker)
+        with pytest.raises(DistributedTimeout):
+            with watch_section("x", timeout=1, watchdog=wd):
+                clock.advance(2.0)
+                wd.poll()
+
+
+# -- acceptance: injected hang -> detection -> dumps -> diff ------------------
+
+class TestInjectedHangAcceptance:
+    def test_hang_detected_within_deadline_all_ranks_dump_diff_names_culprit(
+            self, tmp_path):
+        """ISSUE acceptance: the fault registry blocks ONE rank's collective;
+        detection happens within FLAGS_collective_timeout, every rank writes
+        a flight-recorder dump, and the diff names the divergent
+        (op, seq, rank) — all on a fake clock, no real sleeps."""
+        paddle.set_flags({"FLAGS_collective_timeout": 60.0})
+        # deterministic chaos: rank 1's 3rd all_reduce hangs
+        faults.configure("collective.hang:#3", seed=0)
+        art = str(tmp_path / "hang")
+        world, hang_rank = 3, 1
+        clock = FakeClock()
+        recs = [FlightRecorder(size=64, rank=r, clock=clock, artifacts=art)
+                for r in range(world)]
+        wds = [Watchdog(clock=clock, recorder=recs[r], artifacts=art)
+               for r in range(world)]
+
+        for seq in (1, 2, 3):
+            hang = faults._REGISTRY.should_fail("collective.hang")
+            if not hang:
+                for r in range(world):
+                    with watch_section("collective.all_reduce",
+                                       watchdog=wds[r]):
+                        with recs[r].record("all_reduce", group="data"):
+                            clock.advance(0.01)
+                continue
+            assert seq == 3  # the schedule is deterministic
+            # survivors enter the collective, block on the hung peer, and
+            # their transport times out at the (flag-derived) deadline
+            survivor_errs = []
+            for r in (0, 2):
+                with pytest.raises(DistributedTimeout) as ei:
+                    with watch_section("collective.all_reduce",
+                                       watchdog=wds[r]):
+                        with recs[r].record("all_reduce", group="data"):
+                            clock.advance(60.5)
+                            raise TimeoutError("recv from peer timed out")
+                survivor_errs.append(ei.value)
+            # the hung rank never exits the op; its watchdog monitor notices
+            # on the first poll past the deadline
+            with pytest.raises(DistributedTimeout) as ei:
+                with watch_section("collective.all_reduce",
+                                   watchdog=wds[hang_rank]):
+                    recs[hang_rank].start("all_reduce", group="data")
+                    clock.advance(60.5)
+                    assert wds[hang_rank].poll()
+            hung_err = ei.value
+
+        # detected within FLAGS_collective_timeout (+ one poll interval)
+        for err in survivor_errs + [hung_err]:
+            assert err.timeout == 60.0
+            assert err.elapsed <= 61.0
+        assert hung_err.rank == hang_rank
+
+        # every rank wrote a flight-recorder dump
+        for r in range(world):
+            assert os.path.exists(recorder.dump_path_for_rank(r, art)), \
+                f"rank {r} left no dump"
+
+        # the diff names the divergent (op, seq, rank)
+        div = frd.diff_dumps(frd.load_dumps([art]))
+        assert div is not None
+        assert div["kind"] == "hung"
+        assert (div["op"], div["seq"]) == ("all_reduce", 3)
+        assert div["pending_ranks"] == [hang_rank]
+        report = frd.format_report(div)
+        assert "op='all_reduce' seq=3" in report
+        assert frd.main([art]) == 1
+
+
+# -- p2p transport hardening --------------------------------------------------
+
+class TestP2PTransport:
+    @pytest.fixture
+    def chan_pair(self, monkeypatch):
+        ports = find_free_ports(2)
+        monkeypatch.setenv(
+            "PADDLE_TPU_P2P_ENDPOINTS",
+            f"127.0.0.1:{ports[0]},127.0.0.1:{ports[1]}")
+        chans = []
+        for r in (0, 1):
+            monkeypatch.setattr(p2p, "_rank_world", lambda r=r: (r, 2))
+            chans.append(p2p._Channel())
+        yield chans
+        for c in chans:
+            c.close()
+
+    def _blocked_recv(self, chan, src, tag, timeout=30):
+        out = {}
+
+        def run():
+            try:
+                chan.recv(src, tag, timeout=timeout)
+            except BaseException as e:  # noqa: BLE001 - captured for asserts
+                out["err"] = e
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        # bounded wait until the recv has parked on its queue
+        deadline = time.monotonic() + 5
+        while not chan.inbox and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return th, out
+
+    def test_roundtrip(self, chan_pair):
+        a, b = chan_pair
+        a.send(1, ("t", 1), {"x": np.arange(3, dtype="int64")})
+        got = b.recv(0, ("t", 1), timeout=10)
+        np.testing.assert_array_equal(got["x"], np.arange(3))
+
+    def test_dead_cached_socket_reconnects_once(self, chan_pair):
+        a, b = chan_pair
+        a.send(1, ("t", 1), "first")
+        assert b.recv(0, ("t", 1), timeout=10) == "first"
+        # kill the cached socket out from under the sender (peer restart /
+        # idle LB reset): the next send must reconnect and still deliver
+        dead = a.out[1]
+        try:
+            dead.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        dead.close()
+        a.send(1, ("t", 2), "second")
+        assert b.recv(0, ("t", 2), timeout=10) == "second"
+
+    def test_recv_timeout_is_bounded(self, chan_pair):
+        a, _ = chan_pair
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="rank 1"):
+            a.recv(1, ("never", 1), timeout=0.2)
+        assert time.monotonic() - t0 < 5
+
+    def test_peer_abort_wakes_blocked_recv_in_bounded_time(self, chan_pair):
+        a, b = chan_pair
+        th, out = self._blocked_recv(a, src=1, tag=("blk", 1))
+        t0 = time.monotonic()
+        # rank 1 dies mid-collective and announces it
+        b.send(0, p2p._ABORT_TAG, {"section": "collective.all_reduce",
+                                   "reason": "watchdog deadline exceeded",
+                                   "rank": 1})
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert time.monotonic() - t0 < 10  # seconds, not the flat 300 s
+        err = out["err"]
+        assert isinstance(err, PeerAbort) and err.src == 1
+        assert "rank 1 aborted in 'collective.all_reduce'" in str(err)
+        # later recvs fail immediately: the abort is sticky
+        with pytest.raises(PeerAbort):
+            a.recv(1, ("later", 1), timeout=30)
+
+    def test_broadcast_abort_names_section(self, chan_pair):
+        a, b = chan_pair
+        th, out = self._blocked_recv(a, src=1, tag=("blk", 1))
+        with p2p._CHAN_LOCK:
+            old = p2p._CHAN[0]
+            p2p._CHAN[0] = b  # the dying rank's channel
+        try:
+            assert p2p.broadcast_abort(
+                "p2p.barrier(0, 1)", reason="rank died") == 1
+        finally:
+            with p2p._CHAN_LOCK:
+                p2p._CHAN[0] = old
+        th.join(timeout=10)
+        err = out["err"]
+        assert isinstance(err, PeerAbort)
+        assert err.section == "p2p.barrier(0, 1)"
+
+    def test_recv_obj_raises_distributed_timeout_and_rolls_back_seq(
+            self, chan_pair):
+        a, _ = chan_pair
+        with p2p._CHAN_LOCK:
+            old = p2p._CHAN[0]
+            p2p._CHAN[0] = a
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(DistributedTimeout) as ei:
+                p2p.recv_obj(1, tag="nothing", timeout=0.2)
+            assert time.monotonic() - t0 < 10
+            assert ei.value.section == "p2p.recv[nothing<-1]"
+            # retry waits on the SAME seq slot
+            assert p2p._SEQ[("r", 1, "nothing")] == 0
+            # the failure dumped the global recorder for post-mortem diffing
+            assert ei.value.dump_path and os.path.exists(ei.value.dump_path)
+        finally:
+            with p2p._CHAN_LOCK:
+                p2p._CHAN[0] = old
+
+    def test_injected_transport_faults(self, chan_pair):
+        a, _ = chan_pair
+        with p2p._CHAN_LOCK:
+            old = p2p._CHAN[0]
+            p2p._CHAN[0] = a
+        try:
+            faults.configure("p2p.send:#1")
+            with pytest.raises(ConnectionError):
+                p2p.send_obj(1, dst=1, tag="x")
+            faults.configure("p2p.recv:#1")
+            with pytest.raises(ConnectionError):
+                p2p.recv_obj(1, tag="x", timeout=1)
+        finally:
+            with p2p._CHAN_LOCK:
+                p2p._CHAN[0] = old
+
+
+# -- elastic store hardening + health marking ---------------------------------
+
+class TestFileStoreHardening:
+    def test_put_is_atomic_and_roundtrips(self, tmp_path):
+        st = FileStore(str(tmp_path), ttl=60)
+        st.put("job/node.0", {"rank": 0, "endpoint": "h:1"})
+        assert st.get("job/node.0") == {"rank": 0, "endpoint": "h:1"}
+        assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+    def test_torn_value_reads_as_absent(self, tmp_path):
+        st = FileStore(str(tmp_path), ttl=60)
+        with open(st._path("job/node.1"), "w") as f:
+            f.write('{"rank": ')  # torn write from a crashed peer
+        assert st.get("job/node.1") is None
+        assert st.alive_values("job/node.") == []
+
+    def test_missing_key_is_absent_not_crash(self, tmp_path):
+        st = FileStore(str(tmp_path), ttl=60)
+        assert st.get("job/node.9") is None
+        st.refresh("job/node.9")  # no raise
+
+    def test_alive_values_skips_inflight_tmp_files(self, tmp_path):
+        st = FileStore(str(tmp_path), ttl=60)
+        st.put("job/node.0", {"rank": 0})
+        # a peer mid-put: valid JSON but still under the tmp name
+        with open(os.path.join(str(tmp_path), "job_node.1.tmp.999"),
+                  "w") as f:
+            json.dump({"rank": 1}, f)
+        assert st.alive_values("job/node.") == [{"rank": 0}]
+
+    def test_file_deleted_between_listdir_and_open(self, tmp_path,
+                                                   monkeypatch):
+        st = FileStore(str(tmp_path), ttl=60)
+        st.put("job/node.0", {"rank": 0})
+        st.put("job/node.1", {"rank": 1})
+        victim = st._path("job/node.0")
+        real_getmtime = os.path.getmtime
+
+        def racing_getmtime(p):
+            if p == victim and os.path.exists(victim):
+                os.remove(victim)  # peer exits exactly here
+            return real_getmtime(p)
+
+        monkeypatch.setattr(os.path, "getmtime", racing_getmtime)
+        assert st.alive_values("job/node.") == [{"rank": 1}]
+
+
+class TestElasticHealthMarking:
+    def test_register_installs_global_health_marker(self, tmp_path):
+        st = FileStore(str(tmp_path), ttl=60)
+        mgr = ElasticManager(st, "job9", rank=2, endpoint="127.0.0.1:1")
+        mgr.register()
+        assert watchdog.get_watchdog()._health_marker is not None
+
+    def test_watchdog_expiry_marks_rank_unhealthy_in_store(self, tmp_path):
+        st = FileStore(str(tmp_path / "store"), ttl=60)
+        mgr = ElasticManager(st, "job9", rank=2, endpoint="127.0.0.1:1")
+        mgr.register()
+        clock = FakeClock()
+        rec = FlightRecorder(size=8, rank=2, clock=clock,
+                             artifacts=str(tmp_path / "art"))
+        wd = Watchdog(clock=clock, recorder=rec,
+                      artifacts=str(tmp_path / "art"))
+        wd.set_health_marker(mgr.mark_unhealthy)
+        sec = wd.register("collective.all_reduce", timeout=10)
+        clock.advance(11.0)
+        assert wd.poll() == [sec]
+        (node,) = mgr.unhealthy_nodes()
+        assert node["rank"] == 2
+        assert node["section"] == "collective.all_reduce"
+        wd.unregister(sec)
+
+
+class TestSignalDump:
+    def test_preemption_drains_a_flight_recorder_dump(self):
+        """SIGTERM (here: programmatic notify) leaves a dump next to the
+        emergency checkpoint, so a killed rank still contributes to the
+        cross-rank diff."""
+        h = recorder.install_signal_dump()
+        assert recorder.install_signal_dump() is h  # idempotent, one action
+        rec = recorder.get_recorder()
+        rec.start("all_reduce", group="data")  # killed mid-op
+        h.notify()
+        assert h.drain() == []
+        path = recorder.dump_path_for_rank(rec.rank)
+        assert os.path.exists(path)
+        with open(path) as f:
+            d = json.load(f)
+        assert d["reason"] == "sigterm"
+        assert d["entries"][-1]["status"] == "started"
+
+
+# -- error-report folding (trainer + launcher) --------------------------------
+
+class TestFailureReportFolding:
+    def test_multitrainer_folds_recorder_tail_for_distributed_errors(self):
+        from paddle_tpu.framework.trainer import MultiTrainer
+        rec = recorder.get_recorder()
+        with rec.record("all_reduce", group="data"):
+            pass
+        errors = [(0, DistributedTimeout("collective.all_reduce", 0,
+                                         60.0, 61.0))]
+        s = MultiTrainer._hang_diagnostic(errors)
+        assert "flight recorder tail" in s
+        assert "all_reduce#1[ok]" in s
+
+    def test_multitrainer_skips_tail_for_ordinary_errors(self):
+        from paddle_tpu.framework.trainer import MultiTrainer
+        assert MultiTrainer._hang_diagnostic([(0, ValueError("x"))]) == ""
+
+    def test_launcher_folds_failed_ranks_recorder_tail(self):
+        from paddle_tpu.distributed.launch_utils import _flight_recorder_hint
+        art = os.environ["PADDLE_TPU_ARTIFACTS_DIR"]
+        rec = FlightRecorder(size=8, rank=7, artifacts=art)
+        rec.start("all_reduce", group="data")  # hung mid-op
+        rec.dump(reason="watchdog:collective.all_reduce")
+        hint = _flight_recorder_hint(7)
+        assert "rank 7" in hint
+        assert "all_reduce#1[started]" in hint
+        assert "flight_recorder_diff" in hint
+        assert _flight_recorder_hint(99) == ""

@@ -1,0 +1,33 @@
+"""Tier-1 smoke for the repo's own lints/gates (tools/).
+
+Running these here means a PR that breaks a checker — or removes a
+fault-injection hook the chaos suite depends on — fails the normal test
+run, not just a CI step somebody has to remember to wire up.
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _run(*argv):
+    return subprocess.run([sys.executable, *map(str, argv)], cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_fault_injection_lint_passes_on_tree():
+    r = _run(REPO / "tools" / "check_injection_points.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "fault-injection lint OK" in r.stdout
+
+
+def test_bench_regression_gate_help_smoke():
+    r = _run(REPO / "tools" / "check_bench_regression.py", "--help")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_flight_recorder_diff_help_smoke():
+    r = _run(REPO / "tools" / "flight_recorder_diff.py", "--help")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "divergent" in r.stdout

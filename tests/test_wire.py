@@ -3,7 +3,9 @@
 The codec must round-trip everything the PS/FleetExecutor protocols carry,
 and decoding attacker-controlled bytes must never execute code (there is no
 code path to execute — only data tags)."""
+import random
 import socket
+import struct
 import threading
 
 import numpy as np
@@ -71,6 +73,135 @@ class TestCodecRoundtrip:
                  + struct.pack("<Q", 8) + b"\x00" * 8)
         with pytest.raises((wire.FrameError, TypeError, ValueError)):
             wire.decode(frame)
+
+
+def _array_frame(dtype=b"<f8", shape=(1,), nraw=8, raw=b"\x00" * 8):
+    """Hand-craft an 'a' (ndarray) frame with arbitrary header fields."""
+    return (b"a" + struct.pack("<B", len(dtype)) + dtype
+            + struct.pack("<B", len(shape))
+            + struct.pack(f"<{len(shape)}q", *shape)
+            + struct.pack("<Q", nraw) + raw)
+
+
+class TestArrayHeaderValidation:
+    def test_negative_dim_rejected(self):
+        with pytest.raises(wire.FrameError, match="negative array dim"):
+            wire.decode(_array_frame(shape=(-1,)))
+
+    def test_negative_dim_in_later_axis_rejected(self):
+        with pytest.raises(wire.FrameError, match="negative array dim"):
+            wire.decode(_array_frame(shape=(2, -3), nraw=48,
+                                     raw=b"\x00" * 48))
+
+    def test_payload_size_mismatch_rejected(self):
+        # shape (2, 2) float64 needs 32 bytes; frame claims 8
+        with pytest.raises(wire.FrameError, match="size mismatch"):
+            wire.decode(_array_frame(shape=(2, 2), nraw=8))
+
+    def test_huge_shape_with_tiny_payload_rejected(self):
+        # a hostile header claiming ~4.6e18 elements must die in validation
+        # (cheap bigint math), never reach frombuffer/reshape
+        with pytest.raises(wire.FrameError, match="size mismatch"):
+            wire.decode(_array_frame(shape=(2 ** 31, 2 ** 31), nraw=8))
+
+    def test_zero_dim_shape_ok(self):
+        got = wire.decode(_array_frame(shape=(0, 3), nraw=0, raw=b""))
+        assert got.shape == (0, 3)
+
+
+class TestWireFuzz:
+    FUZZ_OBJS = [
+        {"cmd": "push", "table": 3,
+         "vals": np.arange(12, dtype="float32").reshape(3, 4),
+         "meta": ["a", (1, 2.5), None, b"\x00\xff"]},
+        [1, "x", (2.5, None), {"k": True}],
+        np.arange(4, dtype="int64"),
+    ]
+
+    def test_truncations_always_raise(self):
+        """Every strict prefix of a valid frame must raise, never return
+        garbage or hang — a truncated stream is how a killed peer looks."""
+        for obj in self.FUZZ_OBJS:
+            enc = wire.encode(obj)
+            for i in range(len(enc)):
+                with pytest.raises((wire.FrameError, ValueError)):
+                    wire.decode(enc[:i])
+
+    def test_bitflips_decode_or_raise_never_crash(self):
+        """Seeded random corruption: decode either succeeds (flip landed in
+        array payload bytes) or raises a clean error — never segfaults,
+        never hangs, never executes anything."""
+        rng = random.Random(0xC0FFEE)
+        base = wire.encode(self.FUZZ_OBJS[0])
+        for _ in range(300):
+            buf = bytearray(base)
+            for _ in range(rng.randint(1, 4)):
+                buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+            try:
+                wire.decode(bytes(buf))
+            except (ValueError, TypeError):
+                # FrameError / UnicodeDecodeError are ValueErrors; TypeError
+                # covers corrupted dict keys decoding to unhashable values
+                pass
+
+
+class TestSocketTimeouts:
+    def _pair(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        cli = socket.create_connection(srv.getsockname())
+        conn, _ = srv.accept()
+        srv.close()
+        return cli, conn
+
+    def test_idle_timeout_with_zero_bytes(self):
+        cli, conn = self._pair()
+        try:
+            with pytest.raises(wire.IdleTimeout):
+                wire.recv_frame(conn, timeout=0.05, idle_ok=True)
+            # the stream is still framed: a frame sent afterwards decodes
+            wire.send_frame(cli, {"x": 1})
+            assert wire.recv_frame(conn, timeout=5) == {"x": 1}
+        finally:
+            cli.close()
+            conn.close()
+
+    def test_midframe_timeout_is_frame_error(self):
+        cli, conn = self._pair()
+        try:
+            cli.sendall(b"\x01\x02\x03")  # partial 9-byte header, then stall
+            with pytest.raises(wire.FrameError, match="mid-frame"):
+                wire.recv_frame(conn, timeout=0.1, idle_ok=True)
+        finally:
+            cli.close()
+            conn.close()
+
+    def test_timeout_without_idle_ok_is_frame_error(self):
+        # only reader loops pass idle_ok=True; a one-shot recv_frame treats
+        # ANY timeout as a dead exchange and drops the connection
+        cli, conn = self._pair()
+        try:
+            with pytest.raises(wire.FrameError):
+                wire.recv_frame(conn, timeout=0.05)
+        finally:
+            cli.close()
+            conn.close()
+
+    def test_injected_wire_faults(self):
+        from paddle_tpu.resilience import faults
+        cli, conn = self._pair()
+        try:
+            faults.configure("wire.send_frame:#1")
+            with pytest.raises(ConnectionError):
+                wire.send_frame(cli, {"x": 1})
+            faults.configure("wire.recv_frame:#1")
+            with pytest.raises(ConnectionError):
+                wire.recv_frame(conn, timeout=1)
+        finally:
+            faults.reset()
+            cli.close()
+            conn.close()
 
 
 class TestFramedSockets:

@@ -32,6 +32,12 @@ REQUIRED = [
      ["put", "refresh"]),
     ("paddle_tpu/incubate/checkpoint.py", "class:CheckpointSaver",
      ["save_checkpoint"]),
+    # transport entry points (hang-detection PR): the chaos suite must be
+    # able to fail or stall the wire itself, not just the ops above it
+    ("paddle_tpu/distributed/p2p.py", "module",
+     ["send_obj", "recv_obj", "group_barrier"]),
+    ("paddle_tpu/distributed/wire.py", "module",
+     ["send_frame", "recv_frame"]),
 ]
 
 # _injected_run is HDFSClient's hook-carrying chokepoint: routing a call

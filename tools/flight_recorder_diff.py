@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Cross-rank flight-recorder diff: name the collective that desynchronized.
+
+Feed it the per-rank JSON dumps the watchdog / failure path wrote
+(``flight_recorder_rank<N>.json``, see paddle_tpu/resilience/recorder.py) and
+it aligns the per-(op, group) sequence streams across ranks and reports the
+FIRST divergent (op, seq) pair:
+
+- **missing**: some ranks never entered the op — they are behind (dead,
+  desynced program order, or partitioned);
+- **hung**: some ranks entered but never finished ("started") or timed out
+  while others completed — the classic one-rank-died-mid-collective shape;
+- **status**: completion statuses disagree (ok vs an exception type).
+
+Usage::
+
+    python tools/flight_recorder_diff.py dump_dir/
+    python tools/flight_recorder_diff.py r0.json r1.json r2.json
+
+Exit code 0 = streams agree, 1 = divergence found (printed), 2 = bad input.
+Pure stdlib + json — runs anywhere, no jax import.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+__all__ = ["load_dumps", "diff_dumps", "main"]
+
+# only never-exited entries count as pending: a rank that FINISHED with a
+# timeout error escaped the op; the rank still inside it is the culprit
+_PENDING = ("started",)
+
+
+def load_dumps(paths):
+    """Load dump files / directories into {rank: dump_dict}."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(
+                glob.glob(os.path.join(p, "flight_recorder_rank*.json"))))
+        else:
+            files.append(p)
+    dumps = {}
+    for fn in files:
+        with open(fn) as f:
+            d = json.load(f)
+        rank = d.get("rank")
+        if rank is None:
+            raise ValueError(f"{fn}: dump has no 'rank' field")
+        dumps[int(rank)] = d
+    return dumps
+
+
+def _key(entry):
+    group = entry.get("group")
+    return (entry["op"], group if group is None else str(group),
+            int(entry["seq"]))
+
+
+def diff_dumps(dumps):
+    """Compare {rank: dump} and return the first divergence, or None.
+
+    Returns a dict: {kind, op, group, seq, ranks, missing_ranks,
+    pending_ranks, status_by_rank} — `kind` is "missing" / "hung" /
+    "status". "First" means smallest max-seq position in the union of keys,
+    ordered by the earliest enter timestamp observed for the key.
+    """
+    if len(dumps) < 2:
+        return None
+    per_rank = {}      # rank -> {key: entry}  (last entry wins per key)
+    order = {}         # key -> earliest t_start anywhere
+    for rank, d in dumps.items():
+        m = {}
+        for e in d.get("entries", []):
+            k = _key(e)
+            m[k] = e
+            t = e.get("t_start")
+            if t is not None and (k not in order or t < order[k]):
+                order[k] = t
+        per_rank[rank] = m
+    ranks = sorted(per_rank)
+    all_keys = sorted(order, key=lambda k: (order[k], k[0], k[2]))
+    for k in all_keys:
+        op, group, seq = k
+        have = {r: per_rank[r].get(k) for r in ranks}
+        missing = [r for r, e in have.items() if e is None]
+        pending = [r for r, e in have.items()
+                   if e is not None and e.get("status") in _PENDING]
+        statuses = {r: e.get("status") for r, e in have.items()
+                    if e is not None}
+        base = {"op": op, "group": group, "seq": seq, "ranks": ranks,
+                "missing_ranks": missing, "pending_ranks": pending,
+                "status_by_rank": statuses}
+        if missing:
+            return dict(base, kind="missing")
+        if pending and len(pending) < len(ranks):
+            return dict(base, kind="hung")
+        if len(set(statuses.values())) > 1:
+            return dict(base, kind="status")
+    return None
+
+
+def format_report(div):
+    if div is None:
+        return "flight-recorder streams agree across ranks (no divergence)"
+    op, seq, group = div["op"], div["seq"], div["group"]
+    head = (f"first divergent collective: op={op!r} seq={seq}"
+            + (f" group={group!r}" if group else ""))
+    lines = [head]
+    if div["kind"] == "missing":
+        lines.append(
+            f"  ranks {div['missing_ranks']} never entered it "
+            f"(behind or dead); ranks "
+            f"{[r for r in div['ranks'] if r not in div['missing_ranks']]} "
+            "did")
+    elif div["kind"] == "hung":
+        lines.append(
+            f"  ranks {div['pending_ranks']} entered but never finished "
+            "(hung/timed out); statuses: "
+            f"{div['status_by_rank']}")
+    else:
+        lines.append(f"  completion statuses disagree: "
+                     f"{div['status_by_rank']}")
+    lines.append("  -> suspect the lowest-numbered rank above, then check "
+                 f"its thread_stacks_rank<N>.txt for where op {op!r} "
+                 "blocked")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    try:
+        dumps = load_dumps(argv)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"flight_recorder_diff: bad input: {e}", file=sys.stderr)
+        return 2
+    if len(dumps) < 2:
+        print(f"flight_recorder_diff: need >=2 rank dumps, got "
+              f"{sorted(dumps)}", file=sys.stderr)
+        return 2
+    div = diff_dumps(dumps)
+    print(format_report(div))
+    return 1 if div else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
