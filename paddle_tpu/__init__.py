@@ -92,6 +92,7 @@ from . import distributed  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import serving  # noqa: F401,E402
 from . import cost_model  # noqa: F401,E402
 from . import sysconfig  # noqa: F401,E402
 from . import callbacks  # noqa: F401,E402

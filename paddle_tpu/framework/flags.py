@@ -41,6 +41,11 @@ _FLAGS: dict[str, Any] = {
     # flight-recorder ring size (entries); dumps land in
     # PADDLE_TPU_ARTIFACTS_DIR as flight_recorder_rank<N>.json
     "FLAGS_flight_recorder_size": 1024,
+    # serving subsystem (paddle_tpu/serving, docs/serving.md):
+    # watchdog deadline for one dispatched batch (assemble→run→reply)
+    "FLAGS_serving_step_timeout": 60.0,
+    # bounded request queue; admission sheds (ServerOverloaded) beyond this
+    "FLAGS_serving_max_queue": 256,
     # inert reference flags accepted for script compatibility
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_allocator_strategy": "auto_growth",

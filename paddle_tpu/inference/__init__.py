@@ -184,13 +184,20 @@ class Tensor:
 
     # -- input side ------------------------------------------------------------
     def reshape(self, shape):
-        dtype = self._host.dtype if self._host is not None else "float32"
-        if (self._host is not None
-                and self._host.size == int(np.prod(shape))):
-            self._host = self._host.reshape(shape)
-        else:
-            # allocation only — contents must be re-staged via copy_from_cpu
-            self._host = np.zeros(shape, dtype)
+        if self._host is None:
+            # pre-staging allocation (ZeroCopyTensor::Reshape before copy)
+            self._host = np.zeros(shape, "float32")
+            return
+        if self._host.size != int(np.prod(shape)):
+            # silently replacing staged data with zeros here served garbage;
+            # a size-changing reshape must be an explicit re-stage
+            from ..framework.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"Tensor '{self.name}': reshape to {list(shape)} "
+                f"({int(np.prod(shape))} elements) does not match the "
+                f"staged data's {self._host.size} elements; call "
+                "copy_from_cpu with the new array instead")
+        self._host = self._host.reshape(shape)
 
     def copy_from_cpu(self, arr):
         if not self._is_input:
@@ -288,8 +295,24 @@ class Predictor:
         self._input_names = meta["inputs"]
         self._output_names = meta["outputs"]
         self._exported_obj = exported
+        # the artifact's input dtypes are fixed at export time (e.g. a bf16
+        # export); cast host arrays to them so callers can feed f32 numpy
+        in_dtypes = meta.get("in_dtypes") or [
+            str(a.dtype) for a in getattr(exported, "in_avals", ())] or None
+
+        def _cast(a, dt):
+            a = np.asarray(a)
+            if dt is None or str(a.dtype) == dt:
+                return a
+            if dt == "bfloat16":
+                import ml_dtypes
+                return a.astype(ml_dtypes.bfloat16)
+            return a.astype(dt)
 
         def run_fn(host_arrays):
+            if in_dtypes is not None and len(in_dtypes) == len(host_arrays):
+                host_arrays = [_cast(a, dt)
+                               for a, dt in zip(host_arrays, in_dtypes)]
             outs = exported.call(*host_arrays)
             return list(outs) if isinstance(outs, (tuple, list)) else [outs]
         self._compiled = run_fn
@@ -428,12 +451,25 @@ class PredictorPool:
     one compiled executable (clone() shares the jit cache via config)."""
 
     def __init__(self, config: Config, size=1):
+        if int(size) < 1:
+            from ..framework.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"PredictorPool size must be >= 1, got {size}")
         self._preds = [Predictor(config)]
-        for _ in range(size - 1):
+        for _ in range(int(size) - 1):
             self._preds.append(self._preds[0].clone())
 
+    def __len__(self):
+        return len(self._preds)
+
     def retrieve(self, idx):
-        return self._preds[idx]
+        if not 0 <= int(idx) < len(self._preds):
+            from ..framework.errors import OutOfRangeError
+            raise OutOfRangeError(
+                f"PredictorPool.retrieve({idx}): pool has "
+                f"{len(self._preds)} predictors (valid: 0.."
+                f"{len(self._preds) - 1})")
+        return self._preds[int(idx)]
 
 
 def save_predictor_model(path_prefix, fn, example_args, input_names=None,
@@ -463,6 +499,7 @@ def save_predictor_model(path_prefix, fn, example_args, input_names=None,
         "inputs": input_names or [f"x{i}" for i in range(len(args))],
         "outputs": output_names or [f"out{i}" for i in range(n_out)],
         "in_shapes": [list(np.asarray(a).shape) for a in args],
+        "in_dtypes": [str(np.asarray(a).dtype) for a in args],
     }
     with open(path_prefix + ".iometa.json", "w") as f:
         json.dump(meta, f)

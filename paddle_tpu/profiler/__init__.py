@@ -20,13 +20,14 @@ import jax
 __all__ = [
     "Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
     "start_profiler", "stop_profiler", "reset_profiler", "profiler",
-    "export_chrome_tracing", "summary",
+    "export_chrome_tracing", "summary", "record_counter",
 ]
 
 
 class _HostEventRecorder:
     def __init__(self):
         self._events = []
+        self._counters = []  # (name, ts_us, value) chrome "C" events
         self._lock = threading.Lock()
         self.enabled = False
 
@@ -36,15 +37,28 @@ class _HostEventRecorder:
         with self._lock:
             self._events.append((name, start_us, dur_us, tid))
 
+    def record_counter(self, name, value, ts_us=None):
+        if not self.enabled:
+            return
+        if ts_us is None:
+            ts_us = time.perf_counter_ns() / 1000.0
+        with self._lock:
+            self._counters.append((name, ts_us, value))
+
     def clear(self):
         with self._lock:
             self._events.clear()
+            self._counters.clear()
 
     def chrome_trace(self):
         evs = [{
             "name": name, "ph": "X", "ts": start, "dur": dur,
             "pid": os.getpid(), "tid": tid, "cat": "host",
         } for name, start, dur, tid in self._events]
+        evs.extend({
+            "name": name, "ph": "C", "ts": ts, "pid": os.getpid(),
+            "args": {"value": value}, "cat": "counter",
+        } for name, ts, value in self._counters)
         return {"traceEvents": evs, "displayTimeUnit": "ms"}
 
     def aggregate(self):
@@ -224,6 +238,13 @@ def _drain_native(lib):
         return json.loads(buf.value.decode())["traceEvents"]
     except Exception:
         return []
+
+
+def record_counter(name, value, ts_us=None):
+    """Emit a chrome-trace counter sample ("ph": "C") onto the host timeline
+    (no-op while profiling is disabled). The serving subsystem exports its
+    queue-depth / shed / occupancy gauges through this."""
+    _recorder.record_counter(name, value, ts_us)
 
 
 def export_chrome_tracing(path, dir_name=None):

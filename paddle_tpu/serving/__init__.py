@@ -1,0 +1,41 @@
+"""TPU-native inference serving: dynamic batching, bucketed compile cache,
+multi-replica dispatch (docs/serving.md).
+
+The layer the ROADMAP's "heavy traffic from millions of users" requires on
+top of ``paddle_tpu.inference``. Prior art: Clipper (NSDI'17) adaptive
+batching + SLO-aware admission; ORCA (OSDI'22) scheduler-level batching for
+accelerator inference. The TPU-specific constraint is XLA compilation:
+arbitrary request shapes mean unbounded recompiles, so batches are padded to
+a fixed bucket set and the compiled-executable cache is bounded and counted.
+
+Quickstart::
+
+    import paddle_tpu.inference as infer
+    from paddle_tpu import serving
+
+    cfg = infer.Config(); cfg.set_layer(model)
+    server = serving.InferenceServer(
+        cfg, serving.ServingConfig(max_batch_size=8, replicas=2))
+    server.start()                       # threaded batching loop
+    out = server.infer([x], timeout=0.2)  # sheds with ServerOverloaded
+    server.stop()
+
+Remote frontends: ``serving.SocketFrontend(server)`` +
+``serving.InferenceClient(frontend.address)`` over the hardened wire codec.
+"""
+from .batcher import (  # noqa: F401
+    Batch, BatchQueue, BucketedExecutor, DeadlineExceeded, Request,
+    ServerOverloaded, bucket_for, pow2_buckets, signature_of,
+)
+from .client import InferenceClient, RemoteInferenceError  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+from .scheduler import Replica, ReplicaDead, Scheduler  # noqa: F401
+from .server import InferenceServer, ServingConfig, SocketFrontend  # noqa: F401
+
+__all__ = [
+    "InferenceServer", "ServingConfig", "SocketFrontend", "InferenceClient",
+    "ServingMetrics", "ServerOverloaded", "DeadlineExceeded", "Request",
+    "Batch", "BatchQueue", "BucketedExecutor", "Scheduler", "Replica",
+    "ReplicaDead", "RemoteInferenceError", "bucket_for", "pow2_buckets",
+    "signature_of",
+]

@@ -1,0 +1,104 @@
+"""Client for the framed-socket serving frontend.
+
+Speaks the :class:`~.server.SocketFrontend` protocol over the hardened
+``distributed/wire.py`` codec: one request frame, one reply frame, per call.
+Server-side errors come back typed — ``ServerOverloaded`` /
+``DeadlineExceeded`` re-raise as themselves so client backoff logic can
+``except ServerOverloaded`` without string matching; anything else raises
+:class:`RemoteInferenceError` carrying the server's error type and message.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+
+from .batcher import DeadlineExceeded, ServerOverloaded
+
+__all__ = ["InferenceClient", "RemoteInferenceError"]
+
+
+class RemoteInferenceError(RuntimeError):
+    """The server answered with an error frame this client can't map to a
+    local exception type."""
+
+    def __init__(self, error_type, message):
+        super().__init__(f"{error_type}: {message}")
+        self.error_type = error_type
+        self.remote_message = message
+
+
+# error_type values that round-trip to the caller as the real exception
+_TYPED = {
+    "ServerOverloaded": ServerOverloaded,
+    "ResourceExhaustedError": ServerOverloaded,
+    "DeadlineExceeded": DeadlineExceeded,
+    "TimeoutError": DeadlineExceeded,
+}
+
+
+class InferenceClient:
+    """Blocking request/response client; thread-safe (one in-flight request
+    per client at a time, serialized by a lock — run N clients for N-way
+    concurrency, they're cheap)."""
+
+    def __init__(self, host, port=None, connect_timeout=10.0):
+        if port is None:
+            host, port = host  # accept the frontend's .address tuple
+        self._addr = (host, int(port))
+        self._connect_timeout = connect_timeout
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _conn(self):
+        if self._sock is None:
+            s = socket.create_connection(self._addr,
+                                         timeout=self._connect_timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def infer(self, inputs, timeout=None, request_id=None):
+        """Run one request; returns the list of output arrays.
+
+        ``timeout`` travels to the server as the request deadline AND bounds
+        the socket wait (plus slack for one reply frame in flight)."""
+        from ..distributed import wire
+        frame = {"inputs": [np.ascontiguousarray(a) for a in inputs],
+                 "timeout": timeout, "id": request_id}
+        io_timeout = (timeout + 5.0) if timeout is not None else ...
+        with self._lock:
+            sock = self._conn()
+            try:
+                wire.send_frame(sock, frame, timeout=(
+                    None if io_timeout is ... else io_timeout))
+                reply = wire.recv_frame(sock, timeout=(
+                    ... if io_timeout is ... else io_timeout))
+            except (wire.FrameError, ConnectionError, OSError):
+                self.close()   # desynced/dead socket: reconnect next call
+                raise
+        if not isinstance(reply, dict):
+            raise RemoteInferenceError("BadReply", repr(reply))
+        if reply.get("error") is not None:
+            etype = reply.get("error_type", "RemoteError")
+            exc = _TYPED.get(etype)
+            if exc is not None:
+                raise exc(reply["error"])
+            raise RemoteInferenceError(etype, reply["error"])
+        return [np.asarray(o) for o in reply["outputs"]]
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,125 @@
+"""Serving metrics: counters + latency reservoir, exported via the profiler.
+
+One :class:`ServingMetrics` instance per :class:`~.server.InferenceServer`.
+Counters are plain monotonic ints behind one lock (queue pressure is the
+bottleneck long before this lock is). Latencies go into a bounded reservoir
+so p50/p99 stay O(1) memory under sustained load.
+
+Export paths:
+
+- :meth:`snapshot` — plain dict (the server's ``stats()``, the bench tool,
+  and the chaos assertions all read this);
+- :meth:`export_to_profiler` — emits each counter as a chrome-trace counter
+  event (``"ph": "C"``) into :mod:`paddle_tpu.profiler`'s host recorder, so
+  ``export_chrome_tracing`` renders queue depth / shed count / batch
+  occupancy on the same timeline as the RecordEvent spans around each batch.
+
+The clock is injectable (fake-clock chaos tests record deterministic
+latencies with no real sleeps).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["ServingMetrics", "percentile"]
+
+_RESERVOIR = 4096
+
+
+def percentile(values, q):
+    """Nearest-rank percentile (q in [0, 100]) of a non-empty sequence."""
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(round(q / 100.0 * (len(vs) - 1)))))
+    return float(vs[idx])
+
+
+class ServingMetrics:
+    COUNTERS = (
+        "submitted",        # requests admitted to the queue
+        "completed",        # requests finished with a result
+        "failed",           # requests finished with an error set
+        "shed",             # requests rejected (ServerOverloaded) or expired
+        "batches",          # batches dispatched
+        "retries",          # batch dispatch retries after a replica failure
+        "rows",             # real rows executed
+        "padded_rows",      # padding rows executed (bucket slack)
+        "replica_deaths",   # replicas marked dead
+        "replica_restarts", # replicas restarted after draining
+    )
+
+    def __init__(self, clock=None):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._c = dict.fromkeys(self.COUNTERS, 0)
+        self._lat = []          # bounded reservoir of request latencies (s)
+        self._gauges = {}       # name -> fn() -> number (e.g. queue depth)
+
+    def _now(self):
+        if self._clock is not None:
+            return self._clock()
+        import time
+        return time.monotonic()
+
+    # -- recording -----------------------------------------------------------
+    def inc(self, name, n=1):
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + n
+
+    def observe_latency(self, seconds):
+        with self._lock:
+            if len(self._lat) >= _RESERVOIR:
+                # overwrite round-robin: keeps a sliding window, O(1)
+                self._lat[self._c.get("completed", 0) % _RESERVOIR] = \
+                    float(seconds)
+            else:
+                self._lat.append(float(seconds))
+
+    def register_gauge(self, name, fn):
+        self._gauges[name] = fn
+
+    # -- reading ---------------------------------------------------------------
+    def get(self, name):
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def latency_percentiles(self):
+        with self._lock:
+            lat = list(self._lat)
+        return {"p50": percentile(lat, 50), "p99": percentile(lat, 99)}
+
+    def batch_occupancy(self):
+        """Real rows / total bucket rows over all dispatched batches —
+        1.0 means every bucket slot carried a real request row."""
+        with self._lock:
+            real = self._c.get("rows", 0)
+            pad = self._c.get("padded_rows", 0)
+        total = real + pad
+        return real / total if total else 0.0
+
+    def snapshot(self):
+        with self._lock:
+            out = dict(self._c)
+            lat = list(self._lat)
+        out["latency_p50"] = percentile(lat, 50)
+        out["latency_p99"] = percentile(lat, 99)
+        total = out["rows"] + out["padded_rows"]
+        out["batch_occupancy"] = out["rows"] / total if total else 0.0
+        for name, fn in self._gauges.items():
+            try:
+                out[name] = fn()
+            except Exception:
+                out[name] = None
+        return out
+
+    # -- profiler export -------------------------------------------------------
+    def export_to_profiler(self, prefix="serving"):
+        """Emit the current snapshot as chrome-trace counter events into the
+        profiler's host recorder (visible when profiling is enabled)."""
+        from .. import profiler
+        snap = self.snapshot()
+        for k, v in snap.items():
+            if isinstance(v, (int, float)):
+                profiler.record_counter(f"{prefix}.{k}", v)
+        return snap

@@ -1,0 +1,218 @@
+"""Multi-replica dispatch: least-loaded placement, health tracking, warmup.
+
+ORCA-style separation: the batcher decides *what* runs (which requests, what
+bucket); the scheduler decides *where* (which `PredictorPool` replica) and
+survives replicas dying mid-batch. Each replica wraps one predictor in a
+:class:`~.batcher.BucketedExecutor`, so the bounded-compile guarantee holds
+per replica and warmup pre-compiles every configured bucket on every replica
+before the server takes traffic.
+
+Failure semantics:
+
+- a replica that raises :class:`ReplicaDead` (or any ConnectionError-shaped
+  transport death — fault injection uses both) is marked unhealthy, drained
+  (its in-flight count must reach zero before restart), and **restarted** by
+  building a fresh predictor from the factory. The server keeps serving on
+  the surviving replicas meanwhile; only when *no* replica is healthy does
+  dispatch shed with :class:`~.batcher.ServerOverloaded`.
+- every dispatch runs inside a resilience ``watch_section`` deadlined by
+  ``FLAGS_serving_step_timeout``, so a hung XLA execution (or an injected
+  hang) surfaces as a diagnostic ``DistributedTimeout`` with a flight-
+  recorder dump instead of wedging the batching loop forever.
+
+``dispatch`` is the ``serving.dispatch`` fault-injection site. Clock and
+watchdog are injectable: the chaos suite drives replica death + dispatch
+hangs with a fake clock and zero real sleeps.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..resilience.faults import maybe_inject
+from ..resilience.watchdog import DistributedTimeout, Watchdog
+from ..resilience.watchdog import watch_section as _watch_section
+from .batcher import BucketedExecutor, ServerOverloaded
+
+__all__ = ["ReplicaDead", "Replica", "Scheduler"]
+
+
+class ReplicaDead(ConnectionError):
+    """A replica's predictor failed in a way that poisons the replica (device
+    lost, runtime crash) rather than the single batch."""
+
+
+def _flag(name, default):
+    from ..framework.flags import get_flag
+    v = get_flag(name, default)
+    return default if v is None else v
+
+
+class Replica:
+    """One predictor worker: health + load accounting around an executor."""
+
+    __slots__ = ("idx", "executor", "healthy", "inflight", "completed",
+                 "failures", "restarts", "last_error")
+
+    def __init__(self, idx, predictor, max_cached=32):
+        self.idx = idx
+        self.executor = BucketedExecutor(predictor, max_cached=max_cached)
+        self.healthy = True
+        self.inflight = 0
+        self.completed = 0
+        self.failures = 0
+        self.restarts = 0
+        self.last_error = None
+
+    @property
+    def compile_count(self):
+        return self.executor.compile_count
+
+    def describe(self):
+        return {"replica": self.idx, "healthy": self.healthy,
+                "inflight": self.inflight, "completed": self.completed,
+                "failures": self.failures, "restarts": self.restarts,
+                "compiles": self.executor.compile_count,
+                "last_error": (str(self.last_error)
+                               if self.last_error else None)}
+
+
+class Scheduler:
+    """Places batches on the least-loaded healthy replica.
+
+    ``predictor_factory(idx)`` builds (and rebuilds, on restart) the
+    predictor for replica ``idx`` — for a real server that is
+    ``PredictorPool.retrieve`` / ``Predictor.clone``; chaos tests pass fakes.
+    """
+
+    def __init__(self, predictor_factory, size, clock=None, watchdog=None,
+                 step_timeout=None, metrics=None, max_cached=32):
+        if size < 1:
+            raise ValueError(f"scheduler needs size >= 1 replicas: {size}")
+        self._factory = predictor_factory
+        self._clock = clock
+        self._metrics = metrics
+        self._max_cached = max_cached
+        self._step_timeout = step_timeout
+        self._lock = threading.Lock()
+        # a fake clock means deterministic tests: never spawn a monitor
+        # thread; expiry is driven by Watchdog.poll (watchdog.py contract)
+        self._wd = watchdog or (Watchdog(clock=clock) if clock is not None
+                                else None)
+        self.replicas = [Replica(i, predictor_factory(i),
+                                 max_cached=max_cached)
+                         for i in range(size)]
+
+    # -- placement -------------------------------------------------------------
+    def healthy_replicas(self):
+        with self._lock:
+            return [r for r in self.replicas if r.healthy]
+
+    def pick(self, exclude=()):
+        """Least-loaded healthy replica, skipping ``exclude`` (replicas a
+        retried batch already died on)."""
+        with self._lock:
+            avail = [r for r in self.replicas
+                     if r.healthy and r.idx not in exclude]
+            if not avail:
+                any_healthy = any(r.healthy for r in self.replicas)
+                raise ServerOverloaded(
+                    "no healthy replica available"
+                    + ("" if any_healthy else
+                       " (all replicas dead; restart pending)"))
+            return min(avail, key=lambda r: (r.inflight, r.idx))
+
+    def step_timeout(self):
+        if self._step_timeout is not None:
+            return self._step_timeout
+        return float(_flag("FLAGS_serving_step_timeout", 60.0))
+
+    # -- dispatch --------------------------------------------------------------
+    def dispatch(self, batch):
+        """Run one batch on a replica. Raises:
+
+        - :class:`ReplicaDead` — the replica died; it has been marked
+          unhealthy and queued for restart, the caller may retry elsewhere;
+        - ``DistributedTimeout`` — the per-batch watchdog section expired
+          (diagnostics already dumped);
+        - :class:`ServerOverloaded` — no replica to place on.
+        """
+        rep = self.pick(exclude=batch.tried_replicas)
+        batch.tried_replicas.add(rep.idx)
+        with self._lock:
+            rep.inflight += 1
+        try:
+            with _watch_section(f"serving.batch#{batch.id}",
+                                timeout=self.step_timeout(),
+                                watchdog=self._wd):
+                # inside the watched section: an injected TimeoutError here
+                # is exactly a hung dispatch — watch_section turns it into a
+                # diagnostic DistributedTimeout with a flight-recorder dump
+                maybe_inject("serving.dispatch", TimeoutError)
+                maybe_inject("serving.replica_run", ReplicaDead)
+                outputs = rep.executor.run(batch.arrays)
+        except DistributedTimeout:
+            with self._lock:
+                rep.failures += 1
+            raise
+        except (ReplicaDead, ConnectionError) as e:
+            self._mark_dead(rep, e)
+            raise ReplicaDead(
+                f"replica {rep.idx} died running batch#{batch.id}: "
+                f"{e}") from e
+        finally:
+            with self._lock:
+                rep.inflight -= 1
+        with self._lock:
+            rep.completed += 1
+        return outputs, rep
+
+    # -- health ----------------------------------------------------------------
+    def _mark_dead(self, rep, exc):
+        with self._lock:
+            if rep.healthy:
+                rep.healthy = False
+                rep.failures += 1
+                rep.last_error = exc
+                if self._metrics:
+                    self._metrics.inc("replica_deaths")
+
+    def restart_dead(self):
+        """Drain-and-restart every dead replica whose in-flight work has
+        finished. Called from the server loop (and directly by tests);
+        returns the replica indices restarted. A factory failure leaves the
+        replica dead for the next attempt rather than raising into the
+        serving loop."""
+        restarted = []
+        with self._lock:
+            dead = [r for r in self.replicas
+                    if not r.healthy and r.inflight == 0]
+        for rep in dead:
+            try:
+                predictor = self._factory(rep.idx)
+            except Exception as e:  # keep serving on survivors
+                with self._lock:
+                    rep.last_error = e
+                continue
+            with self._lock:
+                rep.executor = BucketedExecutor(predictor,
+                                                max_cached=self._max_cached)
+                rep.healthy = True
+                rep.restarts += 1
+                if self._metrics:
+                    self._metrics.inc("replica_restarts")
+            restarted.append(rep.idx)
+        return restarted
+
+    # -- warmup ----------------------------------------------------------------
+    def warmup(self, signature, buckets):
+        """Pre-compile every configured bucket on every replica so steady-
+        state traffic never pays a compile. Returns total compiles done."""
+        total = 0
+        for rep in self.healthy_replicas():
+            before = rep.executor.compile_count
+            rep.executor.warmup(signature, buckets)
+            total += rep.executor.compile_count - before
+        return total
+
+    def describe(self):
+        return [r.describe() for r in self.replicas]

@@ -1,0 +1,443 @@
+"""In-process inference server + framed-socket frontend.
+
+:class:`InferenceServer` ties the layer together: a bounded
+:class:`~.batcher.BatchQueue` feeds a batching loop that assembles
+shape-bucketed batches and hands them to the :class:`~.scheduler.Scheduler`
+for least-loaded multi-replica dispatch. Two execution modes share one code
+path:
+
+- **threaded** (``server.start()``): a daemon worker drains the queue
+  continuously — the production shape;
+- **pump** (``server.pump()``): one batching round runs synchronously on the
+  caller's thread — the chaos suite drives the whole failure matrix this way
+  with a fake clock and zero real sleeps.
+
+Resilience integration (PR 1–2 stack):
+
+- fault-injection sites on the three serving entry points — ``submit``
+  (serving.enqueue, inside BatchQueue.put), ``dispatch`` (serving.dispatch /
+  serving.replica_run, inside Scheduler), ``reply`` (serving.reply, in
+  :meth:`InferenceServer._reply`);
+- every batch executes inside a watchdog section deadlined by
+  ``FLAGS_serving_step_timeout``;
+- backpressure: ``ServerOverloaded`` at admission when the queue is full or
+  a deadline is unmeetable — shed, never block;
+- a per-server **request flight recorder** (the resilience ring, op =
+  "serving.batch") records every batch with its request ids; on a batch
+  failure or a server crash the ring is dumped to the artifacts dir naming
+  the failed batch.
+
+The socket frontend (:class:`SocketFrontend`) reuses the hardened
+``distributed/wire.py`` codec — non-executable frames, HMAC option,
+IdleTimeout/FrameError split — so the server inherits the transport's
+threat model for free.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+
+from ..resilience.faults import maybe_inject
+from ..resilience.recorder import FlightRecorder
+from ..resilience.watchdog import DistributedTimeout
+from .batcher import (
+    BatchQueue, DeadlineExceeded, Request, ServerOverloaded, pow2_buckets,
+)
+from .metrics import ServingMetrics
+from .scheduler import ReplicaDead, Scheduler
+
+__all__ = ["ServingConfig", "InferenceServer", "SocketFrontend",
+           "ServerOverloaded", "DeadlineExceeded"]
+
+
+def _flag(name, default):
+    from ..framework.flags import get_flag
+    v = get_flag(name, default)
+    return default if v is None else v
+
+
+class ServingConfig:
+    """Knobs for one server. Defaults come from FLAGS where a flag exists so
+    deployments can retune a live binary with ``paddle.set_flags``."""
+
+    def __init__(self, max_batch_size=8, buckets=None, max_queue=None,
+                 replicas=1, default_deadline=None, batch_wait=0.01,
+                 step_timeout=None, max_retries=1, max_cached_executables=32,
+                 warmup_signatures=(), recorder_size=256):
+        self.max_batch_size = int(max_batch_size)
+        self.buckets = sorted(buckets) if buckets else \
+            pow2_buckets(max_batch_size)
+        if self.buckets[0] < 1:
+            raise ValueError(f"buckets must be >= 1: {self.buckets}")
+        self.max_queue = int(max_queue if max_queue is not None
+                             else _flag("FLAGS_serving_max_queue", 256))
+        self.replicas = int(replicas)
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1: {self.replicas}")
+        # seconds a request may live end-to-end when the client sent no
+        # explicit deadline; None = no deadline
+        self.default_deadline = default_deadline
+        # how long the threaded loop waits for more requests before
+        # dispatching a partial batch (the classic batching knob)
+        self.batch_wait = float(batch_wait)
+        self.step_timeout = step_timeout   # None -> FLAGS_serving_step_timeout
+        self.max_retries = int(max_retries)
+        self.max_cached_executables = int(max_cached_executables)
+        # [(signature, ...)] per-row signatures to pre-compile at start
+        self.warmup_signatures = list(warmup_signatures)
+        self.recorder_size = int(recorder_size)
+
+
+class InferenceServer:
+    """Dynamic-batching, multi-replica server over ``inference.Predictor``.
+
+    ``predictor_or_config`` is an ``inference.Config`` (replicas come from a
+    ``PredictorPool``) or a ``predictor_factory(idx)`` callable (tests,
+    custom runtimes). ``clock=None`` uses real time and allows a worker
+    thread; an injected clock forces pump mode (deterministic tests).
+    """
+
+    def __init__(self, predictor_or_config, config=None, clock=None):
+        self.config = config or ServingConfig()
+        self._clock = clock
+        self.metrics = ServingMetrics(clock=clock)
+        factory = self._make_factory(predictor_or_config)
+        self.queue = BatchQueue(self.config.max_queue, clock=clock,
+                                metrics=self.metrics)
+        self.metrics.register_gauge("queue_depth", self.queue.depth)
+        self.scheduler = Scheduler(
+            factory, self.config.replicas, clock=clock,
+            step_timeout=self.config.step_timeout, metrics=self.metrics,
+            max_cached=self.config.max_cached_executables)
+        self.recorder = FlightRecorder(size=self.config.recorder_size,
+                                       rank=0, clock=clock)
+        self._worker = None
+        self._stop = threading.Event()
+        self._crashed = None
+        for sig in self.config.warmup_signatures:
+            self.warmup(sig)
+
+    def _make_factory(self, src):
+        from .. import inference
+        if callable(src) and not isinstance(src, inference.Config):
+            return src
+        if isinstance(src, inference.Config):
+            pool = inference.PredictorPool(src, size=self.config.replicas)
+            base = pool.retrieve(0)
+
+            def factory(idx, _pool=pool, _base=base):
+                # initial build comes from the pool (shared jit cache);
+                # restarts clone the surviving executable cache
+                if idx < self.config.replicas and factory.first[idx]:
+                    factory.first[idx] = False
+                    return _pool.retrieve(idx)
+                return _base.clone()
+            factory.first = [True] * self.config.replicas
+            return factory
+        raise TypeError(
+            "InferenceServer wants an inference.Config or a "
+            f"predictor_factory(idx) callable, got {type(src).__name__}")
+
+    # -- time ------------------------------------------------------------------
+    def _now(self):
+        if self._clock is not None:
+            return self._clock()
+        import time
+        return time.monotonic()
+
+    # -- client API ------------------------------------------------------------
+    def submit(self, inputs, deadline=None, timeout=None, request_id=None):
+        """Admit one request (non-blocking). ``timeout`` is relative seconds
+        (converted to an absolute deadline on the server clock); ``deadline``
+        is already absolute. Raises :class:`ServerOverloaded` when shedding.
+        """
+        now = self._now()
+        if deadline is None:
+            rel = timeout if timeout is not None \
+                else self.config.default_deadline
+            deadline = now + rel if rel is not None else None
+        req = Request(inputs, deadline=deadline, now=now,
+                      request_id=request_id)
+        self.queue.put(req)
+        return req
+
+    def infer(self, inputs, timeout=None):
+        """Synchronous convenience: submit + (pump | wait) + unwrap."""
+        req = self.submit(inputs, timeout=timeout)
+        if self._worker is None:
+            self.pump_until_done(req)
+        else:
+            req.wait(timeout)
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- batching loop ---------------------------------------------------------
+    def pump(self, max_batches=1):
+        """Run up to ``max_batches`` assemble→dispatch→reply rounds on the
+        calling thread. Returns the number of batches processed. Dead
+        replicas are drained/restarted between rounds."""
+        done = 0
+        for _ in range(max_batches):
+            self.scheduler.restart_dead()
+            batch = self.queue.assemble(self.config.buckets,
+                                        max_rows=self.config.max_batch_size)
+            if batch is None:
+                break
+            self._run_batch(batch)
+            done += 1
+        return done
+
+    def pump_until_done(self, request, max_batches=1000):
+        for _ in range(max_batches):
+            if request.done():
+                return
+            if self.pump(1) == 0 and not request.done():
+                raise RuntimeError(
+                    f"request {request.id} not completed but queue is empty "
+                    "(lost request — this is a server bug)")
+        raise RuntimeError(f"request {request.id} still pending after "
+                           f"{max_batches} batches")
+
+    def _run_batch(self, batch):
+        """Dispatch one batch with bounded retries; every request terminates.
+
+        Retry policy: a replica death or a dispatch timeout is retried on a
+        *different* replica (``batch.tried_replicas``) while attempts and
+        deadlines allow; otherwise the batch's requests fail with the
+        diagnostic error. The flight recorder ring gets one entry per
+        attempt and is dumped on final failure, naming the batch.
+        """
+        from .. import profiler
+        attempts = self.config.max_retries + 1
+        last_exc = None
+        for attempt in range(attempts):
+            entry = self.recorder.start(
+                "serving.batch", group=f"bucket{batch.bucket}",
+                shapes=[list(a.shape) for a in batch.arrays],
+                dtypes=[str(a.dtype) for a in batch.arrays],
+                peer={"batch": batch.id, "attempt": attempt,
+                      "requests": [r.id for r in batch.requests]})
+            try:
+                with profiler.RecordEvent(
+                        f"serving.batch.bucket{batch.bucket}"):
+                    outputs, rep = self.scheduler.dispatch(batch)
+            except (ReplicaDead, DistributedTimeout) as e:
+                self.recorder.finish(entry, status=type(e).__name__)
+                last_exc = e
+                self.scheduler.restart_dead()
+                if attempt + 1 < attempts and self._retry_allowed(batch):
+                    self.metrics.inc("retries")
+                    continue
+                break
+            except ServerOverloaded as e:
+                self.recorder.finish(entry, status="ServerOverloaded")
+                last_exc = e
+                break
+            except Exception as e:
+                self.recorder.finish(entry, status=type(e).__name__)
+                last_exc = e
+                break
+            self.recorder.finish(entry, status="ok")
+            try:
+                self._reply(batch, outputs)
+            except Exception as e:
+                # a failed reply must still terminate every request — an
+                # accepted request never goes silent
+                self._fail_batch(batch, e)
+            return
+        self._fail_batch(batch, last_exc)
+
+    def _retry_allowed(self, batch):
+        now = self._now()
+        for req in batch.requests:
+            if req.deadline is not None and req.deadline <= now:
+                return False
+        return bool(self.scheduler.healthy_replicas())
+
+    def _reply(self, batch, outputs):
+        """Complete every request in the batch from the padded outputs."""
+        maybe_inject("serving.reply", ConnectionError)
+        now = self._now()
+        batch.scatter_outputs(outputs)
+        self.metrics.inc("batches")
+        self.metrics.inc("rows", batch.rows)
+        self.metrics.inc("padded_rows", batch.bucket - batch.rows)
+        self.metrics.inc("completed", len(batch.requests))
+        for req in batch.requests:
+            self.metrics.observe_latency(max(0.0, now - req.enqueued_at))
+
+    def _fail_batch(self, batch, exc):
+        exc = exc if exc is not None else RuntimeError(
+            f"batch#{batch.id} failed with no diagnostic")
+        batch.fail(exc)
+        self.metrics.inc("failed", len(batch.requests))
+        dump = self._dump(reason=f"serving-batch-failure:batch#{batch.id}",
+                          batch=batch)
+        if dump:
+            self.metrics.inc("recorder_dumps")
+
+    def _dump(self, reason, batch=None):
+        try:
+            extra = {"failed_batch": batch.describe()} if batch else None
+            return self.recorder.dump(reason=reason, extra=extra)
+        except OSError:
+            return None
+
+    # -- warmup ----------------------------------------------------------------
+    def warmup(self, signature):
+        """Pre-compile all configured buckets for one per-row signature on
+        every replica. signature: [(per_row_shape, dtype), ...]."""
+        sig = tuple((tuple(s), str(d)) for s, d in signature)
+        return self.scheduler.warmup(sig, self.config.buckets)
+
+    # -- threaded mode ---------------------------------------------------------
+    def start(self):
+        """Spawn the batching worker (real-clock servers only — deterministic
+        fake-clock instances are pump-driven by design)."""
+        if self._clock is not None:
+            raise RuntimeError("fake-clock server is pump-driven; call "
+                               "pump() instead of start()")
+        if self._worker is not None and self._worker.is_alive():
+            return self
+        self._stop.clear()
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-batcher")
+        self._worker.start()
+        return self
+
+    def _loop(self):
+        try:
+            while not self._stop.is_set():
+                if not self.queue.wait_nonempty(self.config.batch_wait):
+                    self.scheduler.restart_dead()
+                    continue
+                # brief accumulation window lets concurrent submitters fill
+                # the bucket (classic batching-delay/throughput tradeoff)
+                self._stop.wait(self.config.batch_wait)
+                self.pump(max_batches=4)
+        except BaseException as e:   # crash path: dump + fail everything
+            self._crashed = e
+            self._dump(reason=f"serving-crash:{type(e).__name__}")
+            self.queue.drain(RuntimeError(
+                f"serving worker crashed: {e!r} (flight recorder dumped)"))
+            raise
+
+    def stop(self):
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join(timeout=10)
+            self._worker = None
+        n = self.queue.drain(ServerOverloaded("server stopped"))
+        if n:
+            self.metrics.inc("shed", n)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- introspection ---------------------------------------------------------
+    def stats(self):
+        snap = self.metrics.snapshot()
+        snap["replicas"] = self.scheduler.describe()
+        snap["compiles"] = sum(r.compile_count
+                               for r in self.scheduler.replicas)
+        snap["crashed"] = repr(self._crashed) if self._crashed else None
+        return snap
+
+
+class SocketFrontend:
+    """Framed-TCP frontend over ``distributed/wire.py``.
+
+    Protocol: one frame per request —
+    ``{"id", "inputs": [ndarray...], "timeout": seconds|None}`` — answered by
+    ``{"id", "outputs": [...]}`` or ``{"id", "error", "error_type"}``. The
+    non-executable codec means a hostile client can cause FrameError, never
+    code execution; with PADDLE_TPU_WIRE_SECRET set, frames are HMAC-checked.
+    Connection handler threads block in the server's request wait, so the
+    server must be started (threaded mode).
+    """
+
+    def __init__(self, server, host="127.0.0.1", port=0):
+        self._server = server
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(64)
+        self.address = self._listener.getsockname()
+        self._threads = []
+        self._closing = False
+        self._accept = threading.Thread(target=self._accept_loop, daemon=True,
+                                        name="serving-accept")
+        self._accept.start()
+
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True, name="serving-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _handle(self, conn):
+        from ..distributed import wire
+        try:
+            while not self._closing:
+                try:
+                    msg = wire.recv_frame(conn, idle_ok=True)
+                except wire.IdleTimeout:
+                    continue          # stream still framed; keep waiting
+                except (wire.FrameError, ConnectionError):
+                    return            # desynced or closed: drop connection
+                reply = self._serve_one(msg)
+                try:
+                    wire.send_frame(conn, reply)
+                except (wire.FrameError, ConnectionError, OSError):
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_one(self, msg):
+        rid = msg.get("id") if isinstance(msg, dict) else None
+        try:
+            if not isinstance(msg, dict) or "inputs" not in msg:
+                raise ValueError("frame must be {'id', 'inputs', ...}")
+            inputs = [np.asarray(a) for a in msg["inputs"]]
+            req = self._server.submit(inputs, timeout=msg.get("timeout"),
+                                      request_id=rid)
+            req.wait(msg.get("timeout"))
+            if req.error is not None:
+                raise req.error
+            return {"id": req.id, "outputs": [np.asarray(o)
+                                              for o in req.result]}
+        except BaseException as e:
+            return {"id": rid, "error": str(e),
+                    "error_type": type(e).__name__}
+
+    def close(self):
+        self._closing = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

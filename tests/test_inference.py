@@ -129,6 +129,106 @@ def test_config_summary_and_switches():
     assert "tpu" in cfg.summary()
 
 
+def test_tensor_reshape_size_mismatch_raises_not_zeros():
+    """Regression: reshape to a different element count used to silently
+    replace staged data with zeros — the predictor then served garbage."""
+    from paddle_tpu.framework.errors import InvalidArgumentError
+    h = infer.Tensor("x", predictor=None, is_input=True)
+    h.copy_from_cpu(np.arange(6, dtype="float32").reshape(2, 3))
+    with pytest.raises(InvalidArgumentError, match="does not match"):
+        h.reshape([4, 4])
+    # staged data survived the rejected reshape
+    np.testing.assert_array_equal(h.copy_to_cpu(),
+                                  np.arange(6, dtype="float32").reshape(2, 3))
+    # same-size reshape still works and preserves contents
+    h.reshape([3, 2])
+    assert h.shape() == [3, 2]
+    np.testing.assert_array_equal(h.copy_to_cpu().ravel(), np.arange(6))
+    # pre-staging reshape still allocates
+    h2 = infer.Tensor("y", predictor=None, is_input=True)
+    h2.reshape([2, 2])
+    assert h2.shape() == [2, 2]
+
+
+def test_predictor_pool_size_and_retrieve_validation(mlp):
+    """Regression: size=0 used to build one predictor anyway, and a bad
+    retrieve index raised a bare IndexError."""
+    from paddle_tpu.framework.errors import (
+        InvalidArgumentError, OutOfRangeError,
+    )
+    cfg = infer.Config()
+    cfg.set_layer(mlp)
+    with pytest.raises(InvalidArgumentError, match="size must be >= 1"):
+        infer.PredictorPool(cfg, size=0)
+    with pytest.raises(InvalidArgumentError, match="size must be >= 1"):
+        infer.PredictorPool(cfg, size=-3)
+    pool = infer.PredictorPool(cfg, size=2)
+    assert len(pool) == 2
+    with pytest.raises(OutOfRangeError, match=r"retrieve\(2\).*valid: 0..1"):
+        pool.retrieve(2)
+    with pytest.raises(OutOfRangeError):
+        pool.retrieve(-1)
+
+
+def test_exported_reload_via_config_set_exported_model(tmp_path, mlp):
+    """Full save_predictor_model → Config.set_exported_model →
+    Predictor.run chain for a real Layer (not just a jnp lambda): weights
+    are baked into the artifact, no model python needed at load."""
+    import jax.numpy as jnp
+    params = {k: v._val for k, v in mlp.state_dict().items()}
+
+    def fn(x):
+        h = jnp.maximum(x @ params["fc1.weight"] + params["fc1.bias"], 0.0)
+        return h @ params["fc2.weight"] + params["fc2.bias"]
+
+    x = np.random.RandomState(6).randn(3, 8).astype("float32")
+    prefix = str(tmp_path / "mlp_export")
+    infer.save_predictor_model(prefix, fn, (x,), platforms=["cpu"],
+                               input_names=["x"], output_names=["y"])
+    meta = __import__("json").load(open(prefix + ".iometa.json"))
+    assert meta["in_dtypes"] == ["float32"]
+
+    cfg = infer.Config()
+    cfg.set_exported_model(prefix)
+    p = infer.create_predictor(cfg)
+    p.get_input_handle("x").copy_from_cpu(x)
+    assert p.run()
+    out = p.get_output_handle("y").copy_to_cpu()
+    ref = mlp(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_exported_bf16_reload_casts_inputs(tmp_path):
+    """A bf16-exported artifact reloads and accepts float32 host input —
+    the predictor casts to the artifact's recorded in_dtypes (the bf16
+    precision config path for standalone deployment)."""
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    def fn(x, w):
+        return x @ w
+
+    x16 = np.ones((2, 4), dtype=ml_dtypes.bfloat16)
+    w16 = (np.eye(4, 3) * 2).astype(ml_dtypes.bfloat16)
+    prefix = str(tmp_path / "m_bf16")
+    infer.save_predictor_model(prefix, fn, (x16, w16), platforms=["cpu"],
+                               input_names=["x", "w"], output_names=["y"])
+    meta = __import__("json").load(open(prefix + ".iometa.json"))
+    assert meta["in_dtypes"] == ["bfloat16", "bfloat16"]
+
+    cfg = infer.Config()
+    cfg.set_exported_model(prefix)
+    cfg.enable_low_precision()          # bf16 precision config
+    p = infer.create_predictor(cfg)
+    # feed FLOAT32 — predictor must cast to the artifact's bf16 signature
+    out = p.run([np.ones((2, 4), "float32"),
+                 (np.eye(4, 3) * 2).astype("float32")])[0]
+    assert str(np.asarray(out).dtype) == "bfloat16"
+    np.testing.assert_allclose(np.asarray(out).astype("float32"),
+                               np.ones((2, 4)) @ (np.eye(4, 3) * 2))
+    del jnp  # imported for parity with the other export tests
+
+
 def test_vendor_switches_warn_not_silent():
     """enable_mkldnn / enable_tensorrt_engine are API-compat shims; they
     must SAY they are no-ops (VERDICT r2 weak #6), and the TRT precision

@@ -22,6 +22,27 @@ def test_fault_injection_lint_passes_on_tree():
     assert "fault-injection lint OK" in r.stdout
 
 
+def test_injection_lint_covers_serving_entry_points():
+    """The serving PR's contract: enqueue/dispatch/reply must stay
+    chaos-testable. Guard the lint MANIFEST itself so a refactor can't
+    silently drop the requirement along with the hook."""
+    import ast
+    src = (REPO / "tools" / "check_injection_points.py").read_text()
+    tree = ast.parse(src)
+    required = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "REQUIRED" for t in node.targets))
+    manifest = ast.literal_eval(required)
+    entries = {(rel, scope): names for rel, scope, names in manifest}
+    assert "put" in entries[
+        ("paddle_tpu/serving/batcher.py", "class:BatchQueue")]
+    assert "dispatch" in entries[
+        ("paddle_tpu/serving/scheduler.py", "class:Scheduler")]
+    assert "_reply" in entries[
+        ("paddle_tpu/serving/server.py", "class:InferenceServer")]
+
+
 def test_bench_regression_gate_help_smoke():
     r = _run(REPO / "tools" / "check_bench_regression.py", "--help")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -31,3 +52,9 @@ def test_flight_recorder_diff_help_smoke():
     r = _run(REPO / "tools" / "flight_recorder_diff.py", "--help")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "divergent" in r.stdout
+
+
+def test_serving_bench_help_smoke():
+    r = _run(REPO / "tools" / "serving_bench.py", "--help")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "shed rate" in r.stdout

@@ -1,0 +1,667 @@
+"""Serving subsystem tests (docs/serving.md).
+
+Covers the batcher (bucketing, padding, deadline-aware admission), the
+bounded compile cache, the multi-replica scheduler (least-loaded placement,
+death/drain/restart), the server's pump loop, the socket frontend/client
+over the hardened wire codec, and the two acceptance scenarios from the
+serving issue:
+
+- **chaos**: concurrent client load + injected replica death + injected
+  dispatch hang — the server sheds or retries the affected requests, every
+  other request completes within its deadline, no request goes silent, and
+  the flight-recorder dump names the failed batch. Fake clock, zero real
+  sleeps.
+- **bounded compiles**: randomized request shapes over a configured bucket
+  set drive the compile counter to at most ``len(buckets)``; a full queue
+  sheds with ``ServerOverloaded`` instead of blocking.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import serving
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.watchdog import DistributedTimeout
+from paddle_tpu.serving import (
+    BatchQueue, BucketedExecutor, DeadlineExceeded, InferenceClient,
+    InferenceServer, Request, Scheduler, ServerOverloaded, ServingConfig,
+    SocketFrontend, bucket_for, pow2_buckets, signature_of,
+)
+from paddle_tpu.serving.batcher import Batch, pad_rows
+from paddle_tpu.serving.metrics import ServingMetrics, percentile
+from paddle_tpu.serving.scheduler import ReplicaDead
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakePredictor:
+    """Predictor-shaped double: doubles input[0]; counts calls and distinct
+    shape signatures (a stand-in for XLA compilations)."""
+
+    def __init__(self, fail_after=None):
+        self.calls = 0
+        self.signatures = set()
+        self.fail_after = fail_after
+
+    def run(self, arrays):
+        self.calls += 1
+        if self.fail_after is not None and self.calls > self.fail_after:
+            raise ReplicaDead("simulated device loss")
+        self.signatures.add(tuple(
+            (tuple(a.shape), str(a.dtype)) for a in arrays))
+        return [np.asarray(arrays[0]) * 2.0]
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_ARTIFACTS_DIR", str(tmp_path / "artifacts"))
+    faults.reset()
+    yield
+    faults.reset()
+    paddle.set_flags({"FLAGS_serving_step_timeout": 60.0,
+                      "FLAGS_serving_max_queue": 256})
+
+
+def make_server(replicas=2, max_batch_size=8, clock=None, **kw):
+    clock = clock or FakeClock()
+    cfg = ServingConfig(max_batch_size=max_batch_size, replicas=replicas,
+                        **kw)
+    srv = InferenceServer(lambda i: FakePredictor(), cfg, clock=clock)
+    return srv, clock
+
+
+# -- bucketing ---------------------------------------------------------------
+
+class TestBuckets:
+    def test_pow2_buckets(self):
+        assert pow2_buckets(8) == [1, 2, 4, 8]
+        assert pow2_buckets(1) == [1]
+        assert pow2_buckets(6) == [1, 2, 4, 6]  # max kept even if not pow2
+
+    def test_bucket_for(self):
+        assert bucket_for(1, [1, 2, 4]) == 1
+        assert bucket_for(3, [1, 2, 4]) == 4
+        assert bucket_for(9, [1, 2, 4]) == 4  # clamped; assembler splits
+
+    def test_signature_strips_batch_dim(self):
+        a = np.zeros((3, 5), "float32")
+        b = np.zeros((3, 2, 2), "int64")
+        assert signature_of([a, b]) == (((5,), "float32"),
+                                        ((2, 2), "int64"))
+
+    def test_signature_rejects_scalars(self):
+        with pytest.raises(ValueError, match="leading batch"):
+            signature_of([np.float32(1.0)])
+
+    def test_pad_rows(self):
+        [p] = pad_rows([np.ones((3, 2), "float32")], 3, 8)
+        assert p.shape == (8, 2)
+        assert p[:3].sum() == 6 and p[3:].sum() == 0
+
+
+# -- requests and queue ------------------------------------------------------
+
+class TestRequestAndQueue:
+    def test_request_validation(self):
+        with pytest.raises(ValueError, match="empty request"):
+            Request([])
+        with pytest.raises(ValueError, match="disagree on row count"):
+            Request([np.zeros((2, 3)), np.zeros((3, 3))])
+        with pytest.raises(ValueError, match="zero rows"):
+            Request([np.zeros((0, 3))])
+
+    def test_queue_full_sheds_not_blocks(self):
+        clock = FakeClock()
+        q = BatchQueue(max_size=2, clock=clock)
+        q.put(Request([np.zeros((1, 2))], now=clock()))
+        q.put(Request([np.zeros((1, 2))], now=clock()))
+        with pytest.raises(ServerOverloaded, match="queue full"):
+            q.put(Request([np.zeros((1, 2))], now=clock()))
+
+    def test_unmeetable_deadline_shed_at_door(self):
+        clock = FakeClock(100.0)
+        q = BatchQueue(max_size=8, clock=clock)
+        with pytest.raises(ServerOverloaded, match="unmeetable"):
+            q.put(Request([np.zeros((1, 2))], deadline=99.0, now=clock()))
+
+    def test_expired_request_fails_loudly_not_silently(self):
+        clock = FakeClock()
+        q = BatchQueue(max_size=8, clock=clock)
+        req = q.put(Request([np.zeros((1, 2))], deadline=5.0, now=clock()))
+        clock.advance(10.0)
+        assert q.assemble([1, 2, 4]) is None  # expired, nothing to run
+        assert req.done()
+        assert isinstance(req.error, DeadlineExceeded)
+
+    def test_enqueue_injection_site(self):
+        faults.configure("serving.enqueue:#1")
+        q = BatchQueue(max_size=8, clock=FakeClock())
+        with pytest.raises(ServerOverloaded, match="injected"):
+            q.put(Request([np.zeros((1, 2))]))
+
+    def test_assemble_groups_by_signature(self):
+        clock = FakeClock()
+        q = BatchQueue(max_size=8, clock=clock)
+        a1 = q.put(Request([np.zeros((1, 2), "float32")], now=clock()))
+        b1 = q.put(Request([np.zeros((1, 3), "float32")], now=clock()))
+        a2 = q.put(Request([np.zeros((2, 2), "float32")], now=clock()))
+        batch = q.assemble([1, 2, 4, 8])
+        assert [r.id for r in batch.requests] == [a1.id, a2.id]
+        assert batch.rows == 3 and batch.bucket == 4
+        batch2 = q.assemble([1, 2, 4, 8])
+        assert [r.id for r in batch2.requests] == [b1.id]
+
+    def test_assemble_respects_max_rows(self):
+        clock = FakeClock()
+        q = BatchQueue(max_size=16, clock=clock)
+        for _ in range(5):
+            q.put(Request([np.zeros((2, 2))], now=clock()))
+        batch = q.assemble([1, 2, 4, 8], max_rows=4)
+        assert batch.rows == 4 and len(batch.requests) == 2
+        assert len(q) == 3
+
+    def test_drain_fails_everything(self):
+        q = BatchQueue(max_size=8, clock=FakeClock())
+        reqs = [q.put(Request([np.zeros((1, 2))])) for _ in range(3)]
+        assert q.drain(ServerOverloaded("stopping")) == 3
+        assert all(isinstance(r.error, ServerOverloaded) for r in reqs)
+
+
+class TestBatchScatter:
+    def test_scatter_slices_rows_back(self):
+        reqs = [Request([np.full((n, 2), n, "float32")]) for n in (1, 2, 3)]
+        batch = Batch(reqs, buckets=[1, 2, 4, 8])
+        assert batch.rows == 6 and batch.bucket == 8
+        outs = [batch.arrays[0] * 10]
+        batch.scatter_outputs(outs)
+        for n, r in zip((1, 2, 3), reqs):
+            assert r.result[0].shape == (n, 2)
+            np.testing.assert_allclose(r.result[0], n * 10)
+
+
+# -- bounded compile cache ---------------------------------------------------
+
+class TestBucketedExecutor:
+    def test_compile_counting(self):
+        ex = BucketedExecutor(FakePredictor())
+        for b in (1, 2, 4, 2, 1, 4):
+            ex.run([np.zeros((b, 3), "float32")])
+        assert ex.compile_count == 3
+
+    def test_lru_bound_evicts(self):
+        ex = BucketedExecutor(FakePredictor(), max_cached=2)
+        for b in (1, 2, 3, 1):   # 1 evicted by 3, recompiles
+            ex.run([np.zeros((b, 3), "float32")])
+        assert ex.compile_count == 4
+        assert len(ex._keys) == 2
+
+    def test_lru_eviction_reaches_predictor_jit_cache(self):
+        class P(FakePredictor):
+            def __init__(self):
+                super().__init__()
+                self._jit_cache = {}
+
+            def run(self, arrays):
+                key = tuple((tuple(np.asarray(a).shape),
+                             str(np.asarray(a).dtype)) for a in arrays)
+                self._jit_cache[key] = True
+                return super().run(arrays)
+
+        p = P()
+        ex = BucketedExecutor(p, max_cached=2)
+        for b in (1, 2, 3):
+            ex.run([np.zeros((b, 3), "float32")])
+        assert len(p._jit_cache) == 2  # bucket-1 executable evicted
+
+    def test_warmup_precompiles_all_buckets(self):
+        ex = BucketedExecutor(FakePredictor())
+        ex.warmup((((3,), "float32"),), [1, 2, 4, 8])
+        assert ex.compile_count == 4
+        ex.run([np.zeros((4, 3), "float32")])
+        assert ex.compile_count == 4  # warm
+
+
+# -- scheduler ---------------------------------------------------------------
+
+class TestScheduler:
+    def _sched(self, size=3, clock=None):
+        return Scheduler(lambda i: FakePredictor(), size,
+                         clock=clock or FakeClock(), step_timeout=60.0)
+
+    def test_least_loaded_pick(self):
+        s = self._sched()
+        s.replicas[0].inflight = 2
+        s.replicas[1].inflight = 1
+        assert s.pick().idx == 2 or s.replicas[2].inflight == 0
+        s.replicas[2].inflight = 5
+        assert s.pick().idx == 1
+
+    def test_pick_excludes_tried(self):
+        s = self._sched(size=2)
+        assert s.pick(exclude={0}).idx == 1
+        with pytest.raises(ServerOverloaded):
+            s.pick(exclude={0, 1})
+
+    def test_dead_replica_drained_and_restarted(self):
+        s = self._sched(size=2)
+        batch = Batch([Request([np.ones((1, 2), "float32")])], [1, 2])
+        faults.configure("serving.replica_run:#1")
+        with pytest.raises(ReplicaDead, match="died running batch"):
+            s.dispatch(batch)
+        dead = [r for r in s.replicas if not r.healthy]
+        assert len(dead) == 1 and dead[0].inflight == 0
+        assert s.restart_dead() == [dead[0].idx]
+        assert all(r.healthy for r in s.replicas)
+        assert dead[0].restarts == 1
+
+    def test_factory_failure_keeps_replica_dead(self):
+        calls = {"n": 0}
+
+        def factory(i):
+            calls["n"] += 1
+            if calls["n"] > 2:   # initial builds ok, restart fails
+                raise RuntimeError("no device")
+            return FakePredictor()
+
+        s = Scheduler(factory, 2, clock=FakeClock(), step_timeout=60.0)
+        s._mark_dead(s.replicas[0], RuntimeError("x"))
+        assert s.restart_dead() == []
+        assert not s.replicas[0].healthy
+        assert s.pick().idx == 1  # survivors keep serving
+
+    def test_warmup_covers_every_replica(self):
+        s = self._sched(size=2)
+        n = s.warmup((((3,), "float32"),), [1, 2, 4])
+        assert n == 6
+        assert all(r.compile_count == 3 for r in s.replicas)
+
+
+# -- server: pump mode -------------------------------------------------------
+
+class TestInferenceServer:
+    def test_end_to_end_result(self):
+        srv, _ = make_server()
+        r = srv.submit([np.full((2, 3), 5.0, "float32")])
+        assert srv.pump(1) == 1
+        np.testing.assert_allclose(r.result[0], 10.0)
+        assert r.result[0].shape == (2, 3)
+
+    def test_infer_sync_pump_mode(self):
+        srv, _ = make_server()
+        [out] = srv.infer([np.ones((1, 4), "float32")])
+        np.testing.assert_allclose(out, 2.0)
+
+    def test_metrics_occupancy_and_latency(self):
+        srv, clock = make_server()
+        srv.submit([np.ones((3, 2), "float32")])
+        clock.advance(0.5)
+        srv.pump(1)
+        s = srv.stats()
+        assert s["batches"] == 1 and s["rows"] == 3 and s["padded_rows"] == 1
+        assert s["batch_occupancy"] == pytest.approx(0.75)
+        assert s["latency_p50"] == pytest.approx(0.5)
+        assert s["queue_depth"] == 0
+
+    def test_default_deadline_applied(self):
+        srv, clock = make_server(default_deadline=1.0)
+        r = srv.submit([np.ones((1, 2), "float32")])
+        assert r.deadline == pytest.approx(clock() + 1.0)
+
+    def test_reply_injection_fails_requests_loudly(self):
+        srv, _ = make_server()
+        faults.configure("serving.reply:#1")
+        r = srv.submit([np.ones((1, 2), "float32")])
+        srv.pump(1)
+        assert r.done() and isinstance(r.error, ConnectionError)
+
+    def test_warmup_signatures_in_config(self):
+        clock = FakeClock()
+        cfg = ServingConfig(max_batch_size=4, replicas=1,
+                            warmup_signatures=[(((3,), "float32"),)])
+        srv = InferenceServer(lambda i: FakePredictor(), cfg, clock=clock)
+        assert srv.stats()["compiles"] == 3  # buckets 1,2,4
+        srv.infer([np.ones((3, 3), "float32")])
+        assert srv.stats()["compiles"] == 3  # served warm
+
+    def test_fake_clock_server_refuses_threaded_start(self):
+        srv, _ = make_server()
+        with pytest.raises(RuntimeError, match="pump-driven"):
+            srv.start()
+
+    def test_real_predictor_pool_integration(self):
+        import paddle_tpu.inference as infer
+        paddle.seed(0)
+        layer = nn.Linear(4, 2)
+        cfg = infer.Config()
+        cfg.set_layer(layer)
+        srv = InferenceServer(cfg,
+                              ServingConfig(max_batch_size=2, replicas=2),
+                              clock=FakeClock())
+        x = np.random.RandomState(0).randn(1, 4).astype("float32")
+        [out] = srv.infer([x])
+        ref = layer(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+# -- acceptance: bounded compiles + shedding ---------------------------------
+
+class TestBoundedCompiles:
+    def test_randomized_shapes_bounded_by_bucket_count(self):
+        """ISSUE acceptance: randomized request row counts over a configured
+        bucket set → compile counter <= len(buckets), per replica."""
+        buckets = [1, 2, 4, 8]
+        srv, _ = make_server(replicas=2, max_batch_size=8, buckets=buckets,
+                             max_queue=512)
+        rng = np.random.RandomState(42)
+        for _ in range(60):
+            rows = int(rng.randint(1, 9))
+            srv.submit([rng.randn(rows, 3).astype("float32")])
+            if rng.random() < 0.5:
+                srv.pump(1)
+        while srv.pump(1):
+            pass
+        for rep in srv.scheduler.replicas:
+            assert rep.compile_count <= len(buckets), rep.describe()
+        assert srv.metrics.get("completed") == 60
+        # XLA only ever saw bucket shapes
+        for rep in srv.scheduler.replicas:
+            seen = rep.executor.predictor.signatures
+            assert {s[0][0][0] for s in seen} <= set(buckets)
+
+    def test_queue_full_raises_overloaded_not_blocks(self):
+        """ISSUE acceptance: load shedding raises ServerOverloaded rather
+        than blocking indefinitely."""
+        srv, _ = make_server(max_queue=4)
+        for _ in range(4):
+            srv.submit([np.ones((1, 2), "float32")])
+        with pytest.raises(ServerOverloaded, match="queue full"):
+            srv.submit([np.ones((1, 2), "float32")])
+        assert srv.metrics.get("shed") == 1
+
+
+# -- acceptance: chaos -------------------------------------------------------
+
+@pytest.mark.chaos
+class TestServingChaos:
+    def test_replica_death_plus_dispatch_hang_under_load(self, tmp_path):
+        """The issue's chaos acceptance scenario, all on a fake clock:
+
+        concurrent clients submit 24 requests; fault injection kills a
+        replica on one batch and hangs dispatch on another. The server
+        retries both affected batches on surviving replicas (deadlines
+        allow it), every request completes with correct data, nothing goes
+        silent, and the mid-flight failures are visible in the metrics and
+        the flight recorder.
+        """
+        clock = FakeClock()
+        srv, _ = make_server(replicas=3, max_batch_size=4, clock=clock,
+                             max_queue=64, max_retries=2)
+        # batch schedule: replica death on the 2nd executed batch, dispatch
+        # hang on the 4th dispatch attempt
+        faults.configure("serving.replica_run:#2,serving.dispatch:#4")
+
+        reqs = []
+        lock = threading.Lock()
+
+        def client(k):
+            for i in range(6):
+                r = srv.submit([np.full((1, 3), k * 10 + i, "float32")],
+                               deadline=clock() + 30.0)
+                with lock:
+                    reqs.append(r)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(reqs) == 24
+
+        rounds = 0
+        while srv.pump(1):
+            rounds += 1
+            assert rounds < 100
+        # every request terminated, none silently lost
+        assert all(r.done() for r in reqs)
+        ok = [r for r in reqs if r.error is None]
+        assert len(ok) == 24  # retries absorbed both failures
+        for r in ok:  # data integrity: each row came back as its own double
+            np.testing.assert_allclose(r.result[0], r.inputs[0] * 2.0)
+        assert srv.metrics.get("replica_deaths") == 1
+        assert srv.metrics.get("retries") >= 2
+        assert srv.metrics.get("replica_restarts") >= 1
+        assert all(rep.healthy for rep in srv.scheduler.replicas)
+        # the flight recorder ring kept both failure attempts
+        statuses = [e["status"] for e in srv.recorder.entries()]
+        assert "ReplicaDead" in statuses
+        assert "DistributedTimeout" in statuses
+
+    def test_unretryable_failure_sheds_and_dumps_named_batch(self, tmp_path):
+        """When every dispatch attempt hangs, the batch's requests shed with
+        the diagnostic DistributedTimeout and the flight-recorder dump in
+        the artifacts dir names the failed batch and its requests."""
+        clock = FakeClock()
+        srv, _ = make_server(replicas=2, max_batch_size=4, clock=clock,
+                             max_retries=1)
+        faults.configure("serving.dispatch:#1+")   # hang every attempt
+        victim = srv.submit([np.ones((2, 3), "float32")],
+                            deadline=clock() + 30.0)
+        srv.pump(2)
+        assert victim.done()
+        assert isinstance(victim.error, DistributedTimeout)
+        # other traffic still flows once the injection stops
+        faults.reset()
+        survivor = srv.submit([np.ones((1, 3), "float32")],
+                              deadline=clock() + 30.0)
+        srv.pump(2)
+        assert survivor.error is None
+
+        from paddle_tpu.resilience.recorder import artifacts_dir
+        dump_file = (tmp_path / "artifacts" /
+                     "flight_recorder_rank0.json")
+        assert str(dump_file.parent) == artifacts_dir()
+        dump = json.loads(dump_file.read_text())
+        assert dump["reason"].startswith("serving-batch-failure:batch#")
+        failed = dump["failed_batch"]
+        assert failed["requests"] == [victim.id]
+        assert any(e["status"] == "DistributedTimeout"
+                   for e in dump["entries"])
+
+    def test_deadline_too_tight_for_retry_sheds_affected_only(self):
+        """A dispatch failure with no deadline headroom sheds the affected
+        batch instead of retrying past the SLO; concurrent traffic with
+        slack completes."""
+        clock = FakeClock()
+        deaths = {"left": 1}
+
+        class SlowDying(FakePredictor):
+            """Each attempt costs 2 fake seconds; the first attempt in the
+            process also kills its replica (death after time was spent —
+            the case where retrying would blow the SLO)."""
+
+            def run(self, arrays):
+                clock.advance(2.0)
+                if deaths["left"] > 0:
+                    deaths["left"] -= 1
+                    raise ReplicaDead("died mid-batch after 2s")
+                return super().run(arrays)
+
+        srv = InferenceServer(
+            lambda i: SlowDying(),
+            ServingConfig(max_batch_size=2, replicas=2, max_retries=3),
+            clock=clock)
+        tight = srv.submit([np.ones((1, 2), "float32")],
+                           deadline=clock() + 1.0)  # no retry headroom
+        loose = srv.submit([np.ones((1, 3), "float32")],
+                           deadline=clock() + 60.0)
+        while srv.pump(1):
+            pass
+        assert tight.done() and isinstance(tight.error, ReplicaDead)
+        assert loose.done() and loose.error is None
+        assert srv.metrics.get("retries") == 0  # SLO forbade the retry
+
+    def test_all_replicas_dead_sheds_with_overloaded(self):
+        clock = FakeClock()
+        dead = {"all": False}
+
+        class Dying(FakePredictor):
+            def run(self, arrays):
+                if dead["all"]:
+                    raise ReplicaDead("device gone")
+                return super().run(arrays)
+
+        factory_fails = {"on": False}
+
+        def factory(i):
+            if factory_fails["on"]:
+                raise RuntimeError("no devices left")
+            return Dying()
+
+        srv = InferenceServer(factory,
+                              ServingConfig(max_batch_size=2, replicas=2,
+                                            max_retries=3),
+                              clock=clock)
+        dead["all"] = True
+        factory_fails["on"] = True
+        r = srv.submit([np.ones((1, 2), "float32")])
+        srv.pump(4)
+        assert r.done()
+        assert isinstance(r.error, (ServerOverloaded, ReplicaDead))
+
+
+# -- socket frontend + client ------------------------------------------------
+
+class TestSocketServing:
+    """Real-socket integration (threaded server, real clock, sub-second
+    bounded waits — same budget discipline as the p2p transport tests)."""
+
+    @pytest.fixture()
+    def served(self):
+        cfg = ServingConfig(max_batch_size=4, replicas=2, batch_wait=0.005)
+        srv = InferenceServer(lambda i: FakePredictor(), cfg)
+        srv.start()
+        fe = SocketFrontend(srv)
+        yield srv, fe
+        fe.close()
+        srv.stop()
+
+    def test_roundtrip(self, served):
+        srv, fe = served
+        with InferenceClient(fe.address) as cli:
+            x = np.arange(6, dtype="float32").reshape(2, 3)
+            [out] = cli.infer([x], timeout=10.0)
+            np.testing.assert_allclose(out, x * 2.0)
+
+    def test_concurrent_clients(self, served):
+        srv, fe = served
+        outs = {}
+        errs = []
+
+        def one(k):
+            try:
+                with InferenceClient(fe.address) as cli:
+                    [o] = cli.infer([np.full((1, 3), k, "float32")],
+                                    timeout=10.0)
+                    outs[k] = o
+            except Exception as e:   # collected, not swallowed
+                errs.append(e)
+
+        threads = [threading.Thread(target=one, args=(k,)) for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert not errs
+        assert len(outs) == 8
+        for k, o in outs.items():
+            np.testing.assert_allclose(o, k * 2.0)
+        assert srv.metrics.get("completed") == 8
+
+    def test_shed_roundtrips_as_typed_overloaded(self, served):
+        srv, fe = served
+        faults.configure("serving.enqueue:#1")
+        with InferenceClient(fe.address) as cli:
+            with pytest.raises(ServerOverloaded):
+                cli.infer([np.ones((1, 3), "float32")], timeout=10.0)
+
+    def test_malformed_frame_gets_error_reply(self, served):
+        from paddle_tpu.distributed import wire
+        import socket as socket_mod
+        srv, fe = served
+        with socket_mod.create_connection(fe.address, timeout=5) as s:
+            wire.send_frame(s, {"id": 1, "not_inputs": []})
+            reply = wire.recv_frame(s, timeout=5)
+        assert reply["error_type"] == "ValueError"
+        assert "inputs" in reply["error"]
+
+
+# -- bench tool --------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serving_bench_smoke():
+    """tools/serving_bench.py --smoke must complete a real threaded sweep on
+    CPU and emit parseable JSON with the report fields."""
+    import subprocess
+    import sys
+    from pathlib import Path
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(__import__("os").environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, str(repo / "tools" / "serving_bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    [res] = doc["results"]
+    assert res["completed"] > 0 and res["failed"] == 0
+    for key in ("throughput_rps", "latency_ms_p50", "latency_ms_p99",
+                "batch_occupancy", "shed_rate"):
+        assert res[key] is not None
+    # bucketed serving: compiles bounded by buckets x replicas
+    assert doc["total_compiles"] <= 4
+
+
+# -- metrics -----------------------------------------------------------------
+
+class TestMetrics:
+    def test_percentile(self):
+        assert percentile([], 50) == 0.0
+        assert percentile([1.0], 99) == 1.0
+        vals = list(range(1, 101))
+        assert percentile(vals, 50) == pytest.approx(50, abs=1)
+        assert percentile(vals, 99) == pytest.approx(99, abs=1)
+
+    def test_snapshot_keys(self):
+        m = ServingMetrics(clock=FakeClock())
+        m.inc("rows", 6)
+        m.inc("padded_rows", 2)
+        m.observe_latency(0.1)
+        snap = m.snapshot()
+        assert snap["batch_occupancy"] == pytest.approx(0.75)
+        assert snap["latency_p50"] == pytest.approx(0.1)
+
+    def test_export_to_profiler_emits_counters(self, tmp_path):
+        from paddle_tpu import profiler
+        m = ServingMetrics(clock=FakeClock())
+        m.inc("submitted", 3)
+        with profiler.Profiler(timer_only=True):
+            m.export_to_profiler()
+            trace_path = str(tmp_path / "trace.json")
+        profiler.export_chrome_tracing(trace_path)
+        trace = json.loads((tmp_path / "trace.json").read_text())
+        counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+        assert any(e["name"] == "serving.submitted"
+                   and e["args"]["value"] == 3 for e in counters)

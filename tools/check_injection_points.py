@@ -38,6 +38,15 @@ REQUIRED = [
      ["send_obj", "recv_obj", "group_barrier"]),
     ("paddle_tpu/distributed/wire.py", "module",
      ["send_frame", "recv_frame"]),
+    # serving entry points (serving PR): the chaos suite must be able to
+    # shed at the door (enqueue), kill/hang a batch in flight (dispatch),
+    # and fail the result path (reply)
+    ("paddle_tpu/serving/batcher.py", "class:BatchQueue",
+     ["put"]),
+    ("paddle_tpu/serving/scheduler.py", "class:Scheduler",
+     ["dispatch"]),
+    ("paddle_tpu/serving/server.py", "class:InferenceServer",
+     ["_reply"]),
 ]
 
 # _injected_run is HDFSClient's hook-carrying chokepoint: routing a call

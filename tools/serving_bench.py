@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Offered-load sweep for the serving subsystem (docs/serving.md).
+
+Drives an :class:`paddle_tpu.serving.InferenceServer` (threaded mode, real
+clock) with open-loop Poisson arrivals at each offered rate and reports, per
+rate: achieved throughput, p50/p99 latency, batch occupancy, and shed rate.
+The open-loop shape matters — a closed loop (wait for each reply before
+sending the next) can never overload the server, so it cannot show the
+backpressure knee this tool exists to find.
+
+Examples::
+
+    # sweep a tiny MLP on whatever backend JAX_PLATFORMS selects
+    python tools/serving_bench.py --rates 50,200,800 --duration 2
+
+    # CPU smoke (the test suite runs exactly this, slow lane)
+    JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke
+
+Output: one JSON document on stdout (the bench-gate pattern: machines parse
+stdout, humans read the table on stderr).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_server(args):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.inference as infer
+    import paddle_tpu.nn as nn
+    from paddle_tpu import serving
+
+    paddle.seed(0)
+    layer = nn.Sequential(nn.Linear(args.features, args.hidden), nn.ReLU(),
+                          nn.Linear(args.hidden, 8))
+    cfg = infer.Config()
+    cfg.set_layer(layer)
+    scfg = serving.ServingConfig(
+        max_batch_size=args.max_batch_size,
+        replicas=args.replicas,
+        max_queue=args.max_queue,
+        batch_wait=args.batch_wait,
+        default_deadline=args.deadline,
+        warmup_signatures=[(((args.features,), "float32"),)],
+    )
+    server = serving.InferenceServer(cfg, scfg)
+    # one extra end-to-end warm call so the sweep never measures a compile
+    server.start()
+    server.infer([np.zeros((1, args.features), "float32")], timeout=60.0)
+    return server
+
+
+def run_rate(server, rate, duration, features):
+    """Open-loop load at `rate` req/s for `duration` s; returns the stats
+    delta plus client-observed latencies."""
+    import numpy as np
+
+    from paddle_tpu.serving import ServerOverloaded
+
+    before = server.metrics.snapshot()
+    t0 = time.monotonic()
+    lat, shed, errors = [], [0], [0]
+    pending = []
+    lock = threading.Lock()
+    rng = random.Random(1234)
+    x = np.random.RandomState(0).randn(1, features).astype("float32")
+
+    def reap():
+        with lock:
+            live = []
+            for req, t_sub in pending:
+                if req.done():
+                    if req.error is None:
+                        lat.append(time.monotonic() - t_sub)
+                    else:
+                        errors[0] += 1
+                else:
+                    live.append((req, t_sub))
+            pending[:] = live
+
+    deadline = t0 + duration
+    now = time.monotonic()
+    while now < deadline:
+        try:
+            req = server.submit([x])
+            with lock:
+                pending.append((req, now))
+        except ServerOverloaded:
+            shed[0] += 1
+        reap()
+        # Poisson arrivals: exponential inter-arrival gaps
+        time.sleep(min(rng.expovariate(rate), 0.25))
+        now = time.monotonic()
+    # drain
+    drain_by = time.monotonic() + 10.0
+    while pending and time.monotonic() < drain_by:
+        reap()
+        time.sleep(0.005)
+    wall = time.monotonic() - t0
+    after = server.metrics.snapshot()
+
+    def delta(k):
+        return after[k] - before[k]
+
+    offered = len(lat) + errors[0] + shed[0] + len(pending)
+    lat_ms = sorted(x * 1e3 for x in lat)
+
+    def pct(q):
+        if not lat_ms:
+            return None
+        return lat_ms[min(len(lat_ms) - 1,
+                          int(round(q / 100 * (len(lat_ms) - 1))))]
+
+    rows = delta("rows")
+    pad = delta("padded_rows")
+    return {
+        "offered_rate": rate,
+        "offered": offered,
+        "completed": len(lat),
+        "shed": shed[0],
+        "failed": errors[0],
+        "undrained": len(pending),
+        "throughput_rps": len(lat) / wall,
+        "shed_rate": shed[0] / offered if offered else 0.0,
+        "latency_ms_p50": pct(50),
+        "latency_ms_p99": pct(99),
+        "batch_occupancy": rows / (rows + pad) if rows + pad else None,
+        "batches": delta("batches"),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Offered-load sweep: throughput, p50/p99 latency, "
+                    "batch occupancy, shed rate per rate.")
+    ap.add_argument("--rates", default="50,200,800",
+                    help="comma-separated offered rates (req/s)")
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="seconds per rate point")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--max-batch-size", type=int, default=8)
+    ap.add_argument("--max-queue", type=int, default=128)
+    ap.add_argument("--batch-wait", type=float, default=0.002)
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-request SLO seconds (default: none)")
+    ap.add_argument("--features", type=int, default=16)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fast run (CI slow-lane smoke)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.rates, args.duration = "100", 0.5
+        args.hidden, args.replicas = 8, 1
+
+    server = build_server(args)
+    results = []
+    try:
+        for rate in [float(r) for r in args.rates.split(",") if r]:
+            res = run_rate(server, rate, args.duration, args.features)
+            results.append(res)
+            print(f"rate={rate:>7.0f}/s  thru={res['throughput_rps']:>7.1f}/s"
+                  f"  p50={res['latency_ms_p50'] or -1:>7.2f}ms"
+                  f"  p99={res['latency_ms_p99'] or -1:>7.2f}ms"
+                  f"  occ={res['batch_occupancy'] or 0:>5.2f}"
+                  f"  shed={res['shed_rate']:>5.1%}",
+                  file=sys.stderr)
+    finally:
+        server.stop()
+    doc = {"config": {"replicas": args.replicas,
+                      "max_batch_size": args.max_batch_size,
+                      "max_queue": args.max_queue,
+                      "batch_wait": args.batch_wait,
+                      "duration": args.duration},
+           "results": results,
+           "total_compiles": server.stats()["compiles"]}
+    json.dump(doc, sys.stdout, indent=1)
+    print()
+    # sanity: the sweep must have completed work and stayed shape-bucketed
+    ok = all(r["completed"] > 0 for r in results)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
