@@ -34,10 +34,17 @@ def save_hybrid_checkpoint(path, model, optimizer=None, meta=None):
     """Gather all (possibly sharded) state to host and save one artifact."""
     from ..framework.io_utils import save as save_obj
     inner, _ = _unwrap_model(model)
+    meta = dict(meta or {})
+    from ..resilience.recovery import current_generation
+    gen = current_generation()
+    if gen and "generation" not in meta:
+        # stamp the collective generation so resume-time diagnostics can
+        # tell which incarnation of the group produced this snapshot
+        meta["generation"] = gen
     blob = {
         "model": {k: np.asarray(t._val)
                   for k, t in inner.state_dict().items()},
-        "meta": dict(meta or {}),
+        "meta": meta,
     }
     if optimizer is not None:
         opt = getattr(optimizer, "_inner", optimizer)

@@ -132,7 +132,8 @@ def _watched(op, g, value=None):
     a rank dying mid-collective fails its peers in seconds instead of
     leaving them to idle out the full queue timeout."""
     from ..resilience.recorder import describe, get_recorder
-    from ..resilience.watchdog import PeerAbort, watch_section
+    from ..resilience.watchdog import PeerAbort, StaleGeneration, \
+        watch_section
     rec = get_recorder()
     shapes, dtypes = describe(value)
     try:
@@ -141,8 +142,10 @@ def _watched(op, g, value=None):
                             shapes=shapes, dtypes=dtypes):
                 yield
     except BaseException as err:
-        if not isinstance(err, PeerAbort):
-            # a PeerAbort means someone ELSE already failed and told us;
+        if not isinstance(err, (PeerAbort, StaleGeneration)):
+            # a PeerAbort means someone ELSE already failed and told us; a
+            # StaleGeneration means the group re-rendezvoused WITHOUT us —
+            # a stale rank must not inject aborts into the new incarnation;
             # anything else is OUR failure — tell the peers
             try:
                 rec.dump(reason=f"failure:collective.{op}")

@@ -15,12 +15,22 @@ from __future__ import annotations
 import json
 import os
 import time
+from urllib.parse import quote
 
 from ...resilience.faults import maybe_inject
 from ...resilience.retry import retry_call
 from .fs import ExecuteError
 
 __all__ = ["ElasticStatus", "FileStore", "ElasticManager"]
+
+
+def _encode_key(key):
+    """Injective, prefix-preserving filename encoding. Percent-encoding
+    every reserved byte per character means distinct keys can never map to
+    the same filename ("job/node.1" vs a literal "job_node.1") and
+    ``alive_values`` prefix matching on encoded names matches exactly the
+    keys under the raw prefix."""
+    return quote(key, safe="")
 
 
 class ElasticStatus:
@@ -40,7 +50,7 @@ class FileStore:
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key):
-        return os.path.join(self.root, key.replace("/", "_"))
+        return os.path.join(self.root, _encode_key(key))
 
     def put(self, key, value):
         maybe_inject("store.put", ExecuteError)
@@ -78,9 +88,9 @@ class FileStore:
         """Values of all non-expired keys under prefix. Keys deleted between
         listdir and open, and torn writes, count as expired."""
         out = []
+        enc_prefix = _encode_key(prefix)
         for name in sorted(os.listdir(self.root)):
-            if not name.startswith(prefix.replace("/", "_")) \
-                    or ".tmp." in name:
+            if not name.startswith(enc_prefix) or ".tmp." in name:
                 continue
             p = os.path.join(self.root, name)
             try:
@@ -92,16 +102,41 @@ class FileStore:
         return out
 
     def delete(self, key):
-        p = self._path(key)
-        if os.path.exists(p):
-            os.remove(p)
+        """Idempotent: two ranks may race to clear the same key (e.g. both
+        survivors wiping a dead rank's unhealthy marker) — losing the race
+        must not raise."""
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def gc_tmp(self, max_age=None):
+        """Garbage-collect orphaned ``*.tmp.<pid>`` staging files left by
+        writers that died mid-``put``. Only files older than the TTL (or
+        ``max_age``) are removed — a young tmp file may be an in-flight
+        write about to be os.replace'd. Returns the removed names."""
+        maybe_inject("store.gc", ExecuteError)
+        max_age = self.ttl if max_age is None else max_age
+        removed = []
+        for name in os.listdir(self.root):
+            if ".tmp." not in name:
+                continue
+            p = os.path.join(self.root, name)
+            try:
+                if time.time() - os.path.getmtime(p) > max_age:
+                    os.remove(p)
+                    removed.append(name)
+            except FileNotFoundError:
+                continue  # a concurrent gc (or the writer) won the race
+        return removed
 
 
 class ElasticManager:
     """manager.py:125 parity over a Store."""
 
     def __init__(self, store, job_id, np_min=1, np_max=None, rank=0,
-                 endpoint="127.0.0.1:0", heartbeat_interval=1.0):
+                 endpoint="127.0.0.1:0", heartbeat_interval=1.0,
+                 clock=None, sleep=None):
         self.store = store
         self.job_id = job_id
         self.np_min = np_min
@@ -112,6 +147,20 @@ class ElasticManager:
         self._key = f"{job_id}/node.{rank}"
         self._registered = False
         self._last_np = None
+        # HOLD is a latched state, not just a return value: recovering to
+        # the SAME np as before the dip must still emit RESTART (the group
+        # composition changed even if the count didn't)
+        self._held = False
+        self._generation = 0
+        # injectable for fake-clock chaos tests (zero real sleeps)
+        self._clock = clock
+        self._sleep_fn = sleep
+
+    def _now(self):
+        return self._clock() if self._clock is not None else time.monotonic()
+
+    def _sleep(self, dt):
+        (self._sleep_fn or time.sleep)(dt)
 
     # -- registration / heartbeat ------------------------------------------
     def register(self):
@@ -176,18 +225,33 @@ class ElasticManager:
         return [v["endpoint"] for v in nodes]
 
     # -- watch loop --------------------------------------------------------
-    def poll(self):
-        """One membership check → HOLD (below np_min) / RESTART (membership
-        changed) / "ok" (steady state). manager.py watch-step parity."""
-        self.heartbeat()
-        cur = self.np()
+    def _transition(self, cur):
+        """Shared HOLD/RESTART/ok state machine for poll() and watch().
+
+        HOLD latches: while below np_min the count keeps tracking (so a
+        recovery to the SAME np as before the dip is still a membership
+        change), and the first poll back at/above np_min emits RESTART
+        unconditionally.
+        """
         if cur < self.np_min:
+            self._held = True
+            self._last_np = cur
             return ElasticStatus.HOLD
+        if self._held:
+            self._held = False
+            self._last_np = cur
+            return ElasticStatus.RESTART
         if self._last_np is not None and cur != self._last_np:
             self._last_np = cur
             return ElasticStatus.RESTART
         self._last_np = cur
         return "ok"
+
+    def poll(self):
+        """One membership check → HOLD (below np_min) / RESTART (membership
+        changed) / "ok" (steady state). manager.py watch-step parity."""
+        self.heartbeat()
+        return self._transition(self.np())
 
     def watch(self, until=None, on_restart=None):
         """Heartbeat + watch membership until `until()` returns True.
@@ -202,13 +266,84 @@ class ElasticManager:
             with watch_section("elastic.watch"):
                 self.heartbeat()
                 cur = self.np()
-            if self._last_np is not None and cur != self._last_np and \
-                    cur >= self.np_min:
-                self._last_np = cur
+            if self._transition(cur) == ElasticStatus.RESTART:
                 if on_restart:
                     on_restart(cur)
                 return ElasticStatus.RESTART
-            self._last_np = cur
             if until and until():
                 return ElasticStatus.COMPLETED
-            time.sleep(self.heartbeat_interval)
+            self._sleep(self.heartbeat_interval)
+
+    # -- generation-fenced rendezvous --------------------------------------
+    def _gen_key(self):
+        return f"{self.job_id}/gen"
+
+    def announce(self, gen):
+        """Publish this rank's arrival at generation ``gen`` (TTL-leased
+        like the node key, so a rank that dies mid-rendezvous ages out)."""
+        self.store.put(
+            f"{self.job_id}/rdzv.{gen}/rank.{self.rank}",
+            {"rank": self.rank, "endpoint": self.endpoint,
+             "gen": int(gen), "ts": time.time()})
+
+    def rendezvous(self, timeout=None, poll_interval=None):
+        """Agree on the next collective generation through the store and
+        gather the new group. Returns ``(generation, endpoints)`` with
+        endpoints sorted by rank.
+
+        Every participant proposes ``max(stored, last seen) + 1`` and
+        adopts the highest proposal it observes, so concurrent survivors
+        converge on one generation. The wait runs until ``np_max`` ranks
+        arrive; at ``timeout`` it proceeds scaled-in if at least ``np_min``
+        arrived (the caller reshards via ``load_hybrid_checkpoint`` /
+        ``reshard_model``), else raises ``RendezvousTimeout``.
+        """
+        maybe_inject("recovery.rendezvous", ExecuteError)
+        from ...resilience.recovery import RendezvousTimeout, set_generation
+        if timeout is None:
+            from ...framework.flags import get_flag
+            timeout = float(get_flag("FLAGS_recovery_rendezvous_timeout",
+                                     300.0))
+        interval = poll_interval if poll_interval is not None \
+            else min(self.heartbeat_interval, 1.0)
+        if hasattr(self.store, "gc_tmp"):
+            try:
+                self.store.gc_tmp()
+            except Exception:
+                pass  # housekeeping must never block recovery
+        if not self._registered:
+            self.register()
+        # a rank that reached rendezvous is alive: clear its own stale
+        # unhealthy marker so the new group doesn't re-diagnose old news
+        self.store.delete(f"{self.job_id}/unhealthy.{self.rank}")
+        rec = self.store.get(self._gen_key()) or {}
+        gen = max(int(rec.get("gen", 0)), self._generation) + 1
+        self.store.put(self._gen_key(), {"gen": gen})
+        self.announce(gen)
+        start = self._now()
+        while True:
+            rec = self.store.get(self._gen_key()) or {}
+            if int(rec.get("gen", 0)) > gen:
+                gen = int(rec.get("gen", 0))
+                self.announce(gen)
+            arrived = self.store.alive_values(f"{self.job_id}/rdzv.{gen}/")
+            if len(arrived) >= self.np_max:
+                break
+            if self._now() - start >= timeout:
+                if len(arrived) >= self.np_min:
+                    break  # proceed scaled-in at the ranks that showed up
+                raise RendezvousTimeout(gen, len(arrived), self.np_min,
+                                        timeout)
+            self.heartbeat()
+            self._sleep(interval)
+        # the agreed group starts with a clean bill of health: markers from
+        # the dead incarnation would otherwise re-trigger recovery until
+        # their TTL lapses (delete is idempotent — every survivor may wipe)
+        for u in self.unhealthy_nodes():
+            self.store.delete(f"{self.job_id}/unhealthy.{u.get('rank')}")
+        self._generation = gen
+        self._last_np = len(arrived)
+        self._held = False
+        set_generation(gen)
+        nodes = sorted(arrived, key=lambda v: v["rank"])
+        return gen, [v["endpoint"] for v in nodes]

@@ -18,8 +18,8 @@ import sys
 import time
 
 from ..launch_utils import (
-    get_cluster_from_args, start_local_trainers, terminate_local_procs,
-    watch_local_trainers,
+    get_cluster_from_args, start_local_trainers, supervise_local_trainers,
+    terminate_local_procs, watch_local_trainers,
 )
 
 __all__ = ["launch", "main"]
@@ -38,7 +38,13 @@ def _parse_args(argv):
                    help="per-rank workerlog.N directory")
     p.add_argument("--start_port", type=int, default=None)
     p.add_argument("--elastic_retries", type=int, default=0,
-                   help="relaunch attempts on failure (elastic-lite)")
+                   help="whole-job relaunch attempts on failure "
+                        "(elastic-lite)")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="per-worker supervised restarts: relaunch ONLY the "
+                        "failed rank (with PADDLE_TPU_GENERATION bumped) "
+                        "instead of tearing down the job; restart causes "
+                        "land in the recovery journal")
     p.add_argument("--cpu_sim", action="store_true",
                    help="force JAX_PLATFORMS=cpu in trainers (virtual mesh)")
     p.add_argument("training_script", type=str)
@@ -83,6 +89,21 @@ def launch(argv=None):
             envs["PADDLE_TPU_WIRE_SECRET"] = wire_secret
         if args.cpu_sim:
             envs["JAX_PLATFORMS"] = "cpu"
+        if args.max_restarts > 0:
+            # supervised mode: per-worker relaunch inside one job attempt;
+            # --elastic_retries still wraps it for whole-job do-overs
+            try:
+                return supervise_local_trainers(
+                    cluster, pod, args.training_script,
+                    args.training_script_args, log_dir=args.log_dir,
+                    envs=envs, max_restarts=args.max_restarts)
+            except RuntimeError as e:
+                last_err = e
+                if attempt + 1 < attempts:
+                    print(f"[launch] attempt {attempt + 1} failed ({e}); "
+                          f"relaunching", file=sys.stderr)
+                    time.sleep(1.0)
+                continue
         procs = start_local_trainers(
             cluster, pod, args.training_script,
             args.training_script_args, log_dir=args.log_dir, envs=envs)

@@ -23,7 +23,8 @@ import time
 
 __all__ = ["Trainer", "Pod", "Cluster", "find_free_ports",
            "get_cluster", "get_cluster_from_args", "start_local_trainers",
-           "watch_local_trainers", "terminate_local_procs", "TrainerProc"]
+           "watch_local_trainers", "supervise_local_trainers",
+           "terminate_local_procs", "TrainerProc"]
 
 
 class Trainer:
@@ -158,24 +159,35 @@ def _trainer_env(cluster, pod, trainer, extra_env=None):
     return env
 
 
+def _launch_one(cluster, pod, trainer, idx, training_script,
+                training_script_args=(), log_dir=None, envs=None,
+                generation=0):
+    """Spawn one trainer subprocess. `generation` > 0 marks a supervised
+    RELAUNCH: the child bootstraps its recovery generation from
+    PADDLE_TPU_GENERATION so it joins the survivors' re-rendezvoused group
+    instead of replaying generation-0 traffic at them."""
+    env = _trainer_env(cluster, pod, trainer, envs)
+    if generation:
+        env["PADDLE_TPU_GENERATION"] = str(int(generation))
+    cmd = [sys.executable, "-u", training_script,
+           *map(str, training_script_args)]
+    fn = None
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        fn = open(os.path.join(log_dir, f"workerlog.{idx}"), "a")
+    proc = subprocess.Popen(cmd, env=env, stdout=fn or None,
+                            stderr=subprocess.STDOUT if fn else None)
+    return TrainerProc(proc, trainer.rank, fn, cmd)
+
+
 def start_local_trainers(cluster, pod, training_script,
                          training_script_args=(), log_dir=None,
                          envs=None):
     """launch_utils.py:468 parity: one subprocess per local trainer with the
     rank env set; stdout/err tee'd to log_dir/workerlog.N."""
-    procs = []
-    for idx, t in enumerate(pod.trainers):
-        env = _trainer_env(cluster, pod, t, envs)
-        cmd = [sys.executable, "-u", training_script,
-               *map(str, training_script_args)]
-        fn = None
-        if log_dir:
-            os.makedirs(log_dir, exist_ok=True)
-            fn = open(os.path.join(log_dir, f"workerlog.{idx}"), "a")
-        proc = subprocess.Popen(cmd, env=env, stdout=fn or None,
-                                stderr=subprocess.STDOUT if fn else None)
-        procs.append(TrainerProc(proc, t.rank, fn, cmd))
-    return procs
+    return [_launch_one(cluster, pod, t, idx, training_script,
+                        training_script_args, log_dir=log_dir, envs=envs)
+            for idx, t in enumerate(pod.trainers)]
 
 
 def terminate_local_procs(procs, timeout=15):
@@ -213,6 +225,82 @@ def _flight_recorder_hint(rank, n=3):
     return (f" | rank {rank} flight recorder tail ({data.get('reason')}): "
             f"{ops} — run tools/flight_recorder_diff.py on the artifacts "
             "dir to find the first divergent collective")
+
+
+def supervise_local_trainers(cluster, pod, training_script,
+                             training_script_args=(), log_dir=None,
+                             envs=None, max_restarts=None,
+                             poll_interval=0.5, journal=None, sleep=None):
+    """Supervised relaunch loop: restart ONLY failed workers.
+
+    The reference elastic manager relaunches the whole local pod on any
+    failure; here a worker that exits non-zero is relaunched in place (same
+    rank, same endpoint) with ``PADDLE_TPU_GENERATION`` bumped, so it joins
+    the survivors' re-rendezvoused group rather than forcing a full-job
+    teardown. Every restart's cause — exit code, the failed rank's
+    flight-recorder tail, the generation handed to the replacement — is
+    recorded in the per-job recovery journal (``PADDLE_TPU_ARTIFACTS_DIR``).
+    When the shared restart budget (default ``FLAGS_recovery_max_restarts``)
+    is spent, the remaining workers are terminated and the journal records
+    the exhaustion. Returns per-rank exit codes once every rank exited 0.
+    """
+    if max_restarts is None:
+        from ..framework.flags import get_flag
+        max_restarts = int(get_flag("FLAGS_recovery_max_restarts", 3))
+    if journal is None:
+        from ..resilience.recovery import get_journal
+        journal = get_journal()
+    _sleep = sleep or time.sleep
+    generation = int(os.environ.get("PADDLE_TPU_GENERATION", "0") or 0)
+    procs = []
+    slots = {}  # rank -> (trainer, local idx) for in-place relaunch
+    for idx, t in enumerate(pod.trainers):
+        procs.append(_launch_one(cluster, pod, t, idx, training_script,
+                                 training_script_args, log_dir=log_dir,
+                                 envs=envs))
+        slots[t.rank] = (t, idx)
+    alive = list(procs)
+    codes = {}
+    restarts = 0
+    try:
+        while alive:
+            for tp in list(alive):
+                ret = tp.proc.poll()
+                if ret is None:
+                    continue
+                alive.remove(tp)
+                if tp.log_fn:
+                    tp.log_fn.close()
+                    tp.log_fn = None
+                if ret == 0:
+                    codes[tp.rank] = 0
+                    continue
+                restarts += 1
+                hint = _flight_recorder_hint(tp.rank)
+                if restarts > max_restarts:
+                    journal.record("recovery_exhausted", rank=tp.rank,
+                                   code=ret, restarts=restarts - 1,
+                                   cause=f"exit code {ret}{hint}")
+                    raise RuntimeError(
+                        f"trainer rank {tp.rank} exited with code {ret} "
+                        f"and the restart budget ({max_restarts}) is spent"
+                        f"{hint} | recovery journal: {journal.path}")
+                generation += 1
+                journal.record("worker_restart", rank=tp.rank, code=ret,
+                               restart=restarts, generation=generation,
+                               cause=f"exit code {ret}{hint}")
+                t, idx = slots[tp.rank]
+                ntp = _launch_one(cluster, pod, t, idx, training_script,
+                                  training_script_args, log_dir=log_dir,
+                                  envs=envs, generation=generation)
+                procs.append(ntp)
+                alive.append(ntp)
+            if alive:
+                _sleep(poll_interval)
+    except (RuntimeError, KeyboardInterrupt):
+        terminate_local_procs(procs)
+        raise
+    return [codes[t.rank] for t in pod.trainers]
 
 
 def watch_local_trainers(procs, nranks=None, poll_interval=0.5):

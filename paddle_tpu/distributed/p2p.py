@@ -36,14 +36,14 @@ import numpy as np
 
 from ..resilience.faults import maybe_inject
 from ..resilience.recorder import get_recorder
-from ..resilience.watchdog import PeerAbort, watch_section
+from ..resilience.watchdog import PeerAbort, StaleGeneration, watch_section
 from . import wire
 
 __all__ = ["send_obj", "recv_obj", "send_array", "recv_array",
            "group_all_reduce", "group_all_gather", "group_broadcast",
            "group_reduce_scatter",
            "group_alltoall", "group_barrier", "endpoints", "shutdown",
-           "broadcast_abort", "PeerAbort"]
+           "broadcast_abort", "PeerAbort", "StaleGeneration"]
 
 _CONNECT_TIMEOUT = float(os.environ.get("PADDLE_TPU_P2P_CONNECT_TIMEOUT",
                                         "60"))
@@ -52,6 +52,10 @@ _CONNECT_TIMEOUT = float(os.environ.get("PADDLE_TPU_P2P_CONNECT_TIMEOUT",
 _READER_TIMEOUT = float(os.environ.get("PADDLE_TPU_P2P_READER_TIMEOUT", "30"))
 
 _ABORT_TAG = "__abort__"
+# generation-fence control frame: a receiver that drops a stale peer's frame
+# answers with its own (higher) generation so the stale rank fails fast with
+# StaleGeneration instead of idling out its recv timeout
+_STALE_TAG = "__stale__"
 _ABORT_SENTINEL = object()
 
 
@@ -116,6 +120,14 @@ class _Channel:
         self.out_lock = threading.Lock()
         self.closing = False
         self.aborts = {}  # src rank -> {"section", "reason", ...}
+        # highest newer generation observed (None = not stale); sticky like
+        # aborts: once the group moved on, every send/recv on this channel
+        # must fail with StaleGeneration until the channel is torn down
+        self.stale = None
+        # generation source; None -> the process-wide recovery generation.
+        # Overridable per channel so chaos tests can emulate two ranks at
+        # DIFFERENT generations inside one process.
+        self._gen_fn = None
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="p2p-accept")
         t.start()
@@ -157,6 +169,31 @@ class _Channel:
                     self._on_abort(int(frame["src"]),
                                    frame.get("payload") or {})
                     continue
+                if frame["tag"] == _STALE_TAG:
+                    self._on_stale(
+                        int((frame.get("payload") or {}).get("gen", 0)),
+                        src=int(frame["src"]))
+                    continue
+                fgen = wire.frame_generation(frame)
+                mygen = self._gen()
+                if fgen != mygen:
+                    if fgen < mygen:
+                        # a rank from a previous incarnation is replaying
+                        # generation-g traffic at us: drop the frame and
+                        # tell it where the group went (best-effort — if
+                        # the peer is gone its recv timeout still bounds it)
+                        try:
+                            self.send(int(frame["src"]), _STALE_TAG,
+                                      {"gen": mygen},
+                                      connect_timeout=min(
+                                          5.0, _CONNECT_TIMEOUT))
+                        except (ConnectionError, TimeoutError, OSError,
+                                StaleGeneration):
+                            pass
+                    else:
+                        # the group re-rendezvoused without us: WE are stale
+                        self._on_stale(fgen, src=int(frame["src"]))
+                    continue
                 self._queue(int(frame["src"]), frame["tag"]).put(
                     frame.get("payload"))
         except (ConnectionError, OSError, wire.FrameError):
@@ -176,6 +213,29 @@ class _Channel:
         info = self.aborts[src]
         raise PeerAbort(src, section=info.get("section", ""),
                         reason=info.get("reason", ""))
+
+    # -- generation fence -----------------------------------------------------
+    def _gen(self):
+        fn = self._gen_fn
+        if fn is not None:
+            return int(fn())
+        from ..resilience.recovery import current_generation
+        return current_generation()
+
+    def _on_stale(self, newer, src=None):
+        """The group moved to a newer generation without us: latch it and
+        wake every blocked recv so this rank fails in seconds with a typed
+        StaleGeneration instead of hanging out its timeout."""
+        self._stale_src = src
+        self.stale = max(self.stale or 0, int(newer))
+        with self.inbox_lock:
+            queues = list(self.inbox.values())
+        for q in queues:
+            q.put(_ABORT_SENTINEL)
+
+    def _raise_stale(self):
+        raise StaleGeneration(self._gen(), self.stale,
+                              src=getattr(self, "_stale_src", None))
 
     # -- send side ------------------------------------------------------------
     def _sock_to(self, dst, connect_timeout=None):
@@ -211,10 +271,14 @@ class _Channel:
                 pass
 
     def send(self, dst, tag, payload, connect_timeout=None):
+        if self.stale is not None and tag != _STALE_TAG:
+            self._raise_stale()
         if dst == self.rank:
             self._queue(self.rank, tag).put(payload)
             return
-        frame = {"src": self.rank, "tag": tag, "payload": payload}
+        frame = wire.stamp_generation(
+            {"src": self.rank, "tag": tag, "payload": payload},
+            generation=self._gen())
         s = self._sock_to(dst, connect_timeout=connect_timeout)
         try:
             wire.send_frame(s, frame)
@@ -230,6 +294,8 @@ class _Channel:
     def recv(self, src, tag, timeout=None):
         if self.aborts:
             self._raise_abort()
+        if self.stale is not None:
+            self._raise_stale()
         t = _recv_timeout() if timeout is None else timeout
         try:
             v = self._queue(src, tag).get(timeout=t)
@@ -240,6 +306,8 @@ class _Channel:
         if v is _ABORT_SENTINEL:
             if self.aborts:
                 self._raise_abort()
+            if self.stale is not None:
+                self._raise_stale()
             raise ConnectionError("p2p channel aborted")
         return v
 

@@ -22,7 +22,7 @@ import numpy as np
 from ..resilience.faults import maybe_inject
 
 __all__ = ["encode", "decode", "send_frame", "recv_frame", "FrameError",
-           "IdleTimeout"]
+           "IdleTimeout", "stamp_generation", "frame_generation"]
 
 _MAX_FRAME = 1 << 33  # 8 GiB sanity bound
 _MAX_DEPTH = 64
@@ -308,3 +308,33 @@ def read_frame_from(rfile):
         if not hmac.compare_digest(mac, want):
             raise FrameError("HMAC verification failed")
     return decode(payload)
+
+
+# -- generation fencing (resilience/recovery.py) -----------------------------
+
+def stamp_generation(frame, generation=None):
+    """Stamp the collective generation into an outgoing frame dict.
+
+    Generation 0 — a process that never rendezvoused — stamps nothing, so
+    pre-recovery jobs and the serving frontend keep producing byte-identical
+    frames. The stamp rides inside the frame dict (no header change): peers
+    that predate the fence simply ignore the extra key.
+    """
+    if generation is None:
+        from ..resilience.recovery import current_generation
+        generation = current_generation()
+    if generation and isinstance(frame, dict):
+        frame["gen"] = int(generation)
+    return frame
+
+
+def frame_generation(frame):
+    """The generation stamped into a received frame (0 when unstamped or
+    mangled — an unfenced peer must read as 'generation 0', not crash the
+    reader loop)."""
+    if isinstance(frame, dict):
+        try:
+            return int(frame.get("gen", 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+    return 0

@@ -41,6 +41,14 @@ _FLAGS: dict[str, Any] = {
     # flight-recorder ring size (entries); dumps land in
     # PADDLE_TPU_ARTIFACTS_DIR as flight_recorder_rank<N>.json
     "FLAGS_flight_recorder_size": 1024,
+    # coordinated elastic recovery (paddle_tpu/resilience/recovery.py):
+    # in-job restart budget before RecoveryExhausted
+    "FLAGS_recovery_max_restarts": 3,
+    # how long a re-rendezvous waits for replacement ranks before
+    # proceeding scaled-in at np_min (or failing below it)
+    "FLAGS_recovery_rendezvous_timeout": 300.0,
+    # exponential backoff base between restarts (doubles per restart)
+    "FLAGS_recovery_backoff_base": 1.0,
     # serving subsystem (paddle_tpu/serving, docs/serving.md):
     # watchdog deadline for one dispatched batch (assemble→run→reply)
     "FLAGS_serving_step_timeout": 60.0,

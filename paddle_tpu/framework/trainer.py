@@ -76,9 +76,15 @@ class MultiTrainer:
     """trainer.h MultiTrainer parity: owns the worker fleet for one
     train_from_dataset call."""
 
-    def __init__(self, workers):
+    def __init__(self, workers, max_worker_restarts=0):
         self.workers = workers
         self.stop_event = threading.Event()
+        # in-process analog of the launcher's supervised relaunch: a worker
+        # that died of a transport/distributed failure is restarted in
+        # place under a SHARED budget (0 = off, preserving fail-fast)
+        self.max_worker_restarts = int(max_worker_restarts)
+        self.worker_restarts = 0
+        self._restart_lock = threading.Lock()
 
     def run(self, dataset, debug=False, print_period=100, fetch_info=None):
         from ..jit.to_static import pause_donation
@@ -113,14 +119,19 @@ class MultiTrainer:
         self.stop_event.clear()
 
         def loop(w):
-            try:
-                w.run(dataset, debug=debug, print_period=print_period,
-                      fetch_info=fetch_info, stop_event=self.stop_event)
-            except BaseException as e:  # surface the real error from join
-                errors.append((w.worker_id, e))
-                # stop siblings early: draining a full shard after a
-                # correlated fault wastes the whole pass
-                self.stop_event.set()
+            while True:
+                try:
+                    w.run(dataset, debug=debug, print_period=print_period,
+                          fetch_info=fetch_info, stop_event=self.stop_event)
+                    return
+                except BaseException as e:  # surface the real error
+                    if self._try_restart(w, e):
+                        continue
+                    errors.append((w.worker_id, e))
+                    # stop siblings early: draining a full shard after a
+                    # correlated fault wastes the whole pass
+                    self.stop_event.set()
+                    return
 
         threads = [threading.Thread(target=loop, args=(w,), daemon=True)
                    for w in self.workers]
@@ -149,6 +160,31 @@ class MultiTrainer:
             ) from errors[0][1]
         from ..resilience import preempt
         preempt.check()
+
+    def _try_restart(self, w, err):
+        """Restart a worker in place after a recoverable transport failure
+        (DistributedError / ConnectionError / TimeoutError). Deterministic
+        errors and Preempted (a SystemExit) propagate — restarting can't fix
+        a bug and must never eat a preemption. Each restart's cause lands in
+        the recovery journal."""
+        from ..resilience.watchdog import DistributedError
+        if not isinstance(err, (DistributedError, ConnectionError,
+                                TimeoutError)):
+            return False
+        with self._restart_lock:
+            if self.worker_restarts >= self.max_worker_restarts or \
+                    self.stop_event.is_set():
+                return False
+            self.worker_restarts += 1
+            n = self.worker_restarts
+        try:
+            from ..resilience.recovery import get_journal
+            get_journal().record("worker_restart", worker=w.worker_id,
+                                 restart=n, cause=type(err).__name__,
+                                 detail=str(err))
+        except Exception:
+            pass  # journaling must not turn a recovery into a crash
+        return True
 
     @staticmethod
     def _hang_diagnostic(errors):

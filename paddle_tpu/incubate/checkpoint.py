@@ -202,6 +202,11 @@ class TrainEpochRange:
                  if hasattr(obj, "state_dict")}
         meta = {"epoch_no": epoch_no, "max_epoch_num": self.max_epoch_num,
                 "name": self.name}
+        from ..resilience.recovery import current_generation
+        gen = current_generation()
+        if gen:
+            # which incarnation of the collective group wrote this snapshot
+            meta["generation"] = gen
         if extra:
             meta.update(extra)
         self._saver.save_checkpoint(state, meta)

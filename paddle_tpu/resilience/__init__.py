@@ -19,6 +19,10 @@ modules compose:
 - :mod:`.watchdog` — deadlines on blocking distributed sections; expiry
   dumps the recorder + thread stacks, marks the rank unhealthy in the
   elastic store, aborts peers, and raises :class:`DistributedTimeout`.
+- :mod:`.recovery` — closes the detect→recover loop: generation-fenced
+  rendezvous through the elastic store (stale ranks fail with
+  :class:`StaleGeneration`), automatic in-job restart with a budget
+  (:class:`RecoveryManager`), and a per-job recovery journal.
 """
 from __future__ import annotations
 
@@ -26,20 +30,29 @@ from . import faults  # noqa: F401
 from . import guard  # noqa: F401
 from . import preempt  # noqa: F401
 from . import recorder  # noqa: F401
+from . import recovery  # noqa: F401
 from . import retry  # noqa: F401
 from . import watchdog  # noqa: F401
 from .faults import FaultInjected, fault_point, maybe_inject  # noqa: F401
 from .guard import BadStepError, StepGuard  # noqa: F401
 from .preempt import Preempted, PreemptionCallback, PreemptionHandler  # noqa: F401
 from .recorder import FlightRecorder, get_recorder  # noqa: F401
+from .recovery import (  # noqa: F401
+    MembershipChange, RecoveryExhausted, RecoveryJournal, RecoveryManager,
+    RendezvousTimeout, current_generation,
+)
 from .retry import retry_call  # noqa: F401
 from .watchdog import (  # noqa: F401
-    DistributedError, DistributedTimeout, PeerAbort, Watchdog, watch_section,
+    DistributedError, DistributedTimeout, PeerAbort, StaleGeneration,
+    Watchdog, watch_section,
 )
 
-__all__ = ["faults", "retry", "guard", "preempt", "recorder", "watchdog",
+__all__ = ["faults", "retry", "guard", "preempt", "recorder", "recovery",
+           "watchdog",
            "maybe_inject", "fault_point", "FaultInjected", "StepGuard",
            "BadStepError", "Preempted", "PreemptionHandler",
            "PreemptionCallback", "retry_call", "FlightRecorder",
            "get_recorder", "Watchdog", "watch_section", "DistributedError",
-           "DistributedTimeout", "PeerAbort"]
+           "DistributedTimeout", "PeerAbort", "StaleGeneration",
+           "RecoveryManager", "RecoveryJournal", "RecoveryExhausted",
+           "RendezvousTimeout", "MembershipChange", "current_generation"]

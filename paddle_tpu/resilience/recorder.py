@@ -159,6 +159,14 @@ class FlightRecorder:
         path = dump_path_for_rank(self.rank, base)
         payload = {"version": 1, "rank": self.rank, "reason": reason,
                    "dumped_at": self._now(), "entries": self.entries()}
+        try:
+            # which incarnation this rank was in when it dumped — lets the
+            # cross-rank diff separate "hung in gen g" from "stale rank
+            # still replaying gen g-1"
+            from .recovery import current_generation
+            payload["generation"] = current_generation()
+        except Exception:
+            pass
         if extra:
             payload.update(extra)
         tmp = f"{path}.tmp.{os.getpid()}"

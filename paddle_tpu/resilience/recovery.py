@@ -1,0 +1,340 @@
+"""Coordinated elastic recovery: generation fencing + automatic restart.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:125-240 — the
+reference manager doesn't just *detect* membership change, it rewrites
+endpoints and relaunches trainers. This module closes the same
+detect→recover loop for the TPU-native stack:
+
+- **Generation fencing.** Every (re)start of the collective group gets a
+  monotonic generation number agreed through the elastic Store
+  (:meth:`ElasticManager.rendezvous`). The process-wide generation lives
+  here; p2p frames are stamped with it (``distributed/wire.py``
+  ``stamp_generation``) and every ``watch_section`` checks it on exit, so a
+  rank still replaying generation ``g`` after the survivors moved to
+  ``g+1`` fails fast with a typed :class:`StaleGeneration` instead of
+  corrupting or hanging the new group.
+- **Automatic in-job restart.** :class:`RecoveryManager` supervises a train
+  function: any :class:`DistributedError` (watchdog timeout, peer abort,
+  stale generation) or transport failure tears down the p2p channel,
+  re-rendezvouses at the next generation — waiting for replacements up to
+  ``FLAGS_recovery_rendezvous_timeout``, proceeding scaled-in at ``np_min``
+  — restores from the last good checkpoint via the caller's ``restore``
+  hook, and resumes. A restart budget (``FLAGS_recovery_max_restarts``,
+  exponential backoff) bounds flapping; when spent the job fails with
+  :class:`RecoveryExhausted`.
+- **Recovery journal.** Every restart's cause — exception, flight-recorder
+  tail, unhealthy markers, new generation and group size — is appended to a
+  per-job JSONL journal in ``PADDLE_TPU_ARTIFACTS_DIR`` so a post-mortem
+  can name every incarnation without grepping worker logs.
+
+Clock and sleep are injectable everywhere so chaos tests (tests/
+test_recovery.py) run the whole kill→re-rendezvous→resume loop with zero
+real sleeps.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .faults import maybe_inject
+from .watchdog import (  # noqa: F401  (StaleGeneration re-exported)
+    DistributedError, DistributedTimeout, PeerAbort, StaleGeneration,
+)
+
+__all__ = ["StaleGeneration", "RecoveryExhausted", "RendezvousTimeout",
+           "MembershipChange", "RECOVERABLE",
+           "current_generation", "set_generation", "reset_generation",
+           "RecoveryJournal", "get_journal", "reset_journal",
+           "RecoveryManager"]
+
+
+class RendezvousTimeout(DistributedError):
+    """Rendezvous could not gather even ``np_min`` ranks in time."""
+
+    def __init__(self, generation, arrived, np_min, timeout):
+        super().__init__(
+            f"rendezvous at generation {generation} gathered {arrived} "
+            f"rank(s) in {timeout:.1f}s but needs at least {np_min}")
+        self.generation = int(generation)
+        self.arrived = int(arrived)
+        self.np_min = int(np_min)
+        self.timeout = float(timeout)
+
+
+class RecoveryExhausted(DistributedError):
+    """The restart budget (FLAGS_recovery_max_restarts) is spent."""
+
+    def __init__(self, max_restarts, cause=""):
+        msg = f"recovery budget exhausted after {max_restarts} restart(s)"
+        if cause:
+            msg += f"; last cause: {cause}"
+        super().__init__(msg)
+        self.max_restarts = int(max_restarts)
+        self.cause = cause
+
+
+class MembershipChange(DistributedError):
+    """The elastic manager saw RESTART/HOLD or unhealthy peers: the group
+    must re-rendezvous. Raised by :meth:`RecoveryManager.check` at step
+    boundaries and recovered by :meth:`RecoveryManager.run`."""
+
+    def __init__(self, status, np=None, unhealthy=()):
+        msg = f"elastic membership change: status={status}"
+        if np is not None:
+            msg += f", np={np}"
+        if unhealthy:
+            msg += f", unhealthy ranks={sorted(unhealthy)}"
+        super().__init__(msg)
+        self.status = status
+        self.np = np
+        self.unhealthy = list(unhealthy)
+
+
+# -- process-wide generation state -------------------------------------------
+
+_GEN_LOCK = threading.Lock()
+# bootstrapped from the launcher's relaunch env so a restarted worker joins
+# the survivors' generation instead of replaying generation 0 at them
+_GENERATION = [int(os.environ.get("PADDLE_TPU_GENERATION", "0") or 0)]
+
+
+def current_generation():
+    """This process's collective generation (0 = never rendezvoused; frames
+    stay unstamped and fencing is inert, so pre-recovery jobs are
+    unaffected)."""
+    return _GENERATION[0]
+
+
+def set_generation(gen):
+    """Adopt a generation. Monotonic: a LOWER value is ignored — a stale
+    rank must never drag the process's fence backwards. Returns the
+    effective generation."""
+    with _GEN_LOCK:
+        _GENERATION[0] = max(_GENERATION[0], int(gen))
+        return _GENERATION[0]
+
+
+def reset_generation():
+    """Test hook: back to the unfenced generation 0."""
+    with _GEN_LOCK:
+        _GENERATION[0] = 0
+
+
+# -- recovery journal --------------------------------------------------------
+
+class RecoveryJournal:
+    """Append-only JSONL journal of recovery events for one job.
+
+    One JSON object per line; readers (``entries``) tolerate a torn final
+    line from a writer that died mid-append. Lands in
+    ``PADDLE_TPU_ARTIFACTS_DIR`` next to the flight-recorder dumps so one
+    directory holds the whole post-mortem.
+    """
+
+    def __init__(self, job_id="local", dir=None, clock=None):
+        self.job_id = str(job_id)
+        self._dir = dir
+        self._clock = clock
+        self._lock = threading.Lock()
+
+    @property
+    def path(self):
+        from .recorder import artifacts_dir
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in self.job_id)
+        return os.path.join(self._dir or artifacts_dir(),
+                            f"recovery_journal_{safe}.jsonl")
+
+    def _now(self):
+        return self._clock() if self._clock is not None else time.time()
+
+    def record(self, event, **fields):
+        """Append one event. Auto-stamps job/ts/generation; explicit fields
+        win (the launcher records the CHILD's generation, not its own)."""
+        entry = {"event": event, "job": self.job_id, "ts": self._now(),
+                 "generation": current_generation()}
+        entry.update(fields)
+        line = json.dumps(entry, default=repr)
+        with self._lock:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+                f.flush()
+        return entry
+
+    def entries(self):
+        try:
+            with open(self.path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return []
+        out = []
+        for ln in lines:
+            try:
+                out.append(json.loads(ln))
+            except ValueError:
+                continue  # torn tail from a writer that died mid-append
+        return out
+
+
+_JOURNAL = [None]
+_J_LOCK = threading.Lock()
+
+
+def get_journal():
+    """Process-global journal keyed by PADDLE_JOB_ID (default "local")."""
+    with _J_LOCK:
+        if _JOURNAL[0] is None:
+            _JOURNAL[0] = RecoveryJournal(
+                os.environ.get("PADDLE_JOB_ID", "local"))
+        return _JOURNAL[0]
+
+
+def reset_journal():
+    with _J_LOCK:
+        _JOURNAL[0] = None
+
+
+# -- recovery manager --------------------------------------------------------
+
+def _flag(name, default):
+    from ..framework.flags import get_flag
+    v = get_flag(name, default)
+    return default if v is None else v
+
+
+# what run() recovers from: every distributed diagnostic (timeout, peer
+# abort, stale generation, membership change) plus raw transport failures.
+# Everything else — ValueError, Preempted (SystemExit), OOM — propagates:
+# restarting can't fix a deterministic bug and must not eat a preemption.
+RECOVERABLE = (DistributedError, ConnectionError, TimeoutError)
+
+
+class RecoveryManager:
+    """Supervises a train function: detect → tear down → re-rendezvous →
+    restore → resume, under a restart budget.
+
+    Parameters
+    ----------
+    elastic: ElasticManager — owns registration and the rendezvous.
+    restore: callable(generation) -> resume-state, called after each
+        re-rendezvous (typically ``load_hybrid_checkpoint`` which reshards
+        onto the possibly-smaller group); its return value is passed to
+        ``train_fn`` on the next attempt.
+    on_restart: callable(generation, endpoints) — post-restore hook.
+    max_restarts / rendezvous_timeout / backoff_base: default to
+        ``FLAGS_recovery_*``.
+    clock / sleep / journal: injectable for fake-clock chaos tests.
+    """
+
+    def __init__(self, elastic, restore=None, on_restart=None,
+                 max_restarts=None, rendezvous_timeout=None,
+                 backoff_base=None, clock=None, sleep=None, journal=None):
+        self.elastic = elastic
+        self.restore = restore
+        self.on_restart = on_restart
+        self.max_restarts = int(
+            _flag("FLAGS_recovery_max_restarts", 3)
+            if max_restarts is None else max_restarts)
+        self.rendezvous_timeout = float(
+            _flag("FLAGS_recovery_rendezvous_timeout", 300.0)
+            if rendezvous_timeout is None else rendezvous_timeout)
+        self.backoff_base = float(
+            _flag("FLAGS_recovery_backoff_base", 1.0)
+            if backoff_base is None else backoff_base)
+        self._clock = clock
+        self._sleep = sleep or time.sleep
+        self.journal = journal or get_journal()
+        self.restarts = 0
+
+    # -- detection ---------------------------------------------------------
+    def check(self):
+        """Step-boundary poll: raise :class:`MembershipChange` (recoverable)
+        when the manager sees RESTART/HOLD or another rank went unhealthy —
+        the survivor side of "watchdog marks a rank unhealthy"."""
+        from ..distributed.fleet.elastic import ElasticStatus
+        status = self.elastic.poll()
+        unhealthy = [u.get("rank") for u in self.elastic.unhealthy_nodes()
+                     if u.get("rank") != self.elastic.rank]
+        if status in (ElasticStatus.RESTART, ElasticStatus.HOLD):
+            raise MembershipChange(status, np=self.elastic.np(),
+                                   unhealthy=unhealthy)
+        if unhealthy:
+            raise MembershipChange("unhealthy", np=self.elastic.np(),
+                                   unhealthy=unhealthy)
+        return status
+
+    # -- supervision -------------------------------------------------------
+    def run(self, train_fn):
+        """Run ``train_fn(resume)`` to completion, restarting it through
+        :meth:`restart` on every recoverable failure. ``resume`` is None on
+        the first attempt and the ``restore`` hook's return value after
+        each restart."""
+        resume = None
+        while True:
+            try:
+                return train_fn(resume)
+            except RECOVERABLE as e:
+                resume = self.restart(cause=e)
+
+    def restart(self, cause=None):
+        """One full recovery cycle. Order matters:
+
+        1. budget + exponential backoff (correlated failure storms must
+           not produce rendezvous stampedes);
+        2. capture diagnostics — flight-recorder tail, unhealthy markers —
+           BEFORE teardown clears them;
+        3. tear down p2p so generation-g sockets/queues can't leak into
+           g+1;
+        4. re-rendezvous (replacements may join; below np_max the group
+           proceeds scaled-in) and rewrite PADDLE_TRAINER_ENDPOINTS to the
+           survivors;
+        5. restore from the last good checkpoint and journal the cause.
+        """
+        maybe_inject("recovery.restart", ConnectionError)
+        cause_name = type(cause).__name__ if cause is not None else \
+            "requested"
+        self.restarts += 1
+        if self.restarts > self.max_restarts:
+            self.journal.record("recovery_exhausted", cause=cause_name,
+                                detail=str(cause or ""),
+                                restarts=self.restarts - 1)
+            raise RecoveryExhausted(self.max_restarts,
+                                    cause=repr(cause)) from cause
+        tail = self._flight_tail()
+        try:
+            unhealthy = [u.get("rank")
+                         for u in self.elastic.unhealthy_nodes()]
+        except Exception:
+            unhealthy = []
+        delay = self.backoff_base * (2 ** (self.restarts - 1))
+        if delay > 0:
+            self._sleep(min(delay, 60.0))
+        try:
+            from ..distributed import p2p
+            p2p.shutdown()
+        except Exception:
+            pass  # teardown is best-effort; rendezvous decides liveness
+        gen, endpoints = self.elastic.rendezvous(
+            timeout=self.rendezvous_timeout)
+        if endpoints:
+            os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+        resume = self.restore(gen) if self.restore is not None else None
+        self.journal.record(
+            "restart", restart=self.restarts, cause=cause_name,
+            detail=str(cause or ""), generation=gen, np=len(endpoints),
+            flight_tail=tail, unhealthy=unhealthy)
+        if self.on_restart is not None:
+            self.on_restart(gen, endpoints)
+        return resume
+
+    @staticmethod
+    def _flight_tail(n=3):
+        from .recorder import get_recorder
+        try:
+            return [f"{e.get('op')}#{e.get('seq')}[{e.get('status')}]"
+                    for e in get_recorder().tail(n)]
+        except Exception:
+            return []

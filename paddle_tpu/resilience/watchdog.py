@@ -27,8 +27,8 @@ from contextlib import contextmanager
 from . import recorder as _recorder
 
 __all__ = ["DistributedError", "DistributedTimeout", "PeerAbort",
-           "Watchdog", "watch_section", "get_watchdog", "reset",
-           "set_health_marker", "format_all_stacks"]
+           "StaleGeneration", "Watchdog", "watch_section", "get_watchdog",
+           "reset", "set_health_marker", "format_all_stacks"]
 
 
 class DistributedError(RuntimeError):
@@ -70,6 +70,36 @@ class PeerAbort(DistributedError):
         self.src = src
         self.section = section
         self.reason = reason
+
+
+class StaleGeneration(DistributedError):
+    """Traffic (or a blocked section's late result) from a previous
+    incarnation of the collective group reached the current one.
+
+    The recovery layer (:mod:`.recovery`) fences every re-rendezvous with a
+    monotonic generation number; a rank still replaying generation ``g``
+    after the survivors moved to ``g+1`` must fail fast with this error
+    instead of corrupting or hanging the new group.
+    """
+
+    def __init__(self, stale_gen, current_gen, section="", src=None):
+        msg = (f"stale generation {stale_gen}: the collective group is now "
+               f"at generation {current_gen}")
+        if section:
+            msg += f" (section '{section}')"
+        if src is not None:
+            msg += f" [peer rank {src}]"
+        super().__init__(msg)
+        self.stale_gen = int(stale_gen)
+        self.current_gen = int(current_gen)
+        self.section = section
+        self.src = src
+
+
+def _current_generation():
+    # lazy: recovery imports this module for the error taxonomy
+    from .recovery import current_generation
+    return current_generation()
 
 
 def format_all_stacks():
@@ -264,15 +294,21 @@ def watch_section(name, timeout=None, watchdog=None):
       section fails with :class:`DistributedTimeout` even if the body
       eventually returned — a post-deadline "success" already desynchronized
       the job (matches the NCCL-watchdog abort semantics);
-    - :class:`PeerAbort` and :class:`DistributedTimeout` raised inside pass
-      through untouched (already diagnostic).
+    - :class:`PeerAbort`, :class:`DistributedTimeout` and
+      :class:`StaleGeneration` raised inside pass through untouched
+      (already diagnostic);
+    - if the recovery layer re-rendezvoused to a NEW generation while the
+      body was blocked, the section fails with :class:`StaleGeneration`
+      even if the body eventually returned — a late "success" belongs to
+      the dead incarnation and must not be committed into the new one.
     """
     wd = watchdog or get_watchdog()
     sec = wd.register(name, timeout=timeout)
     rank = wd.recorder().rank
+    gen0 = _current_generation()
     try:
         yield sec
-    except (DistributedTimeout, PeerAbort):
+    except (DistributedTimeout, PeerAbort, StaleGeneration):
         raise
     except TimeoutError as e:
         elapsed = wd._now() - sec.start
@@ -288,3 +324,6 @@ def watch_section(name, timeout=None, watchdog=None):
         raise DistributedTimeout(name, rank, sec.timeout,
                                  wd._now() - sec.start,
                                  dump_path=sec.dump_path)
+    gen1 = _current_generation()
+    if gen1 != gen0:
+        raise StaleGeneration(gen0, gen1, section=name)

@@ -12,11 +12,12 @@ pytestmark = pytest.mark.slow
 
 from paddle_tpu.distributed.launch_utils import (
     Cluster, find_free_ports, get_cluster_from_args, start_local_trainers,
-    terminate_local_procs, watch_local_trainers,
+    supervise_local_trainers, terminate_local_procs, watch_local_trainers,
 )
 from paddle_tpu.distributed.fleet.elastic import (
     ElasticManager, ElasticStatus, FileStore,
 )
+from paddle_tpu.resilience.recovery import RecoveryJournal
 
 WORKER = """
 import json, os, sys
@@ -120,6 +121,63 @@ class TestLocalLaunch:
         s0 = (tmp_path / "sec0").read_text()
         s1 = (tmp_path / "sec1").read_text()
         assert s0 and s0 == s1 and len(s0) == 64
+
+
+SUP_WORKER = """
+import os, sys
+out = sys.argv[1]
+rank = os.environ["PADDLE_TRAINER_ID"]
+marker = os.path.join(out, "died" + rank)
+if rank == "1" and not os.path.exists(marker):
+    open(marker, "w").write("x")
+    sys.exit(7)
+gen = os.environ.get("PADDLE_TPU_GENERATION", "")
+open(os.path.join(out, "gen" + rank), "w").write(gen)
+"""
+
+
+class TestSupervisedRelaunch:
+    def test_failed_rank_relaunched_with_bumped_generation(self, tmp_path):
+        """Supervised mode relaunches ONLY the failed rank: rank 1 dies once
+        (exit 7), its replacement comes up with PADDLE_TPU_GENERATION=1 while
+        rank 0's incarnation is never disturbed, and the journal names the
+        restart cause."""
+        script = tmp_path / "w.py"
+        script.write_text(SUP_WORKER)
+        cluster, pod = get_cluster_from_args(nproc_per_node=2)
+        journal = RecoveryJournal("sup", dir=str(tmp_path))
+        codes = supervise_local_trainers(
+            cluster, pod, str(script), [str(tmp_path)],
+            envs={"PYTHONPATH": ""}, max_restarts=2, poll_interval=0.05,
+            journal=journal)
+        assert codes == [0, 0]
+        # the survivor stayed at generation 0; the replacement joined at 1
+        assert (tmp_path / "gen0").read_text() == ""
+        assert (tmp_path / "gen1").read_text() == "1"
+        (entry,) = journal.entries()
+        assert entry["event"] == "worker_restart"
+        assert entry["rank"] == 1 and entry["code"] == 7
+        assert entry["restart"] == 1 and entry["generation"] == 1
+        assert "exit code 7" in entry["cause"]
+
+    def test_budget_exhaustion_terminates_job_and_journals(self, tmp_path):
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "sys.exit(7) if os.environ['PADDLE_TRAINER_ID'] == '1' "
+            "else time.sleep(60)\n")
+        cluster, pod = get_cluster_from_args(nproc_per_node=2)
+        journal = RecoveryJournal("sup2", dir=str(tmp_path))
+        t0 = time.time()
+        with pytest.raises(RuntimeError,
+                           match=r"restart budget \(1\) is spent"):
+            supervise_local_trainers(
+                cluster, pod, str(script), [], envs={"PYTHONPATH": ""},
+                max_restarts=1, poll_interval=0.05, journal=journal)
+        assert time.time() - t0 < 40  # the sleeper was terminated, not waited
+        events = [e["event"] for e in journal.entries()]
+        assert events == ["worker_restart", "recovery_exhausted"]
+        assert journal.entries()[-1]["rank"] == 1
 
 
 class TestElastic:

@@ -43,6 +43,28 @@ def test_injection_lint_covers_serving_entry_points():
         ("paddle_tpu/serving/server.py", "class:InferenceServer")]
 
 
+def test_injection_lint_covers_recovery_entry_points():
+    """The elastic-recovery PR's contract: the rendezvous, the restart
+    cycle, and store GC must stay chaos-testable (sites recovery.rendezvous
+    / recovery.restart / store.gc). Guard the MANIFEST so a refactor can't
+    silently drop the requirement along with the hook."""
+    import ast
+    src = (REPO / "tools" / "check_injection_points.py").read_text()
+    tree = ast.parse(src)
+    required = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "REQUIRED" for t in node.targets))
+    manifest = ast.literal_eval(required)
+    entries = {(rel, scope): names for rel, scope, names in manifest}
+    assert "gc_tmp" in entries[
+        ("paddle_tpu/distributed/fleet/elastic.py", "class:FileStore")]
+    assert "rendezvous" in entries[
+        ("paddle_tpu/distributed/fleet/elastic.py", "class:ElasticManager")]
+    assert "restart" in entries[
+        ("paddle_tpu/resilience/recovery.py", "class:RecoveryManager")]
+
+
 def test_bench_regression_gate_help_smoke():
     r = _run(REPO / "tools" / "check_bench_regression.py", "--help")
     assert r.returncode == 0, r.stdout + r.stderr

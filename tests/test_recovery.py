@@ -1,0 +1,800 @@
+"""Coordinated elastic recovery chaos suite (docs/resilience.md §Recovery).
+
+Covers the generation-fenced rendezvous through the elastic FileStore, the
+StaleGeneration fence at the watch_section and p2p frame levels, the
+RecoveryManager detect→teardown→re-rendezvous→restore loop with its restart
+budget and journal, the MultiTrainer in-process worker restarts, and the
+FileStore hardening satellites (injective key encoding, idempotent delete,
+tmp GC). All clocked components take an injected fake clock/sleep — the
+acceptance tests run the whole kill→re-rendezvous→resume cycle with zero
+real sleeps. The p2p fencing tests use real sockets with sub-second
+timeouts and bounded joins, mirroring tests/test_hang_detection.py.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import p2p
+from paddle_tpu.distributed.checkpoint import (
+    load_hybrid_checkpoint, save_hybrid_checkpoint,
+)
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, FileStore,
+)
+from paddle_tpu.distributed.fleet.fs import ExecuteError
+from paddle_tpu.distributed.launch_utils import find_free_ports
+from paddle_tpu.resilience import faults, preempt, recorder, recovery, watchdog
+from paddle_tpu.resilience.recorder import FlightRecorder
+from paddle_tpu.resilience.recovery import (
+    MembershipChange, RecoveryExhausted, RecoveryJournal, RecoveryManager,
+    RendezvousTimeout, StaleGeneration,
+)
+from paddle_tpu.resilience.watchdog import (
+    DistributedTimeout, PeerAbort, Watchdog, watch_section,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_recovery_state(tmp_path, monkeypatch):
+    """Fresh faults/recorder/watchdog/generation/journal per test; artifacts
+    into tmp_path; zero retry backoff so nothing really sleeps."""
+    monkeypatch.setenv("PADDLE_TPU_ARTIFACTS_DIR", str(tmp_path / "artifacts"))
+    paddle.set_flags({"FLAGS_retry_backoff_base": 0.0})
+    faults.reset()
+    recorder.reset()
+    watchdog.reset()
+    recovery.reset_generation()
+    recovery.reset_journal()
+    yield
+    faults.reset()
+    recorder.reset()
+    watchdog.reset()
+    recovery.reset_generation()
+    recovery.reset_journal()
+    preempt.uninstall()
+    p2p.shutdown()
+    paddle.set_flags({"FLAGS_retry_backoff_base": 0.5})
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _make(seed=0):
+    paddle.seed(seed)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    return model, opt
+
+
+def _sgd_step(model, opt, step):
+    """One deterministic step: the data depends only on `step`, so an
+    interrupted run that replays a step computes the identical update."""
+    rng = np.random.RandomState(1000 + step)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    loss = F.mse_loss(model(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.numpy())
+
+
+# -- generation state ---------------------------------------------------------
+
+class TestGenerationState:
+    def test_monotonic_set(self):
+        assert recovery.current_generation() == 0
+        assert recovery.set_generation(3) == 3
+        # a stale rank must never drag the fence backwards
+        assert recovery.set_generation(1) == 3
+        assert recovery.current_generation() == 3
+        recovery.reset_generation()
+        assert recovery.current_generation() == 0
+
+
+# -- FileStore satellites -----------------------------------------------------
+
+class TestFileStoreKeyEncoding:
+    """S1: `key.replace("/", "_")` collided "job/node.1" with a literal
+    "job_node.1" and made alive_values prefix matching ambiguous."""
+
+    def test_slash_and_underscore_keys_do_not_collide(self, tmp_path):
+        st = FileStore(str(tmp_path), ttl=60.0)
+        st.put("job/node.1", {"v": "slash"})
+        st.put("job_node.1", {"v": "underscore"})
+        assert st.get("job/node.1") == {"v": "slash"}
+        assert st.get("job_node.1") == {"v": "underscore"}
+
+    def test_alive_values_prefix_is_unambiguous(self, tmp_path):
+        st = FileStore(str(tmp_path), ttl=60.0)
+        st.put("job/node.0", {"rank": 0})
+        st.put("job_node.1", {"rank": "impostor"})
+        assert st.alive_values("job/node.") == [{"rank": 0}]
+
+    def test_delete_targets_exactly_one_key(self, tmp_path):
+        st = FileStore(str(tmp_path), ttl=60.0)
+        st.put("job/node.1", {"v": "slash"})
+        st.put("job_node.1", {"v": "underscore"})
+        st.delete("job/node.1")
+        assert st.get("job/node.1") is None
+        assert st.get("job_node.1") == {"v": "underscore"}
+
+
+class TestFileStoreDeleteAndGC:
+    """S2: idempotent delete + GC of orphaned tmp staging files."""
+
+    def test_delete_is_idempotent(self, tmp_path):
+        st = FileStore(str(tmp_path), ttl=60.0)
+        st.put("k", 1)
+        st.delete("k")
+        st.delete("k")  # concurrent-delete race loser: must not raise
+        st.delete("never-existed")
+        assert st.get("k") is None
+
+    def test_gc_removes_only_stale_tmp_files(self, tmp_path):
+        st = FileStore(str(tmp_path), ttl=10.0)
+        st.put("job/node.0", {"rank": 0})
+        old = tmp_path / "dead.tmp.12345"
+        old.write_text("{torn")
+        past = time.time() - 100
+        os.utime(old, (past, past))
+        young = tmp_path / "inflight.tmp.999"
+        young.write_text("{writing")
+        removed = st.gc_tmp()
+        assert removed == ["dead.tmp.12345"]
+        assert not old.exists()
+        assert young.exists()  # may be an in-flight put about to replace
+        assert st.get("job/node.0") == {"rank": 0}
+        assert st.gc_tmp() == []  # idempotent
+
+    def test_gc_is_fault_injectable(self, tmp_path):
+        st = FileStore(str(tmp_path), ttl=10.0)
+        faults.configure("store.gc:#1")
+        with pytest.raises(ExecuteError):
+            st.gc_tmp()
+        assert st.gc_tmp() == []
+
+
+# -- HOLD transition (S3) -----------------------------------------------------
+
+class TestHoldTransition:
+    def _pair(self, tmp_path, np_min=2):
+        st = FileStore(str(tmp_path), ttl=1e6)
+        a = ElasticManager(st, "j", np_min=np_min, np_max=2, rank=0,
+                           endpoint="a:1")
+        b = ElasticManager(st, "j", np_min=np_min, np_max=2, rank=1,
+                           endpoint="b:1")
+        a.register()
+        b.register()
+        while a.poll() != "ok":  # settle after both registrations
+            pass
+        return st, a, b
+
+    def test_hold_then_recover_to_same_np_emits_restart(self, tmp_path):
+        """The S3 bug: recovering ABOVE np_min with the same count as before
+        the dip never emitted RESTART, so survivors kept stale endpoints."""
+        _, a, b = self._pair(tmp_path)
+        b.exit()
+        assert a.poll() == ElasticStatus.HOLD
+        assert a.poll() == ElasticStatus.HOLD  # held, not flapping
+        replacement = ElasticManager(a.store, "j", np_min=2, np_max=2,
+                                     rank=1, endpoint="b2:1")
+        replacement.register()
+        assert a.poll() == ElasticStatus.RESTART
+        assert a.poll() == "ok"
+
+    def test_plain_scale_change_still_restarts(self, tmp_path):
+        st, a, b = self._pair(tmp_path, np_min=1)
+        b.exit()
+        assert a.poll() == ElasticStatus.RESTART  # 2 -> 1, above np_min
+        assert a.poll() == "ok"
+
+
+# -- rendezvous ---------------------------------------------------------------
+
+class TestRendezvous:
+    def _mgr(self, tmp_path, rank=0, np_min=1, np_max=1, clock=None,
+             sleep=None, job="job"):
+        st = FileStore(str(tmp_path / "store"), ttl=1e6)
+        return ElasticManager(st, job, np_min=np_min, np_max=np_max,
+                              rank=rank, endpoint=f"h{rank}:1",
+                              clock=clock, sleep=sleep)
+
+    def test_single_rank_generations_are_monotonic(self, tmp_path):
+        clock = FakeClock()
+        m = self._mgr(tmp_path, clock=clock, sleep=clock.advance)
+        m.register()
+        gen, eps = m.rendezvous(timeout=5.0)
+        assert (gen, eps) == (1, ["h0:1"])
+        assert recovery.current_generation() == 1
+        gen2, _ = m.rendezvous(timeout=5.0)
+        assert gen2 == 2
+        assert recovery.current_generation() == 2
+
+    def test_two_ranks_converge_on_one_generation(self, tmp_path):
+        clock = FakeClock()
+        st = FileStore(str(tmp_path / "store"), ttl=1e6)
+        m1 = ElasticManager(st, "job", np_min=1, np_max=2, rank=1,
+                            endpoint="h1:1", clock=clock)
+        m1.register()
+        joined = []
+
+        def sleep(dt):
+            clock.advance(dt)
+            if not joined:  # peer shows up during the wait
+                rec = st.get("job/gen") or {}
+                m1.announce(rec.get("gen", 1))
+                joined.append(1)
+
+        m0 = ElasticManager(st, "job", np_min=1, np_max=2, rank=0,
+                            endpoint="h0:1", clock=clock, sleep=sleep)
+        m0.register()
+        gen, eps = m0.rendezvous(timeout=30.0)
+        assert gen == 1
+        assert eps == ["h0:1", "h1:1"]  # sorted by rank
+
+    def test_adopts_higher_competing_proposal(self, tmp_path):
+        clock = FakeClock()
+        st = FileStore(str(tmp_path / "store"), ttl=1e6)
+        m1 = ElasticManager(st, "job", np_min=1, np_max=2, rank=1,
+                            endpoint="h1:1", clock=clock)
+        m1.register()
+
+        def sleep(dt):
+            clock.advance(dt)
+            # a survivor with a longer memory proposes a HIGHER generation
+            # mid-wait: everyone must converge on it
+            cur = (st.get("job/gen") or {}).get("gen", 0)
+            if cur < 7:
+                st.put("job/gen", {"gen": 7})
+            m1.announce(7)
+
+        m0 = ElasticManager(st, "job", np_min=1, np_max=2, rank=0,
+                            endpoint="h0:1", clock=clock, sleep=sleep)
+        m0.register()
+        gen, eps = m0.rendezvous(timeout=30.0)
+        assert gen == 7
+        assert eps == ["h0:1", "h1:1"]
+        assert recovery.current_generation() == 7
+
+    def test_scaled_in_after_timeout_at_np_min(self, tmp_path):
+        clock = FakeClock()
+        m = self._mgr(tmp_path, np_min=1, np_max=2, clock=clock,
+                      sleep=clock.advance)
+        m.register()
+        gen, eps = m.rendezvous(timeout=5.0)
+        assert gen == 1
+        assert eps == ["h0:1"]  # nobody else came: proceed scaled-in
+        assert clock.t >= 5.0  # waited the full replacement window
+
+    def test_below_np_min_raises_rendezvous_timeout(self, tmp_path):
+        clock = FakeClock()
+        m = self._mgr(tmp_path, np_min=2, np_max=2, clock=clock,
+                      sleep=clock.advance)
+        m.register()
+        with pytest.raises(RendezvousTimeout, match="needs at least 2"):
+            m.rendezvous(timeout=5.0)
+
+    def test_rendezvous_clears_unhealthy_markers(self, tmp_path):
+        clock = FakeClock()
+        m = self._mgr(tmp_path, clock=clock, sleep=clock.advance)
+        m.register()
+        m.mark_unhealthy("collective.all_reduce")
+        m.store.put("job/unhealthy.7", {"rank": 7})  # dead incarnation's
+        assert m.unhealthy_nodes()
+        m.rendezvous(timeout=5.0)
+        assert m.unhealthy_nodes() == []
+
+    def test_rendezvous_is_fault_injectable(self, tmp_path):
+        clock = FakeClock()
+        m = self._mgr(tmp_path, clock=clock, sleep=clock.advance)
+        m.register()
+        faults.configure("recovery.rendezvous:#1")
+        with pytest.raises(ExecuteError):
+            m.rendezvous(timeout=5.0)
+
+
+# -- recovery journal ---------------------------------------------------------
+
+class TestRecoveryJournal:
+    def test_record_roundtrip_and_auto_fields(self, tmp_path):
+        clock = FakeClock(42.0)
+        j = RecoveryJournal("job/with:odd chars", dir=str(tmp_path),
+                            clock=clock)
+        recovery.set_generation(5)
+        j.record("restart", cause="PeerAbort", np=2)
+        j.record("restart", cause="DistributedTimeout", generation=9)
+        ents = j.entries()
+        assert [e["event"] for e in ents] == ["restart", "restart"]
+        assert ents[0]["ts"] == 42.0 and ents[0]["generation"] == 5
+        assert ents[0]["cause"] == "PeerAbort" and ents[0]["np"] == 2
+        assert ents[1]["generation"] == 9  # explicit field wins
+        assert os.path.basename(j.path).startswith("recovery_journal_")
+
+    def test_torn_final_line_is_skipped(self, tmp_path):
+        j = RecoveryJournal("t", dir=str(tmp_path))
+        j.record("restart", cause="x")
+        with open(j.path, "a") as f:
+            f.write('{"event": "rest')  # writer died mid-append
+        assert [e["event"] for e in j.entries()] == ["restart"]
+
+    def test_default_journal_lands_in_artifacts_dir(self, tmp_path):
+        j = recovery.get_journal()
+        j.record("worker_restart", rank=1)
+        assert j.path.startswith(os.environ["PADDLE_TPU_ARTIFACTS_DIR"])
+        assert j.entries()[0]["rank"] == 1
+
+
+# -- StaleGeneration fencing --------------------------------------------------
+
+class TestWatchSectionFence:
+    def _wd(self):
+        clock = FakeClock()
+        rec = FlightRecorder(size=8, rank=0, clock=clock)
+        return Watchdog(clock=clock, recorder=rec), clock
+
+    def test_generation_change_inside_section_raises_stale(self):
+        wd, _ = self._wd()
+        recovery.set_generation(3)
+        with pytest.raises(StaleGeneration) as exc:
+            with watch_section("collective.all_reduce", watchdog=wd):
+                # the group re-rendezvoused while this section was blocked:
+                # its late "success" belongs to the dead incarnation
+                recovery.set_generation(4)
+        assert exc.value.stale_gen == 3
+        assert exc.value.current_gen == 4
+        assert "collective.all_reduce" in str(exc.value)
+
+    def test_steady_generation_passes(self):
+        wd, _ = self._wd()
+        recovery.set_generation(3)
+        with watch_section("collective.all_reduce", watchdog=wd):
+            pass
+
+    def test_stale_generation_raised_inside_passes_through(self):
+        wd, _ = self._wd()
+        with pytest.raises(StaleGeneration) as exc:
+            with watch_section("p2p.recv", watchdog=wd):
+                raise StaleGeneration(1, 2, section="p2p.recv")
+        assert exc.value.stale_gen == 1  # not re-wrapped
+
+
+class TestP2PGenerationFence:
+    @pytest.fixture
+    def chan_pair(self, monkeypatch):
+        ports = find_free_ports(2)
+        monkeypatch.setenv(
+            "PADDLE_TPU_P2P_ENDPOINTS",
+            f"127.0.0.1:{ports[0]},127.0.0.1:{ports[1]}")
+        chans = []
+        for r in (0, 1):
+            monkeypatch.setattr(p2p, "_rank_world", lambda r=r: (r, 2))
+            chans.append(p2p._Channel())
+        yield chans
+        for c in chans:
+            c.close()
+
+    def _wait(self, cond, timeout=10):
+        deadline = time.monotonic() + timeout
+        while not cond() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert cond()
+
+    def test_generation_zero_frames_roundtrip_unstamped(self, chan_pair):
+        a, b = chan_pair
+        a.send(1, ("t", 1), {"x": np.arange(3, dtype="int64")})
+        got = b.recv(0, ("t", 1), timeout=10)
+        np.testing.assert_array_equal(got["x"], np.arange(3))
+
+    def test_matching_generations_roundtrip(self, chan_pair):
+        a, b = chan_pair
+        a._gen_fn = b._gen_fn = lambda: 4
+        a.send(1, ("t", 1), "hello")
+        assert b.recv(0, ("t", 1), timeout=10) == "hello"
+
+    def test_replaying_old_generation_raises_stale_not_hang(self, chan_pair):
+        """The acceptance property: a rank replaying generation-g traffic
+        into the g+1 group gets a typed StaleGeneration in bounded time —
+        on both its recv AND its next send — instead of hanging."""
+        a, b = chan_pair
+        a._gen_fn = lambda: 2  # the re-rendezvoused survivor
+        b._gen_fn = lambda: 1  # still replaying the old incarnation
+        t0 = time.monotonic()
+        b.send(0, ("t", 1), "stale payload")
+        # the survivor drops the frame (never delivered to its queue) and
+        # notifies the sender, whose channel latches stale
+        self._wait(lambda: b.stale is not None)
+        with pytest.raises(StaleGeneration) as exc:
+            b.recv(0, ("r", 1), timeout=10)
+        assert exc.value.stale_gen == 1 and exc.value.current_gen == 2
+        with pytest.raises(StaleGeneration):
+            b.send(0, ("t", 2), "more stale")
+        assert time.monotonic() - t0 < 8
+        assert (0, ("t", 1)) not in a.inbox  # stale frame never queued
+
+    def test_newer_frame_makes_blocked_receiver_stale(self, chan_pair):
+        a, b = chan_pair
+        a._gen_fn = lambda: 2
+        b._gen_fn = lambda: 3  # b moved on without a
+        out = {}
+
+        def run():
+            try:
+                a.recv(1, ("t", 1), timeout=30)
+            except BaseException as e:  # noqa: BLE001 - captured for asserts
+                out["err"] = e
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        self._wait(lambda: a.inbox)
+        b.send(0, ("t", 1), "from the future")
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert isinstance(out["err"], StaleGeneration)
+        assert out["err"].current_gen == 3
+
+
+# -- RecoveryManager ----------------------------------------------------------
+
+def _single_rank_setup(tmp_path, np_min=1, np_max=1):
+    clock = FakeClock()
+    st = FileStore(str(tmp_path / "store"), ttl=1e6)
+    m = ElasticManager(st, "job", np_min=np_min, np_max=np_max, rank=0,
+                       endpoint="h0:1", clock=clock, sleep=clock.advance)
+    m.register()
+    return clock, st, m
+
+
+class TestRecoveryManager:
+    def test_restart_rendezvouses_restores_and_journals(self, tmp_path):
+        clock, _, m = _single_rank_setup(tmp_path)
+        journal = RecoveryJournal("job", dir=str(tmp_path), clock=clock)
+        restored = []
+
+        def restore(gen):
+            restored.append(gen)
+            return {"resumed_at": gen}
+
+        rm = RecoveryManager(m, restore=restore, max_restarts=3,
+                             rendezvous_timeout=5.0, backoff_base=1.0,
+                             sleep=clock.advance, journal=journal)
+        calls = []
+
+        def train(resume):
+            calls.append(resume)
+            if len(calls) == 1:
+                raise PeerAbort(1, section="collective.all_reduce",
+                                reason="injected")
+            return resume
+
+        assert rm.run(train) == {"resumed_at": 1}
+        assert calls == [None, {"resumed_at": 1}]
+        assert restored == [1]
+        (entry,) = journal.entries()
+        assert entry["event"] == "restart"
+        assert entry["cause"] == "PeerAbort"
+        assert entry["generation"] == 1 and entry["np"] == 1
+
+    def test_budget_exhaustion_with_exponential_backoff(self, tmp_path):
+        clock, _, m = _single_rank_setup(tmp_path)
+        journal = RecoveryJournal("job", dir=str(tmp_path), clock=clock)
+        sleeps = []
+
+        def sleep(dt):
+            sleeps.append(dt)
+            clock.advance(dt)
+
+        rm = RecoveryManager(m, max_restarts=2, rendezvous_timeout=5.0,
+                             backoff_base=1.0, sleep=sleep, journal=journal)
+
+        def always_dies(resume):
+            raise DistributedTimeout("collective.all_reduce", 0, 60.0, 61.0)
+
+        with pytest.raises(RecoveryExhausted, match="after 2 restart"):
+            rm.run(always_dies)
+        assert sleeps == [1.0, 2.0]  # backoff doubles per restart
+        events = [e["event"] for e in journal.entries()]
+        assert events == ["restart", "restart", "recovery_exhausted"]
+        assert journal.entries()[-1]["cause"] == "DistributedTimeout"
+
+    def test_non_recoverable_error_propagates(self, tmp_path):
+        clock, _, m = _single_rank_setup(tmp_path)
+        rm = RecoveryManager(m, max_restarts=3, rendezvous_timeout=5.0,
+                             backoff_base=0.0, sleep=clock.advance,
+                             journal=RecoveryJournal("j", dir=str(tmp_path)))
+        with pytest.raises(ValueError, match="deterministic bug"):
+            rm.run(lambda resume: (_ for _ in ()).throw(
+                ValueError("deterministic bug")))
+        assert rm.restarts == 0
+
+    def test_restart_is_fault_injectable(self, tmp_path):
+        clock, _, m = _single_rank_setup(tmp_path)
+        rm = RecoveryManager(m, max_restarts=3, rendezvous_timeout=5.0,
+                             backoff_base=0.0, sleep=clock.advance,
+                             journal=RecoveryJournal("j", dir=str(tmp_path)))
+        faults.configure("recovery.restart:#1")
+        with pytest.raises(ConnectionError):
+            rm.restart(cause=RuntimeError("x"))
+
+    def test_check_raises_membership_change_on_hold(self, tmp_path):
+        st = FileStore(str(tmp_path / "store"), ttl=1e6)
+        a = ElasticManager(st, "j", np_min=2, np_max=2, rank=0,
+                           endpoint="a:1")
+        b = ElasticManager(st, "j", np_min=2, np_max=2, rank=1,
+                           endpoint="b:1")
+        a.register()
+        b.register()
+        rm = RecoveryManager(a, max_restarts=1, rendezvous_timeout=1.0,
+                             backoff_base=0.0,
+                             journal=RecoveryJournal("j", dir=str(tmp_path)))
+        while True:  # settle registrations
+            try:
+                rm.check()
+                break
+            except MembershipChange:
+                continue
+        b.exit()
+        with pytest.raises(MembershipChange, match="hold"):
+            rm.check()
+
+    def test_check_raises_on_unhealthy_peer(self, tmp_path):
+        st = FileStore(str(tmp_path / "store"), ttl=1e6)
+        a = ElasticManager(st, "j", np_min=1, np_max=2, rank=0,
+                           endpoint="a:1")
+        b = ElasticManager(st, "j", np_min=1, np_max=2, rank=1,
+                           endpoint="b:1")
+        a.register()
+        b.register()
+        rm = RecoveryManager(a, max_restarts=1, rendezvous_timeout=1.0,
+                             backoff_base=0.0,
+                             journal=RecoveryJournal("j", dir=str(tmp_path)))
+        while True:
+            try:
+                rm.check()
+                break
+            except MembershipChange:
+                continue
+        b.mark_unhealthy("collective.all_reduce")
+        with pytest.raises(MembershipChange) as exc:
+            rm.check()
+        assert exc.value.unhealthy == [1]
+
+
+# -- MultiTrainer in-process restarts ----------------------------------------
+
+class TestMultiTrainerRestart:
+    def _worker(self, cls, wid, n, **kw):
+        w = cls(wid, n, **kw)
+
+        class _Prog:  # pre-warmed: skip the single-threaded warmup path
+            _trainer_warmed = True
+            feed_vars = []
+        w._program = _Prog()
+        return w
+
+    def _dataset(self, n_batches):
+        from paddle_tpu.distributed import InMemoryDataset
+        ds = InMemoryDataset()
+        ds.set_batch_size(1)
+        ds.set_use_var(["x"])
+        ds.set_sample_list([(np.float32(i),) for i in range(n_batches)])
+        return ds
+
+    def test_transport_failure_restarts_worker_within_budget(self, tmp_path):
+        from paddle_tpu.framework.trainer import DeviceWorker, MultiTrainer
+        died = []
+
+        class Flaky(DeviceWorker):
+            def train_step(self, feed):
+                if not died and float(np.ravel(feed["x"])[0]) == 2.0:
+                    died.append(1)
+                    raise ConnectionError("peer reset")
+                return {}
+
+        w = self._worker(Flaky, 0, 1)
+        mt = MultiTrainer([w], max_worker_restarts=1)
+        mt._run_inner(self._dataset(5), False, 100, None)
+        assert mt.worker_restarts == 1
+        # restarted run re-walks the shard from the top: 2 steps before the
+        # failure + all 5 after the restart
+        assert w.steps == 7
+        events = recovery.get_journal().entries()
+        assert [e["event"] for e in events] == ["worker_restart"]
+        assert events[0]["cause"] == "ConnectionError"
+
+    def test_budget_zero_preserves_fail_fast(self):
+        from paddle_tpu.framework.trainer import DeviceWorker, MultiTrainer
+
+        class Dies(DeviceWorker):
+            def train_step(self, feed):
+                raise ConnectionError("boom")
+
+        mt = MultiTrainer([self._worker(Dies, 0, 1)])
+        with pytest.raises(RuntimeError, match="ConnectionError"):
+            mt._run_inner(self._dataset(3), False, 100, None)
+        assert mt.worker_restarts == 0
+
+    def test_deterministic_error_is_never_restarted(self):
+        from paddle_tpu.framework.trainer import DeviceWorker, MultiTrainer
+
+        class Bug(DeviceWorker):
+            def train_step(self, feed):
+                raise ValueError("bug")
+
+        mt = MultiTrainer([self._worker(Bug, 0, 1)], max_worker_restarts=5)
+        with pytest.raises(RuntimeError, match="bug"):
+            mt._run_inner(self._dataset(3), False, 100, None)
+        assert mt.worker_restarts == 0
+
+
+# -- end-to-end: preempt → resume at generation g+1 (S4) ----------------------
+
+class TestPreemptResume:
+    def test_sigterm_snapshot_resumes_at_next_generation(self, tmp_path):
+        """PR 1's SIGTERM snapshot + this PR's rendezvous: a preempted rank
+        snapshots mid-run, a NEW incarnation rendezvouses at g+1, restores
+        step/optimizer state, and the loss curve continues exactly."""
+        golden_model, golden_opt = _make(seed=7)
+        golden = [_sgd_step(golden_model, golden_opt, s) for s in range(6)]
+
+        clock = FakeClock()
+        st = FileStore(str(tmp_path / "store"), ttl=1e6)
+        m = ElasticManager(st, "job", np_min=1, np_max=1, rank=0,
+                           endpoint="h0:1", clock=clock, sleep=clock.advance)
+        m.register()
+        g1, _ = m.rendezvous(timeout=5.0)
+        assert g1 == 1
+
+        model, opt = _make(seed=7)
+        ckpt = str(tmp_path / "ckpt.pdparams")
+        state = {"step": 0}
+        handler = preempt.PreemptionHandler()
+        handler.add_action(lambda: save_hybrid_checkpoint(
+            ckpt, model, opt, meta={"step": state["step"],
+                                    "preempted": True}))
+        losses = []
+        with pytest.raises(preempt.Preempted) as exc:
+            for step in range(6):
+                handler.check()  # drains the snapshot action, then raises
+                losses.append(_sgd_step(model, opt, step))
+                state["step"] = step + 1
+                if step == 2:
+                    handler.notify()  # SIGTERM equivalent, no real signal
+        assert exc.value.code == 143  # 128 + SIGTERM
+
+        # --- new process: fresh model/optimizer, fresh generation state ---
+        recovery.reset_generation()
+        model2, opt2 = _make(seed=99)  # junk init: the load must win
+        m2 = ElasticManager(st, "job", np_min=1, np_max=1, rank=0,
+                            endpoint="h0:1", clock=clock,
+                            sleep=clock.advance)
+        m2.register()
+        g2, _ = m2.rendezvous(timeout=5.0)
+        assert g2 == g1 + 1
+
+        meta = load_hybrid_checkpoint(ckpt, model2, opt2)
+        assert meta["step"] == 3
+        assert meta["preempted"] is True
+        assert meta["generation"] == g1  # snapshot names its incarnation
+        losses += [_sgd_step(model2, opt2, s) for s in range(meta["step"], 6)]
+        np.testing.assert_allclose(losses, golden, rtol=0, atol=0)
+        for (k, want), (_, got) in zip(
+                golden_model.state_dict().items(),
+                model2.state_dict().items()):
+            np.testing.assert_array_equal(np.asarray(want._val),
+                                          np.asarray(got._val))
+
+
+# -- acceptance: kill + hang → re-rendezvous → resume, zero real sleeps -------
+
+class TestChaosElasticRecoveryAcceptance:
+    def test_kill_and_hang_recover_with_no_lost_steps(self, tmp_path):
+        """ISSUE 4 acceptance: fault injection kills one rank mid-step and
+        hangs another's collective; the job re-rendezvouses at a higher
+        generation each time (once WITH a replacement, once scaled-in),
+        resumes from the last checkpoint, completes training with no lost
+        accepted steps, and the journal names every restart cause."""
+        t0 = time.monotonic()
+        golden_model, golden_opt = _make(seed=3)
+        golden = [_sgd_step(golden_model, golden_opt, s) for s in range(6)]
+
+        clock = FakeClock()
+        st = FileStore(str(tmp_path / "store"), ttl=1e6)
+        m1 = ElasticManager(st, "jobA", np_min=1, np_max=2, rank=1,
+                            endpoint="h1:1", clock=clock)
+        m1.register()
+        allow_join = [True]
+
+        def sleep(dt):
+            clock.advance(dt)
+            if allow_join[0]:  # rank 1 (or its replacement) shows up
+                rec = st.get("jobA/gen") or {}
+                if rec.get("gen"):
+                    m1.announce(rec["gen"])
+
+        m0 = ElasticManager(st, "jobA", np_min=1, np_max=2, rank=0,
+                            endpoint="h0:1", clock=clock, sleep=sleep)
+        m0.register()
+        gen0, eps0 = m0.rendezvous(timeout=5.0)
+        assert gen0 == 1 and len(eps0) == 2
+
+        model, opt = _make(seed=3)
+        ckpt = str(tmp_path / "ckpt.pdparams")
+        journal = RecoveryJournal("jobA", dir=str(tmp_path), clock=clock)
+        # step attempts across all incarnations: s0 s1 s2(kill) | s2 s3
+        # s4(hang) | s4 s5 — the kill is the 3rd kill-site evaluation, the
+        # hang the 5th hang-site evaluation (the killed attempt never
+        # reaches the hang site)
+        faults.configure("chaos.kill:#3,chaos.hang:#5")
+        reg = faults._REGISTRY
+        accepted = []
+        losses = {}
+
+        def train(resume):
+            start = resume["step"] if resume else 0
+            for step in range(start, 6):
+                if reg.should_fail("chaos.kill"):
+                    # rank 1 died mid-step and its abort reached us
+                    raise PeerAbort(1, section="collective.all_reduce",
+                                    reason="rank killed mid-step")
+                if reg.should_fail("chaos.hang"):
+                    # our collective hung and the watchdog expired it; also
+                    # the signal to run the next rendezvous without rank 1
+                    allow_join[0] = False
+                    raise DistributedTimeout("collective.all_reduce", 0,
+                                             60.0, 61.0)
+                losses[step] = _sgd_step(model, opt, step)
+                save_hybrid_checkpoint(ckpt, model, opt,
+                                       meta={"step": step + 1})
+                accepted.append(step)
+            return "done"
+
+        def restore(gen):
+            return load_hybrid_checkpoint(ckpt, model, opt)
+
+        rm = RecoveryManager(m0, restore=restore, max_restarts=3,
+                             rendezvous_timeout=5.0, backoff_base=1.0,
+                             sleep=sleep, journal=journal)
+        assert rm.run(train) == "done"
+
+        # no lost accepted steps: every step committed exactly once
+        assert accepted == list(range(6))
+        assert rm.restarts == 2
+        assert recovery.current_generation() == 3  # 1 → kill → 2 → hang → 3
+        ents = [e for e in journal.entries() if e["event"] == "restart"]
+        assert [e["cause"] for e in ents] == \
+            ["PeerAbort", "DistributedTimeout"]
+        assert [e["generation"] for e in ents] == [2, 3]
+        # first restart got the replacement; second proceeded scaled-in
+        assert [e["np"] for e in ents] == [2, 1]
+        # the recovered run's loss curve matches an uninterrupted one
+        np.testing.assert_allclose([losses[s] for s in range(6)], golden,
+                                   rtol=0, atol=0)
+
+        # a rank replaying generation-g work into g+1 fails typed, not hung
+        wd = Watchdog(clock=FakeClock(),
+                      recorder=FlightRecorder(size=8, rank=0,
+                                              clock=FakeClock()))
+        with pytest.raises(StaleGeneration) as exc:
+            with watch_section("collective.all_reduce", watchdog=wd):
+                recovery.set_generation(4)  # the group moved on mid-section
+        assert exc.value.stale_gen == 3 and exc.value.current_gen == 4
+        assert time.monotonic() - t0 < 30.0  # fake clock: no real sleeps

@@ -29,7 +29,14 @@ REQUIRED = [
      ["all_reduce", "all_gather", "broadcast", "scatter", "reduce_scatter",
       "alltoall", "send", "recv", "barrier", "reduce"]),
     ("paddle_tpu/distributed/fleet/elastic.py", "class:FileStore",
-     ["put", "refresh"]),
+     ["put", "refresh", "gc_tmp"]),
+    # recovery entry points (elastic-recovery PR): the chaos suite must be
+    # able to fail the rendezvous itself (recovery.rendezvous), the restart
+    # cycle (recovery.restart), and store housekeeping (store.gc)
+    ("paddle_tpu/distributed/fleet/elastic.py", "class:ElasticManager",
+     ["rendezvous"]),
+    ("paddle_tpu/resilience/recovery.py", "class:RecoveryManager",
+     ["restart"]),
     ("paddle_tpu/incubate/checkpoint.py", "class:CheckpointSaver",
      ["save_checkpoint"]),
     # transport entry points (hang-detection PR): the chaos suite must be
