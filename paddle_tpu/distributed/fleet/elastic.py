@@ -151,7 +151,15 @@ class ElasticManager:
         # the SAME np as before the dip must still emit RESTART (the group
         # composition changed even if the count didn't)
         self._held = False
-        self._generation = 0
+        # PADDLE_TPU_GENERATION (set by the launcher on a supervised
+        # relaunch) is a FLOOR for rendezvous proposals only — never the
+        # frame-stamping generation. A relaunched child whose launcher
+        # counter ran ahead of the store-agreed generation then proposes
+        # high at rendezvous (survivors converge up through the store)
+        # instead of stamping frames above its peers' generation, which
+        # would make healthy survivors latch themselves stale.
+        self._generation = int(
+            os.environ.get("PADDLE_TPU_GENERATION", "0") or 0)
         # injectable for fake-clock chaos tests (zero real sleeps)
         self._clock = clock
         self._sleep_fn = sleep
@@ -319,13 +327,25 @@ class ElasticManager:
         rec = self.store.get(self._gen_key()) or {}
         gen = max(int(rec.get("gen", 0)), self._generation) + 1
         self.store.put(self._gen_key(), {"gen": gen})
-        self.announce(gen)
         start = self._now()
         while True:
             rec = self.store.get(self._gen_key()) or {}
-            if int(rec.get("gen", 0)) > gen:
-                gen = int(rec.get("gen", 0))
-                self.announce(gen)
+            stored = int(rec.get("gen", 0))
+            if stored > gen:
+                gen = stored
+            elif stored < gen:
+                # a slow proposer's read-then-put can regress the agreed key
+                # after others already adopted a higher generation; ranks at
+                # the higher generation would otherwise never re-publish, so
+                # subgroups could settle at different generations and EACH
+                # proceed scaled-in at np_min — split-brain. Re-publish the
+                # maximum until the store converges.
+                self.store.put(self._gen_key(), {"gen": gen})
+            # re-announce every poll: the arrival record is TTL-leased, and
+            # with real settings (ttl << rendezvous timeout) a waiting
+            # rank's record would age out mid-wait, undercounting the group
+            # exactly when the scaled-in np_min decision needs it
+            self.announce(gen)
             arrived = self.store.alive_values(f"{self.job_id}/rdzv.{gen}/")
             if len(arrived) >= self.np_max:
                 break

@@ -163,9 +163,14 @@ def _launch_one(cluster, pod, trainer, idx, training_script,
                 training_script_args=(), log_dir=None, envs=None,
                 generation=0):
     """Spawn one trainer subprocess. `generation` > 0 marks a supervised
-    RELAUNCH: the child bootstraps its recovery generation from
-    PADDLE_TPU_GENERATION so it joins the survivors' re-rendezvoused group
-    instead of replaying generation-0 traffic at them."""
+    RELAUNCH: PADDLE_TPU_GENERATION seeds the child's ElasticManager as a
+    FLOOR for its rendezvous proposals, so it proposes a generation above
+    every incarnation the launcher has seen and converges with the
+    survivors through the store. It is NOT the child's frame-stamping
+    generation — that is only adopted from an agreed rendezvous, so a
+    launcher counter that ran ahead (crash-looping worker) can't make the
+    child stamp frames above healthy survivors and force a spurious
+    group-wide recovery."""
     env = _trainer_env(cluster, pod, trainer, envs)
     if generation:
         env["PADDLE_TPU_GENERATION"] = str(int(generation))
@@ -235,8 +240,9 @@ def supervise_local_trainers(cluster, pod, training_script,
 
     The reference elastic manager relaunches the whole local pod on any
     failure; here a worker that exits non-zero is relaunched in place (same
-    rank, same endpoint) with ``PADDLE_TPU_GENERATION`` bumped, so it joins
-    the survivors' re-rendezvoused group rather than forcing a full-job
+    rank, same endpoint) with ``PADDLE_TPU_GENERATION`` bumped — a floor
+    for the replacement's rendezvous proposals — so it joins the
+    survivors' re-rendezvoused group rather than forcing a full-job
     teardown. Every restart's cause — exit code, the failed rank's
     flight-recorder tail, the generation handed to the replacement — is
     recorded in the per-job recovery journal (``PADDLE_TPU_ARTIFACTS_DIR``).

@@ -225,7 +225,13 @@ class _Channel:
     def _on_stale(self, newer, src=None):
         """The group moved to a newer generation without us: latch it and
         wake every blocked recv so this rank fails in seconds with a typed
-        StaleGeneration instead of hanging out its timeout."""
+        StaleGeneration instead of hanging out its timeout.
+
+        Notifications at or below our CURRENT generation are ignored: a
+        delayed __stale__ frame about traffic this rank sent before it
+        recovered must not permanently poison a channel that is current."""
+        if int(newer) <= self._gen():
+            return
         self._stale_src = src
         self.stale = max(self.stale or 0, int(newer))
         with self.inbox_lock:

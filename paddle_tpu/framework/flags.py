@@ -49,6 +49,10 @@ _FLAGS: dict[str, Any] = {
     "FLAGS_recovery_rendezvous_timeout": 300.0,
     # exponential backoff base between restarts (doubles per restart)
     "FLAGS_recovery_backoff_base": 1.0,
+    # consecutive healthy steps (clean RecoveryManager.check passes /
+    # note_progress calls) after which the restart budget refills;
+    # 0 = per-job-lifetime budget
+    "FLAGS_recovery_restart_reset_steps": 100,
     # serving subsystem (paddle_tpu/serving, docs/serving.md):
     # watchdog deadline for one dispatched batch (assemble→run→reply)
     "FLAGS_serving_step_timeout": 60.0,

@@ -95,9 +95,13 @@ class MembershipChange(DistributedError):
 # -- process-wide generation state -------------------------------------------
 
 _GEN_LOCK = threading.Lock()
-# bootstrapped from the launcher's relaunch env so a restarted worker joins
-# the survivors' generation instead of replaying generation 0 at them
-_GENERATION = [int(os.environ.get("PADDLE_TPU_GENERATION", "0") or 0)]
+# NOT seeded from PADDLE_TPU_GENERATION: the launcher's relaunch counter is
+# only a floor for rendezvous PROPOSALS (ElasticManager reads it), never the
+# frame-stamping generation. Stamping frames from the env before the store
+# agreed would let a launcher counter that ran ahead make healthy survivors
+# latch themselves stale. The process adopts a generation only through
+# set_generation() after a store-agreed rendezvous.
+_GENERATION = [0]
 
 
 def current_generation():
@@ -224,14 +228,22 @@ class RecoveryManager:
         onto the possibly-smaller group); its return value is passed to
         ``train_fn`` on the next attempt.
     on_restart: callable(generation, endpoints) — post-restore hook.
-    max_restarts / rendezvous_timeout / backoff_base: default to
-        ``FLAGS_recovery_*``.
+    max_restarts / rendezvous_timeout / backoff_base /
+        restart_reset_steps: default to ``FLAGS_recovery_*``.
     clock / sleep / journal: injectable for fake-clock chaos tests.
+
+    The restart budget refills after sustained healthy progress:
+    ``restart_reset_steps`` consecutive healthy steps (clean
+    :meth:`check` passes or explicit :meth:`note_progress` calls) reset
+    the counter, so unrelated transient faults days apart don't
+    accumulate into :class:`RecoveryExhausted`. Set it to 0 for a
+    per-job-lifetime budget.
     """
 
     def __init__(self, elastic, restore=None, on_restart=None,
                  max_restarts=None, rendezvous_timeout=None,
-                 backoff_base=None, clock=None, sleep=None, journal=None):
+                 backoff_base=None, restart_reset_steps=None, clock=None,
+                 sleep=None, journal=None):
         self.elastic = elastic
         self.restore = restore
         self.on_restart = on_restart
@@ -244,10 +256,14 @@ class RecoveryManager:
         self.backoff_base = float(
             _flag("FLAGS_recovery_backoff_base", 1.0)
             if backoff_base is None else backoff_base)
+        self.restart_reset_steps = int(
+            _flag("FLAGS_recovery_restart_reset_steps", 100)
+            if restart_reset_steps is None else restart_reset_steps)
         self._clock = clock
         self._sleep = sleep or time.sleep
         self.journal = journal or get_journal()
         self.restarts = 0
+        self._healthy_steps = 0
 
     # -- detection ---------------------------------------------------------
     def check(self):
@@ -264,7 +280,25 @@ class RecoveryManager:
         if unhealthy:
             raise MembershipChange("unhealthy", np=self.elastic.np(),
                                    unhealthy=unhealthy)
+        self.note_progress()
         return status
+
+    def note_progress(self, steps=1):
+        """Record healthy progress toward refilling the restart budget.
+        After ``restart_reset_steps`` consecutive healthy steps since the
+        last restart, ``restarts`` resets to 0 (journalled as
+        ``budget_reset``): a job that recovered and then trained cleanly
+        for a long stretch gets a fresh budget, instead of unrelated
+        transient faults days apart eventually spending it. 0 disables
+        the refill (per-job-lifetime budget)."""
+        if self.restarts == 0 or self.restart_reset_steps <= 0:
+            return
+        self._healthy_steps += int(steps)
+        if self._healthy_steps >= self.restart_reset_steps:
+            self.journal.record("budget_reset", restarts=self.restarts,
+                                healthy_steps=self._healthy_steps)
+            self.restarts = 0
+            self._healthy_steps = 0
 
     # -- supervision -------------------------------------------------------
     def run(self, train_fn):
@@ -296,6 +330,7 @@ class RecoveryManager:
         maybe_inject("recovery.restart", ConnectionError)
         cause_name = type(cause).__name__ if cause is not None else \
             "requested"
+        self._healthy_steps = 0  # a failure breaks the healthy streak
         self.restarts += 1
         if self.restarts > self.max_restarts:
             self.journal.record("recovery_exhausted", cause=cause_name,

@@ -293,6 +293,77 @@ class TestRendezvous:
         with pytest.raises(RendezvousTimeout, match="needs at least 2"):
             m.rendezvous(timeout=5.0)
 
+    def test_arrival_lease_survives_wait_longer_than_ttl(self, tmp_path):
+        """Regression: the rdzv.{gen}/rank.N arrival record is TTL-leased,
+        and with real settings (ttl=10s, timeout=300s) a waiting rank's
+        record expired mid-wait, so the scaled-in path undercounted the
+        group and raised RendezvousTimeout despite a live quorum. Every
+        poll must re-announce. The sleep hook force-expires every store
+        entry (mtime backdating — zero real sleeps), so only a record
+        re-announced in the same poll iteration can ever be counted."""
+        clock = FakeClock()
+        st = FileStore(str(tmp_path / "store"), ttl=10.0)
+
+        def sleep(dt):
+            clock.advance(dt)
+            past = time.time() - st.ttl - 1
+            for name in os.listdir(st.root):
+                os.utime(os.path.join(st.root, name), (past, past))
+
+        m = ElasticManager(st, "job", np_min=1, np_max=2, rank=0,
+                           endpoint="h0:1", clock=clock, sleep=sleep)
+        m.register()
+        gen, eps = m.rendezvous(timeout=5.0)  # timeout >> effective ttl
+        assert gen == 1
+        assert eps == ["h0:1"]  # still counted at the np_min decision
+
+    def test_wait_loop_repairs_regressed_gen_key(self, tmp_path):
+        """Regression: generation agreement was last-writer-wins — a slow
+        proposer's stale put could overwrite a higher generation other
+        ranks already adopted, and nobody re-published, so subgroups could
+        settle at different generations (split-brain). The wait loop must
+        re-put the maximum until the store converges."""
+        clock = FakeClock()
+        st = FileStore(str(tmp_path / "store"), ttl=1e6)
+        regressed = []
+
+        def sleep(dt):
+            clock.advance(dt)
+            if not regressed:  # slow proposer's read-then-put lands late
+                st.put("job/gen", {"gen": 1})
+                regressed.append(1)
+
+        m = ElasticManager(st, "job", np_min=1, np_max=2, rank=0,
+                           endpoint="h0:1", clock=clock, sleep=sleep)
+        m._generation = 4  # survivor with a longer memory: proposes 5
+        m.register()
+        gen, _ = m.rendezvous(timeout=5.0)
+        assert gen == 5
+        # the store converged back to the maximum: a rank arriving later
+        # joins generation 5, not the regressed 1
+        assert (st.get("job/gen") or {}).get("gen") == 5
+
+    def test_env_generation_is_proposal_floor_not_frame_stamp(
+            self, tmp_path, monkeypatch):
+        """Regression: a relaunched child whose launcher counter ran ahead
+        of the store-agreed generation used to stamp frames straight from
+        PADDLE_TPU_GENERATION, making healthy survivors latch themselves
+        stale. The env var must only floor rendezvous proposals; the
+        process generation is adopted from the agreed rendezvous."""
+        from paddle_tpu.distributed import wire
+        monkeypatch.setenv("PADDLE_TPU_GENERATION", "5")
+        clock = FakeClock()
+        st = FileStore(str(tmp_path / "store"), ttl=1e6)
+        m = ElasticManager(st, "job", np_min=1, np_max=1, rank=0,
+                           endpoint="h0:1", clock=clock, sleep=clock.advance)
+        # before rendezvous the process is unfenced: frames stay unstamped
+        assert recovery.current_generation() == 0
+        assert "gen" not in wire.stamp_generation({"src": 0, "tag": "t"})
+        m.register()
+        gen, _ = m.rendezvous(timeout=5.0)
+        assert gen == 6  # floor honoured: proposes above every prior gen
+        assert recovery.current_generation() == 6
+
     def test_rendezvous_clears_unhealthy_markers(self, tmp_path):
         clock = FakeClock()
         m = self._mgr(tmp_path, clock=clock, sleep=clock.advance)
@@ -430,6 +501,22 @@ class TestP2PGenerationFence:
         assert time.monotonic() - t0 < 8
         assert (0, ("t", 1)) not in a.inbox  # stale frame never queued
 
+    def test_delayed_stale_notice_at_current_gen_is_ignored(self, chan_pair):
+        """Regression: a delayed __stale__ frame about traffic this rank
+        sent BEFORE it recovered used to latch the channel permanently,
+        failing a rank that is actually current. Notices at or below the
+        channel's current generation must be ignored."""
+        a, b = chan_pair
+        a._gen_fn = b._gen_fn = lambda: 2  # b already recovered to gen 2
+        b._on_stale(2, src=0)  # late notice about pre-recovery traffic
+        assert b.stale is None
+        b._on_stale(1, src=0)  # even older news
+        assert b.stale is None
+        b.send(0, ("t", 1), "still current")  # channel not poisoned
+        assert a.recv(1, ("t", 1), timeout=10) == "still current"
+        b._on_stale(3, src=0)  # genuinely newer: must still latch
+        assert b.stale == 3
+
     def test_newer_frame_makes_blocked_receiver_stale(self, chan_pair):
         a, b = chan_pair
         a._gen_fn = lambda: 2
@@ -533,6 +620,68 @@ class TestRecoveryManager:
         faults.configure("recovery.restart:#1")
         with pytest.raises(ConnectionError):
             rm.restart(cause=RuntimeError("x"))
+
+    def test_budget_refills_after_sustained_healthy_progress(self, tmp_path):
+        """Regression: `restarts` accumulated for the life of the job, so
+        unrelated transient faults days apart eventually raised
+        RecoveryExhausted even though every recovery succeeded."""
+        clock, _, m = _single_rank_setup(tmp_path)
+        journal = RecoveryJournal("job", dir=str(tmp_path), clock=clock)
+        rm = RecoveryManager(m, max_restarts=1, rendezvous_timeout=5.0,
+                             backoff_base=0.0, sleep=clock.advance,
+                             journal=journal, restart_reset_steps=3)
+        rm.restart(cause=ConnectionError("blip 1"))
+        assert rm.restarts == 1
+        rm.note_progress()
+        rm.note_progress()
+        assert rm.restarts == 1  # streak not long enough yet
+        rm.note_progress()
+        assert rm.restarts == 0  # budget refilled
+        rm.restart(cause=ConnectionError("blip 2, days later"))
+        assert rm.restarts == 1  # did NOT raise RecoveryExhausted
+        events = [e["event"] for e in journal.entries()]
+        assert events == ["restart", "budget_reset", "restart"]
+
+    def test_clean_check_counts_as_progress(self, tmp_path):
+        clock, _, m = _single_rank_setup(tmp_path)
+        rm = RecoveryManager(m, max_restarts=1, rendezvous_timeout=5.0,
+                             backoff_base=0.0, sleep=clock.advance,
+                             journal=RecoveryJournal("j", dir=str(tmp_path)),
+                             restart_reset_steps=1)
+        rm.restart(cause=ConnectionError("x"))
+        assert rm.restarts == 1
+        rm.check()  # clean step-boundary poll
+        assert rm.restarts == 0
+
+    def test_restart_reset_zero_keeps_lifetime_budget(self, tmp_path):
+        clock, _, m = _single_rank_setup(tmp_path)
+        rm = RecoveryManager(m, max_restarts=2, rendezvous_timeout=5.0,
+                             backoff_base=0.0, sleep=clock.advance,
+                             journal=RecoveryJournal("j", dir=str(tmp_path)),
+                             restart_reset_steps=0)
+        rm.restart(cause=ConnectionError("a"))
+        for _ in range(50):
+            rm.note_progress()
+        assert rm.restarts == 1  # refill disabled: per-job-lifetime budget
+        rm.restart(cause=ConnectionError("b"))
+        with pytest.raises(RecoveryExhausted):
+            rm.restart(cause=ConnectionError("c"))
+
+    def test_failure_resets_healthy_streak(self, tmp_path):
+        clock, _, m = _single_rank_setup(tmp_path)
+        rm = RecoveryManager(m, max_restarts=3, rendezvous_timeout=5.0,
+                             backoff_base=0.0, sleep=clock.advance,
+                             journal=RecoveryJournal("j", dir=str(tmp_path)),
+                             restart_reset_steps=3)
+        rm.restart(cause=ConnectionError("a"))
+        rm.note_progress()
+        rm.note_progress()
+        rm.restart(cause=ConnectionError("b"))  # breaks the streak at 2
+        rm.note_progress()
+        assert rm.restarts == 2  # needs 3 healthy steps SINCE the failure
+        rm.note_progress()
+        rm.note_progress()
+        assert rm.restarts == 0
 
     def test_check_raises_membership_change_on_hold(self, tmp_path):
         st = FileStore(str(tmp_path / "store"), ttl=1e6)
