@@ -9,6 +9,9 @@ Covers the BASELINE.json configs measurable on one chip:
               single-chip proxy; the multi-chip hybrid path is validated by
               __graft_entry__.dryrun_multichip)
   lenet     — LeNet smoke (config 1)
+  opbench   — kernel-tier lane: per-op microbench + opbench_diff gate vs
+              the checked-in OPBENCH.json (min effective speedup across
+              rows at the fusion-policy-chosen configs; docs/kernels.md)
 
 Default (BENCH_MODEL unset): primary bert + resnet50 in "extra" so one JSON
 line reports both. A failed bench emits {"metric": "bench_error", ...} —
@@ -562,10 +565,54 @@ def bench_lenet():
     }
 
 
+def bench_opbench():
+    """Kernel-tier lane: run the per-op microbench (tools/op_bench.py — full
+    shapes on an accelerator, --smoke on CPU) and gate the artifact through
+    tools/opbench_diff.py against the checked-in OPBENCH.json. The metric is
+    the minimum effective speedup across rows: what the measured fusion
+    policy actually dispatches vs the unfused XLA baseline — by construction
+    it must be >= 1.0, and the diff gate fails this lane if any fused row
+    dispatches slower."""
+    import subprocess
+    import tempfile
+
+    import jax
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="opbench_"), "OPBENCH.json")
+    cmd = [sys.executable, os.path.join(repo, "tools", "op_bench.py"),
+           "--out", out]
+    if jax.devices()[0].platform not in ("tpu", "axon"):
+        cmd.append("--smoke")
+    p = subprocess.run(cmd, capture_output=True, text=True)
+    if p.returncode != 0:
+        raise RuntimeError(f"op_bench failed: {p.stderr[-500:]}")
+    diff = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "opbench_diff.py"),
+         out, os.path.join(repo, "OPBENCH.json")],
+        capture_output=True, text=True)
+    report = json.loads(diff.stdout)
+    with open(out) as f:
+        doc = json.load(f)
+    eff = [r.get("effective_speedup", r["speedup"]) for r in doc["ops"]]
+    return {
+        "metric": "opbench_min_effective_speedup",
+        "value": round(min(eff), 3) if eff else 0.0,
+        "unit": "x",
+        "vs_baseline": round(min(eff), 3) if eff else 0.0,
+        "mfu": None,
+        "extra": {"rows": len(doc["ops"]),
+                  "gate": report["status"],
+                  "policy_failures": report["policy_failures"],
+                  "regressions": report["regressions"]},
+    }
+
+
 _BENCHES = {"bert": bench_bert, "resnet50": bench_resnet50,
             "gpt": bench_gpt, "lenet": bench_lenet,
             "ernie": lambda: bench_bert(arch="ernie"),
-            "gpt1p3b": lambda: bench_gpt(slice_1p3b=True)}
+            "gpt1p3b": lambda: bench_gpt(slice_1p3b=True),
+            "opbench": bench_opbench}
 
 def _release_bench_state():
     """Free the previous bench's device state (params, fp32 masters, f32

@@ -182,7 +182,14 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
         executed.add(id(node))
         slots = pending.pop(id(node), {})
         cots = []
-        for i, (shape, dtype) in enumerate(node.out_meta):
+        for i, meta in enumerate(node.out_meta):
+            if meta is None:
+                # None output slot (empty pytree leaf, e.g. GPTBlock's
+                # carried residual before the first layer): its cotangent
+                # is None to match the forward's output structure
+                cots.append(None)
+                continue
+            shape, dtype = meta
             c = slots.get(i)
             cots.append(c if c is not None else jnp.zeros(shape, dtype=dtype))
         cot = tuple(cots) if node.multi_output else cots[0]

@@ -166,7 +166,10 @@ def _apply_impl(prim, args, kwargs, name):
     # no node to record
     if not any(_is_diff_value(o) for o in outs):
         return _wrap_outputs(out, stop_gradient=True)
-    out_meta = [(o.shape, o.dtype) for o in outs]
+    # None outputs (jax treats None as an empty pytree subtree — e.g.
+    # GPTBlock's (stream, pending=None) carried-residual form under
+    # recompute) pass through: no meta, no Tensor, None cotangent slot
+    out_meta = [None if o is None else (o.shape, o.dtype) for o in outs]
     node = GradNode(
         vjp_fn=vjp_fn,
         inputs=[args[i] for i in diff_idx],
@@ -176,6 +179,9 @@ def _apply_impl(prim, args, kwargs, name):
     )
     tensors = []
     for slot, o in enumerate(outs):
+        if o is None:
+            tensors.append(None)
+            continue
         t = Tensor(o, stop_gradient=False)
         t._grad_node = node
         t._out_index = slot
@@ -187,7 +193,11 @@ def _apply_impl(prim, args, kwargs, name):
 
 def _wrap_outputs(out, stop_gradient):
     if isinstance(out, (tuple, list)):
-        return tuple(Tensor(o, stop_gradient=stop_gradient) for o in out)
+        return tuple(None if o is None
+                     else Tensor(o, stop_gradient=stop_gradient)
+                     for o in out)
+    if out is None:
+        return None
     return Tensor(out, stop_gradient=stop_gradient)
 
 

@@ -316,6 +316,9 @@ class Tensor:
     def _replace_value(self, v):
         """Internal raw replacement (functional state update)."""
         self._value = v
+        # the replacement may move the value into/out of the fused-op
+        # degenerate band (ops/_param_guard.py sticky cache)
+        self._degen_cache = None
 
     def scale_(self, factor):
         self._value = self._val * factor

@@ -68,6 +68,9 @@ def recompute(function, *args, **kwargs):
     _TraceHooks.on_read = on_read
     _TraceHooks.on_write = on_write
     _TraceHooks.on_create = None
+    from ...ops import autotune as _autotune_disc
+    _prev_dir = _autotune_disc._FORCE_DIRECTION[0]
+    _autotune_disc._FORCE_DIRECTION[0] = "fwd_bwd"
     try:
         with _autograd.no_grad():
             jax.eval_shape(
@@ -76,6 +79,7 @@ def recompute(function, *args, **kwargs):
                 *[jax.ShapeDtypeStruct(t._val.shape, t._val.dtype)
                   for t in tensor_args])
     finally:
+        _autotune_disc._FORCE_DIRECTION[0] = _prev_dir
         (_TraceHooks.on_read, _TraceHooks.on_write,
          _TraceHooks.on_create) = prev
         for t, old in written.values():
@@ -121,6 +125,13 @@ def recompute(function, *args, **kwargs):
         prev_force = _fa._FORCE_INTERPRET[0]
         if _force is not None:
             _fa._FORCE_INTERPRET[0] = _force
+        # the body runs under no_grad yet the region IS differentiated (the
+        # outer apply wraps the checkpoint in jax.vjp), so tell the fusion
+        # policy this is fwd+bwd — grad-mode inspection alone would
+        # misclassify it as inference and pick fwd-tuned paths
+        from ...ops import autotune as _autotune
+        prev_dir = _autotune._FORCE_DIRECTION[0]
+        _autotune._FORCE_DIRECTION[0] = "fwd_bwd"
         try:
             for t, v in zip(closure_reads, vals[n_args:]):
                 t._val = v
@@ -138,6 +149,7 @@ def recompute(function, *args, **kwargs):
             return jax.tree_util.tree_map(unwrap, out)
         finally:
             _fa._FORCE_INTERPRET[0] = prev_force
+            _autotune._FORCE_DIRECTION[0] = prev_dir
             _TraceHooks.on_write = prev_write
             for t, old in written.values():
                 t._val = old

@@ -23,6 +23,14 @@ _FLAGS: dict[str, Any] = {
     "FLAGS_max_cached_programs": 64,
     # donate buffers for jitted train steps (memory optimization)
     "FLAGS_donate_state_buffers": True,
+    # kernel tier (paddle_tpu/ops/autotune.py, docs/kernels.md):
+    # measured fusion policy — auto dispatches whichever of fused/unfused
+    # measured faster per (shape-bucket, dtype, direction, placement);
+    # always/never force one side for debugging and A/B runs
+    "FLAGS_fusion_policy": "auto",
+    # master switch for the Pallas block-size / fusion search; off-device
+    # runs never search regardless (deterministic fallback table)
+    "FLAGS_autotune": True,
     # resilience subsystem (paddle_tpu/resilience, docs/resilience.md)
     # fault-injection spec, e.g. "fs.upload:0.3,collective.all_reduce:0.1"
     "FLAGS_fault_injection": "",

@@ -279,6 +279,11 @@ class Layer:
                     raise ValueError(
                         f"shape mismatch for {name}: {v.shape} vs {t._val.shape}")
                 t._value = v.astype(t._val.dtype)
+                # a loaded checkpoint may move the value into/out of the
+                # fused-op degenerate band (ops/_param_guard.py sticky
+                # cache) — ADVICE r5: stale True/False here silently froze
+                # zero LN/BN channels loaded over a warm model
+                t._degen_cache = None
             else:
                 missing.append(name)
         for name in state_dict:

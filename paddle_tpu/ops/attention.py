@@ -61,6 +61,18 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                       and dropout_p == 0.0
                       and qv.shape[1] >= 256
                       and _pallas_supports(query, key))
+        if use_pallas:
+            # measured fusion policy: flash is the "fused" candidate, the
+            # XLA softmax path the "unfused" one. never forces XLA; auto
+            # keeps flash only while it measures faster for this signature
+            # (docs/kernels.md)
+            from . import autotune
+            pol = autotune.fusion_policy()
+            if pol == "never":
+                use_pallas = False
+            elif pol == "auto":
+                use_pallas = _flash_wins(qv, unwrap(key), unwrap(value),
+                                         is_causal, scale)
     elif use_pallas and (attn_mask is not None or dropout_p > 0.0):
         raise ValueError(
             "use_pallas=True is incompatible with attn_mask/dropout_p: the "
@@ -124,6 +136,28 @@ def _flash_bwd(is_causal, scale, interpret, res, g):
 
 
 _flash_attention_diff.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_wins(qv, kv, vv, is_causal, scale):
+    """Measured fusion-policy decision for flash attention: probe the Pallas
+    kernel pair against the XLA softmax path for this (shape-bucket, dtype,
+    direction) signature. The checked-in fallback table keeps flash for all
+    benched signatures (every OPBENCH flash row is >1x), so off-device this
+    is a no-op 'fused' answer."""
+    from . import autotune
+    from .pallas.flash_attention import _interpret
+    interp = _interpret(qv)
+
+    def prim_flash(q, k, v):
+        return _flash_attention_diff(q, k, v, is_causal, scale, interp)
+
+    def prim_xla(q, k, v):
+        return _xla_attention(q, k, v, None, scale, is_causal, 0.0, None)
+
+    _, choice = autotune.choose_fused(
+        "flash_attention", prim_flash, prim_xla, (qv, kv, vv),
+        module="paddle_tpu.ops.pallas.flash_attention")
+    return choice == "fused"
 
 
 def _pallas_supports(query, key):

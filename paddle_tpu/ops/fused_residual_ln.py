@@ -153,17 +153,27 @@ def fused_residual_ln(x, y, weight, bias, epsilon=1e-5,
     # op's inputs to f32 (see _fused_residual_ln_diff docstring)
     stream_dtype = getattr(unwrap(x), "dtype", None)
 
+    def prim_plain(xv, yv, wv, bv):
+        outs, _ = _fwd_impl(xv, yv, wv, bv, epsilon, return_residual,
+                            stream_dtype)
+        return outs
+
+    def prim_fused(xv, yv, wv, bv):
+        return _fused_residual_ln_diff(xv, yv, wv, bv, epsilon,
+                                       return_residual, stream_dtype)
+
     if _weight_degenerate(weight):
         # zero/near-zero LN weight channels: plain autodiff through the
         # IDENTICAL forward (saves z, keeps dw exact where the custom
         # backward's x_hat reconstruction would freeze it)
-        def prim(xv, yv, wv, bv):
-            outs, _ = _fwd_impl(xv, yv, wv, bv, epsilon, return_residual,
-                                stream_dtype)
-            return outs
+        prim = prim_plain
     else:
-        def prim(xv, yv, wv, bv):
-            return _fused_residual_ln_diff(xv, yv, wv, bv, epsilon,
-                                           return_residual, stream_dtype)
+        # measured fusion policy (ops/autotune.py): the plain composition is
+        # the unfused candidate — same math, per-op autodiff residual plan
+        from . import autotune
+        prim, _ = autotune.choose_fused(
+            "fused_residual_ln", prim_fused, prim_plain,
+            (unwrap(x), unwrap(y), unwrap(weight), unwrap(bias)),
+            module="paddle_tpu.ops.fused_residual_ln")
 
     return apply(prim, x, y, weight, bias, name="fused_residual_ln")

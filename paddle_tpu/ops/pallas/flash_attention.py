@@ -29,9 +29,40 @@ from jax.experimental import pallas as pl
 # 512-blocks measured 2.7x faster than 128-blocks on v5e (0.66 vs 1.78
 # ms/iter fwd+bwd at b4/s1024/h16/d64): bigger MXU matmuls, fewer inner-loop
 # trips. Public entry points clamp to the sequence length, so short-seq
-# callers (BERT s=128) degrade gracefully to seq-sized blocks.
+# callers (BERT s=128) degrade gracefully to seq-sized blocks. These are the
+# f32 deterministic fallbacks; on TPU the autotuner (ops/autotune.py)
+# searches the candidate grids below and caches the winner per signature.
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
+
+# Fwd candidates: (block_q, block_k).
+_FWD_CANDIDATES = (
+    (512, 512), (256, 512), (512, 256), (256, 256), (1024, 512),
+)
+
+# Bwd candidates: (block_q_dkv, block_k_dkv, block_q_dq, block_k_dq) — the
+# dkv pass tiles k (parallel) and loops q (reduction); the dq pass tiles q
+# and loops k. The two passes have different working sets, so their blocks
+# tune independently (ISSUE 5 tentpole).
+_BWD_CANDIDATES = (
+    (512, 512, 512, 512),
+    (256, 512, 512, 256),
+    (512, 256, 256, 512),
+    (256, 256, 256, 256),
+    (128, 512, 512, 128),
+)
+
+
+def _bwd_default_blocks(dtype):
+    """bf16-aware deterministic fallback for the backward blocks. The f32
+    P/dS intermediates of shape (block_q, block_k) dominate backward VMEM
+    and do NOT shrink with bf16 inputs, so for bf16 we halve the
+    reduction-loop tile of each pass (q for dkv, k for dq) while keeping
+    the parallel-axis tile at 512 for MXU depth. f32 keeps the measured
+    512/512 blocks."""
+    if jnp.dtype(dtype) == jnp.bfloat16:
+        return (256, 512, 512, 256)
+    return (512, 512, 512, 512)
 
 
 # Ambient interpret override for contexts where the input is a tracer but
@@ -59,6 +90,21 @@ def _interpret(x=None):
         except Exception:
             pass  # tracer: placement decided by the outer jit
     return jax.default_backend() not in ("tpu", "axon")
+
+
+def _tpu_params(interpret, n_grid):
+    """Mosaic compiler params marking every grid axis parallel — each grid
+    instance writes its own output tile with no cross-instance dependency,
+    so the (bh, tiles) axes can be scheduled freely. Skipped under the
+    interpreter (no Mosaic)."""
+    if interpret:
+        return {}
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        return {"compiler_params": pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel",) * n_grid)}
+    except Exception:
+        return {}
 
 
 # ---------------------------------------------------------------------------
@@ -142,6 +188,7 @@ def _flash_fwd_bh(q, k, v, causal, scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
             jax.ShapeDtypeStruct((bh, seq_q, 128), jnp.float32),
         ],
+        **_tpu_params(interpret, 2),
     )(q, k, v)
     return out, lse[:, :, 0]
 
@@ -238,11 +285,14 @@ def _attn_bwd_dq_kernel(q_ref, do_ref, l_ref, dd_ref, k_ref, v_ref, dq_ref,
     dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k", "interpret"))
-def _flash_bwd_bh(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-                  interpret):
-    # all (BH, S, D) except lse (BH, S); returns dq, dk, dv
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q_dkv", "block_k_dkv", "block_q_dq",
+    "block_k_dq", "interpret"))
+def _flash_bwd_bh(q, k, v, o, lse, do, causal, scale, block_q_dkv,
+                  block_k_dkv, block_q_dq, block_k_dq, interpret):
+    # all (BH, S, D) except lse (BH, S); returns dq, dk, dv. The dkv and dq
+    # passes tile different sequence axes, so each takes its own
+    # (block_q, block_k) pair.
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     # D = rowsum(dO * O): one fused elementwise+reduce pass, reads dO/O once.
@@ -254,43 +304,47 @@ def _flash_bwd_bh(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 
     dkv = pl.pallas_call(
         functools.partial(_attn_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, seq_q=seq_q),
-        grid=(bh, seq_k // block_k),
+                          block_q=block_q_dkv, seq_q=seq_q),
+        grid=(bh, seq_k // block_k_dkv),
         interpret=interpret,
         in_specs=[
             pl.BlockSpec((None, seq_q, d), lambda b, j: (b, 0, 0)),    # q
             pl.BlockSpec((None, seq_q, d), lambda b, j: (b, 0, 0)),    # do
             pl.BlockSpec((None, seq_q, 128), lambda b, j: (b, 0, 0)),  # lse
             pl.BlockSpec((None, seq_q, 128), lambda b, j: (b, 0, 0)),  # delta
-            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),  # k
-            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),  # v
+            pl.BlockSpec((None, block_k_dkv, d), lambda b, j: (b, j, 0)),  # k
+            pl.BlockSpec((None, block_k_dkv, d), lambda b, j: (b, j, 0)),  # v
         ],
         out_specs=[
-            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k_dkv, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k_dkv, d), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
             jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
         ],
+        **_tpu_params(interpret, 2),
     )(q, do, lse3, delta3, k, v)
     dk, dv = dkv
 
     dq = pl.pallas_call(
         functools.partial(_attn_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, seq_k=seq_k),
-        grid=(bh, seq_q // block_q),
+                          block_k=block_k_dq, seq_k=seq_k),
+        grid=(bh, seq_q // block_q_dq),
         interpret=interpret,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),   # q
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),   # do
-            pl.BlockSpec((None, block_q, 128), lambda b, i: (b, i, 0)),  # lse
-            pl.BlockSpec((None, block_q, 128), lambda b, i: (b, i, 0)),  # dlt
+            pl.BlockSpec((None, block_q_dq, d), lambda b, i: (b, i, 0)),  # q
+            pl.BlockSpec((None, block_q_dq, d), lambda b, i: (b, i, 0)),  # do
+            pl.BlockSpec((None, block_q_dq, 128),
+                         lambda b, i: (b, i, 0)),                       # lse
+            pl.BlockSpec((None, block_q_dq, 128),
+                         lambda b, i: (b, i, 0)),                       # dlt
             pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),     # k
             pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),     # v
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((None, block_q_dq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        **_tpu_params(interpret, 2),
     )(q, do, lse3, delta3, k, v)
     return dq, dk, dv
 
@@ -326,39 +380,139 @@ def _from_bh(x, b, h):
     return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
 
 
+def _synth_bh(shapes, dtypes):
+    """Concrete probe operands for a tuning run (fixed seed: the timings are
+    value-independent, the arrays just have to exist on device)."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    out = []
+    for shape, dtype in zip(shapes, dtypes):
+        if jnp.issubdtype(jnp.dtype(dtype), jnp.inexact):
+            out.append(jnp.asarray(
+                rng.standard_normal(shape, dtype=np.float32)).astype(dtype))
+        else:
+            out.append(jnp.zeros(shape, dtype))
+    return out
+
+
+def _tuned_fwd_blocks(bh, s_q, s_k, d, dtype, causal, interp):
+    """(block_q, block_k) for the forward kernel: deterministic defaults
+    under interpret/CPU, autotuned (and cached) on TPU."""
+    fallback = (_clamp(DEFAULT_BLOCK_Q, s_q), _clamp(DEFAULT_BLOCK_K, s_k))
+    if interp:
+        return fallback
+    from ..autotune import get_tuner, shape_bucket, short_dtype, \
+        source_version
+    cands = list(dict.fromkeys(
+        (_clamp(bq, s_q), _clamp(bk, s_k)) for bq, bk in _FWD_CANDIDATES))
+    if len(cands) == 1:
+        return cands[0]
+    sig = "fwd|bh%d|s%dx%d|d%d|%s|c%d" % (
+        shape_bucket((bh,))[0], s_q, s_k, d, short_dtype(dtype), int(causal))
+
+    def build(cand):
+        return functools.partial(
+            _flash_fwd_bh, causal=causal, scale=1.0,
+            block_q=cand[0], block_k=cand[1], interpret=False)
+
+    def make_args():
+        return _synth_bh([(bh, s_q, d), (bh, s_k, d), (bh, s_k, d)],
+                         [dtype] * 3)
+
+    return get_tuner().get(
+        "flash_attention", sig, candidates=cands, build=build,
+        make_args=make_args, fallback=fallback,
+        version=source_version(__name__))
+
+
+def _tuned_bwd_blocks(bh, s_q, s_k, d, dtype, causal, interp):
+    """(block_q_dkv, block_k_dkv, block_q_dq, block_k_dq) for the backward
+    pair: bf16-aware deterministic defaults under interpret/CPU, autotuned
+    (and cached) on TPU."""
+    def clamp4(c):
+        return (_clamp(c[0], s_q), _clamp(c[1], s_k),
+                _clamp(c[2], s_q), _clamp(c[3], s_k))
+    fallback = clamp4(_bwd_default_blocks(dtype))
+    if interp:
+        return fallback
+    from ..autotune import get_tuner, shape_bucket, short_dtype, \
+        source_version
+    cands = list(dict.fromkeys(clamp4(c) for c in _BWD_CANDIDATES))
+    if len(cands) == 1:
+        return cands[0]
+    sig = "bwd|bh%d|s%dx%d|d%d|%s|c%d" % (
+        shape_bucket((bh,))[0], s_q, s_k, d, short_dtype(dtype), int(causal))
+
+    def build(cand):
+        return functools.partial(
+            _flash_bwd_bh, causal=causal, scale=1.0,
+            block_q_dkv=cand[0], block_k_dkv=cand[1],
+            block_q_dq=cand[2], block_k_dq=cand[3], interpret=False)
+
+    def make_args():
+        args = _synth_bh(
+            [(bh, s_q, d), (bh, s_k, d), (bh, s_k, d), (bh, s_q, d)],
+            [dtype] * 4)
+        lse = jnp.zeros((bh, s_q), jnp.float32)
+        do = _synth_bh([(bh, s_q, d)], [dtype])[0]
+        return args + [lse, do]
+
+    return get_tuner().get(
+        "flash_attention", sig, candidates=cands, build=build,
+        make_args=make_args, fallback=fallback,
+        version=source_version(__name__))
+
+
 def flash_attention(q, k, v, causal=False, scale=1.0,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=None):
+                    block_q=None, block_k=None, interpret=None):
     """q,k,v: (B, S, H, D) -> (B, S, H, D). Forward only; use
     flash_attention_vjp for the Pallas-backward pair (attention.py wires it
     through jax.custom_vjp). interpret=None resolves per call from placement
     (_interpret); pass an explicit bool when the caller already resolved it
-    (attention.py bakes it through the custom_vjp static args)."""
+    (attention.py bakes it through the custom_vjp static args). block_q /
+    block_k default to the tuned (or fallback) configuration; pass explicit
+    values to pin them."""
     out, _ = flash_attention_fwd(q, k, v, causal, scale, block_q, block_k,
                                  interpret)
     return out
 
 
 def flash_attention_fwd(q, k, v, causal=False, scale=1.0,
-                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                        interpret=None):
+                        block_q=None, block_k=None, interpret=None):
     """Returns (out, lse) with lse (B, H, S) float32 — the residual the
     Pallas backward needs."""
     b, s, h, d = q.shape
+    s_k = k.shape[1]
+    interp = _interpret(q) if interpret is None else interpret
+    if block_q is None and block_k is None:
+        bq, bk = _tuned_fwd_blocks(b * h, s, s_k, d, q.dtype, causal, interp)
+    else:
+        bq = _clamp(block_q or DEFAULT_BLOCK_Q, s)
+        bk = _clamp(block_k or DEFAULT_BLOCK_K, s_k)
     out, lse = _flash_fwd_bh(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,
-                             _clamp(block_q, s), _clamp(block_k, k.shape[1]),
-                             _interpret(q) if interpret is None else interpret)
+                             bq, bk, interp)
     return _from_bh(out, b, h), lse.reshape(b, h, s)
 
 
 def flash_attention_bwd(q, k, v, out, lse, do, causal=False, scale=1.0,
-                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                        interpret=None):
-    """FlashAttention-2 backward: (dq, dk, dv), all (B, S, H, D)."""
+                        block_q=None, block_k=None, interpret=None):
+    """FlashAttention-2 backward: (dq, dk, dv), all (B, S, H, D). With no
+    explicit blocks the dkv and dq passes get independently tuned
+    (block_q, block_k) pairs; explicit block_q/block_k pin both passes
+    (legacy single-pair interface)."""
     b, s, h, d = q.shape
+    s_k = k.shape[1]
+    interp = _interpret(q) if interpret is None else interpret
+    if block_q is None and block_k is None:
+        blocks = _tuned_bwd_blocks(b * h, s, s_k, d, q.dtype, causal, interp)
+    else:
+        bq = block_q or DEFAULT_BLOCK_Q
+        bk = block_k or DEFAULT_BLOCK_K
+        blocks = (bq, bk, bq, bk)
+    blocks = (_clamp(blocks[0], s), _clamp(blocks[1], s_k),
+              _clamp(blocks[2], s), _clamp(blocks[3], s_k))
     dq, dk, dv = _flash_bwd_bh(
         _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(out),
         lse.reshape(b * h, s), _to_bh(do), causal, scale,
-        _clamp(block_q, s), _clamp(block_k, k.shape[1]),
-        _interpret(q) if interpret is None else interpret)
+        blocks[0], blocks[1], blocks[2], blocks[3], interp)
     return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))

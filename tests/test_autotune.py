@@ -1,0 +1,298 @@
+"""Autotune cache + measured fusion policy (ISSUE 5 tentpole + satellite).
+
+Covers: search picks the measured winner and persists it; a warm cache
+(second tuner = second process) performs ZERO timed searches; corrupt/torn
+cache files are ignored and rebuilt; a kernel-source-hash bump invalidates
+stale entries; unsearchable placements (CPU/interpret — this suite) get the
+deterministic fallback without timing anything; FLAGS_fusion_policy
+auto/always/never routing and the profiler counter event.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.ops import autotune
+from paddle_tpu.ops.autotune import Autotuner
+
+
+class ScriptedMeasure:
+    """measure_fn double: returns scripted times keyed by the candidate tag
+    build() embeds, and counts invocations."""
+
+    def __init__(self, times):
+        self.times = times
+        self.calls = 0
+
+    def __call__(self, fn, args):
+        self.calls += 1
+        return self.times[fn[1]]  # fn = ("cand", tag) from _build
+
+
+def _build(cand):
+    return ("cand", cand)
+
+
+def _get(tuner, version="v1", fallback="b"):
+    return tuner.get(
+        "testop", "sig1", candidates=("a", "b", "c"), build=_build,
+        make_args=lambda: (), fallback=fallback, version=version)
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    autotune.reset_counters()
+    yield
+
+
+def _tuner(tmp_path, times, searchable=True):
+    return Autotuner(cache_dir=str(tmp_path),
+                     measure_fn=ScriptedMeasure(times),
+                     searchable=lambda: searchable)
+
+
+class TestAutotuner:
+    def test_search_picks_fastest_and_memoizes(self, tmp_path):
+        t = _tuner(tmp_path, {"a": 3.0, "b": 1.0, "c": 2.0})
+        assert _get(t) == "b"
+        assert autotune.counters()["searches"] == 1
+        assert t._measure.calls == 3
+        # same-process second lookup: memo hit, no new timing
+        assert _get(t) == "b"
+        assert autotune.counters()["searches"] == 1
+        assert t._measure.calls == 3
+        assert autotune.counters()["mem_hits"] == 1
+
+    def test_warm_cache_second_process_zero_searches(self, tmp_path):
+        """Acceptance: search runs at most once per signature per cache
+        lifetime — a fresh tuner over the same dir (= a second process)
+        serves from disk with zero timed searches."""
+        _get(_tuner(tmp_path, {"a": 3.0, "b": 1.0, "c": 2.0}))
+        autotune.reset_counters()
+        fresh = _tuner(tmp_path, {"a": 0.0, "b": 0.0, "c": 0.0})
+        assert _get(fresh) == "b"
+        assert autotune.counters()["searches"] == 0
+        assert autotune.counters()["disk_hits"] == 1
+        assert fresh._measure.calls == 0
+
+    def test_corrupt_cache_ignored_and_rebuilt(self, tmp_path):
+        _get(_tuner(tmp_path, {"a": 3.0, "b": 1.0, "c": 2.0}))
+        (cache_file,) = tmp_path.glob("*.json")
+        cache_file.write_text("{ not json !!")
+        autotune.reset_counters()
+        t2 = _tuner(tmp_path, {"a": 1.0, "b": 5.0, "c": 5.0})
+        assert _get(t2) == "a"  # rebuilt from a fresh search
+        assert autotune.counters()["searches"] == 1
+        # and the file is valid JSON again
+        rec = json.loads(cache_file.read_text())
+        assert rec["value"] == "a"
+
+    def test_torn_cache_file_is_a_miss(self, tmp_path):
+        _get(_tuner(tmp_path, {"a": 3.0, "b": 1.0, "c": 2.0}))
+        (cache_file,) = tmp_path.glob("*.json")
+        full = cache_file.read_text()
+        cache_file.write_text(full[: len(full) // 2])  # torn write
+        t2 = _tuner(tmp_path, {"a": 5.0, "b": 5.0, "c": 1.0})
+        assert _get(t2) == "c"
+
+    def test_wrong_key_record_is_a_miss(self, tmp_path):
+        """sha1-prefix collision / stale-layout safety: a record whose
+        embedded key differs is ignored, not trusted."""
+        t = _tuner(tmp_path, {"a": 3.0, "b": 1.0, "c": 2.0})
+        _get(t)
+        (cache_file,) = tmp_path.glob("*.json")
+        rec = json.loads(cache_file.read_text())
+        rec["key"] = "some|other|key"
+        cache_file.write_text(json.dumps(rec))
+        t2 = _tuner(tmp_path, {"a": 1.0, "b": 9.0, "c": 9.0})
+        assert _get(t2) == "a"
+        assert autotune.counters()["cache_errors"] >= 1
+
+    def test_source_hash_bump_invalidates(self, tmp_path):
+        _get(_tuner(tmp_path, {"a": 3.0, "b": 1.0, "c": 2.0}), version="v1")
+        autotune.reset_counters()
+        t2 = _tuner(tmp_path, {"a": 1.0, "b": 9.0, "c": 9.0})
+        # kernel edited -> new version -> stale entry not served
+        assert _get(t2, version="v2") == "a"
+        assert autotune.counters()["searches"] == 1
+
+    def test_unsearchable_returns_fallback_without_timing(self, tmp_path):
+        t = _tuner(tmp_path, {"a": 1.0, "b": 2.0, "c": 3.0},
+                   searchable=False)
+        assert _get(t, fallback="c") == "c"
+        assert t._measure.calls == 0
+        assert autotune.counters()["fallbacks"] == 1
+        # nothing persisted: a later on-device run still gets to search
+        assert list(tmp_path.glob("*.json")) == []
+
+    def test_all_candidates_failing_returns_fallback(self, tmp_path):
+        def boom(fn, args):
+            raise RuntimeError("does not fit")
+        t = Autotuner(cache_dir=str(tmp_path), measure_fn=boom,
+                      searchable=lambda: True)
+        assert _get(t, fallback="b") == "b"
+
+    def test_tuple_values_roundtrip_through_disk(self, tmp_path):
+        t = _tuner(tmp_path, {(512, 512): 2.0, (256, 512): 1.0})
+        got = t.get("blocks", "s", candidates=((512, 512), (256, 512)),
+                    build=_build, make_args=lambda: (),
+                    fallback=(512, 512), version="v")
+        assert got == (256, 512)
+        t2 = _tuner(tmp_path, {})
+        got2 = t2.get("blocks", "s", candidates=((512, 512), (256, 512)),
+                      build=_build, make_args=lambda: (),
+                      fallback=(512, 512), version="v")
+        assert got2 == (256, 512) and isinstance(got2, tuple)
+
+    def test_default_tuner_unsearchable_on_cpu(self):
+        # this suite runs JAX_PLATFORMS=cpu: the process tuner must never
+        # time anything (tier-1 hermeticity)
+        assert not autotune.get_tuner().searchable()
+
+
+class TestSignatureHelpers:
+    def test_shape_bucket(self):
+        assert autotune.shape_bucket((3, 100, 1024)) == (4, 128, 1024)
+        assert autotune.shape_bucket((1,)) == (1,)
+
+    def test_short_dtype(self):
+        import jax.numpy as jnp
+        assert autotune.short_dtype(jnp.bfloat16) == "bf16"
+        assert autotune.short_dtype(jnp.float32) == "f32"
+
+    def test_source_version_stable_and_real(self):
+        v1 = autotune.source_version("paddle_tpu.ops.pallas.flash_attention")
+        v2 = autotune.source_version("paddle_tpu.ops.pallas.flash_attention")
+        assert v1 == v2 and v1 != "unknown" and len(v1) == 12
+
+
+class TestFusionPolicy:
+    @pytest.fixture(autouse=True)
+    def _restore_policy(self):
+        yield
+        set_flags({"FLAGS_fusion_policy": "auto"})
+
+    def _ffn_args(self, dtype="float32"):
+        rng = np.random.RandomState(0)
+        mk = lambda shape: paddle.to_tensor(
+            rng.randn(*shape).astype("float32")).astype(dtype)
+        return (mk((4, 8)), mk((8, 16)), mk((16,)), mk((16, 8)),
+                mk((8,)))
+
+    def test_auto_cpu_uses_fallback_table(self):
+        from paddle_tpu.core import autograd
+        from paddle_tpu.ops.fused_ffn import fused_ffn
+        with autograd.no_grad():  # direction = fwd
+            y32 = fused_ffn(*self._ffn_args("float32"))
+            c_after_f32 = autotune.counters()
+            assert c_after_f32["policy_fused"] == 1  # f32 fwd stays fused
+            ybf = fused_ffn(*self._ffn_args("bfloat16"))
+        c = autotune.counters()
+        assert c["policy_unfused"] == 1  # bf16 fwd: the 0.551x loser
+        assert y32.shape == [4, 8] and ybf.shape == [4, 8]
+
+    def test_auto_direction_split(self):
+        # bf16 fused_ffn: fwd routes unfused (0.551x), fwd_bwd stays fused
+        # (1.007x) — same op+dtype, different direction
+        from paddle_tpu.ops.fused_ffn import fused_ffn
+        args = self._ffn_args("bfloat16")
+        for a in args[1:]:
+            a.stop_gradient = False
+        y = fused_ffn(*args)  # grad enabled -> fwd_bwd
+        assert autotune.counters()["policy_fused"] == 1
+        y.astype("float32").sum().backward()
+        assert args[1].grad is not None
+
+    def test_always_and_never_force(self):
+        from paddle_tpu.core import autograd
+        from paddle_tpu.ops.fused_ffn import fused_ffn
+        set_flags({"FLAGS_fusion_policy": "always"})
+        with autograd.no_grad():
+            fused_ffn(*self._ffn_args("bfloat16"))
+        assert autotune.counters()["policy_fused"] == 1
+        set_flags({"FLAGS_fusion_policy": "never"})
+        with autograd.no_grad():
+            fused_ffn(*self._ffn_args("float32"))
+        assert autotune.counters()["policy_unfused"] == 1
+
+    def test_policy_parity_fused_vs_unfused(self):
+        # both candidates compute the same math: forcing either side gives
+        # the same numbers (the policy can never change results)
+        from paddle_tpu.ops.fused_ffn import fused_ffn
+        outs = {}
+        for pol in ("always", "never"):
+            set_flags({"FLAGS_fusion_policy": pol})
+            outs[pol] = np.asarray(fused_ffn(*self._ffn_args())._value)
+        np.testing.assert_allclose(outs["always"], outs["never"],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_invalid_policy_raises(self):
+        set_flags({"FLAGS_fusion_policy": "sometimes"})
+        with pytest.raises(ValueError):
+            autotune.fusion_policy()
+
+    def test_decision_recorded_as_profiler_counter(self, monkeypatch):
+        from paddle_tpu import profiler
+        from paddle_tpu.core import autograd
+        from paddle_tpu.ops.fused_ffn import fused_ffn
+        events = []
+        monkeypatch.setattr(profiler, "record_counter",
+                            lambda name, value, ts_us=None:
+                            events.append((name, value)))
+        with autograd.no_grad():
+            fused_ffn(*self._ffn_args("bfloat16"))
+        assert ("fusion_policy/fused_ffn", 0.0) in events
+
+    def test_recompute_direction_hint(self):
+        # inside recompute the body runs under no_grad yet _FORCE_DIRECTION
+        # makes policy decisions use fwd_bwd (the region IS differentiated)
+        assert autotune.current_direction() in ("fwd", "fwd_bwd")
+        prev = autotune._FORCE_DIRECTION[0]
+        autotune._FORCE_DIRECTION[0] = "fwd_bwd"
+        try:
+            from paddle_tpu.core import autograd
+            with autograd.no_grad():
+                assert autotune.current_direction() == "fwd_bwd"
+        finally:
+            autotune._FORCE_DIRECTION[0] = prev
+
+
+class TestFlashBlockFallbacks:
+    def test_interpret_fallbacks_deterministic(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _tuned_bwd_blocks, _tuned_fwd_blocks)
+        # interpret=True (this suite's regime): table answers, no tuner
+        assert _tuned_fwd_blocks(64, 1024, 1024, 64, jnp.float32, True,
+                                 True) == (512, 512)
+        assert _tuned_bwd_blocks(64, 1024, 1024, 64, jnp.float32, True,
+                                 True) == (512, 512, 512, 512)
+        # bf16-aware: reduction-loop tiles halve, parallel tiles stay 512
+        assert _tuned_bwd_blocks(64, 1024, 1024, 64, jnp.bfloat16, True,
+                                 True) == (256, 512, 512, 256)
+        # short sequences clamp every entry to a divisor of s
+        blocks = _tuned_bwd_blocks(8, 256, 256, 64, jnp.bfloat16, True, True)
+        assert all(256 % b == 0 for b in blocks)
+
+    def test_bwd_blocks_parity_tuned_vs_pinned(self):
+        """Independent dkv/dq blocks change scheduling, never numerics."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention_bwd, flash_attention_fwd)
+        rng = np.random.RandomState(0)
+        q, k, v = [jnp.asarray(rng.randn(1, 256, 2, 64).astype("float32"))
+                   for _ in range(3)]
+        out, lse = flash_attention_fwd(q, k, v, causal=True, scale=0.125)
+        do = jnp.asarray(rng.randn(*out.shape).astype("float32"))
+        tuned = flash_attention_bwd(q, k, v, out, lse, do, causal=True,
+                                    scale=0.125)
+        pinned = flash_attention_bwd(q, k, v, out, lse, do, causal=True,
+                                     scale=0.125, block_q=128, block_k=64)
+        for a, b in zip(tuned, pinned):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)

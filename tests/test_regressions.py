@@ -371,3 +371,81 @@ class TestReviewRound2Fixes:
         img_tr, _ = tr[1]
         img_te, _ = te[0]
         assert not np.allclose(img_tr, img_te)
+
+
+class TestKernelTierAdviceR5:
+    """ADVICE r5 regressions riding on the kernel-tier pass (ISSUE 5):
+    None outputs through the dispatch seam (GPTBlock's unfused branch under
+    recompute), and degen-cache invalidation on checkpoint-style writes."""
+
+    def test_gpt_recompute_with_unfused_residual_ln_trains(self, monkeypatch):
+        # high: recompute traces GPTBlock through dispatch.apply; the unfused
+        # branch returns (x, None) and out_meta used to call None.shape
+        from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+        monkeypatch.setenv("PADDLE_TPU_FUSED_RESIDUAL_LN", "0")
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position_embeddings=16, dropout=0.0,
+                        use_flash_attention=False, recompute=True)
+        model = GPTForCausalLM(cfg)
+        model.train()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 64, (2, 8)).astype("int32"))
+        loss = model(ids, labels=ids)
+        assert np.isfinite(float(loss.numpy()))
+        loss.backward()
+        w = model.gpt.h[0].ln1.weight
+        assert w.grad is not None
+        assert np.isfinite(w.grad.numpy()).all()
+
+    def test_dispatch_none_output_passthrough(self):
+        # the seam itself: a prim returning (value, None) must wrap to
+        # (Tensor, None), and backward must feed a None cotangent through
+        from paddle_tpu.core.dispatch import apply
+
+        x = paddle.to_tensor(np.ones((3,), "float32"))
+        x.stop_gradient = False
+        y, nothing = apply(lambda v: (v * 2.0, None), x, name="with_none")
+        assert nothing is None
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2.0 * np.ones(3))
+
+    def test_set_state_dict_refreshes_degenerate_guard(self):
+        # med: loading a checkpoint with a zero LN channel over a WARM model
+        # (sticky _degen_cache = "not degenerate") must re-route to the
+        # plain path, not silently freeze the channel's gradient
+        from paddle_tpu.ops.fused_residual_ln import fused_residual_ln
+
+        def grad_of(ln):
+            rng = np.random.RandomState(0)
+            x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+            y = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+            out = fused_residual_ln(x, y, ln.weight, ln.bias)
+            ln.weight.clear_grad() if ln.weight.grad is not None else None
+            out.sum().backward()
+            return ln.weight.grad.numpy()
+
+        warm = nn.LayerNorm(8)
+        grad_of(warm)  # caches "not degenerate" on warm.weight
+
+        sd = {k: v.numpy().copy() for k, v in warm.state_dict().items()}
+        sd["weight"][3] = 0.0  # dead channel arrives via checkpoint
+        warm.set_state_dict(sd)
+        fresh = nn.LayerNorm(8)
+        fresh.set_state_dict(sd)
+
+        g_warm, g_fresh = grad_of(warm), grad_of(fresh)
+        np.testing.assert_allclose(g_warm, g_fresh, rtol=1e-5, atol=1e-6)
+        assert g_warm[3] != 0.0  # the zero channel still learns
+
+    def test_replace_value_invalidates_degen_cache(self):
+        # low: optimizer/functional state writes go through _replace_value
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops._param_guard import degenerate_below_tol
+
+        t = paddle.to_tensor(np.ones(4, "float32"))
+        assert not degenerate_below_tol(t, 1e-6)
+        t._replace_value(jnp.zeros(4, jnp.float32))
+        assert degenerate_below_tol(t, 1e-6)

@@ -313,6 +313,18 @@ CASES = {
 }
 
 
+def _policy_choice(fused_ms, unfused_ms):
+    """Which side the measured fusion policy (paddle_tpu/ops/autotune.py)
+    would dispatch for this row under the current FLAGS_fusion_policy."""
+    from paddle_tpu.ops.autotune import auto_winner, fusion_policy
+    pol = fusion_policy()
+    if pol == "always":
+        return "fused"
+    if pol == "never":
+        return "unfused"
+    return auto_winner(fused_ms, unfused_ms)
+
+
 def run(filter_=None, dtypes=("bf16", "f32"), small=False, iters=5,
         inner=10):
     import jax
@@ -333,16 +345,23 @@ def run(filter_=None, dtypes=("bf16", "f32"), small=False, iters=5,
                 unfused_ms = max(_timed(unfused_fn, args, iters, inner),
                                  1e-6)
                 speedup = unfused_ms / fused_ms
+                choice = _policy_choice(fused_ms, unfused_ms)
+                chosen_ms = fused_ms if choice == "fused" else unfused_ms
                 rows.append({
                     "op": name, "dtype": dtype, "direction": direction,
                     "shape": case["shape"],
                     "fused_ms": round(fused_ms, 6),
                     "unfused_ms": round(unfused_ms, 6),
                     "speedup": round(speedup, 3),
+                    "policy_choice": choice,
+                    "chosen_ms": round(chosen_ms, 6),
+                    # what the dispatcher actually delivers vs the unfused
+                    # baseline once the policy picks this row's winner
+                    "effective_speedup": round(unfused_ms / chosen_ms, 3),
                 })
                 print(f"[op_bench] {name:18s} {dtype:4s} {direction:7s} "
                       f"fused {fused_ms:8.3f} ms  unfused {unfused_ms:8.3f} "
-                      f"ms  x{speedup:.2f}", file=sys.stderr,
+                      f"ms  x{speedup:.2f}  -> {choice}", file=sys.stderr,
                       flush=True)
     return {"device": jax.devices()[0].device_kind,
             "small": small, "ops": rows}
@@ -378,13 +397,19 @@ def main(argv=None):
     ap.add_argument("--filter", default=None)
     ap.add_argument("--dtypes", default="bf16,f32")
     ap.add_argument("--small", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fastest useful run: --small shapes, one iteration "
+                         "(the non-slow test-suite / bench.py opbench lane)")
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--inner", type=int, default=10)
     ap.add_argument("--check-against", default=None)
     ap.add_argument("--tol", type=float, default=0.10)
     ns = ap.parse_args(argv)
+    if ns.smoke:
+        ns.small, ns.iters, ns.inner = True, 1, 1
     doc = run(ns.filter, tuple(ns.dtypes.split(",")), ns.small, ns.iters,
               ns.inner)
+    doc["smoke"] = ns.smoke
     with open(ns.out, "w") as f:
         json.dump(doc, f, indent=2)
     if ns.check_against and os.path.exists(ns.check_against):

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Kernel-tier CI gate over OPBENCH.json artifacts (ISSUE 5 satellite).
+
+Two checks, either of which fails the run (rc != 0):
+
+1. Policy check (NEW artifact alone): no fused-op row may dispatch a path
+   measured slower than its unfused XLA baseline. A row fails when the
+   policy-chosen config is the *fused* path yet its measured speedup is
+   < 1.0 — i.e. the measured fusion policy (paddle_tpu/ops/autotune.py)
+   failed to fall back, or FLAGS_fusion_policy=always is pinning a loser
+   (the fused_ffn bf16 fwd 0.551x class of regression). Rows that carry an
+   explicit "policy_choice" field (emitted by tools/op_bench.py) are taken
+   at their word; legacy rows derive the choice from the current
+   FLAGS_fusion_policy exactly like the dispatcher would.
+
+2. Regression check (NEW vs OLD): any per-op fused_ms slowdown beyond
+   --tol (default 10%) on the same (op, dtype, direction, shape, device),
+   via op_bench.check_against.
+
+Usage:
+    python tools/opbench_diff.py NEW.json [OLD.json] [--tol 0.10]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def row_choice(row):
+    """The policy-chosen config for a row: the artifact's own record when
+    present, else what the live policy would pick from its measurements."""
+    choice = row.get("policy_choice")
+    if choice in ("fused", "unfused"):
+        return choice
+    from paddle_tpu.ops.autotune import auto_winner, fusion_policy
+    pol = fusion_policy()
+    if pol == "always":
+        return "fused"
+    if pol == "never":
+        return "unfused"
+    return auto_winner(row["fused_ms"], row["unfused_ms"])
+
+
+def policy_failures(doc):
+    """Rows whose policy-chosen config is measured slower than unfused."""
+    fails = []
+    for row in doc.get("ops", []):
+        if row_choice(row) != "fused":
+            continue  # unfused baseline is 1.0x by definition
+        if row["speedup"] < 1.0:
+            fails.append({
+                "op": row["op"], "dtype": row["dtype"],
+                "direction": row["direction"], "shape": row.get("shape"),
+                "speedup": row["speedup"],
+                "fused_ms": row["fused_ms"],
+                "unfused_ms": row["unfused_ms"],
+            })
+    return fails
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("new", help="OPBENCH.json to gate")
+    ap.add_argument("old", nargs="?", default=None,
+                    help="previous artifact for the regression check")
+    ap.add_argument("--tol", type=float, default=0.10)
+    ns = ap.parse_args(argv)
+
+    with open(ns.new) as f:
+        new_doc = json.load(f)
+    failures = policy_failures(new_doc)
+
+    regressions = []
+    if ns.old:
+        import op_bench
+        with open(ns.old) as f:
+            old_doc = json.load(f)
+        regressions = op_bench.check_against(new_doc, old_doc, ns.tol)
+
+    bad = bool(failures or regressions)
+    print(json.dumps({
+        "status": "fail" if bad else "ok",
+        "rows": len(new_doc.get("ops", [])),
+        "policy_failures": failures,
+        "regressions": regressions,
+    }, indent=2))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
