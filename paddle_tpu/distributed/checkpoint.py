@@ -10,6 +10,9 @@ onto dp2×mp4 (or a different pp split) with no resharding tool.
 """
 from __future__ import annotations
 
+import hashlib
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -19,7 +22,44 @@ from ..core.tensor import Tensor
 from .mesh import get_mesh
 
 __all__ = ["save_hybrid_checkpoint", "load_hybrid_checkpoint",
-           "reshard_model"]
+           "reshard_model", "CorruptCheckpointError"]
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint file fails its sha256 sidecar or cannot be unpickled."""
+
+
+def _sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _load_verified(path):
+    """Load one checkpoint file, verifying it against its ``.sha256``
+    sidecar first when one exists (checkpoints predating the sidecar load
+    unverified). Any damage — digest mismatch, torn pickle — surfaces as
+    :class:`CorruptCheckpointError` so the caller can fall back."""
+    from ..framework.io_utils import load as load_obj
+    want = None
+    try:
+        with open(path + ".sha256") as f:
+            want = f.read().strip() or None
+    except OSError:
+        pass
+    if want is not None:
+        got = _sha256_file(path)
+        if got != want:
+            raise CorruptCheckpointError(
+                f"{path}: sha256 mismatch on restore "
+                f"(got {got[:12]}, recorded {want[:12]})")
+    try:
+        return load_obj(path)
+    except Exception as e:
+        raise CorruptCheckpointError(
+            f"{path}: unreadable checkpoint: {e}") from e
 
 
 def _unwrap_model(model):
@@ -52,7 +92,20 @@ def save_hybrid_checkpoint(path, model, optimizer=None, meta=None):
         blob["optimizer"] = {
             k: (np.asarray(t._val) if isinstance(t, Tensor) else t)
             for k, t in opt.state_dict().items()}
+    # retain the previous snapshot (+ its sidecar) as the corruption
+    # fallback: load falls back to `.old` and journals `corrupt_restore`
+    # when the current file fails its sha256 — same discipline as
+    # incubate.CheckpointSaver
+    if os.path.exists(path):
+        if os.path.exists(path + ".sha256"):
+            os.replace(path + ".sha256", path + ".old.sha256")
+        os.replace(path, path + ".old")
     save_obj(blob, path)
+    digest = _sha256_file(path)
+    tmp = f"{path}.sha256.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(digest + "\n")
+    os.replace(tmp, path + ".sha256")
     return path
 
 
@@ -87,9 +140,29 @@ def reshard_model(model):
 
 
 def load_hybrid_checkpoint(path, model, optimizer=None):
-    """Load a canonical checkpoint and re-place it on the current mesh."""
-    from ..framework.io_utils import load as load_obj
-    blob = load_obj(path)
+    """Load a canonical checkpoint and re-place it on the current mesh.
+
+    The file is verified against the sha256 sidecar written at save time; a
+    mismatch (or unreadable pickle, or a current file lost to a crash
+    between the two save-time renames) falls back to the retained ``.old``
+    snapshot — itself verified — and journals a ``corrupt_restore`` cause
+    instead of silently loading garbage. The returned meta then carries
+    ``restored_from_fallback: True``.
+    """
+    try:
+        blob = _load_verified(path)
+    except (CorruptCheckpointError, FileNotFoundError) as e:
+        old = path + ".old"
+        if not os.path.exists(old):
+            raise
+        try:
+            from ..resilience.recovery import get_journal
+            get_journal().record("corrupt_restore", path=path,
+                                 detail=str(e), fallback=old)
+        except Exception:
+            pass  # journaling is best-effort on the failure path
+        blob = _load_verified(old)
+        blob.setdefault("meta", {})["restored_from_fallback"] = True
     inner, _ = _unwrap_model(model)
     sd = inner.state_dict()
     saved = blob["model"]

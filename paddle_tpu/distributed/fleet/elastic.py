@@ -84,9 +84,12 @@ class FileStore:
         except (FileNotFoundError, json.JSONDecodeError):
             return None
 
-    def alive_values(self, prefix):
+    def alive_values(self, prefix, ttl=None):
         """Values of all non-expired keys under prefix. Keys deleted between
-        listdir and open, and torn writes, count as expired."""
+        listdir and open, and torn writes, count as expired. ``ttl``
+        overrides the store lease for this scan — quarantine markers live on
+        FLAGS_quarantine_ttl, far past the node-lease TTL."""
+        ttl = self.ttl if ttl is None else ttl
         out = []
         enc_prefix = _encode_key(prefix)
         for name in sorted(os.listdir(self.root)):
@@ -94,7 +97,7 @@ class FileStore:
                 continue
             p = os.path.join(self.root, name)
             try:
-                if time.time() - os.path.getmtime(p) <= self.ttl:
+                if time.time() - os.path.getmtime(p) <= ttl:
                     with open(p) as f:
                         out.append(json.load(f))
             except (FileNotFoundError, json.JSONDecodeError):
@@ -221,6 +224,42 @@ class ElasticManager:
     def unhealthy_nodes(self):
         return self.store.alive_values(f"{self.job_id}/unhealthy.")
 
+    def quarantine_ttl(self):
+        from ...framework.flags import get_flag
+        return float(get_flag("FLAGS_quarantine_ttl", 3600.0) or 3600.0)
+
+    def mark_quarantined(self, reason="", info=None):
+        """Record a durable health verdict against this rank (failed
+        preflight KAT, named by SDC consensus, opt-in straggler).
+
+        A TTL'd superset of ``mark_unhealthy``: unhealthy markers are wiped
+        when a new group forms, but a quarantine marker *survives*
+        re-rendezvous — the rank stays excluded until the marker ages past
+        ``FLAGS_quarantine_ttl`` (a repaired/replaced host rejoins then).
+        Written with retry: this is the one store write whose loss readmits
+        a known-bad host."""
+        payload = {"rank": self.rank, "endpoint": self.endpoint,
+                   "reason": reason, "ts": time.time()}
+        payload.update(info or {})
+        retry_call(self.store.put,
+                   f"{self.job_id}/quarantined.{self.rank}", payload,
+                   retry_on=(ExecuteError, OSError),
+                   max_backoff=self.ttl_guard())
+
+    def quarantined_nodes(self):
+        prefix = f"{self.job_id}/quarantined."
+        try:
+            return self.store.alive_values(prefix, ttl=self.quarantine_ttl())
+        except TypeError:
+            # a custom store without the per-scan ttl override: quarantine
+            # then lives on the store's own lease
+            return self.store.alive_values(prefix)
+
+    def is_quarantined(self, rank=None):
+        rank = self.rank if rank is None else int(rank)
+        return any(int(q.get("rank", -1)) == rank
+                   for q in self.quarantined_nodes())
+
     # -- membership --------------------------------------------------------
     def alive_nodes(self):
         return self.store.alive_values(f"{self.job_id}/node.")
@@ -324,6 +363,17 @@ class ElasticManager:
         # a rank that reached rendezvous is alive: clear its own stale
         # unhealthy marker so the new group doesn't re-diagnose old news
         self.store.delete(f"{self.job_id}/unhealthy.{self.rank}")
+        # quarantine is the opposite: durable. A rank that failed its KAT
+        # or was named by SDC consensus must not talk its way back into the
+        # group just by showing up — it exits (SystemExit 117) and stays
+        # out until its marker ages past FLAGS_quarantine_ttl.
+        mine = [q for q in self.quarantined_nodes()
+                if int(q.get("rank", -1)) == self.rank]
+        if mine:
+            from ...resilience.health import Quarantined
+            raise Quarantined(self.rank,
+                              reason=mine[0].get("reason", "")
+                              or "quarantined marker present at rendezvous")
         rec = self.store.get(self._gen_key()) or {}
         gen = max(int(rec.get("gen", 0)), self._generation) + 1
         self.store.put(self._gen_key(), {"gen": gen})
@@ -346,7 +396,13 @@ class ElasticManager:
             # rank's record would age out mid-wait, undercounting the group
             # exactly when the scaled-in np_min decision needs it
             self.announce(gen)
-            arrived = self.store.alive_values(f"{self.job_id}/rdzv.{gen}/")
+            # re-read quarantine each poll: a rank can be condemned while
+            # we wait, and counting it toward np_max/np_min would let a
+            # known-bad host back into the agreed group
+            bad = {int(q.get("rank", -1)) for q in self.quarantined_nodes()}
+            arrived = [a for a in
+                       self.store.alive_values(f"{self.job_id}/rdzv.{gen}/")
+                       if int(a.get("rank", -1)) not in bad]
             if len(arrived) >= self.np_max:
                 break
             if self._now() - start >= timeout:
@@ -358,7 +414,9 @@ class ElasticManager:
             self._sleep(interval)
         # the agreed group starts with a clean bill of health: markers from
         # the dead incarnation would otherwise re-trigger recovery until
-        # their TTL lapses (delete is idempotent — every survivor may wipe)
+        # their TTL lapses (delete is idempotent — every survivor may wipe).
+        # quarantined.<rank> markers are deliberately NOT wiped — they must
+        # outlive the re-rendezvous they caused.
         for u in self.unhealthy_nodes():
             self.store.delete(f"{self.job_id}/unhealthy.{u.get('rank')}")
         self._generation = gen

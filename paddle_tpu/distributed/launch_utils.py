@@ -248,7 +248,10 @@ def supervise_local_trainers(cluster, pod, training_script,
     recorded in the per-job recovery journal (``PADDLE_TPU_ARTIFACTS_DIR``).
     When the shared restart budget (default ``FLAGS_recovery_max_restarts``)
     is spent, the remaining workers are terminated and the journal records
-    the exhaustion. Returns per-rank exit codes once every rank exited 0.
+    the exhaustion. A worker that exits with the quarantine code (117 —
+    failed preflight KAT or named by SDC consensus) is terminal for its
+    rank: journaled, not relaunched, and not charged to the restart budget.
+    Returns per-rank exit codes once every rank exited (0 or quarantined).
     """
     if max_restarts is None:
         from ..framework.flags import get_flag
@@ -280,6 +283,19 @@ def supervise_local_trainers(cluster, pod, training_script,
                     tp.log_fn = None
                 if ret == 0:
                     codes[tp.rank] = 0
+                    continue
+                from ..resilience.health import QUARANTINE_EXIT_CODE
+                if ret == QUARANTINE_EXIT_CODE:
+                    # the worker condemned its own hardware (failed KAT /
+                    # named by SDC consensus): relaunching on the same host
+                    # would just fail the next preflight, so the rank stays
+                    # down — without burning the restart budget the healthy
+                    # ranks may still need — and the survivors' rendezvous
+                    # proceeds scaled-in without it
+                    codes[tp.rank] = ret
+                    journal.record("quarantined", rank=tp.rank, code=ret,
+                                   cause="worker exited quarantined "
+                                         f"(code {ret}); not relaunching")
                     continue
                 restarts += 1
                 hint = _flight_recorder_hint(tp.rank)

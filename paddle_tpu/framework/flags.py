@@ -66,6 +66,31 @@ _FLAGS: dict[str, Any] = {
     "FLAGS_serving_step_timeout": 60.0,
     # bounded request queue; admission sheds (ServerOverloaded) beyond this
     "FLAGS_serving_max_queue": 256,
+    # hardware health & SDC defense (resilience/{integrity,health}.py):
+    # steps between cross-replica parameter-checksum consensus rounds;
+    # 0 disables in-training SDC detection
+    "FLAGS_integrity_check_interval": 100,
+    # how long one consensus round waits for peer digests before voting
+    # with whoever reported (a dead peer must not hang the check)
+    "FLAGS_integrity_consensus_timeout": 30.0,
+    # run the known-answer test at startup / re-rendezvous / replica restart
+    "FLAGS_preflight_checks": True,
+    # how long a quarantined.<rank> marker excludes that rank from
+    # rendezvous (seconds); after expiry a repaired host may rejoin
+    "FLAGS_quarantine_ttl": 3600.0,
+    # straggler detector: rolling window (steps) and flag threshold as a
+    # multiple of the group-median step time
+    "FLAGS_straggler_window": 50,
+    "FLAGS_straggler_threshold": 3.0,
+    # opt-in: a rank that detects ITSELF straggling takes the quarantine
+    # exit (off by default — slowness is often the network, not the host)
+    "FLAGS_straggler_quarantine": False,
+    # steps of replay material (rng key + raw inputs) kept for
+    # tools/replay_step.py SDC classification
+    "FLAGS_replay_buffer_size": 8,
+    # rotate the recovery journal past this size, keeping two segments;
+    # 0 = unbounded
+    "FLAGS_journal_max_bytes": 1 << 20,
     # inert reference flags accepted for script compatibility
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_allocator_strategy": "auto_growth",

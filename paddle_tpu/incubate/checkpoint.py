@@ -153,10 +153,18 @@ class CheckpointSaver:
                 return None, None
         try:
             return self._read_snapshot(self._path)
-        except CorruptSnapshotError:
+        except CorruptSnapshotError as e:
             # torn current snapshot (e.g. partial write before a crash):
             # fall back to the retained previous snapshot and promote it so
-            # the next save swaps against a healthy current
+            # the next save swaps against a healthy current. Journaled as a
+            # corrupt_restore cause — losing a snapshot to corruption is a
+            # health signal (disk/SDC), not just an inconvenience.
+            try:
+                from ..resilience.recovery import get_journal
+                get_journal().record("corrupt_restore", path=self._path,
+                                     detail=str(e), fallback=old)
+            except Exception:
+                pass
             if not self._fs.is_exist(os.path.join(old, "meta.json")):
                 return None, None
             state, meta = self._read_snapshot(old)  # may raise: both torn

@@ -20,7 +20,7 @@ import jax
 __all__ = [
     "Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
     "start_profiler", "stop_profiler", "reset_profiler", "profiler",
-    "export_chrome_tracing", "summary", "record_counter",
+    "export_chrome_tracing", "summary", "record_counter", "counter_samples",
 ]
 
 
@@ -245,6 +245,18 @@ def record_counter(name, value, ts_us=None):
     (no-op while profiling is disabled). The serving subsystem exports its
     queue-depth / shed / occupancy gauges through this."""
     _recorder.record_counter(name, value, ts_us)
+
+
+def counter_samples(name=None):
+    """Snapshot of recorded counter events as ``(name, ts_us, value)``
+    tuples, optionally filtered by name. Lets tests and CI gates assert on
+    gauges (integrity check cost, straggler ratios, serving queue depth)
+    without exporting and parsing a chrome trace."""
+    with _recorder._lock:
+        samples = list(_recorder._counters)
+    if name is None:
+        return samples
+    return [s for s in samples if s[0] == name]
 
 
 def export_chrome_tracing(path, dir_name=None):

@@ -23,18 +23,37 @@ modules compose:
   rendezvous through the elastic store (stale ranks fail with
   :class:`StaleGeneration`), automatic in-job restart with a budget
   (:class:`RecoveryManager`), and a per-job recovery journal.
+- :mod:`.integrity` — silent-data-corruption defense: bitwise parameter
+  checksums majority-voted across data-parallel replicas
+  (:class:`ConsensusChecker`), plus a bounded step-replay ring that
+  re-executes an accused step on CPU to classify hardware vs software.
+- :mod:`.health` — preflight known-answer checks, the quarantine
+  lifecycle (:class:`Quarantined`, exit code 117), and k×-median
+  straggler detection.
 """
 from __future__ import annotations
 
 from . import faults  # noqa: F401
 from . import guard  # noqa: F401
+from . import health  # noqa: F401
+from . import integrity  # noqa: F401
 from . import preempt  # noqa: F401
 from . import recorder  # noqa: F401
 from . import recovery  # noqa: F401
 from . import retry  # noqa: F401
 from . import watchdog  # noqa: F401
-from .faults import FaultInjected, fault_point, maybe_inject  # noqa: F401
+from .faults import (  # noqa: F401
+    FaultInjected, fault_point, maybe_inject, should_inject,
+)
 from .guard import BadStepError, StepGuard  # noqa: F401
+from .health import (  # noqa: F401
+    QUARANTINE_EXIT_CODE, PreflightFailure, Quarantined, StragglerDetector,
+    preflight_kat, run_preflight, serving_preflight,
+)
+from .integrity import (  # noqa: F401
+    ConsensusChecker, IntegrityError, StepReplayBuffer, checksum_state,
+    classify_replay,
+)
 from .preempt import Preempted, PreemptionCallback, PreemptionHandler  # noqa: F401
 from .recorder import FlightRecorder, get_recorder  # noqa: F401
 from .recovery import (  # noqa: F401
@@ -48,11 +67,15 @@ from .watchdog import (  # noqa: F401
 )
 
 __all__ = ["faults", "retry", "guard", "preempt", "recorder", "recovery",
-           "watchdog",
-           "maybe_inject", "fault_point", "FaultInjected", "StepGuard",
-           "BadStepError", "Preempted", "PreemptionHandler",
+           "watchdog", "integrity", "health",
+           "maybe_inject", "should_inject", "fault_point", "FaultInjected",
+           "StepGuard", "BadStepError", "Preempted", "PreemptionHandler",
            "PreemptionCallback", "retry_call", "FlightRecorder",
            "get_recorder", "Watchdog", "watch_section", "DistributedError",
            "DistributedTimeout", "PeerAbort", "StaleGeneration",
            "RecoveryManager", "RecoveryJournal", "RecoveryExhausted",
-           "RendezvousTimeout", "MembershipChange", "current_generation"]
+           "RendezvousTimeout", "MembershipChange", "current_generation",
+           "IntegrityError", "ConsensusChecker", "StepReplayBuffer",
+           "checksum_state", "classify_replay", "Quarantined",
+           "PreflightFailure", "preflight_kat", "run_preflight",
+           "serving_preflight", "StragglerDetector", "QUARANTINE_EXIT_CODE"]

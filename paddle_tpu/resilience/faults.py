@@ -27,8 +27,8 @@ import random
 import threading
 
 __all__ = ["FaultInjected", "FaultRegistry", "configure", "reset",
-           "maybe_inject", "fault_point", "stats", "is_active",
-           "reconfigure_from_flags"]
+           "maybe_inject", "should_inject", "fault_point", "stats",
+           "is_active", "reconfigure_from_flags"]
 
 
 class FaultInjected(RuntimeError):
@@ -182,6 +182,20 @@ def maybe_inject(site, exc_type=FaultInjected):
                                      and issubclass(exc_type, FaultInjected)):
         raise exc_type(site, count)
     raise exc_type(f"injected fault at '{site}' (evaluation #{count})")
+
+
+def should_inject(site):
+    """Non-raising injection point for corruption-style faults.
+
+    Some faults don't *fail* an operation — they silently change its result
+    (``device.bitflip`` perturbs a checksum the way flipped device memory
+    would). The call site asks the registry whether this evaluation is
+    corrupted and applies the perturbation itself. Same spec grammar,
+    streams, and counters as :func:`maybe_inject`.
+    """
+    if not _REGISTRY.active:
+        return False
+    return bool(_REGISTRY.should_fail(site))
 
 
 def _init_from_flags():

@@ -57,7 +57,7 @@ class StepGuard:
     """
 
     def __init__(self, objs, scaler=None, max_bad_steps=None, saver=None,
-                 on_rollback=None, check_state=True):
+                 on_rollback=None, check_state=True, replay=None):
         from ..framework.flags import get_flag
         self.objs = [o for o in objs if o is not None]
         self.scaler = scaler
@@ -67,6 +67,11 @@ class StepGuard:
         self.saver = saver
         self.on_rollback = on_rollback
         self.check_state = check_state
+        # optional StepReplayBuffer (resilience/integrity.py): a rollback
+        # means K consecutive bad steps — dump the recorded steps so
+        # tools/replay_step.py can tell a numerically unstable schedule
+        # from a chip producing garbage
+        self.replay = replay
         self.bad_steps = 0       # consecutive
         self.steps = 0           # total steps observed
         self.skipped = 0         # total skipped
@@ -164,6 +169,13 @@ class StepGuard:
         on_rollback hook); resets the consecutive-bad counter."""
         self.bad_steps = 0
         self.rollbacks += 1
+        if self.replay is not None:
+            try:
+                self.replay.dump(
+                    reason=f"guard rollback #{self.rollbacks}: "
+                           f"{self.max_bad_steps} consecutive bad steps")
+            except Exception:
+                pass  # evidence capture must not mask the rollback itself
         if self.on_rollback is not None:
             self.on_rollback(self)
             return

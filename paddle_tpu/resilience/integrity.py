@@ -1,0 +1,400 @@
+"""Silent-data-corruption defense: checksum consensus + deterministic replay.
+
+A chip that flips a bit does not crash — it trains a slightly wrong model,
+or serves a wrong answer, silently. The only cheap invariant a data-parallel
+group has is that the *post-update parameters are bitwise identical on every
+replica*: same init, same data, same deterministic update. This module turns
+that invariant into a detector:
+
+- :func:`checksum_state` — one sha256 over the flattened state dict(s),
+  bitwise (dtype + shape + raw bytes), so a single flipped mantissa bit on
+  one replica changes its digest and nobody else's. The ``device.bitflip``
+  injection site perturbs the digest the same way a real flipped parameter
+  bit would, so chaos tests exercise the full detection path.
+- :class:`ConsensusChecker` — every ``FLAGS_integrity_check_interval``
+  steps, publish the digest to the elastic store and majority-vote across
+  the group. The minority rank(s) are named in a typed
+  :class:`IntegrityError` (kind ``"sdc"``) which :class:`RecoveryManager`
+  journals and recovers from: the culprit self-marks ``quarantined.<rank>``
+  and the survivors re-rendezvous scaled-in without it.
+- :class:`StepReplayBuffer` — a bounded ring of (step, rng key, input
+  checksum, raw inputs) kept on the host. When a rank is accused, the ring
+  is dumped and ``tools/replay_step.py`` re-executes the flagged step on
+  the CPU interpret path: if the CPU reproduces the *majority* digest the
+  device computed garbage (hardware SDC — condemn the chip); if it
+  reproduces the *accused* digest the divergence is deterministic
+  (software bug — don't RMA a healthy chip).
+
+Consensus is store-mediated (no collective on the failure path — a corrupt
+rank may not be able to collectively agree it is corrupt) and clock/sleep
+are injectable, so tests drive the whole accuse→quarantine→re-rendezvous
+cycle with zero real sleeps.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+import time
+
+import numpy as np
+
+from .faults import maybe_inject, should_inject
+from .watchdog import DistributedError
+
+__all__ = ["IntegrityError", "checksum_state", "ConsensusChecker",
+           "StepReplayBuffer", "run_step_on_cpu", "classify_replay"]
+
+
+def _flag(name, default):
+    from ..framework.flags import get_flag
+    v = get_flag(name, default)
+    return default if v is None else v
+
+
+class IntegrityError(DistributedError):
+    """A hardware-health invariant failed.
+
+    ``kind`` is the journaled cause name (``"sdc"``, ``"preflight"``,
+    ``"straggler"``, ``"replay"``) — RecoveryManager journals ``kind``, not
+    the class name, so post-mortems read the verdict directly. ``culprits``
+    are the accused ranks; a rank that finds *itself* in ``culprits``
+    self-quarantines.
+    """
+
+    def __init__(self, message, culprits=(), step=None, kind="sdc",
+                 digests=None):
+        super().__init__(message)
+        self.culprits = sorted(int(r) for r in culprits)
+        self.step = step
+        self.kind = kind
+        self.digests = dict(digests or {})
+
+
+# -- bitwise state checksum ---------------------------------------------------
+
+def _hash_tree(h, key, value):
+    """Order-stable bitwise hash: key path + dtype + shape + raw bytes per
+    leaf, so replicas hashing identical state in identical order agree
+    exactly and any single flipped bit disagrees."""
+    if value is None:
+        h.update(f"{key}=None".encode())
+        return
+    if isinstance(value, dict):
+        for k in sorted(value, key=str):
+            _hash_tree(h, f"{key}/{k}", value[k])
+        return
+    if isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            _hash_tree(h, f"{key}[{i}]", v)
+        return
+    if hasattr(value, "_val"):
+        value = value._val
+    try:
+        arr = np.asarray(value)
+        h.update(key.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    except Exception:
+        h.update(f"{key}={value!r}".encode())
+
+
+def checksum_state(objs):
+    """sha256 digest over the state dict(s) of ``objs`` (anything with
+    ``state_dict()``, or raw dicts/arrays). Bitwise: replicas holding
+    identical parameters produce identical digests; one flipped bit anywhere
+    produces a different one.
+
+    ``device.bitflip`` is the corruption-style injection site: instead of
+    raising, an armed rule flips one nibble of the digest — observationally
+    identical to a real flipped parameter bit on this replica's device
+    memory, which is exactly what the consensus must catch.
+    """
+    maybe_inject("integrity.checksum")
+    if not isinstance(objs, (list, tuple)):
+        objs = [objs]
+    h = hashlib.sha256()
+    for i, obj in enumerate(objs):
+        sd = obj.state_dict() if hasattr(obj, "state_dict") else obj
+        _hash_tree(h, f"#{i}", sd)
+    digest = h.hexdigest()
+    if should_inject("device.bitflip"):
+        digest = format(int(digest[0], 16) ^ 0x1, "x") + digest[1:]
+    return digest
+
+
+# -- deterministic step replay ------------------------------------------------
+
+def _arrays_digest(arrays):
+    h = hashlib.sha256()
+    for a in arrays:
+        arr = np.asarray(a)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def run_step_on_cpu(step_fn, entry):
+    """Run ``step_fn(entry)`` pinned to the CPU backend and return the
+    resulting digest. ``entry`` is a replay-ring record (``step``,
+    ``rng_key``, ``inputs``, ``input_checksum``); ``step_fn`` may return a
+    digest string directly, or state objects which are checksummed with the
+    same :func:`checksum_state` the consensus used."""
+    import jax
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        out = step_fn(entry)
+    if isinstance(out, str):
+        return out
+    return checksum_state(out if isinstance(out, (list, tuple)) else [out])
+
+
+def classify_replay(cpu_digest, expected_digest=None, observed_digest=None):
+    """Name the failure mode from a CPU re-execution of the flagged step.
+
+    - CPU reproduces the majority (``expected``) digest → the device
+      computed garbage from good inputs: ``"hardware_sdc"``.
+    - CPU reproduces the accused rank's (``observed``) digest → the
+      divergence is deterministic, it follows the program, not the chip:
+      ``"software_bug"``.
+    - CPU matches neither → ``"inconclusive"`` (nondeterministic op, or the
+      ring captured a different microbatch than the accusation).
+    """
+    if expected_digest is None and observed_digest is None:
+        return "unverified"
+    if expected_digest is not None and cpu_digest == expected_digest:
+        return "hardware_sdc"
+    if observed_digest is not None and cpu_digest == observed_digest:
+        return "software_bug"
+    return "inconclusive"
+
+
+class StepReplayBuffer:
+    """Bounded ring of the last K steps' replay material.
+
+    Each record holds the step index, the rng key, host copies of the raw
+    input batch, and a checksum of those inputs (so the ring can prove its
+    own copy wasn't the thing that got corrupted). ``dump()`` writes a
+    ``step_replay_rank<N>.json`` + ``.npz`` pair into the artifacts dir for
+    ``tools/replay_step.py``.
+    """
+
+    def __init__(self, size=None, rank=None):
+        from .recorder import _process_rank
+        size = int(_flag("FLAGS_replay_buffer_size", 8)
+                   if size is None else size)
+        self._ring = collections.deque(maxlen=max(1, size))
+        self.rank = _process_rank() if rank is None else int(rank)
+
+    def __len__(self):
+        return len(self._ring)
+
+    def steps(self):
+        return [e["step"] for e in self._ring]
+
+    def get(self, step):
+        for e in self._ring:
+            if e["step"] == int(step):
+                return e
+        return None
+
+    def record(self, step, rng_key=None, inputs=None):
+        """Host-copy one step's inputs into the ring (device buffers may be
+        donated/overwritten by the time anyone wants to replay)."""
+        arrays = []
+        for a in (inputs or ()):
+            if hasattr(a, "_val"):
+                a = a._val
+            arrays.append(np.array(a, copy=True))
+        entry = {
+            "step": int(step),
+            "rng_key": None if rng_key is None else np.array(rng_key,
+                                                             copy=True),
+            "inputs": arrays,
+            "input_checksum": _arrays_digest(arrays),
+        }
+        self._ring.append(entry)
+        return entry
+
+    def dump(self, dir=None, reason=""):
+        """Atomically write the ring as a json (metadata) + npz (arrays)
+        pair; returns the json path. Called on accusation, best-effort."""
+        from .recorder import artifacts_dir
+        from .recovery import current_generation
+        base = dir or artifacts_dir()
+        os.makedirs(base, exist_ok=True)
+        jpath = os.path.join(base, f"step_replay_rank{self.rank}.json")
+        npath = os.path.join(base, f"step_replay_rank{self.rank}.npz")
+        arrays, entries = {}, []
+        for e in self._ring:
+            names = []
+            for i, a in enumerate(e["inputs"]):
+                name = f"s{e['step']}_in{i}"
+                arrays[name] = a
+                names.append(name)
+            rng_name = None
+            if e["rng_key"] is not None:
+                rng_name = f"s{e['step']}_rng"
+                arrays[rng_name] = e["rng_key"]
+            entries.append({"step": e["step"], "inputs": names,
+                            "rng_key": rng_name,
+                            "input_checksum": e["input_checksum"]})
+        tmp = f"{npath}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, npath)
+        meta = {"version": 1, "rank": self.rank, "reason": reason,
+                "generation": current_generation(),
+                "arrays": os.path.basename(npath), "entries": entries}
+        tmp = f"{jpath}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1)
+        os.replace(tmp, jpath)
+        return jpath
+
+    def replay(self, step, step_fn, expected_digest=None,
+               observed_digest=None):
+        """Re-execute one recorded step on the CPU path and classify the
+        divergence (see :func:`classify_replay`). Verifies the ring entry's
+        own input checksum first — a corrupted ring can't testify."""
+        maybe_inject("integrity.replay")
+        entry = self.get(step)
+        if entry is None:
+            raise KeyError(
+                f"step {step} not in replay ring (have {self.steps()})")
+        if _arrays_digest(entry["inputs"]) != entry["input_checksum"]:
+            raise IntegrityError(
+                f"replay ring entry for step {step} fails its own input "
+                "checksum — the recorded batch is itself corrupt",
+                step=step, kind="replay")
+        digest = run_step_on_cpu(step_fn, entry)
+        return {"step": int(step), "digest": digest,
+                "classification": classify_replay(
+                    digest, expected_digest, observed_digest)}
+
+
+# -- cross-replica consensus --------------------------------------------------
+
+class ConsensusChecker:
+    """Periodic cross-replica parameter-checksum consensus.
+
+    Call :meth:`after_step` once per training step with the post-update
+    objects already registered at construction; every ``interval`` steps it
+    publishes this rank's digest under
+    ``<job>/integrity.<generation>.<step>/rank.<rank>`` and majority-votes
+    across whatever the group published. Divergence raises
+    :class:`IntegrityError` (kind ``"sdc"``) on **every** rank — the
+    culprit additionally self-quarantines and dumps its replay ring — so
+    the whole group funnels into RecoveryManager's re-rendezvous, which the
+    quarantined rank is excluded from.
+
+    Warm-path cost is one sha256 over host state + one store roundtrip per
+    interval, accumulated in ``counters["seconds"]`` and emitted as the
+    ``integrity.check_ms`` profiler counter so the ≤1%-of-step-time budget
+    is assertable.
+    """
+
+    def __init__(self, elastic, objs, interval=None, timeout=None,
+                 clock=None, sleep=None, recorder=None, replay=None,
+                 poll_interval=0.05):
+        self.elastic = elastic
+        self.objs = list(objs) if isinstance(objs, (list, tuple)) else [objs]
+        self.interval = int(_flag("FLAGS_integrity_check_interval", 100)
+                            if interval is None else interval)
+        self.timeout = float(_flag("FLAGS_integrity_consensus_timeout", 30.0)
+                             if timeout is None else timeout)
+        self._clock = clock
+        self._sleep = sleep or time.sleep
+        self.recorder = recorder
+        self.replay = replay
+        self.poll_interval = poll_interval
+        self.counters = {"checks": 0, "divergences": 0, "seconds": 0.0}
+
+    def _now(self):
+        return self._clock() if self._clock is not None else time.monotonic()
+
+    def _prefix(self, step):
+        from .recovery import current_generation
+        return (f"{self.elastic.job_id}/integrity."
+                f"{current_generation()}.{int(step)}/")
+
+    def after_step(self, step, inputs=None, rng_key=None):
+        """Per-step hook: feed the replay ring, and on an interval boundary
+        run the consensus check. Returns this rank's digest on check steps,
+        None otherwise."""
+        from ..profiler import record_counter
+        t0 = time.perf_counter()
+        digest = None
+        try:
+            if self.replay is not None:
+                self.replay.record(step, rng_key=rng_key, inputs=inputs)
+            if self.interval > 0 and (int(step) + 1) % self.interval == 0:
+                digest = self.check(step)
+        finally:
+            dt = time.perf_counter() - t0
+            self.counters["seconds"] += dt
+            if digest is not None:
+                record_counter("integrity.check_ms", dt * 1e3)
+        return digest
+
+    def check(self, step):
+        """One consensus round at ``step``. Publishes, gathers (bounded by
+        ``timeout`` — a dead peer must not hang the check), votes."""
+        self.counters["checks"] += 1
+        digest = checksum_state(self.objs)
+        rank = self.elastic.rank
+        prefix = self._prefix(step)
+        self.elastic.store.put(prefix + f"rank.{rank}",
+                               {"rank": rank, "digest": digest,
+                                "step": int(step)})
+        expected = max(self.elastic.np(), 1)
+        start = self._now()
+        while True:
+            reports = self.elastic.store.alive_values(prefix)
+            if len(reports) >= expected:
+                break
+            if self._now() - start >= self.timeout:
+                break
+            self._sleep(self.poll_interval)
+        by_rank = {int(r["rank"]): r["digest"] for r in reports}
+        if len(by_rank) < 2:
+            return digest  # nobody showed up to vote with
+        tally = {}
+        for r, d in by_rank.items():
+            tally.setdefault(d, []).append(r)
+        # deterministic across ranks: all vote on the same store contents,
+        # ties broken by digest string (a 2-way 1:1 split is unattributable
+        # by counting — replay classification decides, docs/resilience.md)
+        majority_digest = max(tally, key=lambda d: (len(tally[d]), d))
+        culprits = sorted(r for d, ranks in tally.items()
+                          if d != majority_digest for r in ranks)
+        if not culprits:
+            return digest
+        self.counters["divergences"] += 1
+        if self.recorder is not None:
+            entry = self.recorder.start("integrity.consensus")
+            entry["culprits"] = culprits
+            entry["step"] = int(step)
+            self.recorder.finish(entry, status="divergent")
+        if rank in culprits:
+            # the accused self-marks: excluded from the next generation's
+            # rendezvous, and leaves its replay ring behind as evidence
+            try:
+                self.elastic.mark_quarantined(
+                    reason=f"sdc: checksum minority at step {step}",
+                    info={"step": int(step)})
+            except Exception:
+                pass
+            if self.replay is not None:
+                try:
+                    self.replay.dump(reason=f"sdc accusation at step {step}")
+                except Exception:
+                    pass
+        raise IntegrityError(
+            f"parameter checksum divergence at step {step}: rank(s) "
+            f"{culprits} disagree with the majority "
+            f"({len(tally[majority_digest])}/{len(by_rank)} agree)",
+            culprits=culprits, step=step, kind="sdc", digests=by_rank)

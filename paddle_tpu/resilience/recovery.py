@@ -163,23 +163,46 @@ class RecoveryJournal:
         line = json.dumps(entry, default=repr)
         with self._lock:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            self._rotate(len(line) + 1)
             with open(self.path, "a") as f:
                 f.write(line + "\n")
                 f.flush()
         return entry
 
-    def entries(self):
+    def _rotate(self, incoming):
+        """Bound journal growth over a long job's restart history: when the
+        append would push the segment past ``FLAGS_journal_max_bytes``, the
+        segment moves to ``<path>.1`` (replacing the previous rotation) —
+        at most two segments ever exist. 0 disables. Caller holds _lock."""
+        from ..framework.flags import get_flag
+        limit = int(get_flag("FLAGS_journal_max_bytes", 1 << 20) or 0)
+        if limit <= 0:
+            return
         try:
-            with open(self.path) as f:
-                lines = f.read().splitlines()
+            size = os.path.getsize(self.path)
         except OSError:
-            return []
-        out = []
-        for ln in lines:
+            return
+        if size and size + incoming > limit:
             try:
-                out.append(json.loads(ln))
-            except ValueError:
-                continue  # torn tail from a writer that died mid-append
+                os.replace(self.path, self.path + ".1")
+            except OSError:
+                pass  # rotation is housekeeping; the append must proceed
+
+    def entries(self):
+        """All readable events, oldest first: the rotated segment (if any)
+        then the current one. Torn lines are skipped in either."""
+        out = []
+        for p in (self.path + ".1", self.path):
+            try:
+                with open(p) as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                continue
+            for ln in lines:
+                try:
+                    out.append(json.loads(ln))
+                except ValueError:
+                    continue  # torn tail from a writer that died mid-append
         return out
 
 
@@ -243,10 +266,15 @@ class RecoveryManager:
     def __init__(self, elastic, restore=None, on_restart=None,
                  max_restarts=None, rendezvous_timeout=None,
                  backoff_base=None, restart_reset_steps=None, clock=None,
-                 sleep=None, journal=None):
+                 sleep=None, journal=None, preflight=None):
         self.elastic = elastic
         self.restore = restore
         self.on_restart = on_restart
+        # callable(generation), run after every re-rendezvous and BEFORE
+        # restore (typically health.run_preflight): a survivor whose device
+        # went bad since the last generation quarantines itself here —
+        # Quarantined is a SystemExit, not RECOVERABLE, so it propagates
+        self.preflight = preflight
         self.max_restarts = int(
             _flag("FLAGS_recovery_max_restarts", 3)
             if max_restarts is None else max_restarts)
@@ -280,8 +308,27 @@ class RecoveryManager:
         if unhealthy:
             raise MembershipChange("unhealthy", np=self.elastic.np(),
                                    unhealthy=unhealthy)
+        quarantined = self._quarantined_live_peers()
+        if quarantined:
+            raise MembershipChange("quarantined", np=self.elastic.np(),
+                                   unhealthy=quarantined)
         self.note_progress()
         return status
+
+    def _quarantined_live_peers(self):
+        """Quarantined peers that still hold a live node lease: the group
+        must re-rendezvous them OUT. Intersecting with the live leases is
+        what terminates the loop — once the quarantined rank exits (its
+        lease lapses) its long-TTL marker alone no longer trips check()."""
+        try:
+            alive = {int(v.get("rank", -1))
+                     for v in self.elastic.alive_nodes()}
+            return sorted(
+                r for r in (int(q.get("rank", -1))
+                            for q in self.elastic.quarantined_nodes())
+                if r != self.elastic.rank and r in alive)
+        except AttributeError:
+            return []  # elastic manager without quarantine support
 
     def note_progress(self, steps=1):
         """Record healthy progress toward refilling the restart budget.
@@ -328,8 +375,22 @@ class RecoveryManager:
         5. restore from the last good checkpoint and journal the cause.
         """
         maybe_inject("recovery.restart", ConnectionError)
+        from .integrity import IntegrityError
         cause_name = type(cause).__name__ if cause is not None else \
             "requested"
+        culprits = []
+        if isinstance(cause, IntegrityError):
+            # journal the typed verdict ("sdc", "preflight", ...), not the
+            # class name, and make sure an accused rank is marked even if
+            # its own consensus-side mark was lost to a store hiccup
+            cause_name = cause.kind
+            culprits = list(cause.culprits)
+            if self.elastic.rank in culprits:
+                try:
+                    self.elastic.mark_quarantined(
+                        reason=f"{cause.kind}: {cause}")
+                except Exception:
+                    pass
         self._healthy_steps = 0  # a failure breaks the healthy streak
         self.restarts += 1
         if self.restarts > self.max_restarts:
@@ -356,11 +417,16 @@ class RecoveryManager:
             timeout=self.rendezvous_timeout)
         if endpoints:
             os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+        if self.preflight is not None:
+            self.preflight(gen)
         resume = self.restore(gen) if self.restore is not None else None
-        self.journal.record(
-            "restart", restart=self.restarts, cause=cause_name,
-            detail=str(cause or ""), generation=gen, np=len(endpoints),
-            flight_tail=tail, unhealthy=unhealthy)
+        record = dict(restart=self.restarts, cause=cause_name,
+                      detail=str(cause or ""), generation=gen,
+                      np=len(endpoints), flight_tail=tail,
+                      unhealthy=unhealthy)
+        if culprits:
+            record["culprits"] = culprits
+        self.journal.record("restart", **record)
         if self.on_restart is not None:
             self.on_restart(gen, endpoints)
         return resume

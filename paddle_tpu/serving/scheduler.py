@@ -85,7 +85,8 @@ class Scheduler:
     """
 
     def __init__(self, predictor_factory, size, clock=None, watchdog=None,
-                 step_timeout=None, metrics=None, max_cached=32):
+                 step_timeout=None, metrics=None, max_cached=32,
+                 preflight=None):
         if size < 1:
             raise ValueError(f"scheduler needs size >= 1 replicas: {size}")
         self._factory = predictor_factory
@@ -93,6 +94,10 @@ class Scheduler:
         self._metrics = metrics
         self._max_cached = max_cached
         self._step_timeout = step_timeout
+        # health gate for restarted replicas (default: the hardware KAT,
+        # health.serving_preflight); a replica whose host died once must
+        # prove the device computes right before re-entering dispatch
+        self._preflight = preflight
         self._lock = threading.Lock()
         # a fake clock means deterministic tests: never spawn a monitor
         # thread; expiry is driven by Watchdog.poll (watchdog.py contract)
@@ -193,6 +198,18 @@ class Scheduler:
                 with self._lock:
                     rep.last_error = e
                 continue
+            try:
+                self._run_preflight(predictor)
+            except Exception as e:
+                # the host that killed this replica may be sick, not just
+                # unlucky: until it passes the KAT it stays out of dispatch
+                # (next restart_dead retries) instead of serving wrong
+                # answers from flaky silicon
+                with self._lock:
+                    rep.last_error = e
+                    if self._metrics:
+                        self._metrics.inc("preflight_failures")
+                continue
             with self._lock:
                 rep.executor = BucketedExecutor(predictor,
                                                 max_cached=self._max_cached)
@@ -202,6 +219,13 @@ class Scheduler:
                     self._metrics.inc("replica_restarts")
             restarted.append(rep.idx)
         return restarted
+
+    def _run_preflight(self, predictor):
+        if self._preflight is not None:
+            self._preflight(predictor)
+            return
+        from ..resilience.health import serving_preflight
+        serving_preflight(predictor)
 
     # -- warmup ----------------------------------------------------------------
     def warmup(self, signature, buckets):

@@ -1,0 +1,906 @@
+"""Hardware health & SDC defense chaos suite (docs/resilience.md
+§Integrity & health).
+
+Covers the preflight known-answer test and its quarantine path, the
+quarantine marker lifecycle (long TTL, survives re-rendezvous, expires for
+repaired hosts), the cross-replica checksum consensus with its
+``device.bitflip`` corruption injection, straggler detection over fake-clock
+step times, the deterministic step-replay ring + tools/replay_step.py
+classification, journal rotation, checkpoint corrupt-restore fallbacks, the
+serving restart preflight gate, and the acceptance scenario: an injected
+bit flip on one rank is detected within one check interval, only that rank
+is quarantined, and the job continues scaled-in with an exact loss-curve
+match against an uninjected golden run. Every clocked component takes an
+injected fake clock/sleep — zero real sleeps.
+"""
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import profiler
+from paddle_tpu.distributed.checkpoint import (
+    CorruptCheckpointError, load_hybrid_checkpoint, save_hybrid_checkpoint,
+)
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, FileStore, _encode_key,
+)
+from paddle_tpu.distributed.fleet.fs import LocalFS
+from paddle_tpu.resilience.faults import FaultInjected
+from paddle_tpu.resilience import faults, health, integrity, recorder, recovery, watchdog
+from paddle_tpu.resilience.health import (
+    QUARANTINE_EXIT_CODE, PreflightFailure, Quarantined, StragglerDetector,
+    preflight_kat, run_preflight,
+)
+from paddle_tpu.resilience.integrity import (
+    ConsensusChecker, IntegrityError, StepReplayBuffer, checksum_state,
+    classify_replay, run_step_on_cpu,
+)
+from paddle_tpu.resilience.recorder import FlightRecorder
+from paddle_tpu.resilience.recovery import (
+    MembershipChange, RecoveryJournal, RecoveryManager,
+)
+
+pytestmark = pytest.mark.chaos
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(tmp_path, monkeypatch):
+    """Fresh faults/recorder/watchdog/generation/journal/profiler per test;
+    artifacts into tmp_path; zero retry backoff so nothing really sleeps."""
+    monkeypatch.setenv("PADDLE_TPU_ARTIFACTS_DIR", str(tmp_path / "artifacts"))
+    paddle.set_flags({"FLAGS_retry_backoff_base": 0.0})
+    faults.reset()
+    recorder.reset()
+    watchdog.reset()
+    recovery.reset_generation()
+    recovery.reset_journal()
+    profiler._recorder.enabled = False
+    profiler.reset_profiler()
+    yield
+    faults.reset()
+    recorder.reset()
+    watchdog.reset()
+    recovery.reset_generation()
+    recovery.reset_journal()
+    profiler._recorder.enabled = False
+    profiler.reset_profiler()
+    paddle.set_flags({"FLAGS_retry_backoff_base": 0.5,
+                      "FLAGS_journal_max_bytes": 1 << 20,
+                      "FLAGS_preflight_checks": True})
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _make(seed=0):
+    paddle.seed(seed)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    return model, opt
+
+
+def _data(step):
+    rng = np.random.RandomState(1000 + step)
+    return (rng.randn(8, 4).astype(np.float32),
+            rng.randn(8, 4).astype(np.float32))
+
+
+def _apply_step(model, opt, x, y):
+    loss = F.mse_loss(model(paddle.to_tensor(x)), paddle.to_tensor(y))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.numpy())
+
+
+def _sgd_step(model, opt, step):
+    """One deterministic step: the data depends only on `step`, so replicas
+    (and a CPU replay) compute bitwise-identical updates."""
+    x, y = _data(step)
+    return _apply_step(model, opt, x, y)
+
+
+def _managers(tmp_path, n, job="j", np_min=1, clock=None, sleeps=None,
+              ttl=1e6):
+    st = FileStore(str(tmp_path / "store"), ttl=ttl)
+    ems = []
+    for r in range(n):
+        em = ElasticManager(st, job, np_min=np_min, np_max=n, rank=r,
+                            endpoint=f"h{r}:1", clock=clock,
+                            sleep=(sleeps or {}).get(r))
+        em.register()
+        ems.append(em)
+    return st, ems
+
+
+# -- bitwise state checksum ---------------------------------------------------
+
+class TestChecksumState:
+    def test_identical_replicas_agree_bitwise(self):
+        a = _make(seed=4)
+        b = _make(seed=4)
+        assert checksum_state(list(a)) == checksum_state(list(b))
+        _sgd_step(*a, 0)
+        assert checksum_state(list(a)) != checksum_state(list(b))
+        _sgd_step(*b, 0)
+        assert checksum_state(list(a)) == checksum_state(list(b))
+
+    def test_single_flipped_bit_changes_digest(self):
+        model, opt = _make(seed=4)
+        clean = checksum_state([model, opt])
+        w = next(iter(model.state_dict().values()))
+        arr = np.asarray(w._val).copy()
+        arr.view(np.uint32)[0] ^= 1  # one mantissa bit
+        w._value = arr
+        assert checksum_state([model, opt]) != clean
+
+    def test_device_bitflip_corrupts_exactly_the_armed_evaluation(self):
+        model, opt = _make(seed=4)
+        faults.configure("device.bitflip:#2")
+        d1 = checksum_state([model, opt])
+        d2 = checksum_state([model, opt])
+        d3 = checksum_state([model, opt])
+        assert d1 == d3  # evaluations 1 and 3 are clean
+        assert d2 != d1  # the armed one is silently wrong, it did not raise
+        assert d2[1:] == d1[1:]  # a single flipped nibble, like real SDC
+
+    def test_checksum_site_is_raising_injectable(self):
+        faults.configure("integrity.checksum:#1")
+        with pytest.raises(FaultInjected):
+            checksum_state([_make(seed=1)[0]])
+
+
+# -- preflight KAT ------------------------------------------------------------
+
+class TestPreflight:
+    def test_kat_is_deterministic_per_seed(self):
+        assert preflight_kat(seed=1) == preflight_kat(seed=1)
+        assert preflight_kat(seed=1) != preflight_kat(seed=2)
+
+    def test_kat_is_fault_injectable(self):
+        faults.configure("integrity.preflight:#1")
+        with pytest.raises(PreflightFailure):
+            preflight_kat()
+        assert preflight_kat()  # device recovered: next run passes
+
+    def test_run_preflight_publishes_verdict_to_store(self, tmp_path):
+        _, (em,) = _managers(tmp_path, 1)
+        digest = run_preflight(elastic=em)
+        rec = em.store.get("j/preflight.0")
+        assert rec["ok"] is True and rec["digest"] == digest
+
+    def test_failed_preflight_quarantines_and_journals(self, tmp_path):
+        _, (em,) = _managers(tmp_path, 1)
+        journal = RecoveryJournal("j", dir=str(tmp_path))
+        faults.configure("integrity.preflight:#1")
+        with pytest.raises(Quarantined) as exc:
+            run_preflight(elastic=em, journal=journal)
+        assert exc.value.code == QUARANTINE_EXIT_CODE
+        assert em.is_quarantined()
+        assert em.store.get("j/preflight.0")["ok"] is False
+        (entry,) = journal.entries()
+        assert entry["event"] == "preflight_failed" and entry["rank"] == 0
+
+    def test_flag_off_skips_the_kat_entirely(self, tmp_path):
+        _, (em,) = _managers(tmp_path, 1)
+        paddle.set_flags({"FLAGS_preflight_checks": False})
+        faults.configure("integrity.preflight:#1")
+        assert run_preflight(elastic=em) is None  # armed fault never reached
+        assert not em.is_quarantined()
+
+    def test_recovery_manager_runs_preflight_after_rendezvous(self, tmp_path):
+        clock = FakeClock()
+        _, (em,) = _managers(tmp_path, 1, clock=clock,
+                             sleeps={0: clock.advance})
+        gens = []
+        rm = RecoveryManager(em, max_restarts=3, rendezvous_timeout=5.0,
+                             backoff_base=0.0, sleep=clock.advance,
+                             journal=RecoveryJournal("j", dir=str(tmp_path)),
+                             preflight=gens.append)
+        rm.restart(cause=ConnectionError("blip"))
+        assert gens == [1]  # ran against the NEW generation, before restore
+
+    def test_sick_survivor_quarantines_out_of_recovery(self, tmp_path):
+        """A survivor whose device went bad since the last generation fails
+        the post-rendezvous KAT: Quarantined (SystemExit) propagates out of
+        the recovery loop instead of looping fail->restart->fail."""
+        clock = FakeClock()
+        _, (em,) = _managers(tmp_path, 1, clock=clock,
+                             sleeps={0: clock.advance})
+        rm = RecoveryManager(em, max_restarts=3, rendezvous_timeout=5.0,
+                             backoff_base=0.0, sleep=clock.advance,
+                             journal=RecoveryJournal("j", dir=str(tmp_path)),
+                             preflight=lambda gen: run_preflight(elastic=em))
+        faults.configure("integrity.preflight:#1")
+
+        def train(resume):
+            raise ConnectionError("transport blip")
+
+        with pytest.raises(Quarantined):
+            rm.run(train)
+        assert em.is_quarantined()
+
+
+# -- quarantine marker lifecycle ----------------------------------------------
+
+class TestQuarantineLifecycle:
+    def _backdate(self, st, key, age):
+        path = os.path.join(st.root, _encode_key(key))
+        past = time.time() - age
+        os.utime(path, (past, past))
+
+    def test_marker_outlives_the_node_lease_ttl(self, tmp_path):
+        _, (em,) = _managers(tmp_path, 1, ttl=5.0)
+        em.mark_quarantined(reason="preflight: KAT failed")
+        self._backdate(em.store, "j/quarantined.0", age=100.0)
+        # the 5s node lease says dead; the quarantine verdict must persist
+        assert em.store.alive_values("j/quarantined.") == []
+        (q,) = em.quarantined_nodes()
+        assert q["rank"] == 0 and "KAT" in q["reason"]
+        assert em.is_quarantined()
+
+    def test_marker_expires_after_quarantine_ttl(self, tmp_path):
+        clock = FakeClock()
+        _, (em,) = _managers(tmp_path, 1, ttl=5.0, clock=clock,
+                             sleeps={0: clock.advance})
+        em.mark_quarantined(reason="sdc")
+        self._backdate(em.store, "j/quarantined.0", age=4000.0)
+        assert em.quarantined_nodes() == []  # repaired host may rejoin
+        gen, eps = em.rendezvous(timeout=5.0)
+        assert gen == 1 and eps == ["h0:1"]
+
+    def test_rendezvous_rejects_quarantined_self(self, tmp_path):
+        clock = FakeClock()
+        _, (em,) = _managers(tmp_path, 1, clock=clock,
+                             sleeps={0: clock.advance})
+        em.mark_quarantined(reason="sdc: checksum minority at step 7")
+        with pytest.raises(Quarantined) as exc:
+            em.rendezvous(timeout=5.0)
+        assert exc.value.code == QUARANTINE_EXIT_CODE
+        assert "step 7" in exc.value.reason
+
+    def test_check_flags_live_quarantined_peer_until_it_exits(self, tmp_path):
+        _, (a, b) = _managers(tmp_path, 2)
+        rm = RecoveryManager(a, max_restarts=1, rendezvous_timeout=1.0,
+                             backoff_base=0.0,
+                             journal=RecoveryJournal("j", dir=str(tmp_path)))
+        while True:  # settle registrations
+            try:
+                rm.check()
+                break
+            except MembershipChange:
+                continue
+        b.mark_quarantined(reason="sdc")
+        with pytest.raises(MembershipChange, match="quarantined") as exc:
+            rm.check()
+        assert exc.value.unhealthy == [1]
+        b.exit()  # the condemned rank took its SystemExit: lease lapses
+        while True:  # one RESTART for the np change, then steady state
+            try:
+                rm.check()
+                break
+            except MembershipChange:
+                continue
+        rm.check()  # marker alone (no live lease) no longer trips detection
+
+
+# -- consensus ----------------------------------------------------------------
+
+class TestConsensusChecker:
+    def _checker(self, em, objs, **kw):
+        kw.setdefault("interval", 1)
+        kw.setdefault("timeout", 0.0)
+        return ConsensusChecker(em, objs, **kw)
+
+    def test_unanimous_group_passes(self, tmp_path):
+        _, ems = _managers(tmp_path, 3)
+        reps = [_make(seed=6) for _ in ems]
+        checkers = [self._checker(em, list(rep))
+                    for em, rep in zip(ems, reps)]
+        for c in checkers[1:]:
+            c.check(0)  # publish; <2 reports visible -> no vote yet
+        digest = checkers[0].check(0)  # sees all 3: unanimous
+        assert digest == checksum_state(list(reps[0]))
+        assert checkers[0].counters == {"checks": 1, "divergences": 0,
+                                        "seconds": 0.0}
+
+    def test_minority_rank_is_named_quarantined_and_dumps(self, tmp_path):
+        _, ems = _managers(tmp_path, 3)
+        good = _make(seed=6)
+        bad = _make(seed=99)  # rank 2 holds diverged parameters
+        rec = FlightRecorder(size=8, rank=2, clock=FakeClock())
+        replay = StepReplayBuffer(size=4, rank=2)
+        replay.record(5, inputs=[np.ones(3, np.float32)])
+        c2 = self._checker(ems[2], list(bad), recorder=rec, replay=replay)
+        for r in (0, 1):  # majority reports already in the store
+            ems[r].store.put(c2._prefix(5) + f"rank.{r}",
+                             {"rank": r, "digest": checksum_state(list(good)),
+                              "step": 5})
+        with pytest.raises(IntegrityError) as exc:
+            c2.check(5)
+        e = exc.value
+        assert e.kind == "sdc" and e.culprits == [2] and e.step == 5
+        assert len(e.digests) == 3
+        assert ems[2].is_quarantined()
+        assert not ems[0].is_quarantined()
+        assert os.path.exists(os.path.join(
+            os.environ["PADDLE_TPU_ARTIFACTS_DIR"], "step_replay_rank2.json"))
+        (entry,) = [x for x in rec.entries()
+                    if x["op"] == "integrity.consensus"]
+        assert entry["status"] == "divergent" and entry["culprits"] == [2]
+
+    def test_survivor_raises_but_does_not_quarantine_itself(self, tmp_path):
+        _, ems = _managers(tmp_path, 3)
+        good = _make(seed=6)
+        c0 = self._checker(ems[0], list(good))
+        ems[1].store.put(c0._prefix(0) + "rank.1",
+                         {"rank": 1, "digest": checksum_state(list(good)),
+                          "step": 0})
+        ems[2].store.put(c0._prefix(0) + "rank.2",
+                         {"rank": 2, "digest": "0" * 64, "step": 0})
+        with pytest.raises(IntegrityError) as exc:
+            c0.check(0)
+        assert exc.value.culprits == [2]
+        assert not ems[0].is_quarantined()
+
+    def test_two_way_tie_is_deterministic_across_ranks(self, tmp_path):
+        """A 1:1 split is unattributable by counting; both ranks must still
+        converge on the SAME verdict (digest-ordered) so the group recovers
+        coherently and replay classification settles the truth."""
+        _, ems = _managers(tmp_path, 2)
+        a = _make(seed=1)
+        b = _make(seed=2)
+        da, db = checksum_state(list(a)), checksum_state(list(b))
+        expected_culprit = 0 if min(da, db) == da else 1
+        c1 = self._checker(ems[1], list(b))
+        c1.check(0)  # publishes rank 1; sees only itself -> no vote
+        c0 = self._checker(ems[0], list(a))
+        with pytest.raises(IntegrityError) as exc:
+            c0.check(0)
+        assert exc.value.culprits == [expected_culprit]
+
+    def test_interval_gates_the_warm_path(self, tmp_path):
+        _, (em,) = _managers(tmp_path, 1)
+        c = ConsensusChecker(em, [_make(seed=0)[0]], interval=4, timeout=0.0,
+                             replay=StepReplayBuffer(size=8, rank=0))
+        for step in range(3):
+            assert c.after_step(step, inputs=[np.ones(2)]) is None
+        assert em.store.alive_values("j/integrity.") == []  # nothing ran
+        assert c.after_step(3, inputs=[np.ones(2)]) is not None
+        assert c.counters["checks"] == 1
+        assert c.replay.steps() == [0, 1, 2, 3]  # ring fed every step
+
+    def test_gather_timeout_bounded_by_fake_clock(self, tmp_path):
+        clock = FakeClock()
+        _, ems = _managers(tmp_path, 2, clock=clock)
+        c0 = ConsensusChecker(ems[0], [_make(seed=0)[0]], interval=1,
+                              timeout=30.0, clock=clock, sleep=clock.advance)
+        digest = c0.check(0)  # peer never reports: no hang, no vote
+        assert isinstance(digest, str) and len(digest) == 64
+        assert clock.t >= 30.0  # waited the full window, in fake time only
+
+
+# -- straggler detection ------------------------------------------------------
+
+class TestStragglerDetector:
+    def _group(self, tmp_path, n=3, **kw):
+        _, ems = _managers(tmp_path, n)
+        return ems, [StragglerDetector(em, window=4, threshold=3.0, **kw)
+                     for em in ems]
+
+    def test_slow_rank_flagged_with_ratio(self, tmp_path):
+        profiler.start_profiler()
+        ems, dets = self._group(tmp_path)
+        rec = FlightRecorder(size=8, rank=0, clock=FakeClock())
+        dets[0].recorder = rec
+        for _ in range(3):
+            dets[0].note_step(0.1)
+            dets[1].note_step(0.1)
+            dets[2].note_step(0.5)
+        assert dets[0].check() == [2]
+        assert dets[0].last_ratios[2] == pytest.approx(5.0)
+        assert dets[0].last_ratios[0] == pytest.approx(1.0)
+        (s,) = profiler.counter_samples("straggler.rank2")
+        assert s[2] == pytest.approx(5.0)
+        assert profiler.counter_samples("steptime.rank2_ms")
+        (entry,) = [x for x in rec.entries()
+                    if x["op"] == "health.straggler"]
+        assert entry["peer"] == 2 and entry["status"] == "detected"
+
+    def test_rolling_window_forgets_old_steps(self, tmp_path):
+        _, (em,) = _managers(tmp_path, 1)
+        d = StragglerDetector(em, window=2, threshold=3.0)
+        d.note_step(1.0)
+        d.note_step(0.1)
+        assert d.note_step(0.1) == pytest.approx(0.1)  # the 1.0 aged out
+
+    def test_begin_end_bracket_uses_injected_clock(self, tmp_path):
+        clock = FakeClock()
+        _, (em,) = _managers(tmp_path, 1)
+        d = StragglerDetector(em, window=4, clock=clock)
+        d.begin_step()
+        clock.advance(0.25)
+        assert d.end_step() == pytest.approx(0.25)
+        assert em.store.get("j/steptime.0")["mean"] == pytest.approx(0.25)
+
+    def test_single_rank_has_no_peers_to_lag(self, tmp_path):
+        _, (em,) = _managers(tmp_path, 1)
+        d = StragglerDetector(em, window=4, threshold=3.0)
+        d.note_step(9.9)
+        assert d.check() == []
+
+    def test_detection_only_by_default_quarantine_opt_in(self, tmp_path):
+        ems, dets = self._group(tmp_path)
+        for _ in range(3):
+            for d, t in zip(dets, (0.1, 0.1, 0.5)):
+                d.note_step(t)
+        assert dets[2].check() == [2]  # default: observe, don't condemn
+        assert not ems[2].is_quarantined()
+        d2q = StragglerDetector(ems[2], window=4, threshold=3.0,
+                                quarantine=True)
+        d2q.note_step(0.5)
+        with pytest.raises(Quarantined) as exc:
+            d2q.check()
+        assert "group median" in exc.value.reason
+        assert ems[2].is_quarantined()
+
+
+# -- step replay --------------------------------------------------------------
+
+class TestStepReplay:
+    def test_ring_is_bounded(self):
+        buf = StepReplayBuffer(size=3, rank=0)
+        for s in range(5):
+            buf.record(s, inputs=[np.full(2, s, np.float32)])
+        assert len(buf) == 3 and buf.steps() == [2, 3, 4]
+
+    def test_classification_matrix(self):
+        assert classify_replay("d", expected_digest="d") == "hardware_sdc"
+        assert classify_replay("d", expected_digest="e",
+                               observed_digest="d") == "software_bug"
+        assert classify_replay("d", expected_digest="e",
+                               observed_digest="f") == "inconclusive"
+        assert classify_replay("d") == "unverified"
+
+    def test_replay_reruns_step_on_cpu(self):
+        buf = StepReplayBuffer(size=4, rank=0)
+        x = np.arange(6, dtype=np.float32)
+        buf.record(3, inputs=[x])
+
+        def fn(entry):
+            return hashlib.sha256(
+                (entry["inputs"][0] * 2).tobytes()).hexdigest()
+
+        want = hashlib.sha256((x * 2).tobytes()).hexdigest()
+        out = buf.replay(3, fn, expected_digest=want,
+                         observed_digest="f" * 64)
+        assert out == {"step": 3, "digest": want,
+                       "classification": "hardware_sdc"}
+
+    def test_run_step_on_cpu_checksums_state_results(self):
+        model, _ = _make(seed=3)
+        out = run_step_on_cpu(lambda entry: model, {"step": 0})
+        assert out == checksum_state([model])
+
+    def test_tampered_ring_cannot_testify(self):
+        buf = StepReplayBuffer(size=4, rank=0)
+        buf.record(1, inputs=[np.zeros(4, np.float32)])
+        buf.get(1)["inputs"][0][0] = 7.0  # evidence corrupted after capture
+        with pytest.raises(IntegrityError) as exc:
+            buf.replay(1, lambda e: "x")
+        assert exc.value.kind == "replay"
+
+    def test_replay_site_is_fault_injectable(self):
+        buf = StepReplayBuffer(size=4, rank=0)
+        buf.record(1, inputs=[np.zeros(2)])
+        faults.configure("integrity.replay:#1")
+        with pytest.raises(FaultInjected):
+            buf.replay(1, lambda e: "x")
+
+    def test_missing_step_raises_keyerror(self):
+        buf = StepReplayBuffer(size=4, rank=0)
+        buf.record(1, inputs=[])
+        with pytest.raises(KeyError, match="not in replay ring"):
+            buf.replay(9, lambda e: "x")
+
+
+@pytest.mark.slow
+class TestReplayStepCLI:
+    def _run(self, *argv, cwd=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=str(REPO))
+        return subprocess.run(
+            [sys.executable, str(REPO / "tools" / "replay_step.py"),
+             *map(str, argv)],
+            cwd=cwd or REPO, env=env, capture_output=True, text=True,
+            timeout=300)
+
+    def _dump(self, tmp_path):
+        buf = StepReplayBuffer(size=4, rank=0)
+        x = np.arange(6, dtype=np.float32)
+        buf.record(3, inputs=[x], rng_key=np.array([0, 1], np.uint32))
+        return x, buf.dump(dir=str(tmp_path))
+
+    def test_list_mode_verifies_checksums(self, tmp_path):
+        _, jp = self._dump(tmp_path)
+        r = self._run(jp)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "step 3" in r.stdout and "ok" in r.stdout
+
+    def test_list_mode_flags_corrupt_evidence(self, tmp_path):
+        _, jp = self._dump(tmp_path)
+        npz = os.path.join(str(tmp_path),
+                           json.load(open(jp))["arrays"])
+        with np.load(npz) as z:
+            arrays = {k: z[k] for k in z.files}
+        arrays["s3_in0"] = arrays["s3_in0"] + 1.0
+        with open(npz, "wb") as f:
+            np.savez(f, **arrays)
+        r = self._run(jp)
+        assert r.returncode == 1
+        assert "CORRUPT" in r.stdout
+
+    def test_replay_mode_classifies(self, tmp_path):
+        x, jp = self._dump(tmp_path)
+        (tmp_path / "sfn.py").write_text(
+            "import hashlib\n"
+            "def fn(entry):\n"
+            "    doubled = entry['inputs'][0] * 2\n"
+            "    return hashlib.sha256(doubled.tobytes()).hexdigest()\n")
+        want = hashlib.sha256((x * 2).tobytes()).hexdigest()
+        r = self._run(jp, "--step", 3, "--step-fn", "sfn:fn",
+                      "--expected", want, "--observed", "f" * 64,
+                      cwd=tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "classification: hardware_sdc" in r.stdout
+
+
+# -- journal rotation ---------------------------------------------------------
+
+class TestJournalRotation:
+    def test_rotation_bounds_growth_keeps_two_segments(self, tmp_path):
+        paddle.set_flags({"FLAGS_journal_max_bytes": 400})
+        j = RecoveryJournal("rot", dir=str(tmp_path))
+        for i in range(50):
+            j.record("tick", idx=i, pad="x" * 40)
+        assert os.path.exists(j.path) and os.path.exists(j.path + ".1")
+        assert not os.path.exists(j.path + ".2")
+        assert os.path.getsize(j.path) <= 400
+        idxs = [e["idx"] for e in j.entries()]
+        # a continuous tail of recent history ending at the newest record
+        assert idxs == list(range(idxs[0], 50))
+        assert 0 < len(idxs) < 50
+
+    def test_zero_disables_rotation(self, tmp_path):
+        paddle.set_flags({"FLAGS_journal_max_bytes": 0})
+        j = RecoveryJournal("rot0", dir=str(tmp_path))
+        for i in range(50):
+            j.record("tick", idx=i, pad="x" * 40)
+        assert not os.path.exists(j.path + ".1")
+        assert len(j.entries()) == 50
+
+
+# -- checkpoint corrupt-restore fallbacks -------------------------------------
+
+class TestCorruptRestore:
+    def test_hybrid_restore_verifies_and_falls_back(self, tmp_path):
+        model, opt = _make(seed=2)
+        ckpt = str(tmp_path / "c.pdparams")
+        save_hybrid_checkpoint(ckpt, model, opt, meta={"step": 1})
+        want = {k: np.asarray(v._val).copy()
+                for k, v in model.state_dict().items()}
+        _sgd_step(model, opt, 0)
+        save_hybrid_checkpoint(ckpt, model, opt, meta={"step": 2})
+        assert os.path.exists(ckpt + ".sha256")
+        assert os.path.exists(ckpt + ".old.sha256")
+        with open(ckpt, "r+b") as f:  # one flipped byte, not a torn file
+            b = f.read(1)
+            f.seek(0)
+            f.write(bytes([b[0] ^ 0xFF]))
+        model2, opt2 = _make(seed=9)
+        meta = load_hybrid_checkpoint(ckpt, model2, opt2)
+        assert meta["restored_from_fallback"] is True
+        assert meta["step"] == 1  # the retained previous snapshot won
+        for k, arr in want.items():
+            np.testing.assert_array_equal(
+                arr, np.asarray(model2.state_dict()[k]._val))
+        events = recovery.get_journal().entries()
+        (e,) = [x for x in events if x["event"] == "corrupt_restore"]
+        assert e["path"] == ckpt and "sha256 mismatch" in e["detail"]
+
+    def test_no_fallback_raises_typed(self, tmp_path):
+        model, opt = _make(seed=2)
+        ckpt = str(tmp_path / "c.pdparams")
+        save_hybrid_checkpoint(ckpt, model, opt)
+        with open(ckpt, "ab") as f:
+            f.write(b"garbage")
+        with pytest.raises(CorruptCheckpointError, match="sha256 mismatch"):
+            load_hybrid_checkpoint(ckpt, model, opt)
+
+    def test_incubate_fallback_journals_corrupt_restore(self, tmp_path):
+        from paddle_tpu.incubate.checkpoint import CheckpointSaver
+        model, _ = _make(seed=2)
+        saver = CheckpointSaver(LocalFS(), str(tmp_path / "snap"))
+        saver.save_checkpoint({"0": model.state_dict()}, {"epoch_no": 0})
+        saver.save_checkpoint({"0": model.state_dict()}, {"epoch_no": 1})
+        with open(tmp_path / "snap" / "state.pdparams", "ab") as f:
+            f.write(b"garbage")
+        state, meta = saver.load_checkpoint()
+        assert meta["epoch_no"] == 0  # fell back to the retained snapshot
+        events = recovery.get_journal().entries()
+        (e,) = [x for x in events if x["event"] == "corrupt_restore"]
+        assert "checksum mismatch" in e["detail"]
+
+
+# -- serving restart preflight ------------------------------------------------
+
+class TestServingPreflight:
+    class _Predictor:
+        def run(self, arrays):
+            return [np.asarray(arrays[0]) * 2.0]
+
+    class _Metrics:
+        def __init__(self):
+            self.counts = {}
+
+        def inc(self, name, n=1):
+            self.counts[name] = self.counts.get(name, 0) + n
+
+    def _sched(self, metrics=None, preflight=None):
+        from paddle_tpu.serving import Scheduler
+        return Scheduler(lambda i: self._Predictor(), 2, clock=FakeClock(),
+                         step_timeout=60.0, metrics=metrics,
+                         preflight=preflight)
+
+    def test_restarted_replica_passes_kat_before_dispatch(self, tmp_path):
+        s = self._sched()
+        s._mark_dead(s.replicas[0], RuntimeError("device lost"))
+        assert s.restart_dead() == [0]  # healthy host: KAT passes, rejoins
+        assert s.replicas[0].healthy
+
+    def test_failed_kat_keeps_replica_out_of_dispatch(self, tmp_path):
+        metrics = self._Metrics()
+        s = self._sched(metrics=metrics)
+        s._mark_dead(s.replicas[0], RuntimeError("device lost"))
+        faults.configure("integrity.preflight:#1")
+        assert s.restart_dead() == []  # sick host: stays dead, not serving
+        assert not s.replicas[0].healthy
+        assert isinstance(s.replicas[0].last_error, PreflightFailure)
+        assert metrics.counts["preflight_failures"] == 1
+        assert s.pick().idx == 1  # survivors keep serving
+        assert s.restart_dead() == [0]  # next attempt: fault cleared, rejoin
+        assert metrics.counts["replica_restarts"] == 1
+
+    def test_custom_preflight_callable_wins(self, tmp_path):
+        seen = []
+        s = self._sched(preflight=seen.append)
+        s._mark_dead(s.replicas[1], RuntimeError("x"))
+        assert s.restart_dead() == [1]
+        assert len(seen) == 1 and isinstance(seen[0], self._Predictor)
+
+
+# -- launcher: quarantine exit is terminal ------------------------------------
+
+QUAR_WORKER = """
+import os, sys
+sys.exit(117 if os.environ["PADDLE_TRAINER_ID"] == "1" else 0)
+"""
+
+
+@pytest.mark.slow
+class TestLauncherQuarantineExit:
+    def test_exit_117_not_relaunched_and_budget_intact(self, tmp_path):
+        from paddle_tpu.distributed.launch_utils import (
+            get_cluster_from_args, supervise_local_trainers,
+        )
+        script = tmp_path / "w.py"
+        script.write_text(QUAR_WORKER)
+        cluster, pod = get_cluster_from_args(nproc_per_node=2)
+        journal = RecoveryJournal("quar", dir=str(tmp_path))
+        codes = supervise_local_trainers(
+            cluster, pod, str(script), [], envs={"PYTHONPATH": ""},
+            max_restarts=1, poll_interval=0.05, journal=journal)
+        assert codes == [0, QUARANTINE_EXIT_CODE]
+        events = [e["event"] for e in journal.entries()]
+        assert events == ["quarantined"]  # no worker_restart: rank stayed down
+        (entry,) = journal.entries()
+        assert entry["rank"] == 1 and entry["code"] == QUARANTINE_EXIT_CODE
+
+
+# -- acceptance: bit flip -> consensus -> quarantine -> scaled-in resume ------
+
+class TestChaosIntegrityAcceptance:
+    def test_bitflip_detected_quarantined_and_training_continues(
+            self, tmp_path):
+        """ISSUE 6 acceptance: an injected device bit flip on rank 2 is
+        detected by checksum consensus within one check interval, exactly
+        that rank is quarantined (its next rendezvous is a typed SystemExit
+        117), the survivors re-rendezvous scaled-in and resume from the
+        checkpoint, the loss curve matches an uninjected golden run bitwise,
+        and the dumped replay ring classifies the divergence as hardware
+        SDC. Zero real sleeps."""
+        t0 = time.monotonic()
+        golden_model, golden_opt = _make(seed=5)
+        golden = [_sgd_step(golden_model, golden_opt, s) for s in range(8)]
+
+        clock = FakeClock()
+        st = FileStore(str(tmp_path / "store"), ttl=1e6)
+        ems = {}
+        allow2 = [True]
+
+        def sleep0(dt):
+            clock.advance(dt)
+            rec = st.get("jobI/gen") or {}
+            if rec.get("gen"):  # peers show up during rank 0's waits
+                ems[1].announce(rec["gen"])
+                if allow2[0]:
+                    ems[2].announce(rec["gen"])
+
+        hook = {"armed": False, "step": None}
+
+        def sleep2(dt):
+            clock.advance(dt)
+            if hook["armed"]:  # rank 0's report lands mid-gather
+                hook["armed"] = False
+                d0 = checksum_state([models[0], opts[0]])
+                st.put(checkers[2]._prefix(hook["step"]) + "rank.0",
+                       {"rank": 0, "digest": d0, "step": hook["step"]})
+
+        for r, slp in ((0, sleep0), (1, clock.advance), (2, sleep2)):
+            ems[r] = ElasticManager(st, "jobI", np_min=1, np_max=3, rank=r,
+                                    endpoint=f"h{r}:1", clock=clock,
+                                    sleep=slp)
+            ems[r].register()
+        gen0, eps0 = ems[0].rendezvous(timeout=5.0)
+        assert gen0 == 1 and len(eps0) == 3
+
+        models, opts = {}, {}
+        for r in range(3):
+            models[r], opts[r] = _make(seed=5)
+        replay2 = StepReplayBuffer(size=4, rank=2)
+        checkers = {
+            r: ConsensusChecker(ems[r], [models[r], opts[r]], interval=4,
+                                timeout=30.0, clock=clock,
+                                sleep=(sleep2 if r == 2 else clock.advance),
+                                replay=(replay2 if r == 2 else None))
+            for r in range(3)}
+        # rank 2's SECOND digest evaluation is the flipped one: at the first
+        # check step the order is rank1, rank2(corrupt), rank0-via-hook
+        faults.configure("device.bitflip:#2")
+
+        ckpt = str(tmp_path / "ckpt.pdparams")
+        journal = RecoveryJournal("jobI", dir=str(tmp_path), clock=clock)
+        alive = {0, 1, 2}
+        losses = {0: {}, 1: {}}
+        caught2 = []
+
+        def train(resume):
+            start = resume["step"] if resume else 0
+            for step in range(start, 8):
+                x, y = _data(step)
+                losses[0][step] = _apply_step(models[0], opts[0], x, y)
+                losses[1][step] = _apply_step(models[1], opts[1], x, y)
+                if 2 in alive:
+                    _apply_step(models[2], opts[2], x, y)
+                save_hybrid_checkpoint(ckpt, models[0], opts[0],
+                                       meta={"step": step + 1})
+                if (step + 1) % 4 == 0:
+                    checkers[1].after_step(step, inputs=[x, y])
+                    if 2 in alive:
+                        hook.update(armed=True, step=step)
+                        try:
+                            checkers[2].after_step(step, inputs=[x, y])
+                        except IntegrityError as e:
+                            # rank 2's own view: it marked itself, dumped
+                            # its ring, and its process exits quarantined
+                            caught2.append(e)
+                            alive.discard(2)
+                            allow2[0] = False
+                            ems[2].exit()
+                    checkers[0].after_step(step, inputs=[x, y])
+                elif 2 in alive:
+                    checkers[2].after_step(step, inputs=[x, y])
+            return "done"
+
+        def restore(gen):
+            return load_hybrid_checkpoint(ckpt, models[0], opts[0])
+
+        rm = RecoveryManager(ems[0], restore=restore, max_restarts=3,
+                             rendezvous_timeout=5.0, backoff_base=1.0,
+                             sleep=sleep0, journal=journal,
+                             preflight=lambda gen: run_preflight(
+                                 elastic=ems[0]))
+        assert rm.run(train) == "done"
+
+        # detected at the FIRST check step (within one interval), rank 2 only
+        (err2,) = caught2
+        assert err2.step == 3 and err2.culprits == [2]
+        assert rm.restarts == 1
+        assert recovery.current_generation() == 2
+        quarantined = ems[0].quarantined_nodes()
+        assert [q["rank"] for q in quarantined] == [2]
+        assert "step 3" in quarantined[0]["reason"]
+        # the survivors' post-rendezvous preflight published a clean verdict
+        assert st.get("jobI/preflight.0")["ok"] is True
+        # rank 2's next rendezvous is the quarantine exit, not a rejoin
+        with pytest.raises(Quarantined) as exc:
+            ems[2].rendezvous(timeout=1.0)
+        assert exc.value.code == QUARANTINE_EXIT_CODE
+
+        ents = [e for e in journal.entries() if e["event"] == "restart"]
+        assert [e["cause"] for e in ents] == ["sdc"]
+        assert ents[0]["culprits"] == [2]
+        assert ents[0]["generation"] == 2 and ents[0]["np"] == 2
+
+        # loss parity: the recovered scaled-in run matches golden bitwise
+        for r in (0, 1):
+            np.testing.assert_allclose(
+                [losses[r][s] for s in range(8)], golden, rtol=0, atol=0)
+        # the post-recovery check at step 7 was clean on both survivors
+        assert checkers[0].counters == pytest.approx(
+            {"checks": 2, "divergences": 1,
+             "seconds": checkers[0].counters["seconds"]})
+
+        # replay the flagged step from rank 2's dumped ring: the CPU
+        # reproduces the MAJORITY digest, so the device computed garbage
+        majority = err2.digests[0]
+        observed = err2.digests[2]
+        assert majority == err2.digests[1] != observed
+
+        def replay_fn(entry):
+            model, opt = _make(seed=5)
+            for s in range(entry["step"]):
+                _sgd_step(model, opt, s)
+            _apply_step(model, opt, entry["inputs"][0], entry["inputs"][1])
+            return checksum_state([model, opt])
+
+        verdict = replay2.replay(3, replay_fn, expected_digest=majority,
+                                 observed_digest=observed)
+        assert verdict["classification"] == "hardware_sdc"
+        assert os.path.exists(os.path.join(
+            os.environ["PADDLE_TPU_ARTIFACTS_DIR"], "step_replay_rank2.json"))
+        assert time.monotonic() - t0 < 60.0  # fake clock: no real sleeps
+
+    def test_warm_path_overhead_within_one_percent(self, tmp_path):
+        """The default-interval integrity check must cost <=1% of train
+        time, asserted from the profiler counter it emits."""
+        _, (em,) = _managers(tmp_path, 1)
+        model, opt = _make(seed=1)
+        checker = ConsensusChecker(em, [model, opt], timeout=1.0,
+                                   replay=StepReplayBuffer(size=8, rank=0))
+        assert checker.interval == 100  # FLAGS_integrity_check_interval
+        profiler.start_profiler()
+        t0 = time.perf_counter()
+        for step in range(200):
+            _sgd_step(model, opt, step)
+            x, y = _data(step)
+            checker.after_step(step, inputs=[x, y])
+        total_ms = (time.perf_counter() - t0) * 1e3
+        samples = profiler.counter_samples("integrity.check_ms")
+        assert len(samples) == 2  # steps 99 and 199
+        check_ms = sum(v for _, _, v in samples)
+        assert checker.counters["checks"] == 2
+        assert check_ms <= 0.01 * total_ms, (
+            f"integrity checks cost {check_ms:.2f}ms of {total_ms:.0f}ms "
+            f"({100 * check_ms / total_ms:.2f}% > 1% budget)")

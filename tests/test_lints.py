@@ -65,6 +65,39 @@ def test_injection_lint_covers_recovery_entry_points():
         ("paddle_tpu/resilience/recovery.py", "class:RecoveryManager")]
 
 
+def test_injection_lint_covers_integrity_entry_points():
+    """The hardware-health PR's contract: the preflight KAT, the consensus
+    checksum (with its non-raising device.bitflip corruption hook), and the
+    step replay must stay chaos-testable. Guard both the MANIFEST and the
+    HOOK_CALLS set so a refactor can't silently drop the requirement."""
+    import ast
+    src = (REPO / "tools" / "check_injection_points.py").read_text()
+    tree = ast.parse(src)
+
+    def _assigned(name):
+        return next(
+            node.value for node in ast.walk(tree)
+            if isinstance(node, ast.Assign)
+            and any(getattr(t, "id", None) == name for t in node.targets))
+
+    manifest = ast.literal_eval(_assigned("REQUIRED"))
+    entries = {(rel, scope): names for rel, scope, names in manifest}
+    assert "preflight_kat" in entries[
+        ("paddle_tpu/resilience/health.py", "module")]
+    assert "checksum_state" in entries[
+        ("paddle_tpu/resilience/integrity.py", "module")]
+    assert "replay" in entries[
+        ("paddle_tpu/resilience/integrity.py", "class:StepReplayBuffer")]
+    hooks = ast.literal_eval(_assigned("HOOK_CALLS"))
+    assert "should_inject" in hooks
+
+
+def test_replay_step_help_smoke():
+    r = _run(REPO / "tools" / "replay_step.py", "--help")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "hardware_sdc" in r.stdout
+
+
 def test_bench_regression_gate_help_smoke():
     r = _run(REPO / "tools" / "check_bench_regression.py", "--help")
     assert r.returncode == 0, r.stdout + r.stderr

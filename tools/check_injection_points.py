@@ -54,11 +54,24 @@ REQUIRED = [
      ["dispatch"]),
     ("paddle_tpu/serving/server.py", "class:InferenceServer",
      ["_reply"]),
+    # hardware health / SDC entry points (integrity PR): the chaos suite
+    # must be able to fail the preflight KAT (integrity.preflight), corrupt
+    # a replica's digest (device.bitflip, evaluated via should_inject inside
+    # checksum_state), and fail a step replay (integrity.replay)
+    ("paddle_tpu/resilience/health.py", "module",
+     ["preflight_kat"]),
+    ("paddle_tpu/resilience/integrity.py", "module",
+     ["checksum_state"]),
+    ("paddle_tpu/resilience/integrity.py", "class:StepReplayBuffer",
+     ["replay"]),
 ]
 
 # _injected_run is HDFSClient's hook-carrying chokepoint: routing a call
-# through it counts as hooked (its body holds the maybe_inject)
-HOOK_CALLS = {"maybe_inject", "fault_point", "_injected_run"}
+# through it counts as hooked (its body holds the maybe_inject).
+# should_inject is the non-raising hook for corruption-style faults
+# (device.bitflip perturbs a result instead of failing the call).
+HOOK_CALLS = {"maybe_inject", "fault_point", "_injected_run",
+              "should_inject"}
 
 
 def _has_hook(fn_node):

@@ -12,6 +12,13 @@ FIRST divergent (op, seq) pair:
   while others completed — the classic one-rank-died-mid-collective shape;
 - **status**: completion statuses disagree (ok vs an exception type).
 
+Dumps written across an elastic re-rendezvous carry different generation
+stamps; comparing a pre-restart dump against a post-restart one produces
+nonsense "missing" reports. Dumps are therefore grouped by generation first:
+the diff runs within the largest (ties: newest) generation group, stale
+ranks are reported in the header, and if no generation has two dumps the
+report says so (kind "generation") instead of fabricating a divergence.
+
 Usage::
 
     python tools/flight_recorder_diff.py dump_dir/
@@ -27,7 +34,7 @@ import json
 import os
 import sys
 
-__all__ = ["load_dumps", "diff_dumps", "main"]
+__all__ = ["load_dumps", "group_by_generation", "diff_dumps", "main"]
 
 # only never-exited entries count as pending: a rank that FINISHED with a
 # timeout error escaped the op; the rank still inside it is the culprit
@@ -60,16 +67,54 @@ def _key(entry):
             int(entry["seq"]))
 
 
+def _generation(dump):
+    try:
+        return int(dump.get("generation", 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def group_by_generation(dumps):
+    """Partition {rank: dump} by the dump's elastic-generation stamp.
+
+    Returns {generation: {rank: dump}}. Dumps with no stamp (pre-elastic
+    recorders) land in generation 0.
+    """
+    groups = {}
+    for rank, d in dumps.items():
+        groups.setdefault(_generation(d), {})[rank] = d
+    return groups
+
+
 def diff_dumps(dumps):
     """Compare {rank: dump} and return the first divergence, or None.
 
-    Returns a dict: {kind, op, group, seq, ranks, missing_ranks,
-    pending_ranks, status_by_rank} — `kind` is "missing" / "hung" /
-    "status". "First" means smallest max-seq position in the union of keys,
-    ordered by the earliest enter timestamp observed for the key.
+    Dumps are first grouped by generation stamp; the sequence diff runs
+    within the largest group (ties broken toward the newer generation).
+    Returns a dict: {kind, generation, stale_ranks, op, group, seq, ranks,
+    missing_ranks, pending_ranks, status_by_rank} — `kind` is "missing" /
+    "hung" / "status", or "generation" when no single generation holds two
+    dumps to compare (in which case only {kind, generation_by_rank} is set).
     """
     if len(dumps) < 2:
         return None
+    groups = group_by_generation(dumps)
+    gen, current = max(groups.items(), key=lambda kv: (len(kv[1]), kv[0]))
+    stale = sorted(r for r in dumps if r not in current)
+    if len(current) < 2:
+        # every dump is from a different incarnation of the group — a
+        # sequence diff across generations would be meaningless
+        return {"kind": "generation",
+                "generation_by_rank": {r: _generation(d)
+                                       for r, d in sorted(dumps.items())}}
+    div = _diff_one_generation(current)
+    if div is not None:
+        div["generation"] = gen
+        div["stale_ranks"] = stale
+    return div
+
+
+def _diff_one_generation(dumps):
     per_rank = {}      # rank -> {key: entry}  (last entry wins per key)
     order = {}         # key -> earliest t_start anywhere
     for rank, d in dumps.items():
@@ -103,9 +148,36 @@ def diff_dumps(dumps):
     return None
 
 
-def format_report(div):
+def _generation_header(dumps):
+    """One line naming which generation was diffed and which ranks were
+    excluded as stale; empty when every dump shares one stamp."""
+    if not dumps:
+        return ""
+    groups = group_by_generation(dumps)
+    if len(groups) <= 1:
+        gen = next(iter(groups), 0)
+        return f"generation {gen}: ranks {sorted(dumps)}" if gen else ""
+    gen, current = max(groups.items(), key=lambda kv: (len(kv[1]), kv[0]))
+    stale = {r: _generation(d) for r, d in sorted(dumps.items())
+             if r not in current}
+    line = f"generation {gen}: ranks {sorted(current)}"
+    if stale:
+        line += ("; stale: " + ", ".join(
+            f"rank {r} at generation {g}" for r, g in stale.items()))
+    return line
+
+
+def format_report(div, dumps=None):
+    header = _generation_header(dumps or {})
     if div is None:
-        return "flight-recorder streams agree across ranks (no divergence)"
+        report = "flight-recorder streams agree across ranks (no divergence)"
+        return f"{header}\n{report}" if header else report
+    if div["kind"] == "generation":
+        by_rank = div["generation_by_rank"]
+        return ("no two dumps share a generation — nothing to diff; "
+                "rerun with dumps from one incarnation of the group\n  "
+                + ", ".join(f"rank {r}: generation {g}"
+                            for r, g in sorted(by_rank.items())))
     op, seq, group = div["op"], div["seq"], div["group"]
     head = (f"first divergent collective: op={op!r} seq={seq}"
             + (f" group={group!r}" if group else ""))
@@ -127,6 +199,8 @@ def format_report(div):
     lines.append("  -> suspect the lowest-numbered rank above, then check "
                  f"its thread_stacks_rank<N>.txt for where op {op!r} "
                  "blocked")
+    if header:
+        lines.insert(0, header)
     return "\n".join(lines)
 
 
@@ -145,7 +219,7 @@ def main(argv=None):
               f"{sorted(dumps)}", file=sys.stderr)
         return 2
     div = diff_dumps(dumps)
-    print(format_report(div))
+    print(format_report(div, dumps))
     return 1 if div else 0
 
 

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Re-execute a dumped training step on CPU and classify the divergence.
+
+Input is the ``step_replay_rank<N>.json`` + ``.npz`` pair written by
+``paddle_tpu.resilience.integrity.StepReplayBuffer.dump`` when a rank is
+accused of silent data corruption (or when the step guard rolls back).
+
+Two modes:
+
+- **list** (default): print the dumped ring — steps, input shapes, reason,
+  generation — and verify each entry's recorded inputs against its stored
+  ``input_checksum``. A mismatch means the evidence itself is corrupt
+  (exit 1); replaying it would prove nothing.
+- **replay** (``--step-fn pkg.module:fn --step N``): rebuild the ring entry
+  and re-run it through the CPU interpret path via
+  ``integrity.run_step_on_cpu``. With ``--expected`` (the majority digest
+  from the consensus report) and/or ``--observed`` (the accused rank's
+  digest), the result is classified:
+
+  * CPU == expected  → ``hardware_sdc``  (device computed garbage; condemn
+    the chip)
+  * CPU == observed  → ``software_bug``  (deterministic divergence; the
+    program, not the chip)
+  * neither          → ``inconclusive``
+  * no digests given → ``unverified`` (digest printed for manual comparison)
+
+The step function receives one ring-entry dict
+``{"step", "rng_key", "inputs", "input_checksum"}`` and returns either a
+digest string or state objects (checksummed with the same
+``checksum_state`` the consensus used).
+
+Usage::
+
+    python tools/replay_step.py dump_dir/step_replay_rank2.json
+    python tools/replay_step.py dump.json --step 37 \
+        --step-fn my_train:replay_fn --expected <majority> --observed <mine>
+
+Exit code 0 = ok, 1 = corrupt dump / failed verification, 2 = bad input.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+__all__ = ["load_dump", "verify_dump", "replay", "main"]
+
+
+def load_dump(json_path):
+    """Load a dump pair into (meta, {step: entry}) with arrays rebuilt as
+    in-memory ring entries (same shape StepReplayBuffer.replay consumes)."""
+    with open(json_path) as f:
+        meta = json.load(f)
+    npz_path = os.path.join(os.path.dirname(os.path.abspath(json_path)),
+                            meta["arrays"])
+    with np.load(npz_path) as z:
+        arrays = {k: z[k] for k in z.files}
+    entries = {}
+    for e in meta["entries"]:
+        try:
+            inputs = [arrays[n] for n in e["inputs"]]
+            rng = arrays[e["rng_key"]] if e["rng_key"] else None
+        except KeyError as exc:
+            raise ValueError(
+                f"{json_path}: entry for step {e['step']} references array "
+                f"{exc} missing from {meta['arrays']}")
+        entries[int(e["step"])] = {
+            "step": int(e["step"]), "rng_key": rng, "inputs": inputs,
+            "input_checksum": e["input_checksum"],
+        }
+    return meta, entries
+
+
+def verify_dump(entries):
+    """Check every entry's inputs against its recorded checksum; returns the
+    list of step indices that fail (corrupt evidence)."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu.resilience.integrity import _arrays_digest
+    return [s for s, e in sorted(entries.items())
+            if _arrays_digest(e["inputs"]) != e["input_checksum"]]
+
+
+def _resolve_step_fn(spec):
+    if ":" not in spec:
+        raise ValueError(f"--step-fn must be 'module:function', got {spec!r}")
+    mod_name, fn_name = spec.split(":", 1)
+    sys.path.insert(0, os.getcwd())
+    fn = getattr(importlib.import_module(mod_name), fn_name, None)
+    if fn is None:
+        raise ValueError(f"{mod_name} has no attribute {fn_name!r}")
+    return fn
+
+
+def replay(entries, step, step_fn, expected=None, observed=None):
+    """Library entry point for the replay mode; returns the classification
+    dict from StepReplayBuffer-compatible entries."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu.resilience.integrity import (classify_replay,
+                                                 run_step_on_cpu)
+    entry = entries.get(int(step))
+    if entry is None:
+        raise KeyError(
+            f"step {step} not in dump (have {sorted(entries)})")
+    digest = run_step_on_cpu(step_fn, entry)
+    return {"step": int(step), "digest": digest,
+            "classification": classify_replay(digest, expected, observed)}
+
+
+def _list_report(meta, entries, bad):
+    gen = meta.get("generation", 0)
+    lines = [f"replay dump: rank {meta.get('rank')} generation {gen}"
+             + (f"  reason: {meta['reason']}" if meta.get("reason") else "")]
+    for s, e in sorted(entries.items()):
+        shapes = ", ".join(f"{a.dtype}{list(a.shape)}" for a in e["inputs"])
+        ok = "CORRUPT" if s in bad else "ok"
+        rng = "" if e["rng_key"] is None else " rng"
+        lines.append(f"  step {s}: inputs [{shapes}]{rng} "
+                     f"checksum {e['input_checksum'][:12]} {ok}")
+    if bad:
+        lines.append(f"evidence corrupt for step(s) {bad}: the recorded "
+                     "batch no longer matches its own checksum")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("dump", help="step_replay_rank<N>.json path")
+    ap.add_argument("--step", type=int, default=None,
+                    help="step index to replay (default: list the dump)")
+    ap.add_argument("--step-fn", default=None,
+                    help="module:function taking one ring-entry dict")
+    ap.add_argument("--expected", default=None,
+                    help="majority digest from the consensus report")
+    ap.add_argument("--observed", default=None,
+                    help="accused rank's digest")
+    args = ap.parse_args(argv)
+    try:
+        meta, entries = load_dump(args.dump)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"replay_step: bad dump: {e}", file=sys.stderr)
+        return 2
+    bad = verify_dump(entries)
+    if args.step is None:
+        print(_list_report(meta, entries, bad))
+        return 1 if bad else 0
+    if args.step_fn is None:
+        print("replay_step: --step requires --step-fn", file=sys.stderr)
+        return 2
+    if args.step in bad:
+        print(f"replay_step: step {args.step} evidence is corrupt (input "
+              "checksum mismatch) — refusing to replay it", file=sys.stderr)
+        return 1
+    try:
+        fn = _resolve_step_fn(args.step_fn)
+    except (ValueError, ImportError) as e:
+        print(f"replay_step: {e}", file=sys.stderr)
+        return 2
+    try:
+        result = replay(entries, args.step, fn,
+                        expected=args.expected, observed=args.observed)
+    except KeyError as e:
+        print(f"replay_step: {e.args[0]}", file=sys.stderr)
+        return 2
+    print(f"step {result['step']}: cpu digest {result['digest']}")
+    print(f"classification: {result['classification']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
