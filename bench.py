@@ -109,6 +109,33 @@ def _fwd_ctx(precision):
 _LAST_CURVE = {}  # model-name -> per-step loss curve of the last timed run
 _LAST_SPE = {}    # model-name -> steps-per-execution the curve was run with
 _LAST_DISTINCT = {}  # model-name -> number of DISTINCT batches in the run
+_LAST_BREAKDOWN = {}  # model-name -> step_breakdown block (phase attribution)
+
+
+def _capture_breakdown(curve_key, st, dt):
+    """Fold the lane's steptimer state into the step_breakdown block: phase
+    ms + fractions of the measured timed wall, p50/p99 step time (synced
+    steps preferred — they carry true device time), and the timer's
+    self-measured overhead so the <1% contract is visible in the artifact.
+    """
+    if not curve_key:
+        return
+    bd = st.breakdown()
+    wall_ms = dt * 1e3
+    attributed = sum(bd["phase_ms"].values())
+    _LAST_BREAKDOWN[curve_key] = {
+        "phase_ms": {k: round(v, 3) for k, v in bd["phase_ms"].items()},
+        "phase_fraction": {k: round(v / wall_ms, 4) if wall_ms else 0.0
+                           for k, v in bd["phase_ms"].items()},
+        "step_ms_p50": round(bd["step_ms_p50"], 3),
+        "step_ms_p99": round(bd["step_ms_p99"], 3),
+        "steps": bd["steps"],
+        "synced_steps": bd["synced_steps"],
+        "measured_wall_ms": round(wall_ms, 3),
+        "attributed_fraction": round(attributed / wall_ms, 4)
+        if wall_ms else 0.0,
+        "overhead_ms": round(bd["overhead_ms"], 3),
+    }
 
 
 def _timed_steps(step, data_fn, steps, warmup=5, curve_key=None,
@@ -179,11 +206,20 @@ def _timed_steps(step, data_fn, steps, warmup=5, curve_key=None,
         for args_i in staged[:warmup]:
             record(step(*args_i))
         curve[-1].item()  # sync warm-up
+        from paddle_tpu.profiler import steptimer as _steptimer
+        _steptimer.reset_steptimer()  # attribution covers ONLY the timed
+        _st = _steptimer.get_steptimer()  # window (staging is untimed)
         t0 = time.time()
         for args_i in staged[warmup:]:
-            record(step(*args_i))
-        _ = curve[-1].item()  # sync
+            with _st.step(n_steps=1):
+                with _st.phase("step/compute"):
+                    out = step(*args_i)
+                    _st.sync(out)
+                    record(out)
+        with _st.phase("step/compute"):
+            _ = curve[-1].item()  # sync
         dt = time.time() - t0
+        _capture_breakdown(curve_key, _st, dt)
         if curve_key:
             _LAST_CURVE[curve_key] = [
                 float(np.asarray(l.numpy(), np.float32)) for l in curve]
@@ -227,11 +263,20 @@ def _timed_steps(step, data_fn, steps, warmup=5, curve_key=None,
     losses[-1].item()
     record(losses)
     t = _mark("warm2 (steady exec)", t)
+    from paddle_tpu.profiler import steptimer as _steptimer
+    _steptimer.reset_steptimer()  # attribution covers ONLY the timed window
+    _st = _steptimer.get_steptimer()
     t0 = time.time()
     for i in range(n_exec):
-        record(step.run_steps(*stacks[2 + i]))
-    _ = curve[-1][-1].item()  # sync
+        with _st.step(n_steps=spe):
+            with _st.phase("step/compute"):
+                out = step.run_steps(*stacks[2 + i])
+                _st.sync(out)
+                record(out)
+    with _st.phase("step/compute"):
+        _ = curve[-1][-1].item()  # sync
     dt = time.time() - t0
+    _capture_breakdown(curve_key, _st, dt)
     _mark(f"timed ({n_exec} exec x {spe} steps)", t0)
     if curve_key:
         _LAST_CURVE[curve_key] = [
@@ -757,6 +802,12 @@ def main():
         result = {"metric": "bench_error", "value": 0.0,
                   "unit": "error", "vs_baseline": 0.0,
                   "error": repr(e)[:200]}
+    if _LAST_BREAKDOWN:
+        # attributable step time (docs/observability.md): from this block
+        # on, a bench delta names the phase that moved — gated per-phase by
+        # tools/check_bench_regression.py
+        result.setdefault("extra", {})["step_breakdown"] = \
+            dict(_LAST_BREAKDOWN)
     if _LAST_CURVE and os.environ.get("BENCH_LOSS_CURVES", "1") != "0":
         # loss-curve evidence (BASELINE "loss parity"; precision-regime
         # parity is asserted in tests/test_loss_parity.py — these are the

@@ -131,16 +131,21 @@ def _watched(op, g, value=None):
     the recorder is dumped and peers get a best-effort abort broadcast, so
     a rank dying mid-collective fails its peers in seconds instead of
     leaving them to idle out the full queue timeout."""
+    from ..profiler.steptimer import get_steptimer
     from ..resilience.recorder import describe, get_recorder
     from ..resilience.watchdog import PeerAbort, StaleGeneration, \
         watch_section
     rec = get_recorder()
     shapes, dtypes = describe(value)
     try:
-        with watch_section(f"collective.{op}"):
-            with rec.record(op, group=getattr(g, "axis", None),
-                            shapes=shapes, dtypes=dtypes):
-                yield
+        # step-phase attribution OUTSIDE the watchdog/recorder wrappers:
+        # collective_wait covers the whole eager tail, including the
+        # interception machinery itself
+        with get_steptimer().phase("step/collective_wait"):
+            with watch_section(f"collective.{op}"):
+                with rec.record(op, group=getattr(g, "axis", None),
+                                shapes=shapes, dtypes=dtypes):
+                    yield
     except BaseException as err:
         if not isinstance(err, (PeerAbort, StaleGeneration)):
             # a PeerAbort means someone ELSE already failed and told us; a

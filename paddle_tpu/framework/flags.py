@@ -91,6 +91,15 @@ _FLAGS: dict[str, Any] = {
     # rotate the recovery journal past this size, keeping two segments;
     # 0 = unbounded
     "FLAGS_journal_max_bytes": 1 << 20,
+    # observability (paddle_tpu/profiler/{metrics,steptimer}.py,
+    # docs/observability.md): step-phase attribution master switch
+    "FLAGS_steptimer": True,
+    # steps between block_until_ready samples that split device time from
+    # host dispatch time; 0 = never sync (host-dispatch times only)
+    "FLAGS_steptimer_sync_interval": 16,
+    # seconds between metrics snapshots written to PADDLE_TPU_ARTIFACTS_DIR
+    # (metrics_rank<N>.prom / .jsonl); 0 disables the exporter
+    "FLAGS_metrics_export_interval": 60.0,
     # inert reference flags accepted for script compatibility
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_allocator_strategy": "auto_growth",

@@ -14,6 +14,7 @@ from ..core.tensor import Tensor
 from ..io import DataLoader
 from ..jit.to_static import StaticFunction
 from ..metric import Metric
+from ..profiler import steptimer as _steptimer
 
 __all__ = ["Model"]
 
@@ -79,12 +80,17 @@ class Model:
                 self._optimizer.clear_grad()
                 return total
             self._compiled_train_step = StaticFunction(_step)
-        ins = [i if isinstance(i, Tensor) else Tensor(np.asarray(i))
-               for i in _to_list(inputs)]
-        labs = [l if isinstance(l, Tensor) else Tensor(np.asarray(l))
-                for l in _to_list(labels)]
-        loss = self._compiled_train_step(ins, labs)
-        return [float(loss.item())]
+        st = _steptimer.get_steptimer()
+        with st.phase("step/h2d"):
+            ins = [i if isinstance(i, Tensor) else Tensor(np.asarray(i))
+                   for i in _to_list(inputs)]
+            labs = [l if isinstance(l, Tensor) else Tensor(np.asarray(l))
+                    for l in _to_list(labels)]
+        with st.phase("step/compute"):
+            loss = self._compiled_train_step(ins, labs)
+            st.sync(loss)
+            out = [float(loss.item())]
+        return out
 
     def _train_steps(self, batches):
         """Run len(batches) optimizer steps in ONE compiled scan dispatch
@@ -105,16 +111,21 @@ class Model:
                      for i in _to_list(ins)],
                     [l if isinstance(l, Tensor) else Tensor(np.asarray(l))
                      for l in _to_list(labs)])
-        pairs = [to_tensors(i, l) for i, l in batches]
-        n_in = len(pairs[0][0])
-        ins_stacked = [Tensor(jnp.stack([p[0][j]._val for p in pairs]))
-                       for j in range(n_in)]
-        labs_stacked = [Tensor(jnp.stack([p[1][j]._val for p in pairs]))
-                        for j in range(len(pairs[0][1]))]
-        losses = self._compiled_train_step.run_steps(ins_stacked,
-                                                     labs_stacked)
-        return head + [[float(v)]
-                       for v in np.asarray(losses.numpy()).reshape(-1)]
+        st = _steptimer.get_steptimer()
+        with st.phase("step/h2d"):
+            pairs = [to_tensors(i, l) for i, l in batches]
+            n_in = len(pairs[0][0])
+            ins_stacked = [Tensor(jnp.stack([p[0][j]._val for p in pairs]))
+                           for j in range(n_in)]
+            labs_stacked = [Tensor(jnp.stack([p[1][j]._val for p in pairs]))
+                            for j in range(len(pairs[0][1]))]
+        with st.phase("step/compute"):
+            losses = self._compiled_train_step.run_steps(ins_stacked,
+                                                         labs_stacked)
+            st.sync(losses)
+            out = head + [[float(v)]
+                          for v in np.asarray(losses.numpy()).reshape(-1)]
+        return out
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -197,23 +208,29 @@ class Model:
 
             def run_group(group, step0):
                 nonlocal logs, it
+                st = _steptimer.get_steptimer()
                 if len(group) == 1:
                     # single-step path keeps the begin-before-execute
                     # callback contract (timers/profiler regions)
                     cbs.on_train_batch_begin(step0)
-                    if guard is not None:
-                        guard.before_step()
-                    try:
-                        loss = self.train_batch(*group[0])
-                    except FloatingPointError:
-                        # eager NaN scan (discovery passes) fires before the
-                        # guard can see the loss — same fault, same handling
-                        if guard is None:
-                            raise
-                        loss = [float("nan")]
-                    logs = {"loss": loss, "step": step0}
-                    if guard is not None and not guard.after_step(loss):
-                        logs["skipped"] = True
+                    with st.step(n_steps=1):
+                        if guard is not None:
+                            guard.before_step()
+                        try:
+                            loss = self.train_batch(*group[0])
+                        except FloatingPointError:
+                            # eager NaN scan (discovery passes) fires before
+                            # the guard can see the loss — same fault, same
+                            # handling
+                            if guard is None:
+                                raise
+                            loss = [float("nan")]
+                        logs = {"loss": loss, "step": step0}
+                        if guard is not None:
+                            with st.phase("step/integrity"):
+                                kept = guard.after_step(loss)
+                            if not kept:
+                                logs["skipped"] = True
                     cbs.on_train_batch_end(step0, logs)
                     it += 1
                     return
@@ -221,18 +238,21 @@ class Model:
                 # all ends report per-step losses
                 for k in range(len(group)):
                     cbs.on_train_batch_begin(step0 + k)
-                if guard is not None:
-                    # the scan is one launch: the guard can only keep or
-                    # restore the whole group
-                    guard.before_step()
-                try:
-                    losses = self._train_steps(group)
-                except FloatingPointError:
-                    if guard is None:
-                        raise
-                    losses = [[float("nan")]] * len(group)
-                group_skipped = (guard is not None
-                                 and not guard.after_step(losses))
+                with st.step(n_steps=len(group)):
+                    if guard is not None:
+                        # the scan is one launch: the guard can only keep or
+                        # restore the whole group
+                        guard.before_step()
+                    try:
+                        losses = self._train_steps(group)
+                    except FloatingPointError:
+                        if guard is None:
+                            raise
+                        losses = [[float("nan")]] * len(group)
+                    group_skipped = False
+                    if guard is not None:
+                        with st.phase("step/integrity"):
+                            group_skipped = not guard.after_step(losses)
                 for k, loss in enumerate(losses):
                     s = step0 + k
                     logs = {"loss": loss, "step": s}
@@ -242,7 +262,16 @@ class Model:
                     it += 1
 
             group_sig = None
-            for batch in loader:
+            _st = _steptimer.get_steptimer()
+            _loader_it = iter(loader)
+            _done = object()
+            while True:
+                # manual iteration so loader blocking is attributable:
+                # time spent waiting on the next batch is step/input_wait
+                with _st.phase("step/input_wait"):
+                    batch = next(_loader_it, _done)
+                if batch is _done:
+                    break
                 ins, labs = self._split_batch(batch)
                 sig = _batch_sig((ins, labs)) if spe > 1 else None
                 if group and spe > 1 and sig != group_sig:
@@ -354,9 +383,10 @@ class Model:
     # -- persistence ------------------------------------------------------------
     def save(self, path, training=True):
         from ..framework.io_utils import save as _save
-        _save(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
-            _save(self._optimizer.state_dict(), path + ".pdopt")
+        with _steptimer.get_steptimer().phase("step/ckpt_io"):
+            _save(self.network.state_dict(), path + ".pdparams")
+            if training and self._optimizer is not None:
+                _save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         import os

@@ -336,7 +336,13 @@ class _ProcessPool:
         return bidx
 
     def recv(self):
-        """Next result; polls so a dead worker raises instead of hanging."""
+        """Next result; polls so a dead worker raises instead of hanging.
+        Blocking here is attributable input wait (step/input_wait)."""
+        from ..profiler.steptimer import get_steptimer
+        with get_steptimer().phase("step/input_wait"):
+            return self._recv()
+
+    def _recv(self):
         import queue as q
         waited = 0.0
         while True:
@@ -488,10 +494,16 @@ class DataLoader:
         def drain_one():
             """Receive one result into the reorder buffer (raises on a
             failed worker)."""
-            ridx, status, payload = pool.recv()
+            item = pool.recv()
+            ridx, status, payload = item[0], item[1], item[2]
             state["in_flight"] -= 1
             if status == "err":
                 raise RuntimeError(f"DataLoader worker failed:\n{payload}")
+            meta = item[3] if len(item) > 3 else None
+            if meta and isinstance(meta.get("fetch_ms"), (int, float)):
+                from ..profiler import metrics as _metrics
+                _metrics.get_registry().observe("io.worker_fetch_ms",
+                                                meta["fetch_ms"])
             state["ready"][ridx] = payload
 
         def pop_ready():
@@ -519,7 +531,8 @@ class DataLoader:
             import queue as _q
             while state["in_flight"]:
                 try:
-                    _, status, payload = pool.result_queue.get(timeout=5)
+                    item = pool.result_queue.get(timeout=5)
+                    status, payload = item[1], item[2]
                 except (_q.Empty, OSError):
                     break
                 state["in_flight"] -= 1

@@ -14,6 +14,7 @@ tensors), small objects ride the result queue directly.
 from __future__ import annotations
 
 import os
+import time
 import traceback
 
 import numpy as np
@@ -122,9 +123,14 @@ def worker_loop(dataset, index_queue, result_queue, worker_id, num_workers,
         bidx, indices = item
         shms = []
         try:
+            t0 = time.perf_counter()
             samples = [_to_numpy(dataset[i]) for i in indices]
             payload = _encode(samples, shms, use_shared_memory)
-            result_queue.put((bidx, "ok", payload))
+            # meta rides as a 4th tuple element; the parent folds fetch_ms
+            # into the io.worker_fetch_ms histogram (observability layer)
+            meta = {"fetch_ms": (time.perf_counter() - t0) * 1e3,
+                    "worker_id": worker_id}
+            result_queue.put((bidx, "ok", payload, meta))
             for shm in shms:
                 shm.close()  # parent unlinks after copying out
         except Exception:
@@ -136,4 +142,4 @@ def worker_loop(dataset, index_queue, result_queue, worker_id, num_workers,
                     shm.unlink()
                 except Exception:
                     pass
-            result_queue.put((bidx, "err", traceback.format_exc()))
+            result_queue.put((bidx, "err", traceback.format_exc(), None))

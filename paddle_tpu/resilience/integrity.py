@@ -326,13 +326,16 @@ class ConsensusChecker:
         run the consensus check. Returns this rank's digest on check steps,
         None otherwise."""
         from ..profiler import record_counter
+        from ..profiler.steptimer import get_steptimer
         t0 = time.perf_counter()
         digest = None
         try:
-            if self.replay is not None:
-                self.replay.record(step, rng_key=rng_key, inputs=inputs)
-            if self.interval > 0 and (int(step) + 1) % self.interval == 0:
-                digest = self.check(step)
+            with get_steptimer().phase("step/integrity"):
+                if self.replay is not None:
+                    self.replay.record(step, rng_key=rng_key, inputs=inputs)
+                if self.interval > 0 and \
+                        (int(step) + 1) % self.interval == 0:
+                    digest = self.check(step)
         finally:
             dt = time.perf_counter() - t0
             self.counters["seconds"] += dt

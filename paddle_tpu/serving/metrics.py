@@ -62,10 +62,18 @@ class ServingMetrics:
         import time
         return time.monotonic()
 
+    @staticmethod
+    def _registry():
+        from ..profiler import metrics as _metrics
+        return _metrics.get_registry()
+
     # -- recording -----------------------------------------------------------
     def inc(self, name, n=1):
         with self._lock:
             self._c[name] = self._c.get(name, 0) + n
+        # always-on mirror: production counters must survive with the
+        # profiler disabled (docs/observability.md naming manifest)
+        self._registry().inc_counter(f"serving.{name}_total", n)
 
     def observe_latency(self, seconds):
         with self._lock:
@@ -75,9 +83,13 @@ class ServingMetrics:
                     float(seconds)
             else:
                 self._lat.append(float(seconds))
+        self._registry().observe("serving.request_latency_ms",
+                                 float(seconds) * 1e3)
 
     def register_gauge(self, name, fn):
         self._gauges[name] = fn
+        # pull-style: evaluated at metrics-export/snapshot time
+        self._registry().register_gauge_fn(f"serving.{name}_count", fn)
 
     # -- reading ---------------------------------------------------------------
     def get(self, name):

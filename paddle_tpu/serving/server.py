@@ -211,6 +211,8 @@ class InferenceServer:
         attempt and is dumped on final failure, naming the batch.
         """
         from .. import profiler
+        from ..profiler.steptimer import get_steptimer
+        st = get_steptimer()
         attempts = self.config.max_retries + 1
         last_exc = None
         for attempt in range(attempts):
@@ -221,7 +223,10 @@ class InferenceServer:
                 peer={"batch": batch.id, "attempt": attempt,
                       "requests": [r.id for r in batch.requests]})
             try:
-                with profiler.RecordEvent(
+                # a serving batch has no trainer step around it: the phase
+                # lands in the timer's global accumulators and the
+                # steptimer.compute_ms histogram
+                with st.phase("step/compute"), profiler.RecordEvent(
                         f"serving.batch.bucket{batch.bucket}"):
                     outputs, rep = self.scheduler.dispatch(batch)
             except (ReplicaDead, DistributedTimeout) as e:

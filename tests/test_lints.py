@@ -92,6 +92,45 @@ def test_injection_lint_covers_integrity_entry_points():
     assert "should_inject" in hooks
 
 
+def test_metric_name_lint_passes_on_tree():
+    r = _run(REPO / "tools" / "check_metric_names.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "metric-name lint OK" in r.stdout
+
+
+def test_metric_name_lint_manifest_guard():
+    """The observability PR's contract: the step-phase / registry metric
+    subsystems stay registered and the grandfather list stays frozen (new
+    names must pass subsystem.noun_unit, not grow the escape hatch). Guard
+    the lint's own manifests so a refactor can't silently gut the check."""
+    import ast
+    src = (REPO / "tools" / "check_metric_names.py").read_text()
+    tree = ast.parse(src)
+
+    def _assigned(name):
+        return next(
+            node.value for node in ast.walk(tree)
+            if isinstance(node, ast.Assign)
+            and any(getattr(t, "id", None) == name for t in node.targets))
+
+    subsystems = set(ast.literal_eval(_assigned("SUBSYSTEMS")))
+    assert {"steptimer", "metrics", "serving", "io",
+            "integrity"} <= subsystems
+    units = set(ast.literal_eval(_assigned("UNITS")))
+    assert {"ms", "total", "per_sec"} <= units
+    grandfathered = set(ast.literal_eval(_assigned("GRANDFATHERED")))
+    # frozen: pre-convention names only — anything new must follow the
+    # pattern instead of being added here
+    assert grandfathered <= {"autotune.search/{}", "fusion_policy/{}",
+                             "straggler.rank{}", "{}.{}"}
+
+
+def test_trace_merge_help_smoke():
+    r = _run(REPO / "tools" / "trace_merge.py", "--help")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "timeline" in r.stdout
+
+
 def test_replay_step_help_smoke():
     r = _run(REPO / "tools" / "replay_step.py", "--help")
     assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,567 @@
+"""Observability layer: always-on metrics registry, step-phase attribution,
+profiler scheduler, and the cross-rank trace merge.
+
+Covers the attributable-step-time PR's acceptance claims directly:
+
+- the registry records correctly under concurrent writers and bounds label
+  cardinality instead of growing without limit;
+- the exporter's tmp+``os.replace`` discipline survives injected ``fs.write``
+  faults (old files stay intact, no torn tmp leftovers, failures counted);
+- fake-clock phase attribution reconstructs nested phases exactly, and the
+  real-clock overhead of the instrumentation stays under 1% of step wall
+  time while the attributed phases sum to within 5% of the wall;
+- ``tools/trace_merge.py`` aligns three synthetic ranks onto one timeline,
+  quarantines a stale-generation straggler dump, and names the slowest
+  rank per phase;
+- the bench regression gate fails on a phase that regressed, honors scoped
+  waivers, and ignores sub-millisecond noise.
+"""
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import trace_merge  # noqa: E402
+from check_bench_regression import compare  # noqa: E402
+
+from paddle_tpu import profiler
+from paddle_tpu.profiler import metrics as pmetrics
+from paddle_tpu.profiler import steptimer
+from paddle_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.reset()
+    pmetrics.reset_registry()
+    profiler.reset_profiler()
+    steptimer.reset_steptimer()
+    yield
+    faults.reset()
+    pmetrics.reset_registry()
+    profiler.reset_profiler()
+    steptimer.reset_steptimer()
+
+
+# -- metrics registry ----------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = pmetrics.MetricsRegistry()
+    reg.inc_counter("serving.shed_total")
+    reg.inc_counter("serving.shed_total", 2)
+    reg.set_gauge("io.queue_depth_count", 7)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        reg.observe("steptimer.step_ms", v)
+    assert reg.counter_value("serving.shed_total") == 3.0
+    assert reg.gauge_value("io.queue_depth_count") == 7.0
+    s = reg.histogram_summary("steptimer.step_ms")
+    assert s["count"] == 4 and s["sum"] == 10.0
+    assert s["min"] == 1.0 and s["max"] == 4.0
+    assert s["min"] <= s["p50"] <= s["p99"] <= s["max"]
+    snap = reg.snapshot()
+    assert snap["counters"]["serving.shed_total"] == 3.0
+    assert snap["gauges"]["io.queue_depth_count"] == 7.0
+    assert "steptimer.step_ms" in snap["histograms"]
+
+
+def test_registry_concurrent_writers():
+    reg = pmetrics.MetricsRegistry()
+    n_threads, n_iter = 8, 500
+
+    def worker(i):
+        for _ in range(n_iter):
+            reg.inc_counter("io.batches_total")
+            reg.observe("io.worker_fetch_ms", float(i))
+            reg.record_sample("integrity.check_ms", 1.0)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * n_iter
+    assert reg.counter_value("io.batches_total") == float(total)
+    assert reg.histogram_summary("io.worker_fetch_ms")["count"] == total
+    assert len(reg.counter_samples("integrity.check_ms")) == total
+
+
+def test_label_cardinality_bounded():
+    reg = pmetrics.MetricsRegistry(max_label_sets=4)
+    for i in range(10):
+        reg.inc_counter("io.batches_total", labels={"worker": str(i)})
+    snap = reg.snapshot()
+    # 4 admitted + the overflow fold; nothing past the cap got its own series
+    series = [k for k in snap["counters"] if k.startswith("io.batches")]
+    assert len(series) == 5
+    assert reg.counter_value("io.batches_total",
+                             labels={"overflow": "true"}) == 6.0
+    assert snap["dropped_label_sets"] == 6
+
+
+def test_pull_gauge_and_broken_gauge():
+    reg = pmetrics.MetricsRegistry()
+    reg.register_gauge_fn("serving.queue_depth_count", lambda: 42)
+    reg.register_gauge_fn("serving.broken_count",
+                          lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    snap = reg.snapshot()
+    assert snap["gauges"]["serving.queue_depth_count"] == 42.0
+    assert snap["gauges"]["serving.broken_count"] is None  # not raised
+    # broken gauges are dropped from the prometheus text, not rendered None
+    text = reg.prometheus_text()
+    assert "paddle_tpu_serving_queue_depth_count 42.0" in text
+    assert "broken" not in text
+
+
+def test_prometheus_text_format():
+    reg = pmetrics.MetricsRegistry()
+    reg.inc_counter("serving.shed_total", 5)
+    reg.observe("steptimer.step_ms", 2.0)
+    text = reg.prometheus_text()
+    assert "# TYPE paddle_tpu_serving_shed_total counter" in text
+    assert "paddle_tpu_serving_shed_total 5.0" in text
+    assert "paddle_tpu_steptimer_step_ms_count 1" in text
+    assert 'quantile="0.50"' in text
+    assert text.endswith("\n")
+
+
+# -- exporter ------------------------------------------------------------------
+
+def _exporter(tmp_path, reg, **kw):
+    kw.setdefault("interval", 1.0)
+    kw.setdefault("rank", 3)
+    return pmetrics.MetricsExporter(reg, directory=str(tmp_path), **kw)
+
+
+def test_exporter_writes_both_files(tmp_path):
+    reg = pmetrics.MetricsRegistry()
+    reg.inc_counter("serving.shed_total", 2)
+    exp = _exporter(tmp_path, reg)
+    prom, jsonl = exp.export_once()
+    assert Path(prom).name == "metrics_rank3.prom"
+    assert "paddle_tpu_serving_shed_total 2.0" in Path(prom).read_text()
+    lines = Path(jsonl).read_text().splitlines()
+    doc = json.loads(lines[-1])
+    assert doc["counters"]["serving.shed_total"] == 2.0
+    assert doc["rank"] == 3
+    assert not list(tmp_path.glob("*.tmp.*"))  # no torn leftovers
+
+
+def test_exporter_interval_gating(tmp_path):
+    reg = pmetrics.MetricsRegistry()
+    exp = _exporter(tmp_path, reg, interval=10.0)
+    assert exp.maybe_export(now=0.0) is True
+    assert exp.maybe_export(now=5.0) is False      # interval not elapsed
+    assert exp.maybe_export(now=11.0) is True
+    assert exp.exports == 2
+
+
+def test_exporter_atomic_under_injected_write_faults(tmp_path):
+    reg = pmetrics.MetricsRegistry()
+    reg.inc_counter("serving.shed_total", 1)
+    exp = _exporter(tmp_path, reg, interval=1.0)
+    exp.export_once()
+    before = Path(exp.prom_path).read_text()
+
+    reg.inc_counter("serving.shed_total", 9)
+    faults.configure("fs.write:1.0")
+    assert exp.maybe_export(now=100.0) is False    # failed, swallowed
+    assert exp.export_failures == 1
+    assert reg.counter_value("metrics.export_failures_total") == 1.0
+    # the failed export left the previous files byte-identical and no tmp
+    assert Path(exp.prom_path).read_text() == before
+    assert not list(tmp_path.glob("*.tmp.*"))
+
+    faults.reset()
+    assert exp.maybe_export(now=200.0) is True     # recovered
+    after = Path(exp.prom_path).read_text()
+    assert "paddle_tpu_serving_shed_total 10.0" in after
+
+
+def test_exporter_interval_follows_flag(tmp_path):
+    from paddle_tpu.framework.flags import get_flag, set_flags
+    reg = pmetrics.MetricsRegistry()
+    exp = pmetrics.MetricsExporter(reg, directory=str(tmp_path), rank=0)
+    old = get_flag("FLAGS_metrics_export_interval", 60.0)
+    try:
+        set_flags({"FLAGS_metrics_export_interval": 0})
+        assert exp.maybe_export(now=0.0) is False  # 0 disables
+        set_flags({"FLAGS_metrics_export_interval": 5.0})
+        assert exp.interval == 5.0
+    finally:
+        set_flags({"FLAGS_metrics_export_interval": old})
+
+
+# -- record_counter bridge (always-on) ----------------------------------------
+
+def test_record_counter_without_profiler_session():
+    # no start_profiler anywhere: samples and aggregates must still land
+    profiler.record_counter("integrity.check_ms", 4.0)
+    profiler.record_counter("integrity.check_ms", 6.0)
+    samples = profiler.counter_samples("integrity.check_ms")
+    assert [v for _, _, v in samples] == [4.0, 6.0]
+    s = pmetrics.get_registry().histogram_summary("integrity.check_ms")
+    assert s["count"] == 2 and s["sum"] == 10.0
+
+
+def test_counter_samples_cleared_per_session_aggregates_survive():
+    profiler.record_counter("integrity.check_ms", 4.0)
+    profiler.start_profiler()
+    # session semantics: the ring restarts, the histogram keeps history
+    assert profiler.counter_samples("integrity.check_ms") == []
+    profiler.record_counter("integrity.check_ms", 6.0)
+    assert len(profiler.counter_samples("integrity.check_ms")) == 1
+    profiler.stop_profiler()
+    s = pmetrics.get_registry().histogram_summary("integrity.check_ms")
+    assert s["count"] == 2
+
+
+# -- Profiler scheduler + step instants ---------------------------------------
+
+def test_profiler_step_scheduler_windows():
+    ready = []
+    prof = profiler.Profiler(scheduler=(1, 1, 2, 2), timer_only=True,
+                             on_trace_ready=lambda p: ready.append(
+                                 p._step_num))
+    prof.start()
+    for _ in range(9):
+        prof.step()
+    prof.stop()
+    # cycle = skip1 + warmup1 + active2 = 4 steps; repeat=2 → the active
+    # windows end as step 4 and step 8 begin, and stop() must not fire a
+    # third callback for the closed tail
+    assert ready == [4, 8]
+
+
+def test_profiler_scheduler_validation():
+    with pytest.raises(ValueError):
+        profiler.Profiler(scheduler=(0, 0, 0, 1))
+    with pytest.raises(ValueError):
+        profiler.Profiler(scheduler=(-1, 0, 1, 1))
+
+
+def test_profiler_step_instants_and_samples_gauge():
+    with profiler.Profiler(timer_only=True) as prof:
+        prof.step(num_samples=32)
+        time.sleep(0.001)
+        prof.step(num_samples=32)
+    rate = pmetrics.get_registry().gauge_value("profiler.samples_per_sec")
+    assert rate is not None and 0 < rate < 32 / 0.001
+    trace = profiler._recorder.chrome_trace()
+    instants = [e for e in trace["traceEvents"] if e.get("ph") == "i"]
+    assert sum(e["name"] == "profiler.step" for e in instants) == 2
+
+
+def test_record_event_type_category_filter():
+    with profiler.Profiler(timer_only=True):
+        with profiler.RecordEvent("fwd", event_type="Forward"):
+            pass
+        with profiler.RecordEvent("bwd", event_type="Backward"):
+            pass
+        with profiler.RecordEvent("plain"):
+            pass
+    agg = profiler._recorder.aggregate(event_type="Forward")
+    assert set(agg) == {"fwd"}
+    cats = profiler._recorder.categories()
+    assert cats["fwd"] == "Forward" and cats["plain"] == "host"
+    table = profiler.summary(event_type="Backward")
+    assert "bwd" in table and "fwd" not in table
+    trace = profiler._recorder.chrome_trace()
+    ev_cats = {e["name"]: e.get("cat") for e in trace["traceEvents"]
+               if e.get("ph") == "X"}
+    assert ev_cats["fwd"] == "Forward" and ev_cats["plain"] == "host"
+
+
+# -- steptimer phase attribution ----------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def test_fake_clock_nested_phase_attribution():
+    clk = FakeClock()
+    reg = pmetrics.MetricsRegistry()
+    st = steptimer.StepTimer(clock=clk, sync_interval=0, enabled=True,
+                             registry=reg)
+    with st.step():
+        with st.phase("step/input_wait"):
+            clk.advance(0.005)
+        with st.phase("step/h2d"):
+            clk.advance(0.010)
+        with st.phase("step/compute"):
+            clk.advance(0.050)
+            with st.phase("step/collective_wait"):
+                clk.advance(0.020)
+            clk.advance(0.020)
+        clk.advance(0.000)
+    b = st.breakdown()
+    # nested collective_wait (20ms) is carved OUT of compute's 90ms span
+    assert b["phase_ms"]["compute"] == pytest.approx(70.0)
+    assert b["phase_ms"]["collective_wait"] == pytest.approx(20.0)
+    assert b["phase_ms"]["input_wait"] == pytest.approx(5.0)
+    assert b["phase_ms"]["h2d"] == pytest.approx(10.0)
+    assert b["wall_ms"] == pytest.approx(105.0)
+    assert b["attributed_ms"] == pytest.approx(105.0)
+    assert b["unattributed_ms"] == pytest.approx(0.0)
+    assert b["step_ms_p50"] == pytest.approx(105.0)
+    fr = b["phase_fraction"]
+    assert sum(fr.values()) == pytest.approx(1.0)
+    assert fr["compute"] == pytest.approx(70.0 / 105.0)
+
+
+def test_phase_outside_step_accumulates_globally():
+    clk = FakeClock()
+    reg = pmetrics.MetricsRegistry()
+    st = steptimer.StepTimer(clock=clk, sync_interval=0, enabled=True,
+                             registry=reg)
+    with st.phase("step/ckpt_io"):
+        clk.advance(0.030)
+    b = st.breakdown()
+    assert b["phase_ms"]["ckpt_io"] == pytest.approx(30.0)
+    assert b["unattributed_ms"] == 0.0  # no step wall to attribute against
+    # out-of-step phases feed the histogram immediately
+    assert reg.histogram_summary("steptimer.ckpt_io_ms")["count"] == 1
+
+
+def test_steptimer_disabled_is_passthrough():
+    clk = FakeClock()
+    st = steptimer.StepTimer(clock=clk, enabled=False)
+    with st.step():
+        with st.phase("step/compute"):
+            clk.advance(1.0)
+    assert st.breakdown()["steps"] == 0
+    assert st.overhead_ms == 0.0
+
+
+def test_sync_interval_samples_device_wait():
+    clk = FakeClock()
+    reg = pmetrics.MetricsRegistry()
+    st = steptimer.StepTimer(clock=clk, sync_interval=2, enabled=True,
+                             registry=reg)
+    for _ in range(4):
+        with st.step():
+            clk.advance(0.001)
+    b = st.breakdown()
+    assert b["steps"] == 4
+    assert b["synced_steps"] == 2  # steps 0 and 2 under interval 2
+
+
+def test_step_histograms_normalized_per_step():
+    clk = FakeClock()
+    reg = pmetrics.MetricsRegistry()
+    st = steptimer.StepTimer(clock=clk, sync_interval=0, enabled=True,
+                             registry=reg)
+    with st.step(n_steps=4):  # a fused scan group of 4 steps
+        with st.phase("step/compute"):
+            clk.advance(0.040)
+    s = reg.histogram_summary("steptimer.step_ms")
+    assert s["count"] == 1 and s["sum"] == pytest.approx(10.0)  # 40ms / 4
+    c = reg.histogram_summary("steptimer.compute_ms")
+    assert c["sum"] == pytest.approx(10.0)
+
+
+def test_overhead_under_one_percent_and_phases_sum_to_wall():
+    """The PR's acceptance bar, measured with the real clock: instrumented
+    steps whose work is ~5ms must show <1% self-measured overhead, and the
+    attributed phases must sum to within 5% of the step wall time. The
+    workload busy-waits rather than sleeps — a sleeping CPU wakes with cold
+    caches and scaled-down clocks, which bills OS wake-up latency to the
+    timer; a live step loop (the thing being modeled) never idles."""
+    st = steptimer.StepTimer(sync_interval=0, enabled=True,
+                             registry=pmetrics.MetricsRegistry())
+    for _ in range(80):
+        with st.step():
+            with st.phase("step/compute"):
+                t_end = time.perf_counter() + 0.005
+                while time.perf_counter() < t_end:
+                    pass
+    b = st.breakdown()
+    assert b["steps"] == 80
+    assert b["overhead_ms"] < 0.01 * b["wall_ms"], b
+    assert abs(b["wall_ms"] - b["attributed_ms"]) < 0.05 * b["wall_ms"], b
+
+
+def test_module_level_phase_uses_singleton():
+    st = steptimer.get_steptimer()
+    with steptimer.phase("step/ckpt_io"):
+        pass
+    assert "ckpt_io" in st.breakdown()["phase_ms"]
+    steptimer.reset_steptimer()
+    assert steptimer.get_steptimer() is not st
+
+
+# -- export_rank_trace: the per-rank artifact trace_merge consumes ------------
+
+def test_export_rank_trace_carries_alignment_metadata(tmp_path):
+    with profiler.Profiler(timer_only=True):
+        with profiler.RecordEvent("work"):
+            pass
+    path = profiler.export_rank_trace(directory=str(tmp_path))
+    doc = json.loads(Path(path).read_text())
+    assert Path(path).name == "trace_rank0.json"
+    assert {"wall_s", "ts_us"} <= set(doc["anchor"])
+    assert doc["rank"] == 0 and "generation" in doc
+    assert any(e.get("name") == "work" for e in doc["traceEvents"])
+
+
+# -- trace_merge ---------------------------------------------------------------
+
+def _phase_events(step_ms, compute_ms, n_steps=2):
+    """Synthetic per-rank chrome events: n steps of compute + input_wait."""
+    evs, t = [], 0.0
+    wait_ms = step_ms - compute_ms
+    for _ in range(n_steps):
+        evs.append({"name": "step/compute", "ph": "X", "ts": t * 1e3,
+                    "dur": compute_ms * 1e3, "tid": 1, "cat": "step_phase"})
+        evs.append({"name": "step/input_wait", "ph": "X",
+                    "ts": (t + compute_ms) * 1e3, "dur": wait_ms * 1e3,
+                    "tid": 1, "cat": "step_phase"})
+        evs.append({"name": "step", "ph": "X", "ts": t * 1e3,
+                    "dur": step_ms * 1e3, "tid": 1, "cat": "step"})
+        t += step_ms
+    return evs
+
+
+def _write_cluster(tmp_path):
+    """Three ranks at generation 2 (rank 2 slowest at compute), one stale
+    generation-1 flight dump from rank 1's pre-restart life, a journal, and
+    a torn journal tail line."""
+    wall0 = 1700000000.0
+    for rank, compute in ((0, 60.0), (1, 65.0), (2, 90.0)):
+        doc = {"traceEvents": _phase_events(step_ms=95.0, compute_ms=compute),
+               "rank": rank, "generation": 2,
+               "anchor": {"wall_s": wall0 + rank * 0.001, "ts_us": 0.0}}
+        (tmp_path / f"trace_rank{rank}.json").write_text(json.dumps(doc))
+    (tmp_path / "flight_recorder_rank0.json").write_text(json.dumps(
+        {"rank": 0, "generation": 2, "entries": [
+            {"op": "all_reduce", "seq": 1, "t_start": wall0 + 0.01,
+             "t_end": wall0 + 0.02, "status": "ok"},
+            {"op": "barrier", "seq": 2, "t_start": wall0 + 0.05,
+             "status": "pending"}]}))
+    (tmp_path / "flight_recorder_rank1.json").write_text(json.dumps(
+        {"rank": 1, "generation": 1, "entries": [
+            {"op": "all_reduce", "seq": 9, "t_start": wall0 - 5.0,
+             "t_end": wall0 - 4.9, "status": "ok"}]}))
+    journal = [json.dumps({"event": "restart", "ts": wall0 - 1.0,
+                           "generation": 2, "rank": 1}),
+               json.dumps({"event": "old_news", "ts": wall0 - 9.0,
+                           "generation": 1, "rank": 1}),
+               '{"torn']
+    (tmp_path / "recovery_journal_job.jsonl").write_text(
+        "\n".join(journal) + "\n")
+
+
+def test_trace_merge_generations_alignment_and_slowest_rank(tmp_path):
+    _write_cluster(tmp_path)
+    inputs = trace_merge.load_inputs([str(tmp_path)])
+    trace, info = trace_merge.merge(inputs)
+    assert info["generation"] == 2
+    assert info["ranks"] == [0, 1, 2]
+    assert info["stale"] == {1: 1}          # rank 1's pre-restart dump
+    assert info["unaligned_ranks"] == []
+    summary = trace_merge.summarize(trace)
+    assert summary["step/compute"]["slowest_rank"] == 2
+    assert summary["step/compute"]["slowest_ms"] == pytest.approx(180.0)
+    assert summary["step/input_wait"]["slowest_rank"] == 0  # most slack
+    assert summary["step"][2]["count"] == 2
+    # stale-generation journal noise filtered; current generation kept
+    names = [e["name"] for e in trace["traceEvents"] if e.get("ph") == "i"]
+    assert "restart" in names and "old_news" not in names
+    assert any(e["name"] == "barrier (pending)" for e in
+               trace["traceEvents"] if e.get("ph") == "i")
+    # ranks are clock-aligned: each rank's first compute span lands at its
+    # anchor's wall offset (1ms of skew per rank in the synthetic cluster)
+    first_compute = {}
+    for e in trace["traceEvents"]:
+        if e.get("name") == "step/compute":
+            pid = e["pid"]
+            first_compute[pid] = min(first_compute.get(pid, e["ts"]),
+                                     e["ts"])
+    assert first_compute[1] - first_compute[0] == pytest.approx(1000.0)
+    assert first_compute[2] - first_compute[0] == pytest.approx(2000.0)
+
+
+def test_trace_merge_cli_writes_merged_trace(tmp_path, capsys):
+    _write_cluster(tmp_path)
+    rc = trace_merge.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "generation 2: ranks [0, 1, 2]" in out
+    assert "rank 1 at generation 1" in out
+    assert "rank 2" in out                  # named slowest for compute
+    merged = json.loads((tmp_path / "merged_trace.json").read_text())
+    assert merged["generation"] == 2
+    assert not list(tmp_path.glob("merged_trace.json.tmp.*"))
+
+
+def test_trace_merge_skips_unanchored_trace(tmp_path):
+    doc = {"traceEvents": _phase_events(10.0, 5.0), "rank": 0,
+           "generation": 0}  # no anchor: cannot be wall-aligned
+    (tmp_path / "trace_rank0.json").write_text(json.dumps(doc))
+    trace, info = trace_merge.merge(trace_merge.load_inputs([str(tmp_path)]))
+    assert info["unaligned_ranks"] == [0]
+    assert all(e.get("cat") != "step_phase" for e in trace["traceEvents"])
+
+
+def test_trace_merge_rejects_empty_input(tmp_path):
+    assert trace_merge.main([str(tmp_path)]) == 2
+
+
+# -- bench phase-regression gate ----------------------------------------------
+
+def _bench_doc(input_wait=10.0, integrity=0.1, p99=102.0):
+    return {"metric": "bert_base_train_tokens_per_sec_per_chip",
+            "value": 100.0,
+            "extra": {"step_breakdown": {"bert": {
+                "phase_ms": {"compute": 80.0, "input_wait": input_wait,
+                             "integrity": integrity},
+                "step_ms_p50": 95.0, "step_ms_p99": p99}}}}
+
+
+def test_phase_gate_catches_regression_and_honors_waiver():
+    old, bad = _bench_doc(), _bench_doc(input_wait=20.0)
+    regressions, waived, _ = compare(old, bad)
+    assert [r["metric"] for r in regressions] == \
+        ["step_breakdown.bert.input_wait_ms"]
+    assert regressions[0]["direction"] == "lower_is_better"
+    regressions, waived, _ = compare(old, bad, waivers=[
+        {"metric": "step_breakdown.bert.input_wait_ms",
+         "reason": "loader fix traded wait for correctness"}])
+    assert regressions == [] and len(waived) == 1
+
+
+def test_phase_gate_ignores_subms_noise_and_sees_improvement():
+    old = _bench_doc(integrity=0.1, p99=200.0)
+    new = _bench_doc(integrity=0.4, p99=120.0)  # 4x worse but sub-ms
+    regressions, _, improvements = compare(old, new)
+    assert regressions == []
+    assert "step_breakdown.bert.step_ms_p99" in \
+        [i["metric"] for i in improvements]
+
+
+def test_phase_gate_requires_both_sides():
+    # a phase appearing/vanishing is instrumentation coverage, not perf
+    old = _bench_doc()
+    new = _bench_doc()
+    del new["extra"]["step_breakdown"]["bert"]["phase_ms"]["input_wait"]
+    regressions, _, _ = compare(old, new)
+    assert regressions == []
+    # ...and throughput metrics still gate as before alongside phases
+    new2 = _bench_doc()
+    new2["value"] = 80.0
+    regressions, _, _ = compare(old, new2)
+    assert [r["metric"] for r in regressions] == \
+        ["bert_base_train_tokens_per_sec_per_chip"]

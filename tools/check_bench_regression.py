@@ -79,6 +79,31 @@ def _flat_metrics(doc):
     return out
 
 
+# step_breakdown gating: phase times are LOWER-is-better (ms), and noisier
+# than lane throughput — gated with a wider tolerance and an absolute floor
+# so a 0.1ms -> 0.2ms phase wiggle never fails CI
+_PHASE_TOL = 0.25
+_PHASE_MIN_MS = 1.0
+
+
+def _breakdown_metrics(doc):
+    """Flatten extra.step_breakdown into {metric_name: ms} — per-lane
+    per-phase totals plus the p50/p99 step times."""
+    out = {}
+    bd = (doc.get("extra") or {}).get("step_breakdown") or {}
+    for lane, b in sorted(bd.items()):
+        if not isinstance(b, dict):
+            continue
+        for ph, v in sorted((b.get("phase_ms") or {}).items()):
+            if isinstance(v, (int, float)):
+                out[f"step_breakdown.{lane}.{ph}_ms"] = float(v)
+        for k in ("step_ms_p50", "step_ms_p99"):
+            v = b.get(k)
+            if isinstance(v, (int, float)):
+                out[f"step_breakdown.{lane}.{k}"] = float(v)
+    return out
+
+
 def _waiver_round(w):
     """Normalize a waiver's applies_to ("r05" / "r5" / 5) to an int, or
     None when absent/unparseable (such a waiver never applies)."""
@@ -142,6 +167,30 @@ def compare(old_doc, new_doc, tol=0.03, waivers=()):
             else:
                 regressions.append(row)
         elif ratio > 1.0 + tol:
+            improvements.append(row)
+    # attributable phase regressions (extra.step_breakdown): an op can hold
+    # its throughput while, say, input_wait doubles inside the same wall
+    # budget — the breakdown names the phase that moved, so it fails like
+    # an opbench regression. Both-present only (a phase that appears or
+    # vanishes reflects instrumentation coverage, not performance).
+    old_b = _breakdown_metrics(old_doc)
+    new_b = _breakdown_metrics(new_doc)
+    for k, old_v in sorted(old_b.items()):
+        new_v = new_b.get(k)
+        if new_v is None or old_v <= 0:
+            continue
+        if max(old_v, new_v) < _PHASE_MIN_MS:
+            continue  # sub-millisecond noise is not evidence
+        ratio = new_v / old_v
+        row = {"metric": k, "old": old_v, "new": new_v,
+               "ratio": round(ratio, 4), "direction": "lower_is_better"}
+        if ratio > 1.0 + _PHASE_TOL:
+            if k in waived_metrics:
+                row["waiver"] = waived_metrics[k]
+                waived.append(row)
+            else:
+                regressions.append(row)
+        elif ratio < 1.0 - _PHASE_TOL:
             improvements.append(row)
     return regressions, waived, improvements
 

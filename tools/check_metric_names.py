@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Lint: every always-on metric name follows ``subsystem.noun_unit``.
+
+The metrics registry (paddle_tpu/profiler/metrics.py) accepts any string, so
+nothing stops ``serving.latency`` today and ``serving.request_latency_ms``
+tomorrow from coexisting as two dashboards' worth of orphaned series. This
+checker parses the source with ast (no imports, no jax) and fails CI when a
+metric-recording call site uses a name that either
+
+- names a subsystem missing from ``SUBSYSTEMS`` (typo, or a new subsystem
+  that must be registered here — one line, reviewed like an API), or
+- lacks a unit suffix from ``UNITS`` (``_ms``, ``_total``, ...), so every
+  series is self-describing on a dashboard.
+
+Dynamic segments (f-string fields, %-format specs) are normalized to ``{}``
+and allowed inside the noun — ``steptime.rank{}_ms`` is one metric family.
+Names whose first argument is a bare variable cannot be extracted and are
+skipped; the convention is enforced where names are minted, i.e. at literal
+call sites. Pre-existing names that predate the convention are pinned in
+``GRANDFATHERED`` (renaming them would break recorded artifacts and the
+integrity/autotune test assertions) — do not add new entries.
+
+Run directly or via tests/test_lints.py / tests/test_observability.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Directories/files scanned (relative to repo root).
+SCAN = ["paddle_tpu", "bench.py"]
+
+# Registered metric subsystems (the manifest). A new prefix fails the lint
+# until it is added here — the review of that one-line diff is the naming
+# review.
+SUBSYSTEMS = [
+    "autotune",      # kernel-tier block autotuning
+    "fusion_policy", # measured fusion decisions
+    "integrity",     # SDC defense (checksum consensus, replay)
+    "io",            # input pipeline / data workers
+    "metrics",       # the registry/exporter's own health
+    "profiler",      # profiler-internal (samples/sec, ...)
+    "serving",       # inference server
+    "steptime",      # per-rank step-time health beacons
+    "steptimer",     # phase attribution (docs/observability.md)
+    "straggler",     # straggler-quarantine ratios
+]
+
+# Unit suffixes a metric name must end with (after stripping ``{}`` fields).
+UNITS = ["bytes", "count", "ms", "per_sec", "ratio", "sec", "total", "us"]
+
+# Names minted before this convention existed. Renaming them would orphan
+# recorded BENCH/flight artifacts and break assertions in tests/test_autotune
+# and tests/test_integrity, so they are pinned, not fixed. FROZEN: new names
+# must pass the pattern instead.
+GRANDFATHERED = [
+    "autotune.search/{}",   # per-op search counter (slash-namespaced)
+    "fusion_policy/{}",     # per-op fused/unfused decision
+    "straggler.rank{}",     # value is a ratio; name predates unit suffixes
+    "{}.{}",                # serving export_to_profiler re-emits snapshot
+                            # keys under a caller prefix; the source names
+                            # are validated at their minting sites above
+]
+
+# Calls whose first argument mints a metric name. ``observe_many`` takes
+# (name, value) pairs instead and is handled separately; ``_record`` is
+# autotune's local wrapper around record_counter.
+NAME_CALLS = {"record_counter", "record_sample", "_record",
+              "inc_counter", "set_gauge", "observe", "register_gauge_fn"}
+PAIRS_CALLS = {"observe_many"}
+# Of those, the registry methods are only linted when the receiver is
+# recognizably the metrics registry (get_registry(), self._registry, ...):
+# ``observe`` is far too common a method name to lint unconditionally.
+REGISTRY_ONLY = {"inc_counter", "set_gauge", "observe", "register_gauge_fn",
+                 "observe_many"}
+
+_NAME_RE = re.compile(
+    r"^(?P<subsystem>[a-z0-9_]+|\{\})\."
+    r"[a-z0-9_{}./]*_(?P<unit>%s)$" % "|".join(UNITS))
+
+
+def _template(node):
+    """Extract a name template from an ast expression: literal strings stay,
+    dynamic fields become ``{}``. Returns None when not extractable."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                parts.append("{}")
+            else:
+                return None
+        return "".join(parts)
+    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod)
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.left.value, str)):
+        return re.sub(r"%[#0\- +]*[\d*]*(?:\.[\d*]+)?[diouxXeEfFgGrsa]",
+                      "{}", node.left.value)
+    return None
+
+
+def _is_registry_receiver(node):
+    """Heuristic: does this expression denote the metrics registry?
+    Recognizes get_registry()/_registry() call results and any name or
+    attribute containing 'registry'."""
+    if isinstance(node, ast.Call):
+        return _is_registry_receiver(node.func)
+    if isinstance(node, ast.Attribute):
+        return "registry" in node.attr.lower() \
+            or _is_registry_receiver(node.value)
+    if isinstance(node, ast.Name):
+        return "registry" in node.id.lower()
+    return False
+
+
+def _call_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _iter_templates(call):
+    """Yield every extractable name template minted by this call."""
+    name = _call_name(call.func)
+    if name in PAIRS_CALLS:
+        # observe_many(items): walk the argument for (name, value) tuples
+        for arg in call.args:
+            for node in ast.walk(arg):
+                if isinstance(node, ast.Tuple) and node.elts:
+                    t = _template(node.elts[0])
+                    if t is not None:
+                        yield t
+        return
+    if call.args:
+        t = _template(call.args[0])
+        if t is not None:
+            yield t
+
+
+def _py_files(repo):
+    for entry in SCAN:
+        path = os.path.join(repo, entry)
+        if os.path.isfile(path):
+            yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def check(repo=REPO):
+    """Returns ([problems], names_checked)."""
+    problems = []
+    checked = 0
+    grandfathered = set(GRANDFATHERED)
+    subsystems = set(SUBSYSTEMS)
+    for path in _py_files(repo):
+        rel = os.path.relpath(path, repo)
+        with open(path) as f:
+            try:
+                tree = ast.parse(f.read(), filename=rel)
+            except SyntaxError as e:
+                problems.append(f"{rel}: unparseable ({e})")
+                continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            if name not in NAME_CALLS and name not in PAIRS_CALLS:
+                continue
+            if name in REGISTRY_ONLY:
+                recv = node.func.value \
+                    if isinstance(node.func, ast.Attribute) else None
+                if recv is None or not _is_registry_receiver(recv):
+                    continue
+            for tmpl in _iter_templates(node):
+                checked += 1
+                if tmpl in grandfathered:
+                    continue
+                m = _NAME_RE.match(tmpl)
+                if m is None:
+                    problems.append(
+                        f"{rel}:{node.lineno}: metric name {tmpl!r} does "
+                        "not match subsystem.noun_unit (unit suffix one of "
+                        f"{'/'.join(UNITS)})")
+                    continue
+                sub = m.group("subsystem")
+                if sub != "{}" and sub not in subsystems:
+                    problems.append(
+                        f"{rel}:{node.lineno}: metric name {tmpl!r} uses "
+                        f"unregistered subsystem {sub!r} (add it to "
+                        "SUBSYSTEMS in tools/check_metric_names.py)")
+    return problems, checked
+
+
+def main():
+    problems, checked = check()
+    if problems:
+        print("metric-name lint FAILED:")
+        for p in problems:
+            print("  -", p)
+        return 1
+    print(f"metric-name lint OK ({checked} name templates checked, "
+          f"{len(SUBSYSTEMS)} subsystems registered)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Merge per-rank chrome traces, flight-recorder dumps, and recovery-journal
+events onto ONE clock-aligned multi-rank timeline.
+
+Inputs (a directory — typically PADDLE_TPU_ARTIFACTS_DIR — or explicit
+files):
+
+- ``trace_rank<N>.json``          — per-rank chrome traces exported by
+  ``paddle_tpu.profiler.export_rank_trace``. Their timestamps are
+  perf_counter microseconds (a per-process epoch); the export stamps a
+  wall-clock ``anchor`` {wall_s, ts_us} used here to place every rank on
+  one wall clock. Traces without an anchor cannot be aligned and are
+  reported + skipped.
+- ``flight_recorder_rank<N>.json`` — collective flight-recorder dumps
+  (paddle_tpu/resilience/recorder.py); entry t_start/t_end are wall-clock
+  seconds already.
+- ``recovery_journal_*.jsonl``     — recovery journal events
+  (paddle_tpu/resilience/recovery.py), wall-clock ``ts`` seconds.
+
+Dumps written across an elastic re-rendezvous carry different generation
+stamps; merging a pre-restart rank's trace with post-restart peers produces
+nonsense skew. Like tools/flight_recorder_diff.py, sources are grouped by
+generation first: the merge covers the largest (ties: newest) generation,
+stale ranks are reported in the header, and journal events are kept when
+they carry the merged generation (or none — journal lines predating the
+elastic layer).
+
+Output: a merged chrome trace (``--out``, default merged_trace.json beside
+the inputs) with one pid per rank, plus a text summary that names the
+slowest rank per step phase ("why is my step slow" — docs/observability.md).
+
+Exit code 0 = merged, 2 = bad/insufficient input. Pure stdlib, no jax.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+__all__ = ["load_inputs", "group_sources_by_generation", "merge",
+           "summarize", "format_summary", "main"]
+
+_PHASE_CAT = "step_phase"
+_STEP_CAT = "step"
+
+
+def _generation(doc):
+    try:
+        return int(doc.get("generation", 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def load_inputs(paths):
+    """Classify inputs → {"traces": {rank: doc}, "recorders": {rank: doc},
+    "journal": [event, ...]}. Directories are globbed for the three
+    artifact layouts."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for pat in ("trace_rank*.json", "flight_recorder_rank*.json",
+                        "recovery_journal_*.jsonl",
+                        "recovery_journal_*.jsonl.1"):
+                files.extend(sorted(glob.glob(os.path.join(p, pat))))
+        else:
+            files.append(p)
+    out = {"traces": {}, "recorders": {}, "journal": []}
+    for fn in files:
+        base = os.path.basename(fn)
+        if base.endswith(".jsonl") or base.endswith(".jsonl.1"):
+            with open(fn) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out["journal"].append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # torn tail line (crash mid-append)
+            continue
+        with open(fn) as f:
+            doc = json.load(f)
+        if "traceEvents" in doc:
+            rank = doc.get("rank")
+            if rank is None:
+                raise ValueError(f"{fn}: chrome trace has no 'rank' field "
+                                 "(re-export with export_rank_trace)")
+            out["traces"][int(rank)] = doc
+        elif "entries" in doc:
+            rank = doc.get("rank")
+            if rank is None:
+                raise ValueError(f"{fn}: flight-recorder dump has no 'rank'")
+            out["recorders"][int(rank)] = doc
+        else:
+            raise ValueError(f"{fn}: neither a chrome trace nor a "
+                             "flight-recorder dump")
+    return out
+
+
+def group_sources_by_generation(inputs):
+    """Pick the merge generation: largest rank set across traces+recorder
+    dumps, ties toward the newest (flight_recorder_diff semantics).
+    Returns (generation, kept_inputs, stale) where stale maps rank →
+    its generation for every excluded rank-stamped source."""
+    by_gen = {}
+    for kind in ("traces", "recorders"):
+        for rank, doc in inputs[kind].items():
+            by_gen.setdefault(_generation(doc), set()).add(rank)
+    if not by_gen:
+        return 0, {"traces": {}, "recorders": {},
+                   "journal": list(inputs["journal"])}, {}
+    gen, _ranks = max(by_gen.items(), key=lambda kv: (len(kv[1]), kv[0]))
+    kept = {"traces": {}, "recorders": {}, "journal": []}
+    stale = {}
+    for kind in ("traces", "recorders"):
+        for rank, doc in inputs[kind].items():
+            if _generation(doc) == gen:
+                kept[kind][rank] = doc
+            else:
+                stale[rank] = _generation(doc)
+    for ev in inputs["journal"]:
+        ev_gen = ev.get("generation")
+        if ev_gen is None or _generation({"generation": ev_gen}) == gen:
+            kept["journal"].append(ev)
+    return gen, kept, stale
+
+
+def _wall_us(trace_doc, ts_us):
+    """perf_counter µs → wall-clock µs via the trace's anchor."""
+    a = trace_doc.get("anchor") or {}
+    return ts_us - a["ts_us"] + a["wall_s"] * 1e6
+
+
+def merge(inputs):
+    """Build the merged chrome trace dict. Returns (trace, info) where
+    info = {generation, ranks, stale, unaligned_ranks, events}."""
+    gen, kept, stale = group_sources_by_generation(inputs)
+    events = []
+    unaligned = []
+    ranks = sorted(set(kept["traces"]) | set(kept["recorders"]))
+    for rank in ranks:
+        events.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": f"rank {rank}"}})
+    for rank, doc in sorted(kept["traces"].items()):
+        a = doc.get("anchor") or {}
+        if "ts_us" not in a or "wall_s" not in a:
+            unaligned.append(rank)
+            continue
+        for ev in doc.get("traceEvents", []):
+            if "ts" not in ev:
+                continue
+            ev = dict(ev)
+            ev["ts"] = _wall_us(doc, ev["ts"])
+            ev["pid"] = rank
+            events.append(ev)
+    for rank, doc in sorted(kept["recorders"].items()):
+        for e in doc.get("entries", []):
+            t0 = e.get("t_start")
+            if t0 is None:
+                continue
+            t1 = e.get("t_end")
+            ev = {"name": e.get("op", "?"), "pid": rank, "tid": "flight",
+                  "cat": "collective", "ts": t0 * 1e6,
+                  "args": {k: e.get(k) for k in
+                           ("group", "seq", "status", "shapes", "peer")
+                           if e.get(k) is not None}}
+            if t1 is not None:
+                ev["ph"] = "X"
+                ev["dur"] = max(0.0, (t1 - t0) * 1e6)
+            else:  # never exited: the hung-collective shape
+                ev["ph"] = "i"
+                ev["s"] = "p"
+                ev["name"] = f"{ev['name']} (pending)"
+            events.append(ev)
+    for e in kept["journal"]:
+        ts = e.get("ts")
+        if ts is None:
+            continue
+        events.append({"name": e.get("event", "journal"),
+                       "ph": "i", "s": "g",
+                       "pid": e.get("rank", -1), "tid": "journal",
+                       "cat": "journal", "ts": ts * 1e6,
+                       "args": {k: v for k, v in e.items()
+                                if k not in ("event", "ts")}})
+    timed = [ev for ev in events if "ts" in ev]
+    if timed:
+        t_min = min(ev["ts"] for ev in timed)
+        for ev in timed:
+            ev["ts"] -= t_min
+    trace = {"traceEvents": events, "displayTimeUnit": "ms",
+             "generation": gen,
+             "ranks": ranks,
+             "stale_ranks": stale}
+    info = {"generation": gen, "ranks": ranks, "stale": stale,
+            "unaligned_ranks": unaligned, "events": len(events)}
+    return trace, info
+
+
+def summarize(trace):
+    """Per-phase per-rank totals from the merged timeline; names the
+    slowest rank per phase. Returns {phase: {"by_rank": {rank: ms},
+    "slowest_rank": r, "slowest_ms": ms}} plus a "step" entry with
+    per-rank step span counts/totals when step spans exist."""
+    per_phase = {}
+    steps = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        cat = ev.get("cat")
+        if cat == _PHASE_CAT:
+            ms = ev.get("dur", 0.0) / 1e3
+            by = per_phase.setdefault(ev["name"], {})
+            by[ev["pid"]] = by.get(ev["pid"], 0.0) + ms
+        elif cat == _STEP_CAT:
+            s = steps.setdefault(ev["pid"], {"count": 0, "total_ms": 0.0})
+            s["count"] += 1
+            s["total_ms"] += ev.get("dur", 0.0) / 1e3
+    out = {}
+    for phase, by in sorted(per_phase.items()):
+        slowest = max(by.items(), key=lambda kv: kv[1])
+        out[phase] = {"by_rank": by, "slowest_rank": slowest[0],
+                      "slowest_ms": slowest[1]}
+    if steps:
+        out["step"] = {
+            rank: {"count": s["count"], "total_ms": s["total_ms"],
+                   "mean_ms": s["total_ms"] / s["count"] if s["count"]
+                   else 0.0}
+            for rank, s in sorted(steps.items())}
+    return out
+
+
+def format_summary(info, summary):
+    lines = [f"generation {info['generation']}: ranks {info['ranks']}"
+             + ("; stale: " + ", ".join(
+                 f"rank {r} at generation {g}"
+                 for r, g in sorted(info["stale"].items()))
+                if info["stale"] else "")]
+    if info["unaligned_ranks"]:
+        lines.append(f"  unaligned (no wall-clock anchor, skipped): ranks "
+                     f"{info['unaligned_ranks']}")
+    step = summary.get("step")
+    if step:
+        for rank, s in step.items():
+            lines.append(f"  rank {rank}: {s['count']} steps, "
+                         f"mean {s['mean_ms']:.3f} ms")
+    phases = [(k, v) for k, v in summary.items() if k != "step"]
+    if phases:
+        lines.append(f"{'phase':<24}{'slowest':>10}{'ms':>12}  per-rank ms")
+        for phase, row in phases:
+            by = ", ".join(f"{r}={ms:.3f}"
+                           for r, ms in sorted(row["by_rank"].items()))
+            lines.append(f"{phase:<24}{'rank %d' % row['slowest_rank']:>10}"
+                         f"{row['slowest_ms']:>12.3f}  {by}")
+    else:
+        lines.append("no step-phase spans found (enable the profiler "
+                     "around the steps, then export_rank_trace)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge per-rank traces + flight dumps + journal onto "
+                    "one timeline")
+    ap.add_argument("inputs", nargs="+",
+                    help="artifact dir(s) or explicit files")
+    ap.add_argument("--out", default=None,
+                    help="merged chrome trace path (default: "
+                         "merged_trace.json beside the first input)")
+    ap.add_argument("--summary-only", action="store_true",
+                    help="print the summary without writing the merge")
+    ns = ap.parse_args(argv)
+    try:
+        inputs = load_inputs(ns.inputs)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"trace_merge: bad input: {e}", file=sys.stderr)
+        return 2
+    if not inputs["traces"] and not inputs["recorders"]:
+        print("trace_merge: no per-rank traces or flight-recorder dumps "
+              "found", file=sys.stderr)
+        return 2
+    trace, info = merge(inputs)
+    summary = summarize(trace)
+    if not ns.summary_only:
+        out = ns.out
+        if out is None:
+            first = ns.inputs[0]
+            d = first if os.path.isdir(first) else \
+                (os.path.dirname(first) or ".")
+            out = os.path.join(d, "merged_trace.json")
+        tmp = f"{out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(trace, f)
+        os.replace(tmp, out)
+        print(f"merged {info['events']} events -> {out}")
+    print(format_summary(info, summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
