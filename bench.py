@@ -110,6 +110,46 @@ _LAST_CURVE = {}  # model-name -> per-step loss curve of the last timed run
 _LAST_SPE = {}    # model-name -> steps-per-execution the curve was run with
 _LAST_DISTINCT = {}  # model-name -> number of DISTINCT batches in the run
 _LAST_BREAKDOWN = {}  # model-name -> step_breakdown block (phase attribution)
+_LAST_CKPT_STALL = {}  # ckpt_stall_ms block (zero-stall checkpointing)
+
+
+def _bench_ckpt_stall(model, opt):
+    """Measure the blocking cost of one checkpoint save, sync vs async
+    (resilience/snapshot.py zero-stall contract): sync pays serialize +
+    sha256 + fsync in the foreground; async pays only the device→host
+    snapshot, with the rest on the committer thread. Records
+    ``extra.ckpt_stall_ms`` (the async blocking portion — the number the
+    train loop actually stalls for, gated lower-is-better by
+    tools/check_bench_regression.py) plus the sync wall and the ratio as
+    context."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from paddle_tpu.resilience.snapshot import AsyncCheckpointer
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        files = {"bench.pdparams": (model.state_dict(), "model"),
+                 "bench.pdopt": (opt.state_dict(), "optimizer")}
+        ck = AsyncCheckpointer(root, keep=2, background=True)
+        t0 = _time.perf_counter()
+        ck.save(files, step=0, blocking=True)
+        sync_ms = (_time.perf_counter() - t0) * 1e3
+        t0 = _time.perf_counter()
+        ck.save(files, step=1, blocking=False)
+        async_ms = (_time.perf_counter() - t0) * 1e3
+        errs = ck.flush(timeout=120.0)
+        ck.close()
+        if errs:
+            raise errs[0][1]
+        _LAST_CKPT_STALL.update({
+            "ckpt_stall_ms": round(async_ms, 3),
+            "ckpt_stall_sync_ms": round(sync_ms, 3),
+            "ckpt_stall_ratio": round(async_ms / sync_ms, 4)
+            if sync_ms else 0.0,
+        })
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def _capture_breakdown(curve_key, st, dt):
@@ -376,6 +416,14 @@ def bench_bert(arch=None, short=False):
     # over spe=16 on v5e)
     key = arch or "bert"
     dt = _timed_steps(step, data, steps, curve_key=key, spe_default=64)
+    if not short and arch is None:
+        # checkpoint-stall evidence rides the flagship lane only (one
+        # measurement per artifact; failures report, never mask throughput)
+        try:
+            _bench_ckpt_stall(model, opt)
+        except Exception as e:
+            sys.stderr.write(f"ckpt stall bench failed: {e!r}\n")
+            _LAST_CKPT_STALL["ckpt_stall_error"] = repr(e)[:200]
     tokens = batch * seq * steps
     tps = tokens / dt
     fpt = _transformer_flops_per_token(
@@ -808,6 +856,10 @@ def main():
         # tools/check_bench_regression.py
         result.setdefault("extra", {})["step_breakdown"] = \
             dict(_LAST_BREAKDOWN)
+    if _LAST_CKPT_STALL:
+        # blocking portion of one checkpoint save (zero-stall contract) —
+        # gated lower-is-better alongside the phase gates
+        result.setdefault("extra", {}).update(_LAST_CKPT_STALL)
     if _LAST_CURVE and os.environ.get("BENCH_LOSS_CURVES", "1") != "0":
         # loss-curve evidence (BASELINE "loss parity"; precision-regime
         # parity is asserted in tests/test_loss_parity.py — these are the

@@ -92,6 +92,18 @@ def save_hybrid_checkpoint(path, model, optimizer=None, meta=None):
         blob["optimizer"] = {
             k: (np.asarray(t._val) if isinstance(t, Tensor) else t)
             for k, t in opt.state_dict().items()}
+    from ..framework.flags import get_flag
+    if get_flag("FLAGS_async_checkpoint", False):
+        # zero-stall path (resilience/snapshot.py): the gather above was the
+        # whole foreground cost — serialization + sha256 + the atomic
+        # manifest commit happen on the background committer, and load
+        # discovers the result through the manifest
+        from ..resilience import snapshot as _snapshot
+        ck = _snapshot.checkpointer_for(
+            os.path.dirname(os.path.abspath(path)) or ".")
+        ck.save({os.path.basename(path): (blob, "blob")},
+                step=meta.get("step"), meta={"tag": os.path.basename(path)})
+        return path
     # retain the previous snapshot (+ its sidecar) as the corruption
     # fallback: load falls back to `.old` and journals `corrupt_restore`
     # when the current file fails its sha256 — same discipline as
@@ -142,13 +154,31 @@ def reshard_model(model):
 def load_hybrid_checkpoint(path, model, optimizer=None):
     """Load a canonical checkpoint and re-place it on the current mesh.
 
-    The file is verified against the sha256 sidecar written at save time; a
-    mismatch (or unreadable pickle, or a current file lost to a crash
-    between the two save-time renames) falls back to the retained ``.old``
-    snapshot — itself verified — and journals a ``corrupt_restore`` cause
-    instead of silently loading garbage. The returned meta then carries
-    ``restored_from_fallback: True``.
+    ``path`` may be a checkpoint ROOT DIRECTORY (or a single manifest file):
+    restore then discovers the newest committed manifest, verifies every
+    referenced file against its recorded digest, and falls back across
+    older manifests and then legacy ``.old`` blobs — journaling a
+    ``corrupt_restore`` cause per skipped candidate (resilience/snapshot.py
+    layout; docs/resilience.md §Checkpointing).
+
+    A plain file path keeps the original contract: verified against the
+    sha256 sidecar written at save time; a mismatch (or unreadable pickle,
+    or a current file lost to a crash between the two save-time renames)
+    falls back to the retained ``.old`` snapshot — itself verified — and
+    journals a ``corrupt_restore`` cause instead of silently loading
+    garbage. The returned meta then carries ``restored_from_fallback:
+    True``.
     """
+    from ..resilience import snapshot as _snapshot
+    if os.path.isdir(path) or \
+            _snapshot.MANIFEST_RE.match(os.path.basename(path)):
+        blob, src = _snapshot.load_blob(path)
+        meta = _apply_blob(blob, model, optimizer)
+        ts = blob.get("train_state")
+        if ts:
+            _snapshot.restore_train_state(ts)
+        meta.setdefault("restored_from", src)
+        return meta
     try:
         blob = _load_verified(path)
     except (CorruptCheckpointError, FileNotFoundError) as e:
@@ -163,6 +193,13 @@ def load_hybrid_checkpoint(path, model, optimizer=None):
             pass  # journaling is best-effort on the failure path
         blob = _load_verified(old)
         blob.setdefault("meta", {})["restored_from_fallback"] = True
+    return _apply_blob(blob, model, optimizer)
+
+
+def _apply_blob(blob, model, optimizer=None):
+    """Apply a restored blob ({model, optimizer?, meta?}) to the live
+    model/optimizer with shape checks and current-mesh re-placement;
+    returns the blob's meta."""
     inner, _ = _unwrap_model(model)
     sd = inner.state_dict()
     saved = blob["model"]

@@ -91,6 +91,20 @@ _FLAGS: dict[str, Any] = {
     # rotate the recovery journal past this size, keeping two segments;
     # 0 = unbounded
     "FLAGS_journal_max_bytes": 1 << 20,
+    # zero-stall checkpointing (resilience/snapshot.py, docs/resilience.md):
+    # route hapi Model.save / ModelCheckpoint / save_hybrid_checkpoint
+    # through the AsyncCheckpointer — foreground cost is only the
+    # device→host snapshot; serialize + sha256 + atomic manifest commit run
+    # on the background committer thread. Off = sync fallback (everything
+    # in the foreground, errors raise at the call site).
+    "FLAGS_async_checkpoint": False,
+    # keep-last-K manifest retention (per checkpoint root); the newest
+    # committed manifest and every file it references are never deleted.
+    # 0 = keep everything.
+    "FLAGS_ckpt_keep": 3,
+    # bound on waiting for pending background commits at preemption /
+    # recovery-restore time (seconds)
+    "FLAGS_ckpt_flush_timeout": 60.0,
     # observability (paddle_tpu/profiler/{metrics,steptimer}.py,
     # docs/observability.md): step-phase attribution master switch
     "FLAGS_steptimer": True,

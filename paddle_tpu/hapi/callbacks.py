@@ -101,18 +101,35 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
+    """Epoch-end checkpoints through the SAME hardened entry as Model.save
+    (resilience.snapshot.save_model): sha256 sidecars, a generation-stamped
+    manifest commit, and the FLAGS_async_checkpoint background committer —
+    so a callback-driven checkpoint is restorable by RecoveryManager, not
+    just reloadable when every byte happens to be intact."""
+
     def __init__(self, save_freq=1, save_dir=None):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
 
+    def _save(self, tag):
+        path = f"{self.save_dir}/{tag}"
+        save = getattr(self.model, "save", None)
+        if callable(save):
+            save(path)  # Model.save routes through snapshot.save_model
+        else:
+            # bare-Layer fallback: still the hardened path, never raw pickle
+            from ..resilience.snapshot import save_model
+            save_model(getattr(self.model, "network", self.model),
+                       getattr(self.model, "_optimizer", None), path)
+
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and epoch % self.save_freq == 0:
-            self.model.save(f"{self.save_dir}/{epoch}")
+            self._save(str(epoch))
 
     def on_train_end(self, logs=None):
         if self.save_dir:
-            self.model.save(f"{self.save_dir}/final")
+            self._save("final")
 
 
 class LRScheduler(Callback):

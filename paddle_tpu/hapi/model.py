@@ -172,6 +172,9 @@ class Model:
         from .callbacks import CallbackList, ProgBarLogger
         loader = self._make_loader(train_data, batch_size, shuffle, drop_last,
                                    num_workers)
+        # exact-resume contract (resilience/snapshot.py): save() captures
+        # this loader's cursor so a restored run replays no batch
+        self._active_loader = loader
         cb_list = _to_list(callbacks) or [ProgBarLogger(log_freq, verbose)]
         # preemption contract (docs/resilience.md): when a handler is
         # installed, fit polls it after every batch and stops resumable
@@ -382,11 +385,19 @@ class Model:
 
     # -- persistence ------------------------------------------------------------
     def save(self, path, training=True):
-        from ..framework.io_utils import save as _save
+        """Hardened save: routes through resilience.snapshot.save_model —
+        sha256 sidecars plus a generation-stamped manifest commit, so a
+        callback- or fit-driven checkpoint is restorable by RecoveryManager.
+        Under FLAGS_async_checkpoint serialization moves to the background
+        committer, and step/ckpt_io times only the blocking device→host
+        snapshot; the sync fallback keeps the old all-in-foreground cost."""
+        from ..resilience.snapshot import capture_train_state, save_model
         with _steptimer.get_steptimer().phase("step/ckpt_io"):
-            _save(self.network.state_dict(), path + ".pdparams")
-            if training and self._optimizer is not None:
-                _save(self._optimizer.state_dict(), path + ".pdopt")
+            save_model(
+                self.network,
+                self._optimizer if training else None, path,
+                train_state=capture_train_state(
+                    loader=getattr(self, "_active_loader", None)))
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         import os

@@ -172,8 +172,62 @@ class CheckpointSaver:
             self._fs.mv(old, self._path)
             return state, meta
 
-    def clean_redundant_epochs(self):
-        pass  # single rolling snapshot — nothing to clean
+    def clean_redundant_epochs(self, keep=1):
+        """Retention GC for the snapshot family rooted at ``self._path``.
+
+        Deletable: leftover ``.tmp*`` staging dirs (a crash mid-swap strands
+        them) and numbered ``.e<N>`` epoch archives beyond the newest
+        ``keep``. NEVER deletable: the live snapshot, the ``.old`` crash/
+        corruption fallback, and anything referenced by a committed
+        AsyncCheckpointer manifest in the same directory
+        (``snapshot.protected_files``). ``fs.remove`` failures are counted
+        into ``ckpt.gc_failures_total`` — GC is advisory; a failed delete
+        must never take down a save path (metrics-registry pattern,
+        docs/resilience.md)."""
+        import re
+
+        root = os.path.dirname(self._path) or "."
+        base = os.path.basename(self._path)
+        try:
+            _dirs, _files = self._fs.ls_dir(root)
+            entries = list(_dirs) + list(_files)
+        except Exception:
+            return 0
+        protected = {self._path, self._path + ".old"}
+        try:
+            from ..resilience import snapshot as _snapshot
+            protected |= _snapshot.protected_files(root)
+        except Exception:
+            pass
+
+        epoch_re = re.compile(re.escape(base) + r"\.e(\d+)$")
+        epochs = []   # (epoch_no, abspath)
+        doomed = []
+        for name in entries:
+            full = os.path.join(root, name)
+            m = epoch_re.match(name)
+            if m:
+                epochs.append((int(m.group(1)), full))
+            elif name.startswith(base + ".tmp"):
+                doomed.append(full)
+        epochs.sort(reverse=True)
+        doomed.extend(p for _, p in epochs[max(0, int(keep)):])
+
+        removed = 0
+        for full in doomed:
+            if full in protected or full.endswith(".old"):
+                continue
+            try:
+                maybe_inject("fs.remove", OSError)
+                self._fs.delete(full)
+                removed += 1
+            except OSError:
+                try:
+                    from ..profiler.metrics import get_registry
+                    get_registry().inc_counter("ckpt.gc_failures_total")
+                except Exception:
+                    pass
+        return removed
 
 
 class TrainEpochRange:
@@ -218,6 +272,9 @@ class TrainEpochRange:
         if extra:
             meta.update(extra)
         self._saver.save_checkpoint(state, meta)
+        # retention: sweep stranded staging dirs / stale epoch archives
+        # after every successful save (failures counted, never raised)
+        self._saver.clean_redundant_epochs()
 
     def next(self):
         from ..resilience import preempt

@@ -402,6 +402,12 @@ class DataLoader:
         self.prefetch_factor = max(2, prefetch_factor)
         self._pool = None  # persistent spawn pool (persistent_workers=True)
         self._iterable_ds = isinstance(dataset, IterableDataset)
+        # resumable io cursor (resilience/snapshot.py exact-resume
+        # contract): batches handed out this epoch pass, and a pending
+        # fast-forward armed by set_state_dict
+        self._epoch_batches = 0
+        self._resume_skip = 0
+        self._sampler_epoch = None
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
             self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
@@ -420,32 +426,74 @@ class DataLoader:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
 
-    def _iter_batches(self):
+    def _iter_batches(self, skip=0):
         if self._iterable_ds:
+            done = 0
             batch = []
             for item in self.dataset:
                 batch.append(item)
                 if len(batch) == self.batch_size:
-                    yield self.collate_fn(batch)
+                    if done >= skip:
+                        yield self.collate_fn(batch)
+                    done += 1
                     batch = []
-            if batch and not getattr(self, "drop_last", False):
+            if batch and not getattr(self, "drop_last", False) \
+                    and done >= skip:
                 yield self.collate_fn(batch)
         else:
-            for indices in self.batch_sampler:
+            for k, indices in enumerate(self.batch_sampler):
+                if k < skip:
+                    continue  # cursor fast-forward: no dataset fetch
                 samples = [self.dataset[i] for i in indices]
                 yield self.collate_fn(samples)
 
+    # -- resumable cursor (resilience/snapshot.py exact-resume contract) ----
+    def state_dict(self):
+        """Cursor: batches handed out in the current epoch pass, plus the
+        sampler epoch that seeded their order. Captured by
+        ``snapshot.capture_train_state`` at every hardened save."""
+        return {"batches_consumed": int(self._epoch_batches),
+                "epoch": self._sampler_epoch}
+
+    def set_state_dict(self, state):
+        """Arm the NEXT iteration to fast-forward past already-consumed
+        batches (sampler-order skip — the skipped prefix costs no dataset
+        fetch on the num_workers=0 path) so a restored run replays no batch
+        and skips none. Exact order recovery needs a deterministic or
+        epoch-seeded sampler (SequenceSampler, DistributedBatchSampler);
+        RandomSampler draws from the global numpy RNG and cannot replay a
+        half-consumed permutation."""
+        state = state or {}
+        self._resume_skip = int(state.get("batches_consumed") or 0)
+        ep = state.get("epoch")
+        if ep is not None and hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(int(ep))
+
     def __iter__(self):
+        skip, self._resume_skip = self._resume_skip, 0
+        # captured BEFORE the sampler iterates (DistributedBatchSampler
+        # bumps .epoch inside __iter__): this value reproduces the order
+        self._sampler_epoch = getattr(self.batch_sampler, "epoch", None)
+        self._epoch_batches = skip
+        for batch in self._raw_iter(skip):
+            # incremented before the yield returns control: the cursor
+            # counts batches whose effects a step-boundary save has seen
+            self._epoch_batches += 1
+            yield batch
+
+    def _raw_iter(self, skip=0):
         if self.num_workers == 0:
-            yield from self._iter_batches()
+            yield from self._iter_batches(skip)
             return
-        if self._iterable_ds:
-            yield from self._iter_single_producer()
-            return
-        if self.use_multiprocess:
-            yield from self._iter_process_pool()
-            return
-        yield from self._iter_worker_pool()
+        gen = (self._iter_single_producer() if self._iterable_ds
+               else self._iter_process_pool() if self.use_multiprocess
+               else self._iter_worker_pool())
+        # worker pools have no index-level fast-forward: batches before the
+        # cursor are fetched and discarded (correct, just not free)
+        for k, batch in enumerate(gen):
+            if k < skip:
+                continue
+            yield batch
 
     def _iter_worker_pool(self):
         """num_workers fetch+collate batches concurrently with a bounded

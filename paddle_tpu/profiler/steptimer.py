@@ -8,7 +8,10 @@ A :class:`StepTimer` splits each step into named phases —
 - ``step/collective_wait`` — eager collective tail (the watch_section wrap
   points in distributed/collective.py);
 - ``step/optimizer``    — optimizer work outside the compiled step;
-- ``step/ckpt_io``      — checkpoint save/restore;
+- ``step/ckpt_io``      — the BLOCKING portion of checkpoint save/restore
+  only: under ``FLAGS_async_checkpoint`` that is the device→host snapshot
+  (serialize/sha256/commit run on the background committer and show up in
+  the ``ckpt.commit_ms`` metric, not here);
 - ``step/integrity``    — SDC consensus checks (resilience/integrity.py).
 
 Phases nest: a child's wall time is subtracted from its parent's SELF time

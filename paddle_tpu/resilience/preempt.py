@@ -96,6 +96,18 @@ class PreemptionHandler:
                 return []
             self._drained = True
             failures = []
+            # zero-stall checkpoint contract (docs/resilience.md): any
+            # in-flight background manifest commit lands BEFORE the
+            # emergency save, so the grace-window snapshot never races or
+            # orphans a pending commit
+            try:
+                from ..framework.flags import get_flag
+                from . import snapshot as _snapshot
+                for mpath, err in _snapshot.flush_all(
+                        timeout=get_flag("FLAGS_ckpt_flush_timeout", 60.0)):
+                    failures.append((f"ckpt_flush:{mpath}", err))
+            except Exception as e:  # noqa: BLE001 — exit path must survive
+                failures.append(("ckpt_flush", e))
             for name, fn in self._actions:
                 try:
                     fn()
@@ -177,6 +189,13 @@ class PreemptionCallback:
             return
         self.triggered = True
         if self.save_path is not None and self.model is not None:
+            try:
+                # older pending commits land before the emergency save so
+                # the manifest sequence stays ordered under preemption
+                from . import snapshot as _snapshot
+                _snapshot.flush_all()
+            except Exception:
+                pass
             self.model.save(self.save_path)
         h.drain()
         if self.model is not None:

@@ -419,6 +419,17 @@ class RecoveryManager:
             os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
         if self.preflight is not None:
             self.preflight(gen)
+        # zero-stall checkpointing: restore discovers the newest COMMITTED
+        # manifest (snapshot.load_blob via load_hybrid_checkpoint), so any
+        # commit still in flight on our own background committer must land
+        # (or fail into the journal) before the hook looks
+        try:
+            from . import snapshot as _snapshot
+            from ..framework.flags import get_flag
+            _snapshot.flush_all(
+                timeout=get_flag("FLAGS_ckpt_flush_timeout", 60.0))
+        except Exception:
+            pass  # a wedged committer must not block recovery
         resume = self.restore(gen) if self.restore is not None else None
         record = dict(restart=self.restarts, cause=cause_name,
                       detail=str(cause or ""), generation=gen,

@@ -1,0 +1,749 @@
+"""Zero-stall checkpointing: async snapshot → manifest commit → exact resume.
+
+Every robustness layer (elastic recovery, SDC quarantine, preemption) bottoms
+out in "restore from the last good checkpoint", but a synchronous save blocks
+the train loop for the whole serialize+fsync and restore cannot prove a
+checkpoint was *completely* written — only that individual files match their
+sidecars. This module closes both gaps:
+
+- :class:`AsyncCheckpointer` — the only foreground cost of a save is a
+  blocking device→host copy of the state (site ``ckpt.snapshot``, metric
+  ``ckpt.snapshot_ms``, timed under the ``step/ckpt_io`` phase by callers).
+  Serialization, per-file sha256 sidecars, and the final rename run on a
+  background committer thread (sites ``ckpt.serialize`` / ``ckpt.commit``,
+  metric ``ckpt.commit_ms``; queue depth is the ``ckpt.pending_count``
+  gauge).
+- **Manifest commit point** — each save stages its data files under a
+  per-commit ``data-<seq>/`` directory (so it can never clobber a file an
+  earlier manifest references), then commits by atomically renaming
+  ``manifest-<seq>.json`` into place; only after the commit are the legacy
+  top-level names (``<tag>.pdparams`` …, what ``Model.load`` reads)
+  republished as copies. A torn or killed commit therefore leaves the
+  previous *checkpoint* — manifest and data — untouched, so "newest
+  committed manifest" is the single source of truth for restore. The
+  ``ckpt.commit`` fault site fires at *every* file boundary, including
+  between the last data file and the manifest rename, so the chaos suite can
+  kill a commit at any point and assert restore lands on the previous
+  manifest.
+- **Exact resume** — :func:`capture_train_state` snapshots the global RNG
+  (and numpy's), plus the io-pipeline cursor of a resumable
+  :class:`~paddle_tpu.io.DataLoader`; :func:`restore_train_state` re-arms
+  them so a mid-epoch kill + restore replays no batch and skips none (loss
+  curve bit-identical to an uninterrupted run — tests/test_snapshot.py).
+- **Keep-last-K retention** — :meth:`AsyncCheckpointer.gc` deletes manifests
+  beyond ``FLAGS_ckpt_keep`` and their now-unreferenced files, but never the
+  newest committed manifest, never a file a kept manifest references, and
+  never a ``.old`` corruption fallback. Removal failures are counted
+  (``ckpt.gc_failures_total``), not raised.
+
+Wiring (docs/resilience.md §Checkpointing): ``hapi.Model.save`` and
+``ModelCheckpoint`` route through :func:`save_model`
+(``FLAGS_async_checkpoint`` picks async; sync stays the fallback), the
+SIGTERM preempt path calls :func:`flush_all` before the emergency save, and
+``RecoveryManager.restart`` / ``load_hybrid_checkpoint`` discover
+checkpoints through :func:`load_blob` — falling back across manifests, then
+legacy ``.old`` blobs, journaling a ``corrupt_restore`` cause per skip.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+import weakref
+from collections import deque
+
+__all__ = [
+    "AsyncCheckpointer", "CheckpointCommitError", "capture_train_state",
+    "restore_train_state", "save_model", "checkpointer_for", "flush_all",
+    "list_manifests", "read_manifest", "verify_manifest", "load_blob",
+    "protected_files", "serialize_file",
+]
+
+MANIFEST_RE = re.compile(r"^manifest-(\d+)\.json$")
+DATA_DIR_RE = re.compile(r"^data-(\d+)$")
+
+
+def _data_dir(seq):
+    return f"data-{seq:010d}"
+
+
+class CheckpointCommitError(RuntimeError):
+    """A snapshot/serialize/commit stage failed; for async saves this is
+    recorded and surfaced by :meth:`AsyncCheckpointer.flush`, never raised
+    into the train loop."""
+
+
+def _registry():
+    from ..profiler.metrics import get_registry
+    return get_registry()
+
+
+def _journal():
+    from .recovery import get_journal
+    return get_journal()
+
+
+def _sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def host_snapshot(obj):
+    """Blocking device→host copy of a (nested) state structure: Tensors
+    become their numpy-serializable form, so the background committer reads
+    no live training state (the train loop may mutate params while the
+    commit is in flight)."""
+    from ..framework.io_utils import _to_serializable
+    return _to_serializable(obj)
+
+
+def serialize_file(payload, path):
+    """Serialize one already-host-side payload to ``path`` (tmp +
+    ``os.replace``) plus a ``.sha256`` sidecar; returns (digest, bytes).
+    Fault site ``ckpt.serialize``."""
+    import pickle
+
+    from .faults import maybe_inject
+    maybe_inject("ckpt.serialize", CheckpointCommitError)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+        f.flush()
+        os.fsync(f.fileno())
+    digest = _sha256_file(tmp)
+    nbytes = os.path.getsize(tmp)
+    os.replace(tmp, path)
+    stmp = f"{path}.sha256.tmp.{os.getpid()}"
+    with open(stmp, "w") as f:
+        f.write(digest + "\n")
+    os.replace(stmp, path + ".sha256")
+    return digest, nbytes
+
+
+def _publish_alias(src, dst):
+    """Republish a committed staged file (+ sidecar) at its legacy
+    top-level name — the path ``Model.load`` and the pre-manifest restore
+    tooling read. Runs strictly after the manifest rename, so a kill here
+    leaves the aliases at the previous, still-complete checkpoint."""
+    import shutil
+    tmp = f"{dst}.tmp.{os.getpid()}"
+    shutil.copyfile(src, tmp)
+    os.replace(tmp, dst)
+    side = src + ".sha256"
+    if os.path.exists(side):
+        stmp = f"{dst}.sha256.tmp.{os.getpid()}"
+        shutil.copyfile(side, stmp)
+        os.replace(stmp, dst + ".sha256")
+
+
+# -- train-state capture (exact resume) --------------------------------------
+
+def capture_train_state(loader=None, extra=None):
+    """Snapshot everything exact resume needs beyond the model/optimizer:
+    the framework RNG key, numpy's global RNG, and (when given a resumable
+    DataLoader) the io-pipeline cursor. Cheap host-side copies only."""
+    import numpy as np
+
+    from ..core import random as _random
+    state = {"rng": np.asarray(_random.default_generator.get_state()._value),
+             "numpy_rng": np.random.get_state()}
+    if loader is not None and hasattr(loader, "state_dict"):
+        state["cursor"] = loader.state_dict()
+    if extra:
+        state["extra"] = dict(extra)
+    return state
+
+
+def restore_train_state(state, loader=None):
+    """Re-arm the global RNGs (and a DataLoader's cursor) from a restored
+    train-state payload; returns the cursor dict (or None)."""
+    import numpy as np
+
+    from ..core import random as _random
+    from ..core.tensor import Tensor
+    rng = state.get("rng")
+    if rng is not None:
+        rng = rng._value if isinstance(rng, Tensor) else np.asarray(rng)
+        _random.default_generator.set_state(Tensor(rng, stop_gradient=True))
+    np_state = state.get("numpy_rng")
+    if np_state is not None:
+        np.random.set_state(tuple(np_state))
+    cursor = state.get("cursor")
+    if loader is not None and cursor is not None \
+            and hasattr(loader, "set_state_dict"):
+        loader.set_state_dict(cursor)
+    return cursor
+
+
+# -- manifest layer ----------------------------------------------------------
+
+def list_manifests(root):
+    """Committed manifests under ``root`` as [(seq, path)], newest first."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        m = MANIFEST_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, n)))
+    out.sort(reverse=True)
+    return out
+
+
+def read_manifest(path):
+    try:
+        with open(path) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCommitError(f"{path}: unreadable manifest: {e}")
+    if not isinstance(man.get("files"), dict):
+        raise CheckpointCommitError(f"{path}: manifest has no files map")
+    return man
+
+
+def verify_manifest(path, manifest=None):
+    """Check every file the manifest references against its recorded
+    digest; returns the manifest dict, raises :class:`CheckpointCommitError`
+    naming the first damaged file."""
+    root = os.path.dirname(os.path.abspath(path))
+    man = manifest if manifest is not None else read_manifest(path)
+    for rel, info in sorted(man["files"].items()):
+        fp = os.path.join(root, rel)
+        if not os.path.exists(fp):
+            raise CheckpointCommitError(
+                f"{path}: referenced file missing: {rel}")
+        got = _sha256_file(fp)
+        want = info.get("sha256")
+        if want and got != want:
+            raise CheckpointCommitError(
+                f"{path}: {rel}: sha256 mismatch "
+                f"(got {got[:12]}, recorded {want[:12]})")
+    return man
+
+
+def protected_files(root):
+    """Absolute paths of every committed manifest under ``root``, every
+    file (+ sidecar) it references, and the top-level legacy alias of each —
+    the never-delete set shared by the retention GCs here and in
+    ``incubate.CheckpointSaver``."""
+    out = set()
+    for _, mp in list_manifests(root):
+        out.add(os.path.abspath(mp))
+        try:
+            man = read_manifest(mp)
+        except CheckpointCommitError:
+            continue
+        for rel in man["files"]:
+            for p in (os.path.abspath(os.path.join(root, rel)),
+                      os.path.abspath(
+                          os.path.join(root, os.path.basename(rel)))):
+                out.add(p)
+                out.add(p + ".sha256")
+    return out
+
+
+def _blob_from_manifest(mpath, man):
+    """Assemble a hybrid-checkpoint-shaped blob ({model, optimizer, meta,
+    train_state}) from a verified manifest's files."""
+    from ..framework.io_utils import load as load_obj
+    root = os.path.dirname(os.path.abspath(mpath))
+    blob = {"meta": dict(man.get("meta") or {})}
+    blob["meta"].setdefault("step", man.get("step"))
+    for rel, info in sorted(man["files"].items()):
+        kind = info.get("kind")
+        obj = load_obj(os.path.join(root, rel))
+        if kind == "blob" and isinstance(obj, dict):
+            # whole hybrid blob stored as one file: merge, manifest meta wins
+            meta = blob["meta"]
+            blob.update(obj)
+            merged = dict(blob.get("meta") or {})
+            merged.update(meta)
+            blob["meta"] = merged
+        elif kind in ("model", "optimizer", "train_state"):
+            blob[kind] = obj
+    return blob
+
+
+def load_blob(path, journal=None):
+    """Manifest-discovery restore. ``path`` is a checkpoint root directory
+    (or one manifest file to start from). Walks committed manifests newest →
+    oldest verifying every referenced file; each rejected manifest journals
+    a ``corrupt_restore`` cause and falls back to the next. When every
+    manifest is exhausted, legacy ``*.old`` single-file blobs in the root
+    are tried (newest mtime first, same journaling). Returns
+    ``(blob, manifest_path)``; raises FileNotFoundError when nothing under
+    the root restores."""
+    if os.path.isdir(path):
+        root, start_seq = path, None
+    else:
+        root = os.path.dirname(os.path.abspath(path))
+        m = MANIFEST_RE.match(os.path.basename(path))
+        start_seq = int(m.group(1)) if m else None
+    if journal is None:
+        try:
+            journal = _journal()
+        except Exception:
+            journal = None
+
+    def _skip(p, err):
+        if journal is not None:
+            try:
+                journal.record("corrupt_restore", path=p, detail=str(err),
+                               fallback="next manifest/.old")
+            except Exception:
+                pass  # journaling is best-effort on the failure path
+
+    candidates = [(s, p) for s, p in list_manifests(root)
+                  if start_seq is None or s <= start_seq]
+    for _, mp in candidates:
+        try:
+            man = verify_manifest(mp)
+            return _blob_from_manifest(mp, man), mp
+        except CheckpointCommitError as e:
+            _skip(mp, e)
+    # legacy fallback: `.old` blobs retained by the pre-manifest savers
+    olds = []
+    try:
+        for n in os.listdir(root):
+            p = os.path.join(root, n)
+            if n.endswith(".old") and os.path.isfile(p):
+                olds.append((os.path.getmtime(p), p))
+    except OSError:
+        pass
+    for _, p in sorted(olds, reverse=True):
+        try:
+            from ..distributed.checkpoint import _load_verified
+            blob = _load_verified(p)
+            if isinstance(blob, dict) and "model" in blob:
+                blob.setdefault("meta", {})["restored_from_fallback"] = True
+                return blob, p
+        except Exception as e:
+            _skip(p, e)
+    raise FileNotFoundError(
+        f"{root}: no committed manifest or readable .old fallback")
+
+
+# -- the async checkpointer --------------------------------------------------
+
+_LIVE = weakref.WeakSet()
+
+
+class AsyncCheckpointer:
+    """Background-committed, manifest-atomic checkpointer over one root
+    directory.
+
+    :meth:`save` does the only foreground work — the device→host snapshot —
+    and enqueues a commit job. The committer (a daemon thread when
+    ``background=True``, inline otherwise) serializes each payload with a
+    sha256 sidecar and then commits by atomically renaming
+    ``manifest-<seq>.json`` into place; a torn commit leaves the previous
+    manifest untouched. Async commit failures never raise into the train
+    loop: they are counted (``ckpt.commit_failures_total``), journaled
+    (``ckpt_commit_failed``) and returned by :meth:`flush`.
+    """
+
+    def __init__(self, root, keep=None, background=True, journal=None):
+        from ..framework.flags import get_flag
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.keep = int(get_flag("FLAGS_ckpt_keep", 3)
+                        if keep is None else keep)
+        self.background = bool(background)
+        self._journal_obj = journal
+        self._cv = threading.Condition()
+        self._queue = deque()
+        self._inflight = 0
+        self._errors = []
+        self._staging = set()  # seqs mid-commit: the orphan sweep skips them
+        self._closed = False
+        self._thread = None
+        self._seq = max([s for s, _ in list_manifests(self.root)], default=0)
+        _LIVE.add(self)
+
+    # -- foreground --------------------------------------------------------
+    def save(self, files, step=None, meta=None, blocking=False):
+        """Snapshot + enqueue one checkpoint.
+
+        ``files`` maps relpath (under root) → payload or (payload, kind);
+        kind defaults from the extension (``.pdparams`` → model, ``.pdopt``
+        → optimizer, ``.pdstate`` → train_state). The device→host copy
+        happens HERE (fault site ``ckpt.snapshot``, metric
+        ``ckpt.snapshot_ms``); with ``blocking=True`` (the sync fallback)
+        the commit also runs inline and raises on failure. Returns the
+        manifest path this save commits (present once the commit lands)."""
+        from .faults import maybe_inject
+        if self._closed:
+            raise CheckpointCommitError(f"{self.root}: checkpointer closed")
+        t0 = time.perf_counter()
+        maybe_inject("ckpt.snapshot", CheckpointCommitError)
+        job_files = []
+        for rel, val in files.items():
+            payload, kind = val if isinstance(val, tuple) \
+                else (val, _kind_of(rel))
+            job_files.append((rel, host_snapshot(payload), kind))
+        with self._cv:
+            self._seq += 1
+            seq = self._seq
+        man_meta = dict(meta or {})
+        from .recovery import current_generation
+        gen = current_generation()
+        if gen and "generation" not in man_meta:
+            man_meta["generation"] = gen
+        job = {"seq": seq,
+               "step": int(seq if step is None else step),
+               "meta": man_meta, "files": job_files}
+        _registry().observe("ckpt.snapshot_ms",
+                            (time.perf_counter() - t0) * 1e3)
+        if blocking or not self.background:
+            with self._cv:
+                self._inflight += 1
+            self._set_pending()
+            try:
+                if blocking:
+                    return self._commit(job)
+                try:  # inline but async-semantics: record, don't raise
+                    return self._commit(job)
+                except Exception as e:  # noqa: BLE001
+                    self._note_failure(job, e)
+                    return self._manifest_path(seq)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+                self._set_pending()
+        with self._cv:
+            self._queue.append(job)
+            self._ensure_committer()
+            self._cv.notify_all()
+        self._set_pending()
+        return self._manifest_path(seq)
+
+    # -- background committer ----------------------------------------------
+    def _ensure_committer(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="ckpt-committer", daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue and self._closed:
+                    return
+                job = self._queue.popleft()
+                self._inflight += 1
+            self._set_pending()
+            try:
+                self._commit(job)
+            except Exception as e:  # noqa: BLE001 — must not kill the thread
+                self._note_failure(job, e)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+                self._set_pending()
+
+    def _commit(self, job):
+        """Stage every data file under ``data-<seq>/`` (a new save must
+        never clobber a file an earlier manifest references), then
+        atomically rename the manifest — THE commit point. Fault site
+        ``ckpt.commit`` fires at every file boundary: before each data file
+        and once more between the last data file and the manifest rename,
+        so a chaos kill can land anywhere and restore must still find the
+        previous committed manifest with its data intact. Only after the
+        commit are the legacy top-level names republished (what
+        ``Model.load`` reads — a kill between commit and republish leaves
+        them at the previous, still-complete checkpoint)."""
+        from .faults import maybe_inject
+        t0 = time.perf_counter()
+        seq = job["seq"]
+        with self._cv:
+            self._staging.add(seq)
+        try:
+            entries = {}
+            aliases = []
+            for rel, payload, kind in job["files"]:
+                maybe_inject("ckpt.commit", CheckpointCommitError)
+                prel = f"{_data_dir(seq)}/{rel}"
+                digest, nbytes = serialize_file(
+                    payload, os.path.join(self.root, prel))
+                entries[prel] = {"sha256": digest, "bytes": nbytes,
+                                 "kind": kind}
+                aliases.append((prel, rel))
+            maybe_inject("ckpt.commit", CheckpointCommitError)
+            man = {"version": 1, "seq": seq, "step": job["step"],
+                   "ts": time.time(), "meta": job["meta"], "files": entries}
+            mpath = self._manifest_path(seq)
+            tmp = f"{mpath}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(man, f, indent=1, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, mpath)
+            for prel, rel in aliases:
+                _publish_alias(os.path.join(self.root, prel),
+                               os.path.join(self.root, rel))
+            _registry().observe("ckpt.commit_ms",
+                                (time.perf_counter() - t0) * 1e3)
+        finally:
+            with self._cv:
+                self._staging.discard(seq)
+        self.gc()
+        return mpath
+
+    def _note_failure(self, job, exc):
+        with self._cv:
+            self._errors.append((self._manifest_path(job["seq"]), exc))
+        _registry().inc_counter("ckpt.commit_failures_total")
+        try:
+            j = self._journal_obj if self._journal_obj is not None \
+                else _journal()
+            j.record("ckpt_commit_failed", root=self.root, seq=job["seq"],
+                     step=job["step"], detail=str(exc))
+        except Exception:
+            pass  # journaling is best-effort on the failure path
+
+    def _manifest_path(self, seq):
+        return os.path.join(self.root, f"manifest-{seq:010d}.json")
+
+    def _set_pending(self):
+        with self._cv:
+            pending = len(self._queue) + self._inflight
+        _registry().set_gauge("ckpt.pending_count", float(pending))
+
+    # -- waiting / lifecycle ------------------------------------------------
+    @property
+    def pending(self):
+        with self._cv:
+            return len(self._queue) + self._inflight
+
+    def flush(self, timeout=None):
+        """Block until every queued commit has landed or failed (bounded by
+        ``timeout`` seconds). Returns the [(manifest_path, exception)]
+        failures since the last flush — async errors surface here, never
+        mid-train."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._queue or self._inflight:
+                rem = None if deadline is None \
+                    else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    break
+                self._cv.wait(timeout=rem)
+            errs, self._errors = self._errors, []
+        return errs
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # -- discovery / restore ------------------------------------------------
+    def latest_manifest(self):
+        mans = list_manifests(self.root)
+        return mans[0][1] if mans else None
+
+    def restore(self, model=None, optimizer=None, journal=None):
+        """Restore the newest committed manifest (falling back per
+        :func:`load_blob`) into ``model``/``optimizer`` and re-arm the
+        RNG/cursor train state. Returns ``(meta, train_state)``."""
+        blob, _ = load_blob(self.root, journal=journal or self._journal_obj)
+        return apply_blob(blob, model, optimizer)
+
+    def as_restore_hook(self, model, optimizer=None):
+        """A ``RecoveryManager(restore=...)`` hook: flush pending commits,
+        then restore the newest committed manifest."""
+        def _restore(gen):
+            self.flush()
+            meta, _ = self.restore(model, optimizer)
+            return meta
+        return _restore
+
+    # -- retention ----------------------------------------------------------
+    def gc(self):
+        """Keep-last-K retention. Deletes manifests beyond ``keep`` (newest
+        first), their staged data files, and any top-level alias no kept
+        manifest still publishes; stranded ``data-<seq>/`` staging dirs of
+        torn commits are swept too. Never deleted: the newest committed
+        manifest, any file (or alias) a kept manifest references, and
+        ``.old`` corruption fallbacks. ``keep <= 0`` keeps everything."""
+        if self.keep <= 0:
+            return
+        keep = max(1, self.keep)  # the newest committed manifest survives
+        mans = list_manifests(self.root)
+        kept, doomed = mans[:keep], mans[keep:]
+        protected = set()
+        kept_aliases = set()
+        for _, mp in kept:
+            protected.add(mp)
+            try:
+                man = read_manifest(mp)
+            except CheckpointCommitError:
+                continue
+            for rel in man["files"]:
+                p = os.path.join(self.root, rel)
+                protected.add(p)
+                protected.add(p + ".sha256")
+                kept_aliases.add(os.path.basename(rel))
+        for s, mp in doomed:
+            try:
+                files = read_manifest(mp)["files"]
+            except CheckpointCommitError:
+                files = {}
+            for rel in files:
+                p = os.path.join(self.root, rel)
+                if p in protected or p.endswith(".old"):
+                    continue
+                self._remove(p)
+                self._remove(p + ".sha256")
+                alias = os.path.basename(rel)
+                ap = os.path.join(self.root, alias)
+                if alias not in kept_aliases and ap != p \
+                        and not alias.endswith(".old"):
+                    self._remove(ap)
+                    self._remove(ap + ".sha256")
+            if mp not in protected:
+                # manifest goes LAST: a GC killed mid-way leaves the old
+                # checkpoint discoverable, just not yet reclaimed
+                self._sweep_dir(os.path.join(self.root, _data_dir(s)))
+                self._remove(mpath=mp)
+        # torn/failed commits strand a data-<seq>/ staging dir with no
+        # manifest: sweep any older than the newest committed seq (skipping
+        # seqs a concurrent blocking save still has mid-commit)
+        if mans:
+            newest = mans[0][0]
+            committed = {s for s, _ in mans}
+            with self._cv:
+                staging = set(self._staging)
+            try:
+                names = os.listdir(self.root)
+            except OSError:
+                names = []
+            for n in names:
+                m = DATA_DIR_RE.match(n)
+                if not m:
+                    continue
+                s = int(m.group(1))
+                if s in committed or s in staging or s >= newest:
+                    continue
+                self._sweep_dir(os.path.join(self.root, n))
+
+    def _sweep_dir(self, d):
+        """Remove a staging dir's remaining files (counted-not-raised) and
+        the dir itself once empty."""
+        if not os.path.isdir(d):
+            return
+        try:
+            names = os.listdir(d)
+        except OSError:
+            names = []
+        for n in names:
+            self._remove(os.path.join(d, n))
+        try:
+            os.rmdir(d)
+        except OSError:
+            pass  # a file removal failed (already counted) — retry next gc
+
+    def _remove(self, mpath):
+        """Counted-not-raised removal (fault site ``fs.remove``): a GC
+        hiccup is a ``ckpt.gc_failures_total`` tick, never a train-loop
+        failure."""
+        from .faults import maybe_inject
+        try:
+            maybe_inject("fs.remove", OSError)
+            if os.path.exists(mpath):
+                os.remove(mpath)
+        except OSError:
+            _registry().inc_counter("ckpt.gc_failures_total")
+
+
+def _kind_of(rel):
+    if rel.endswith(".pdparams"):
+        return "model"
+    if rel.endswith(".pdopt"):
+        return "optimizer"
+    if rel.endswith(".pdstate"):
+        return "train_state"
+    return "blob"
+
+
+def apply_blob(blob, model=None, optimizer=None):
+    """Apply a restored blob to a model/optimizer (hybrid-checkpoint shape
+    checks + mesh re-placement) and re-arm the train state. Returns
+    ``(meta, train_state)``."""
+    meta = dict(blob.get("meta") or {})
+    if model is not None:
+        from ..distributed.checkpoint import _apply_blob
+        meta = _apply_blob(blob, model, optimizer)
+    train_state = blob.get("train_state")
+    if train_state:
+        restore_train_state(train_state)
+    return meta, train_state
+
+
+# -- process-wide wiring -----------------------------------------------------
+
+_BY_ROOT = {}
+
+
+def checkpointer_for(root, background=True, keep=None):
+    """Shared per-root AsyncCheckpointer (hapi saves into one directory must
+    share a committer so seq numbers and retention cooperate)."""
+    root = os.path.abspath(root)
+    ck = _BY_ROOT.get(root)
+    if ck is None or ck._closed or ck.background != background:
+        ck = AsyncCheckpointer(root, keep=keep, background=background)
+        _BY_ROOT[root] = ck
+    return ck
+
+
+def flush_all(timeout=None):
+    """Flush every live AsyncCheckpointer. The preempt path calls this
+    before the emergency save and ``RecoveryManager.restart`` before
+    restore, so neither ever races a mid-flight commit of our own. Returns
+    the combined [(manifest_path, exception)] failures."""
+    errs = []
+    for ck in list(_LIVE):
+        try:
+            errs.extend(ck.flush(timeout=timeout))
+        except Exception:
+            pass  # a wedged committer must not block the exit path
+    return errs
+
+
+def save_model(network, optimizer, path, train_state=None, blocking=None):
+    """Hardened save entry shared by ``hapi.Model.save`` and
+    ``ModelCheckpoint``: writes ``path.pdparams`` / ``path.pdopt`` (+
+    ``.sha256`` sidecars) and commits a generation-stamped manifest in
+    ``dirname(path)`` — restorable by ``RecoveryManager`` via
+    :func:`load_blob`. ``FLAGS_async_checkpoint`` moves serialization onto
+    the background committer; ``blocking=True`` forces the sync fallback.
+    Returns the manifest path."""
+    from ..framework.flags import get_flag
+    if blocking is None:
+        blocking = not get_flag("FLAGS_async_checkpoint", False)
+    root = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    ck = checkpointer_for(root, background=not blocking)
+    files = {base + ".pdparams": (network.state_dict(), "model")}
+    if optimizer is not None:
+        files[base + ".pdopt"] = (optimizer.state_dict(), "optimizer")
+    files[base + ".pdstate"] = (
+        train_state if train_state is not None else capture_train_state(),
+        "train_state")
+    return ck.save(files, meta={"tag": base}, blocking=blocking)

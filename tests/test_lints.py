@@ -92,6 +92,30 @@ def test_injection_lint_covers_integrity_entry_points():
     assert "should_inject" in hooks
 
 
+def test_injection_lint_covers_checkpoint_entry_points():
+    """The zero-stall checkpointing PR's contract: the foreground snapshot,
+    the background serialize, every commit file boundary, and retention-GC
+    deletes must stay chaos-testable (sites ckpt.snapshot / ckpt.serialize /
+    ckpt.commit / fs.remove). Guard the MANIFEST so a refactor can't
+    silently drop the requirement along with the hook."""
+    import ast
+    src = (REPO / "tools" / "check_injection_points.py").read_text()
+    tree = ast.parse(src)
+    required = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "REQUIRED" for t in node.targets))
+    manifest = ast.literal_eval(required)
+    entries = {(rel, scope): names for rel, scope, names in manifest}
+    ck = entries[("paddle_tpu/resilience/snapshot.py",
+                  "class:AsyncCheckpointer")]
+    assert {"save", "_commit", "_remove"} <= set(ck)
+    assert "serialize_file" in entries[
+        ("paddle_tpu/resilience/snapshot.py", "module")]
+    assert "clean_redundant_epochs" in entries[
+        ("paddle_tpu/incubate/checkpoint.py", "class:CheckpointSaver")]
+
+
 def test_metric_name_lint_passes_on_tree():
     r = _run(REPO / "tools" / "check_metric_names.py")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -115,7 +139,7 @@ def test_metric_name_lint_manifest_guard():
 
     subsystems = set(ast.literal_eval(_assigned("SUBSYSTEMS")))
     assert {"steptimer", "metrics", "serving", "io",
-            "integrity"} <= subsystems
+            "integrity", "ckpt"} <= subsystems
     units = set(ast.literal_eval(_assigned("UNITS")))
     assert {"ms", "total", "per_sec"} <= units
     grandfathered = set(ast.literal_eval(_assigned("GRANDFATHERED")))
@@ -146,6 +170,12 @@ def test_flight_recorder_diff_help_smoke():
     r = _run(REPO / "tools" / "flight_recorder_diff.py", "--help")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "divergent" in r.stdout
+
+
+def test_ckpt_inspect_help_smoke():
+    r = _run(REPO / "tools" / "ckpt_inspect.py", "--help")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "manifest" in r.stdout
 
 
 def test_serving_bench_help_smoke():

@@ -1,0 +1,614 @@
+"""Zero-stall checkpointing chaos suite (docs/resilience.md §Checkpointing).
+
+Covers the AsyncCheckpointer's manifest commit point (kill-mid-commit at
+EVERY file boundary, including between the last data file and the manifest
+rename — restore must always land on the previous committed manifest with
+zero accepted-step loss), async error surfacing through flush (never into
+the train loop), exact resume (mid-epoch kill + restore replays no batch,
+skips none, loss curve bit-identical to the golden run — DataLoader cursor +
+framework/numpy RNG), keep-last-K retention with the never-delete set,
+the hapi Model.save / ModelCheckpoint routing, preempt flush-before-
+emergency-save ordering, manifest discovery through load_hybrid_checkpoint,
+the incubate CheckpointSaver retention satellite, and the ckpt_inspect CLI.
+No real sleeps: background-commit ordering is gated on events, fault
+schedules are deterministic (`site:#N`).
+"""
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.checkpoint import (
+    load_hybrid_checkpoint, save_hybrid_checkpoint,
+)
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.profiler import metrics
+from paddle_tpu.resilience import faults, preempt, recovery
+from paddle_tpu.resilience import snapshot as snap
+from paddle_tpu.resilience.snapshot import (
+    AsyncCheckpointer, CheckpointCommitError, capture_train_state,
+    list_manifests, load_blob, restore_train_state, save_model,
+    verify_manifest,
+)
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_snapshot_state(tmp_path, monkeypatch):
+    """Fresh faults/journal/generation/registry per test; artifacts into
+    tmp_path; async flag off unless a test opts in; per-root checkpointer
+    cache drained so no committer thread leaks across tests."""
+    monkeypatch.setenv("PADDLE_TPU_ARTIFACTS_DIR", str(tmp_path / "artifacts"))
+    paddle.set_flags({"FLAGS_async_checkpoint": False, "FLAGS_ckpt_keep": 3,
+                      "FLAGS_retry_backoff_base": 0.0})
+    faults.reset()
+    recovery.reset_generation()
+    recovery.reset_journal()
+    metrics.reset_registry()
+    yield
+    faults.reset()
+    for ck in list(snap._BY_ROOT.values()):
+        ck.close()
+    snap._BY_ROOT.clear()
+    recovery.reset_generation()
+    recovery.reset_journal()
+    metrics.reset_registry()
+    paddle.set_flags({"FLAGS_async_checkpoint": False, "FLAGS_ckpt_keep": 3,
+                      "FLAGS_retry_backoff_base": 0.5})
+
+
+def _counters():
+    return metrics.get_registry().snapshot()["counters"]
+
+
+def _journal_events():
+    return [e["event"] for e in recovery.get_journal().entries()]
+
+
+def _payload(v):
+    return {"w": np.full((3,), float(v), dtype=np.float32)}
+
+
+def _files(v):
+    return {"m.pdparams": (_payload(v), "model"),
+            "m.pdopt": ({"lr": np.float32(v)}, "optimizer")}
+
+
+def _model_w(blob):
+    w = blob["model"]["w"]
+    return float(np.asarray(w.numpy() if hasattr(w, "numpy") else w)[0])
+
+
+# -- kill-mid-commit: every file boundary -------------------------------------
+
+class TestCommitBoundaries:
+    # two data files -> three ckpt.commit evaluations per commit: before
+    # each data file, plus one between the last data file and the manifest
+    # rename (the not-yet-committed window the manifest protocol exists for)
+    @pytest.mark.parametrize("boundary", [1, 2, 3])
+    def test_torn_commit_leaves_previous_manifest(self, tmp_path, boundary):
+        root = str(tmp_path / "ck")
+        ck = AsyncCheckpointer(root, background=False)
+        good = ck.save(_files(1.0), step=10, blocking=True)
+        assert os.path.exists(good)
+
+        faults.configure(f"ckpt.commit:#{boundary}")
+        with pytest.raises(CheckpointCommitError):
+            ck.save(_files(2.0), step=11, blocking=True)
+        faults.reset()
+
+        # the torn save committed nothing: the previous manifest is intact
+        # and restore lands on it with zero accepted-step loss
+        assert [s for s, _ in list_manifests(root)] == [1]
+        blob, src = load_blob(root)
+        assert src == good
+        assert blob["meta"]["step"] == 10
+        assert _model_w(blob) == 1.0
+
+        # the next save commits cleanly past the gap
+        ck.save(_files(3.0), step=12, blocking=True)
+        blob, _ = load_blob(root)
+        assert _model_w(blob) == 3.0
+
+    def test_serialize_fault_also_aborts_commit(self, tmp_path):
+        root = str(tmp_path / "ck")
+        ck = AsyncCheckpointer(root, background=False)
+        ck.save(_files(1.0), step=1, blocking=True)
+        faults.configure("ckpt.serialize:#1")
+        with pytest.raises(CheckpointCommitError):
+            ck.save(_files(2.0), step=2, blocking=True)
+        faults.reset()
+        blob, _ = load_blob(root)
+        assert _model_w(blob) == 1.0
+
+    def test_snapshot_fault_fails_before_any_io(self, tmp_path):
+        root = str(tmp_path / "ck")
+        ck = AsyncCheckpointer(root, background=False)
+        faults.configure("ckpt.snapshot:#1")
+        with pytest.raises(CheckpointCommitError):
+            ck.save(_files(1.0), blocking=True)
+        faults.reset()
+        assert list_manifests(root) == []
+        assert os.listdir(root) == []  # nothing staged, nothing torn
+
+
+# -- async semantics: errors surface via flush, never raise -------------------
+
+class TestAsyncErrors:
+    def test_background_failure_counted_journaled_flushed(self, tmp_path):
+        root = str(tmp_path / "ck")
+        ck = AsyncCheckpointer(root)
+        ck.save(_files(1.0), step=1)
+        assert not ck.flush(timeout=30.0)
+
+        faults.configure("ckpt.commit:#1")
+        ck.save(_files(2.0), step=2)  # must NOT raise (async semantics)
+        errs = ck.flush(timeout=30.0)
+        faults.reset()
+        assert len(errs) == 1
+        assert isinstance(errs[0][1], CheckpointCommitError)
+        assert _counters().get("ckpt.commit_failures_total") == 1.0
+        assert "ckpt_commit_failed" in _journal_events()
+        # errors are consumed by flush: the next flush is clean
+        assert ck.flush(timeout=30.0) == []
+        # the failed seq never committed; the first save is still current
+        blob, _ = load_blob(root)
+        assert _model_w(blob) == 1.0
+        ck.close()
+
+    def test_flush_all_covers_live_checkpointers(self, tmp_path):
+        a = AsyncCheckpointer(str(tmp_path / "a"))
+        b = AsyncCheckpointer(str(tmp_path / "b"))
+        a.save(_files(1.0))
+        b.save(_files(2.0))
+        assert snap.flush_all(timeout=30.0) == []
+        assert a.pending == 0 and b.pending == 0
+        assert a.latest_manifest() and b.latest_manifest()
+        a.close()
+        b.close()
+
+
+# -- exact resume -------------------------------------------------------------
+
+def _resume_net(seed):
+    paddle.seed(seed)
+    net = nn.Linear(4, 3)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    return net, opt
+
+
+def _resume_step(net, opt, xb, yb):
+    """One step whose loss depends on the params, the batch, the framework
+    RNG (paddle.randn) and numpy's global RNG — so bit-identical resumed
+    losses prove ALL of model/optimizer/cursor/RNG state was restored."""
+    noise = paddle.randn(yb.shape) * 0.01
+    scale = 1.0 + 0.01 * float(np.random.randn())
+    loss = F.mse_loss(net(xb) + noise, yb) * scale
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.numpy())
+
+
+def _resume_data(n=32):
+    r = np.random.RandomState(0)
+    x = r.randn(n, 4).astype(np.float32)
+    y = r.randn(n, 3).astype(np.float32)
+    return TensorDataset([x, y])
+
+
+class TestExactResume:
+    KILL_AT = 5  # mid-epoch: 8 batches/epoch, killed after batch 5
+
+    def _golden(self, steps=16):
+        np.random.seed(7)
+        net, opt = _resume_net(7)
+        loader = DataLoader(_resume_data(), batch_size=4)
+        losses = []
+        for _ in range(2):
+            for xb, yb in loader:
+                losses.append(_resume_step(net, opt, xb, yb))
+        return losses[:steps]
+
+    def test_mid_epoch_kill_restore_is_bit_identical(self, tmp_path):
+        golden = self._golden()
+
+        # run 1: identical prefix, hardened save at the kill point
+        np.random.seed(7)
+        net, opt = _resume_net(7)
+        loader = DataLoader(_resume_data(), batch_size=4)
+        prefix = []
+        for xb, yb in loader:
+            prefix.append(_resume_step(net, opt, xb, yb))
+            if len(prefix) == self.KILL_AT:
+                break
+        assert prefix == golden[:self.KILL_AT]
+        path = str(tmp_path / "ck" / "m")
+        save_model(net, opt, path,
+                   train_state=capture_train_state(loader=loader),
+                   blocking=True)
+
+        # "new process": junk init + perturbed RNG streams — restore must win
+        np.random.seed(999)
+        net2, opt2 = _resume_net(99)
+        loader2 = DataLoader(_resume_data(), batch_size=4)
+        ck = AsyncCheckpointer(str(tmp_path / "ck"), background=False)
+        meta, ts = ck.restore(net2, opt2)
+        assert meta["tag"] == "m"
+        assert ts["cursor"]["batches_consumed"] == self.KILL_AT
+        restore_train_state(ts, loader=loader2)
+
+        # resume: finish the killed epoch (no batch replayed, none skipped),
+        # then the second epoch — every loss bit-identical to golden
+        resumed = []
+        for xb, yb in loader2:
+            resumed.append(_resume_step(net2, opt2, xb, yb))
+        assert len(resumed) == 8 - self.KILL_AT
+        for xb, yb in loader2:
+            resumed.append(_resume_step(net2, opt2, xb, yb))
+        assert resumed == golden[self.KILL_AT:]
+
+    def test_cursor_counts_only_handed_out_batches(self):
+        loader = DataLoader(_resume_data(16), batch_size=4)
+        assert loader.state_dict()["batches_consumed"] == 0
+        it = iter(loader)
+        next(it)
+        next(it)
+        assert loader.state_dict()["batches_consumed"] == 2
+        # a fresh epoch pass resets the cursor
+        list(loader)
+        assert loader.state_dict()["batches_consumed"] == 4
+
+    def test_resume_skip_fetches_nothing_for_skipped_prefix(self):
+        fetched = []
+
+        class Spy(TensorDataset):
+            def __getitem__(s, i):
+                fetched.append(i)
+                return super().__getitem__(i)
+
+        r = np.random.RandomState(0)
+        ds = Spy([r.randn(16, 4).astype(np.float32)])
+        loader = DataLoader(ds, batch_size=4)
+        loader.set_state_dict({"batches_consumed": 2, "epoch": None})
+        batches = list(loader)
+        assert len(batches) == 2
+        # sampler-order fast-forward: indices 0..7 were never fetched
+        assert sorted(fetched) == list(range(8, 16))
+
+
+# -- retention ----------------------------------------------------------------
+
+class TestRetention:
+    def test_keep_k_never_newest_never_old(self, tmp_path):
+        root = str(tmp_path / "ck")
+        os.makedirs(root)
+        legacy = os.path.join(root, "m.pdparams.old")
+        with open(legacy, "w") as f:
+            f.write("legacy fallback")
+        ck = AsyncCheckpointer(root, keep=2, background=False)
+        paths = [ck.save({f"s{i}.pdparams": _payload(i)}, step=i,
+                         blocking=True) for i in range(5)]
+        seqs = [s for s, _ in list_manifests(root)]
+        assert seqs == [5, 4]  # keep-last-2
+        assert not os.path.exists(paths[0])
+        assert not os.path.exists(os.path.join(root, "s0.pdparams"))
+        assert not os.path.exists(os.path.join(root, "s0.pdparams.sha256"))
+        # kept manifests still verify end-to-end
+        for _, mp in list_manifests(root):
+            verify_manifest(mp)
+        assert os.path.exists(legacy)  # .old is never GC'd
+
+    def test_keep_zero_keeps_everything(self, tmp_path):
+        ck = AsyncCheckpointer(str(tmp_path / "ck"), keep=0,
+                               background=False)
+        for i in range(4):
+            ck.save({"s.pdparams": _payload(i)}, blocking=True)
+        assert len(list_manifests(ck.root)) == 4
+
+    def test_shared_alias_survives_while_referenced(self, tmp_path):
+        # hapi layout: every save republishes the same top-level alias
+        # (m.pdparams — what Model.load reads); GC of the older manifests
+        # must drop their staged copies but keep the alias the kept
+        # manifest still publishes
+        ck = AsyncCheckpointer(str(tmp_path / "ck"), keep=1,
+                               background=False)
+        for i in range(3):
+            ck.save({"m.pdparams": _payload(i)}, blocking=True)
+        assert [s for s, _ in list_manifests(ck.root)] == [3]
+        verify_manifest(ck.latest_manifest())
+        assert os.path.exists(os.path.join(ck.root, "m.pdparams"))
+        blob, _ = load_blob(ck.root)
+        assert _model_w(blob) == 2.0
+        # the doomed saves' staging dirs were reclaimed with them
+        dirs = [n for n in os.listdir(ck.root) if snap.DATA_DIR_RE.match(n)]
+        assert dirs == [snap._data_dir(3)]
+
+    def test_gc_failures_counted_not_raised(self, tmp_path):
+        ck = AsyncCheckpointer(str(tmp_path / "ck"), keep=1,
+                               background=False)
+        ck.save({"a.pdparams": _payload(0)}, blocking=True)
+        faults.configure("fs.remove:1.0")
+        # GC inside this commit hits fs.remove faults; the save still lands
+        ck.save({"b.pdparams": _payload(1)}, blocking=True)
+        faults.reset()
+        assert _counters().get("ckpt.gc_failures_total", 0) >= 1.0
+        assert [s for s, _ in list_manifests(ck.root)][0] == 2
+        # next clean save sweeps what the faulted GC could not
+        ck.save({"c.pdparams": _payload(2)}, blocking=True)
+        assert [s for s, _ in list_manifests(ck.root)] == [3]
+
+
+# -- hapi wiring --------------------------------------------------------------
+
+def _hapi_model():
+    from paddle_tpu.hapi import Model
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    return model
+
+
+def _hapi_data(n=32):
+    r = np.random.RandomState(3)
+    x = r.randn(n, 4).astype(np.float32)
+    y = r.randint(0, 3, (n,)).astype(np.int64)
+    return TensorDataset([x, y])
+
+
+class TestHapiWiring:
+    def test_model_save_commits_manifest_and_sidecars(self, tmp_path):
+        model = _hapi_model()
+        model.fit(_hapi_data(), batch_size=8, epochs=1, verbose=0)
+        path = str(tmp_path / "m")
+        model.save(path)
+        # sync default: files at their legacy names + sidecars + manifest
+        for suffix in (".pdparams", ".pdparams.sha256", ".pdopt",
+                       ".pdopt.sha256", ".pdstate"):
+            assert os.path.exists(path + suffix), suffix
+        mans = list_manifests(str(tmp_path))
+        assert len(mans) == 1
+        man = verify_manifest(mans[0][1])
+        assert man["meta"]["tag"] == "m"
+        assert {os.path.basename(r) for r in man["files"]} == \
+            {"m.pdparams", "m.pdopt", "m.pdstate"}
+        # the legacy loader keeps working against the same files
+        model.load(path)
+
+    def test_model_save_async_is_restorable_after_flush(self, tmp_path):
+        paddle.set_flags({"FLAGS_async_checkpoint": True})
+        model = _hapi_model()
+        model.fit(_hapi_data(), batch_size=8, epochs=1, verbose=0)
+        path = str(tmp_path / "m")
+        model.save(path)
+        assert snap.flush_all(timeout=30.0) == []
+        man = verify_manifest(list_manifests(str(tmp_path))[0][1])
+        # generation-stamped meta + train_state captured via _active_loader
+        assert "m.pdstate" in {os.path.basename(r) for r in man["files"]}
+        model.load(path)
+        model2 = _hapi_model()
+        meta = load_hybrid_checkpoint(str(tmp_path), model2.network,
+                                      model2._optimizer)
+        assert meta["tag"] == "m"
+
+    def test_modelcheckpoint_routes_through_hardened_save(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import ModelCheckpoint
+        model = _hapi_model()
+        cb = ModelCheckpoint(save_freq=1, save_dir=str(tmp_path))
+        model.fit(_hapi_data(), batch_size=8, epochs=2, verbose=0,
+                  callbacks=[cb])
+        # per-epoch tags + final, each committed as a verifiable manifest
+        tags = {(verify_manifest(mp)["meta"] or {}).get("tag")
+                for _, mp in list_manifests(str(tmp_path))}
+        assert {"0", "1", "final"} <= tags
+        # and RecoveryManager-restorable through manifest discovery
+        model2 = _hapi_model()
+        load_hybrid_checkpoint(str(tmp_path), model2.network)
+
+
+# -- preempt ordering ---------------------------------------------------------
+
+class TestPreemptFlush:
+    def test_drain_lands_pending_commits_before_actions(self, tmp_path,
+                                                        monkeypatch):
+        release = threading.Event()
+        orig = snap.serialize_file
+
+        def gated(payload, path):
+            assert release.wait(30.0), "commit gate never released"
+            return orig(payload, path)
+
+        monkeypatch.setattr(snap, "serialize_file", gated)
+        ck = AsyncCheckpointer(str(tmp_path / "ck"))
+        ck.save(_files(1.0), step=1)
+
+        seen = []
+        handler = preempt.PreemptionHandler()
+        handler.add_action(
+            lambda: seen.append(ck.latest_manifest()))
+        done = []
+        t = threading.Thread(
+            target=lambda: done.extend(handler.drain() or [()]))
+        t.start()
+        # drain is parked in flush_all: the commit is gated, so no action
+        # has run yet — the emergency save cannot race the pending commit
+        assert t.is_alive() and seen == []
+        release.set()
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        # the action observed the COMMITTED manifest (flush landed it first)
+        assert seen and seen[0] is not None and os.path.exists(seen[0])
+        ck.close()
+
+
+# -- manifest discovery / fallback --------------------------------------------
+
+class TestManifestDiscovery:
+    def test_corrupt_newest_falls_back_and_journals(self, tmp_path):
+        root = str(tmp_path / "ck")
+        os.makedirs(root)
+        model, opt = _resume_net(7)
+        # SAME tag both saves (the hapi Model.save pattern): per-seq data
+        # staging means the second save cannot clobber the first manifest's
+        # files, so the older checkpoint stays independently restorable
+        paddle.set_flags({"FLAGS_async_checkpoint": True})
+        save_hybrid_checkpoint(os.path.join(root, "hy"), model, opt,
+                               meta={"step": 2})
+        save_hybrid_checkpoint(os.path.join(root, "hy"), model, opt,
+                               meta={"step": 3})
+        paddle.set_flags({"FLAGS_async_checkpoint": False})
+        assert snap.flush_all(timeout=30.0) == []
+        mans = list_manifests(root)
+        assert len(mans) == 2  # async saves committed manifests
+
+        # chew a byte out of the newest manifest's data file
+        newest = snap.read_manifest(mans[0][1])
+        victim = os.path.join(root, next(iter(newest["files"])))
+        data = bytearray(open(victim, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        with open(victim, "wb") as f:
+            f.write(bytes(data))
+
+        model2, opt2 = _resume_net(99)
+        meta = load_hybrid_checkpoint(root, model2, opt2)
+        assert meta["step"] == 2  # fell back to the older manifest
+        assert meta["restored_from"] == mans[1][1]
+        assert "corrupt_restore" in _journal_events()
+        np.testing.assert_array_equal(
+            np.asarray(model2.weight.numpy()),
+            np.asarray(model.weight.numpy()))
+
+    def test_all_manifests_dead_falls_back_to_legacy_old(self, tmp_path):
+        root = str(tmp_path / "ck")
+        model, opt = _resume_net(7)
+        # sync saves twice: the second moves the first aside as `.old`
+        save_hybrid_checkpoint(os.path.join(root, "hy"), model, opt,
+                               meta={"step": 1})
+        save_hybrid_checkpoint(os.path.join(root, "hy"), model, opt,
+                               meta={"step": 2})
+        # one committed manifest, then destroy its referenced (staged) files
+        ck = AsyncCheckpointer(root, background=False)
+        ck.save(_files(9.0), step=9, blocking=True)
+        for rel in snap.read_manifest(ck.latest_manifest())["files"]:
+            os.remove(os.path.join(root, rel))
+
+        blob, src = load_blob(root)
+        assert src.endswith(".old")
+        assert blob["meta"]["restored_from_fallback"] is True
+        events = _journal_events()
+        assert events.count("corrupt_restore") >= 1
+
+    def test_nothing_restorable_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_blob(str(tmp_path))
+
+
+# -- incubate CheckpointSaver retention satellite -----------------------------
+
+class TestCheckpointSaverGC:
+    def _saver(self, tmp_path):
+        from paddle_tpu.distributed.fleet.fs import LocalFS
+        from paddle_tpu.incubate.checkpoint import CheckpointSaver
+        root = tmp_path / "auto"
+        root.mkdir()
+        return CheckpointSaver(LocalFS(), str(root / "snap")), root
+
+    def test_sweeps_staging_and_stale_epochs_only(self, tmp_path):
+        saver, root = self._saver(tmp_path)
+        for name in ("snap", "snap.old", "snap.tmp", "snap.tmpXYZ",
+                     "snap.e1", "snap.e2", "snap.e3"):
+            (root / name).mkdir()
+        removed = saver.clean_redundant_epochs(keep=1)
+        assert removed == 4  # two .tmp* + e1 + e2
+        left = sorted(os.listdir(root))
+        assert left == ["snap", "snap.e3", "snap.old"]
+
+    def test_manifest_referenced_files_protected(self, tmp_path):
+        saver, root = self._saver(tmp_path)
+        (root / "snap").mkdir()
+        # a manifest in the same dir references one of the "stale" names
+        ck = AsyncCheckpointer(str(root), background=False)
+        ck.save({"snap.e1": (_payload(1), "blob")}, blocking=True)
+        (root / "snap.e2").mkdir()
+        (root / "snap.e3").mkdir()
+        saver.clean_redundant_epochs(keep=1)
+        assert (root / "snap.e1").exists()   # manifest-referenced
+        assert (root / "snap.e3").exists()   # newest kept epoch
+        assert not (root / "snap.e2").exists()
+
+    def test_remove_failures_counted_not_raised(self, tmp_path):
+        saver, root = self._saver(tmp_path)
+        (root / "snap.tmpA").mkdir()
+        faults.configure("fs.remove:1.0")
+        removed = saver.clean_redundant_epochs()
+        faults.reset()
+        assert removed == 0
+        assert (root / "snap.tmpA").exists()
+        assert _counters().get("ckpt.gc_failures_total") == 1.0
+
+    def test_snapshot_calls_gc(self, tmp_path, monkeypatch):
+        from paddle_tpu.incubate import checkpoint as inc
+        inc.register()  # empty state is fine for this wiring check
+        tr = inc.TrainEpochRange(2, "t", checkpoint_path=str(tmp_path))
+        stale = os.path.join(os.path.dirname(tr._saver._path),
+                             os.path.basename(tr._saver._path) + ".tmpOLD")
+        os.makedirs(stale)
+        tr._snapshot(0)
+        assert not os.path.exists(stale)
+
+
+# -- ckpt_inspect CLI ---------------------------------------------------------
+
+def _inspect_mod():
+    spec = importlib.util.spec_from_file_location(
+        "ckpt_inspect", os.path.join(REPO, "tools", "ckpt_inspect.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCkptInspect:
+    def test_lists_and_verifies(self, tmp_path, capsys):
+        ci = _inspect_mod()
+        root = str(tmp_path / "ck")
+        ck = AsyncCheckpointer(root, background=False)
+        ck.save(_files(1.0), step=7, meta={"generation": 3}, blocking=True)
+        assert ci.main([root]) == 0
+        out = capsys.readouterr().out
+        assert "step=7" in out and "gen=3" in out
+        assert "restore would pick: manifest-0000000001.json" in out
+
+    def test_exit_nonzero_on_corruption(self, tmp_path, capsys):
+        ci = _inspect_mod()
+        root = str(tmp_path / "ck")
+        ck = AsyncCheckpointer(root, background=False)
+        ck.save(_files(1.0), step=1, blocking=True)
+        ck.save(_files(2.0), step=2, blocking=True)
+        # chew on every staged data file so NO manifest verifies
+        for _, mp in list_manifests(root):
+            for rel in snap.read_manifest(mp)["files"]:
+                with open(os.path.join(root, rel), "ab") as f:
+                    f.write(b"garbage")
+        assert ci.main(["--json", root]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert any(r["problems"] for r in doc["manifests"])
+        # nothing verifies — the report must say restore falls through
+        assert doc["restore_pick"] is None
+
+    def test_exit_nonzero_on_empty_root(self, tmp_path, capsys):
+        ci = _inspect_mod()
+        assert ci.main([str(tmp_path)]) == 1
+        assert "no committed manifest" in capsys.readouterr().out

@@ -101,6 +101,12 @@ def _breakdown_metrics(doc):
             v = b.get(k)
             if isinstance(v, (int, float)):
                 out[f"step_breakdown.{lane}.{k}"] = float(v)
+    # checkpoint stall (zero-stall checkpointing contract): the BLOCKING
+    # portion of one save — lower-is-better ms, gated like a phase so an
+    # async regression back toward sync-save stalls fails CI
+    v = (doc.get("extra") or {}).get("ckpt_stall_ms")
+    if isinstance(v, (int, float)):
+        out["ckpt_stall_ms"] = float(v)
     return out
 
 

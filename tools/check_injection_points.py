@@ -38,7 +38,7 @@ REQUIRED = [
     ("paddle_tpu/resilience/recovery.py", "class:RecoveryManager",
      ["restart"]),
     ("paddle_tpu/incubate/checkpoint.py", "class:CheckpointSaver",
-     ["save_checkpoint"]),
+     ["save_checkpoint", "clean_redundant_epochs"]),
     # transport entry points (hang-detection PR): the chaos suite must be
     # able to fail or stall the wire itself, not just the ops above it
     ("paddle_tpu/distributed/p2p.py", "module",
@@ -64,6 +64,15 @@ REQUIRED = [
      ["checksum_state"]),
     ("paddle_tpu/resilience/integrity.py", "class:StepReplayBuffer",
      ["replay"]),
+    # zero-stall checkpointing (snapshot PR): the chaos suite must be able
+    # to fail the foreground device→host snapshot (ckpt.snapshot), the
+    # background pickle+sidecar write (ckpt.serialize), each data-file
+    # boundary of a manifest commit plus the pre-rename boundary
+    # (ckpt.commit), and retention deletes (fs.remove)
+    ("paddle_tpu/resilience/snapshot.py", "class:AsyncCheckpointer",
+     ["save", "_commit", "_remove"]),
+    ("paddle_tpu/resilience/snapshot.py", "module",
+     ["serialize_file"]),
 ]
 
 # _injected_run is HDFSClient's hook-carrying chokepoint: routing a call

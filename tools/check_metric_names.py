@@ -39,6 +39,7 @@ SCAN = ["paddle_tpu", "bench.py"]
 # review.
 SUBSYSTEMS = [
     "autotune",      # kernel-tier block autotuning
+    "ckpt",          # zero-stall checkpointing (resilience/snapshot.py)
     "fusion_policy", # measured fusion decisions
     "integrity",     # SDC defense (checksum consensus, replay)
     "io",            # input pipeline / data workers

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Inspect a zero-stall checkpoint root: list manifests, verify integrity.
+
+A checkpoint directory written by ``resilience.snapshot.AsyncCheckpointer``
+holds per-commit staged data files (``data-<seq>/*.pdparams`` / ``.pdopt`` /
+``.pdstate``) with ``.sha256`` sidecars, top-level legacy aliases of the
+newest checkpoint (what ``Model.load`` reads), and ``manifest-<seq>.json``
+commit records — the manifest rename is the commit point, so "what can I
+restore?" means "which manifests verify?".
+This tool answers that from the operator side of an incident:
+
+- lists every committed manifest (newest first) with its step, generation,
+  tag, timestamp, file count and total bytes;
+- verifies each referenced file against the digest recorded in the manifest
+  (``--no-verify`` skips the hashing for a quick listing);
+- prints which manifest a restore would pick (the newest that verifies) —
+  the same walk ``load_blob`` performs, so the answer matches what
+  ``RecoveryManager.restore`` / ``load_hybrid_checkpoint`` would do.
+
+Usage::
+
+    python tools/ckpt_inspect.py ckpt_dir/
+    python tools/ckpt_inspect.py ckpt_dir/ --json
+    python tools/ckpt_inspect.py ckpt_dir/manifest-0000000007.json
+
+Exit code 0 = every manifest verifies, 1 = corruption found or no committed
+manifest exists, 2 = bad input. Pure stdlib — runs anywhere, no jax import.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+
+__all__ = ["inspect_root", "main"]
+
+MANIFEST_RE = re.compile(r"^manifest-(\d+)\.json$")
+
+
+def _sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _list_manifests(root):
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError as e:
+        raise SystemExit(f"ckpt_inspect: {root}: {e}")
+    for n in names:
+        m = MANIFEST_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, n)))
+    out.sort(reverse=True)
+    return out
+
+
+def _inspect_manifest(root, mpath, verify=True):
+    """One manifest → report dict with per-file problems (empty = healthy)."""
+    rec = {"manifest": os.path.basename(mpath), "problems": []}
+    try:
+        with open(mpath) as f:
+            man = json.load(f)
+        files = man["files"]
+        if not isinstance(files, dict):
+            raise TypeError("files map is not a dict")
+    except Exception as e:  # noqa: BLE001 — any damage = unreadable
+        rec["problems"].append(f"unreadable manifest: {e}")
+        return rec
+    meta = man.get("meta") or {}
+    rec.update(seq=man.get("seq"), step=man.get("step"),
+               generation=meta.get("generation"), tag=meta.get("tag"),
+               ts=man.get("ts"), file_count=len(files),
+               total_bytes=sum(int(i.get("bytes") or 0)
+                               for i in files.values()))
+    for rel, info in sorted(files.items()):
+        fp = os.path.join(root, rel)
+        if not os.path.exists(fp):
+            rec["problems"].append(f"{rel}: missing")
+            continue
+        if not verify:
+            continue
+        want = info.get("sha256")
+        got = _sha256_file(fp)
+        if want and got != want:
+            rec["problems"].append(
+                f"{rel}: sha256 mismatch (got {got[:12]}, "
+                f"recorded {want[:12]})")
+    return rec
+
+
+def inspect_root(path, verify=True):
+    """Returns (reports newest-first, restore_pick_or_None)."""
+    if os.path.isdir(path):
+        root, only = path, None
+    else:
+        root = os.path.dirname(os.path.abspath(path)) or "."
+        only = os.path.basename(path)
+        if not MANIFEST_RE.match(only):
+            raise SystemExit(
+                f"ckpt_inspect: {path}: not a directory or manifest file")
+    mans = _list_manifests(root)
+    if only is not None:
+        mans = [(s, p) for s, p in mans if os.path.basename(p) == only]
+    reports = [_inspect_manifest(root, mp, verify=verify) for _, mp in mans]
+    pick = next((r["manifest"] for r in reports if not r["problems"]), None)
+    return reports, pick
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="List and verify zero-stall checkpoint manifests "
+                    "(manifest-<seq>.json commit records + sha256-checked "
+                    "data files).")
+    ap.add_argument("path", help="checkpoint root directory, or one "
+                                 "manifest-<seq>.json to inspect")
+    ap.add_argument("--no-verify", action="store_true",
+                    help="skip per-file digest checks (listing only)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    reports, pick = inspect_root(args.path, verify=not args.no_verify)
+    corrupt = [r for r in reports if r["problems"]]
+    if args.json:
+        print(json.dumps({"manifests": reports, "restore_pick": pick,
+                          "verified": not args.no_verify}, indent=1))
+    else:
+        if not reports:
+            print(f"{args.path}: no committed manifest "
+                  "(nothing restorable at manifest granularity)")
+            return 1
+        for r in reports:
+            if "seq" in r:
+                head = (f"{r['manifest']}  step={r['step']} "
+                        f"gen={r.get('generation') or '-'} "
+                        f"tag={r.get('tag') or '-'} "
+                        f"files={r['file_count']} "
+                        f"size={_fmt_bytes(r['total_bytes'])}")
+            else:
+                head = r["manifest"]
+            mark = "OK " if not r["problems"] else \
+                ("??? " if args.no_verify else "BAD")
+            print(f"  {mark:4s}{head}")
+            for p in r["problems"]:
+                print(f"        - {p}")
+        if pick:
+            print(f"restore would pick: {pick}")
+        else:
+            print("restore would pick: NONE (every manifest damaged — "
+                  "load_blob falls back to legacy .old files)")
+    return 1 if (corrupt or not reports) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
