@@ -66,6 +66,22 @@ _FLAGS: dict[str, Any] = {
     "FLAGS_serving_step_timeout": 60.0,
     # bounded request queue; admission sheds (ServerOverloaded) beyond this
     "FLAGS_serving_max_queue": 256,
+    # AIMD admission: target per-batch execution latency; at/under the
+    # target the in-system limit creeps up, over it the limit is cut x0.7
+    "FLAGS_serving_admission_target_ms": 100.0,
+    # base retry_after hint (seconds) carried by ServerOverloaded sheds
+    "FLAGS_serving_retry_after": 0.1,
+    # circuit breaker: failures/timeouts within the rolling window that
+    # trip a replica's breaker open, and the cooldown before the half-open
+    # preflight+canary probe may run
+    "FLAGS_serving_breaker_failures": 5,
+    "FLAGS_serving_breaker_window": 30.0,
+    "FLAGS_serving_breaker_cooldown": 10.0,
+    # hedged dispatch: fraction of dispatches allowed a second (hedged)
+    # attempt, and the floor on the p99-derived hedge delay; budget 0
+    # disables hedging
+    "FLAGS_serving_hedge_budget": 0.05,
+    "FLAGS_serving_hedge_min_ms": 10.0,
     # hardware health & SDC defense (resilience/{integrity,health}.py):
     # steps between cross-replica parameter-checksum consensus rounds;
     # 0 disables in-training SDC detection

@@ -27,15 +27,20 @@ from .batcher import (  # noqa: F401
     Batch, BatchQueue, BucketedExecutor, DeadlineExceeded, Request,
     ServerOverloaded, bucket_for, pow2_buckets, signature_of,
 )
+from .autoscaler import Autoscaler, AutoscalerConfig  # noqa: F401
 from .client import InferenceClient, RemoteInferenceError  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
-from .scheduler import Replica, ReplicaDead, Scheduler  # noqa: F401
+from .overload import AdmissionController, CircuitBreaker  # noqa: F401
+from .scheduler import (  # noqa: F401
+    Replica, ReplicaDead, ReplicaRetired, Scheduler,
+)
 from .server import InferenceServer, ServingConfig, SocketFrontend  # noqa: F401
 
 __all__ = [
     "InferenceServer", "ServingConfig", "SocketFrontend", "InferenceClient",
     "ServingMetrics", "ServerOverloaded", "DeadlineExceeded", "Request",
     "Batch", "BatchQueue", "BucketedExecutor", "Scheduler", "Replica",
-    "ReplicaDead", "RemoteInferenceError", "bucket_for", "pow2_buckets",
-    "signature_of",
+    "ReplicaDead", "ReplicaRetired", "RemoteInferenceError",
+    "AdmissionController", "CircuitBreaker", "Autoscaler",
+    "AutoscalerConfig", "bucket_for", "pow2_buckets", "signature_of",
 ]

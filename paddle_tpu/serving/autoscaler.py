@@ -1,0 +1,196 @@
+"""Elastic replica autoscaling: queue-driven, journaled, generation-fenced.
+
+PR 3 fixed the replica count at construction; the ROADMAP's serving item
+asks for "replica scale-up/down from queue depth ... so resizes are safe
+under load". This controller closes that loop:
+
+- **signal**: queue depth per healthy replica (plus "no healthy replica at
+  all", which always wants a scale-up). Sustained pressure over
+  ``high_watermark`` for ``up_stable`` consecutive ticks scales up;
+  sustained slack under ``low_watermark`` for ``down_stable`` ticks scales
+  down. Streaks reset on any tick that breaks them, so a single spike
+  never resizes anything.
+- **safe scale-up**: :meth:`Scheduler.add_replica` builds the predictor,
+  runs the preflight KAT, and re-warms every recorded warmup signature
+  *before* the replica enters the dispatch set — new capacity never pays
+  its bucket compiles on live traffic and a sick host never joins.
+- **safe scale-down**: placement stops first (``begin_drain``), the
+  replica's in-flight batches finish (or a bounded ``drain_timeout``
+  force-removes it), and only then is it torn down. A **force-removed**
+  replica is fenced: its late batch result is dropped by the scheduler,
+  never delivered (:class:`~.scheduler.ReplicaRetired`).
+- **journal + fencing**: every resize is recorded RecoveryJournal-style
+  (``serving_scale_up`` / ``serving_scale_down`` / ``serving_scale_failed``
+  events in ``recovery_journal_<job>.jsonl``) carrying the scheduler's
+  monotonic ``scheduler_generation``, which bumps on every membership
+  change — the same fencing discipline PR 4 uses for elastic training.
+
+``scale_up``/``scale_down`` carry the ``serving.scale`` fault-injection
+site: an injected failure is journaled and retried on a later tick, never
+raised into the serving loop. Everything runs on the injectable clock.
+"""
+from __future__ import annotations
+
+from ..resilience.faults import maybe_inject
+from ..resilience.recovery import RecoveryJournal
+
+__all__ = ["AutoscalerConfig", "Autoscaler"]
+
+
+class AutoscalerConfig:
+    """Controller knobs. Watermarks are queue depth *per healthy replica*;
+    stability counts are consecutive ticks, so the reaction time is
+    ``ticks × tick interval`` regardless of clock source."""
+
+    def __init__(self, min_replicas=1, max_replicas=4, high_watermark=8.0,
+                 low_watermark=1.0, up_stable=2, down_stable=4,
+                 drain_timeout=60.0):
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min <= max replicas: "
+                f"{self.min_replicas}..{self.max_replicas}")
+        self.high_watermark = float(high_watermark)
+        self.low_watermark = float(low_watermark)
+        self.up_stable = int(up_stable)
+        self.down_stable = int(down_stable)
+        self.drain_timeout = float(drain_timeout)
+
+
+class Autoscaler:
+    """Drives one server's replica set between ``min`` and ``max``.
+
+    Attach with ``server.attach_autoscaler(...)``; the server's pump loop
+    (and threaded loop) calls :meth:`tick` once per batching round. Tests
+    call ``tick`` directly with a fake clock.
+    """
+
+    def __init__(self, server, config=None, journal=None, clock=None,
+                 job_id="serving-autoscale"):
+        self.server = server
+        self.scheduler = server.scheduler
+        self.config = config or AutoscalerConfig()
+        self._clock = clock if clock is not None else server._clock
+        self.journal = journal or RecoveryJournal(job_id=job_id,
+                                                  clock=self._clock)
+        self._metrics = server.metrics
+        self._up_streak = 0
+        self._down_streak = 0
+        self._draining = {}     # replica idx -> drain start time
+
+    def _now(self):
+        if self._clock is not None:
+            return self._clock()
+        import time
+        return time.monotonic()
+
+    # -- controller --------------------------------------------------------
+    def replica_count(self):
+        """Replicas that count toward capacity: healthy and not draining."""
+        return len([r for r in self.scheduler.replicas
+                    if r.healthy and not r.draining])
+
+    def tick(self, now=None):
+        """One control round. Returns a dict describing any action taken
+        (for tests and the bench tool); never raises — a failed resize is
+        journaled and retried on a later tick."""
+        now = self._now() if now is None else now
+        action = {"scaled_up": False, "scaled_down": False, "removed": []}
+        action["removed"] = self._finish_drains(now)
+        depth = self.server.queue.depth()
+        n = self.replica_count()
+        per_replica = depth / n if n else float("inf")
+        if per_replica > self.config.high_watermark:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif per_replica <= self.config.low_watermark:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = 0
+            self._down_streak = 0
+        if self._up_streak >= self.config.up_stable and \
+                n < self.config.max_replicas:
+            action["scaled_up"] = self._try(self.scale_up, now)
+            self._up_streak = 0
+        elif self._down_streak >= self.config.down_stable and \
+                n > self.config.min_replicas and not self._draining:
+            action["scaled_down"] = self._try(self.scale_down, now)
+            self._down_streak = 0
+        return action
+
+    def _try(self, op, now):
+        try:
+            op(now)
+            return True
+        except Exception as e:
+            # capacity changes are best-effort: journal and retry later
+            self.journal.record("serving_scale_failed", op=op.__name__,
+                                error=repr(e),
+                                scheduler_generation=self.scheduler.generation)
+            if self._metrics:
+                self._metrics.inc("scale_failures")
+            return False
+
+    # -- resize operations -------------------------------------------------
+    def scale_up(self, now=None):
+        """Warm + preflight a new replica, then admit it to dispatch."""
+        maybe_inject("serving.scale", RuntimeError)
+        now = self._now() if now is None else now
+        idx = self.scheduler.add_replica()
+        if self._metrics:
+            self._metrics.inc("scale_ups")
+        self.journal.record("serving_scale_up", replica=idx,
+                            replicas=self.replica_count(),
+                            scheduler_generation=self.scheduler.generation)
+        return idx
+
+    def scale_down(self, now=None):
+        """Begin draining the highest-index eligible replica: placement
+        stops now; teardown happens in :meth:`_finish_drains` once its
+        in-flight work completes (or ``drain_timeout`` force-fences it)."""
+        maybe_inject("serving.scale", RuntimeError)
+        now = self._now() if now is None else now
+        victims = [r for r in self.scheduler.replicas
+                   if r.healthy and not r.draining]
+        if len(victims) <= self.config.min_replicas:
+            return None
+        victim = max(victims, key=lambda r: r.idx)
+        self.scheduler.begin_drain(victim.idx)
+        self._draining[victim.idx] = now
+        self.journal.record("serving_scale_down_begin", replica=victim.idx,
+                            scheduler_generation=self.scheduler.generation)
+        return victim.idx
+
+    def _finish_drains(self, now):
+        """Tear down drained replicas whose in-flight count reached zero;
+        force-remove (and fence) any that exceeded ``drain_timeout``."""
+        removed = []
+        for idx, started in list(self._draining.items()):
+            rep = self.scheduler.find_replica(idx)
+            if rep is None:                  # already gone (e.g. died)
+                del self._draining[idx]
+                continue
+            forced = now - started > self.config.drain_timeout
+            if rep.inflight > 0 and not forced:
+                continue
+            self.scheduler.remove_replica(idx, force=forced)
+            del self._draining[idx]
+            removed.append(idx)
+            if self._metrics:
+                self._metrics.inc("scale_downs")
+            self.journal.record(
+                "serving_scale_down", replica=idx, forced=forced,
+                replicas=self.replica_count(),
+                scheduler_generation=self.scheduler.generation)
+        return removed
+
+    def describe(self):
+        return {"replicas": self.replica_count(),
+                "min": self.config.min_replicas,
+                "max": self.config.max_replicas,
+                "draining": sorted(self._draining),
+                "up_streak": self._up_streak,
+                "down_streak": self._down_streak,
+                "scheduler_generation": self.scheduler.generation}

@@ -41,8 +41,15 @@ __all__ = ["ServerOverloaded", "DeadlineExceeded", "Request", "Batch",
 
 
 class ServerOverloaded(ResourceExhaustedError):
-    """Load shed at admission: queue full, no healthy replica, or the
-    request's deadline cannot be met. Clients should back off and retry."""
+    """Load shed at admission: queue full, admission limit hit, no healthy
+    replica, or the request's deadline cannot be met. Clients should back
+    off and retry; ``retry_after`` (seconds, may be None) is the server's
+    hint for how long — it rides the wire codec to ``InferenceClient``,
+    whose deadline-aware backoff honors it."""
+
+    def __init__(self, message="", retry_after=None):
+        super().__init__(message)
+        self.retry_after = retry_after
 
 
 class DeadlineExceeded(TimeoutError):
@@ -104,12 +111,17 @@ _batch_ids = itertools.count(1)
 class Request:
     """One admitted inference request. ``inputs`` is a list of arrays whose
     leading dim is the row count (all inputs must agree). Terminates in
-    exactly one of: ``result`` set, ``error`` set."""
+    exactly one of: ``result`` set, ``error`` set. ``priority`` is the
+    admission class (0 = highest; lower classes are shed first under
+    overload); ``on_done`` (set by the server) fires exactly once at
+    termination so the admission controller's in-system count stays exact."""
 
     __slots__ = ("id", "inputs", "rows", "signature", "deadline",
-                 "enqueued_at", "result", "error", "_done")
+                 "enqueued_at", "result", "error", "_done", "priority",
+                 "on_done")
 
-    def __init__(self, inputs, deadline=None, now=0.0, request_id=None):
+    def __init__(self, inputs, deadline=None, now=0.0, request_id=None,
+                 priority=0):
         self.inputs = [np.asarray(a) for a in inputs]
         if not self.inputs:
             raise ValueError("empty request: no input arrays")
@@ -124,8 +136,10 @@ class Request:
         self.id = request_id if request_id is not None else next(_req_ids)
         self.deadline = deadline          # absolute, server-clock seconds
         self.enqueued_at = now
+        self.priority = int(priority)
         self.result = None
         self.error = None
+        self.on_done = None
         self._done = threading.Event()
 
     def done(self):
@@ -139,12 +153,18 @@ class Request:
         return self
 
     def set_result(self, outputs):
+        first = not self._done.is_set()
         self.result = outputs
         self._done.set()
+        if first and self.on_done is not None:
+            self.on_done(self)
 
     def set_error(self, exc):
+        first = not self._done.is_set()
         self.error = exc
         self._done.set()
+        if first and self.on_done is not None:
+            self.on_done(self)
 
 
 class Batch:
@@ -193,12 +213,16 @@ class BatchQueue:
     the bucket set allows, expiring dead requests as it goes.
     """
 
-    def __init__(self, max_size, clock=None, metrics=None):
+    def __init__(self, max_size, clock=None, metrics=None,
+                 retry_after_hint=None):
         if max_size < 1:
             raise ValueError(f"max_size must be >= 1: {max_size}")
         self.max_size = int(max_size)
         self._clock = clock
         self._metrics = metrics
+        # optional fn(reason) -> seconds; the server points this at the
+        # admission controller so queue-full sheds carry a retry_after too
+        self._retry_after_hint = retry_after_hint
         self._pending = []
         self._lock = threading.Lock()
         self.not_empty = threading.Condition(self._lock)
@@ -216,6 +240,14 @@ class BatchQueue:
     def depth(self):
         return len(self)
 
+    def _hint(self, reason):
+        if self._retry_after_hint is None:
+            return None
+        try:
+            return self._retry_after_hint(reason)
+        except Exception:
+            return None
+
     def put(self, request):
         """Admit or shed. Raises :class:`ServerOverloaded` when the queue is
         full or the deadline is already unmeetable; never blocks."""
@@ -223,17 +255,19 @@ class BatchQueue:
         now = self._now()
         if request.deadline is not None and request.deadline <= now:
             if self._metrics:
-                self._metrics.inc("shed")
+                self._metrics.inc("shed", reason="deadline")
             raise ServerOverloaded(
                 f"request {request.id}: deadline {request.deadline:.3f} "
-                f"already unmeetable at enqueue (now {now:.3f})")
+                f"already unmeetable at enqueue (now {now:.3f})",
+                retry_after=self._hint("deadline"))
         with self.not_empty:
             if len(self._pending) >= self.max_size:
                 if self._metrics:
-                    self._metrics.inc("shed")
+                    self._metrics.inc("shed", reason="queue_full")
                 raise ServerOverloaded(
                     f"request {request.id}: queue full "
-                    f"({self.max_size} pending); shedding load")
+                    f"({self.max_size} pending); shedding load",
+                    retry_after=self._hint("queue_full"))
             request.enqueued_at = now
             self._pending.append(request)
             if self._metrics:
@@ -251,7 +285,7 @@ class BatchQueue:
                     f"request {req.id} expired in queue after "
                     f"{now - req.enqueued_at:.3f}s"))
                 if self._metrics:
-                    self._metrics.inc("shed")
+                    self._metrics.inc("shed", reason="deadline")
             else:
                 live.append(req)
         self._pending = live

@@ -6,11 +6,23 @@ Server-side errors come back typed — ``ServerOverloaded`` /
 ``DeadlineExceeded`` re-raise as themselves so client backoff logic can
 ``except ServerOverloaded`` without string matching; anything else raises
 :class:`RemoteInferenceError` carrying the server's error type and message.
+
+Overload behavior: a shed reply carries the server's ``retry_after`` hint
+(the admission controller computes it from how far over the limit the
+system is). :meth:`InferenceClient.infer` retries sheds itself with
+**deadline-aware exponential backoff + full jitter** — each wait is the max
+of the server hint and the jittered exponential term, capped so the retry
+still fits inside the caller's ``timeout``. When the budget can't fit
+another attempt the last ``ServerOverloaded`` is re-raised with
+``retry_after`` set, so callers layering their own policy still see the
+hint. Sleep and RNG are injectable for deterministic tests.
 """
 from __future__ import annotations
 
+import random
 import socket
 import threading
+import time
 
 import numpy as np
 
@@ -41,13 +53,26 @@ _TYPED = {
 class InferenceClient:
     """Blocking request/response client; thread-safe (one in-flight request
     per client at a time, serialized by a lock — run N clients for N-way
-    concurrency, they're cheap)."""
+    concurrency, they're cheap).
 
-    def __init__(self, host, port=None, connect_timeout=10.0):
+    ``retries``/``backoff_base``/``backoff_cap`` govern the overload-retry
+    loop; ``sleep``/``rng``/``clock`` exist so tests drive it with zero real
+    sleeps and a seeded jitter.
+    """
+
+    def __init__(self, host, port=None, connect_timeout=10.0, retries=3,
+                 backoff_base=0.05, backoff_cap=2.0, sleep=None, rng=None,
+                 clock=None):
         if port is None:
             host, port = host  # accept the frontend's .address tuple
         self._addr = (host, int(port))
         self._connect_timeout = connect_timeout
+        self.retries = int(retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._rng = rng if rng is not None else random.Random()
+        self._clock = clock if clock is not None else time.monotonic
         self._sock = None
         self._lock = threading.Lock()
 
@@ -59,14 +84,49 @@ class InferenceClient:
             self._sock = s
         return self._sock
 
-    def infer(self, inputs, timeout=None, request_id=None):
+    def backoff_delay(self, attempt, retry_after=None):
+        """Wait before retry ``attempt`` (0-based): exponential with full
+        jitter, floored at the server's ``retry_after`` hint — the server
+        knows how overloaded it is better than our local guess does."""
+        exp = min(self.backoff_cap, self.backoff_base * (2 ** attempt))
+        jittered = self._rng.uniform(0.0, exp)
+        return max(retry_after or 0.0, jittered)
+
+    def infer(self, inputs, timeout=None, request_id=None, priority=0):
         """Run one request; returns the list of output arrays.
 
         ``timeout`` travels to the server as the request deadline AND bounds
-        the socket wait (plus slack for one reply frame in flight)."""
+        the socket wait (plus slack for one reply frame in flight) AND caps
+        the total time spent across overload retries."""
+        deadline = (self._clock() + timeout) if timeout is not None else None
+        last = None
+        for attempt in range(self.retries + 1):
+            remaining = None if deadline is None \
+                else max(0.0, deadline - self._clock())
+            try:
+                return self._infer_once(inputs, remaining, request_id,
+                                        priority)
+            except ServerOverloaded as e:
+                last = e
+            delay = self.backoff_delay(attempt,
+                                       getattr(last, "retry_after", None))
+            if attempt >= self.retries:
+                break
+            if deadline is not None and \
+                    self._clock() + delay >= deadline:
+                # the budget can't fit the wait plus another attempt:
+                # surface the shed (with its hint) instead of burning the
+                # caller's deadline on a doomed retry
+                break
+            self._sleep(delay)
+        raise last
+
+    def _infer_once(self, inputs, timeout, request_id, priority):
         from ..distributed import wire
         frame = {"inputs": [np.ascontiguousarray(a) for a in inputs],
                  "timeout": timeout, "id": request_id}
+        if priority:
+            frame["priority"] = int(priority)
         io_timeout = (timeout + 5.0) if timeout is not None else ...
         with self._lock:
             sock = self._conn()
@@ -84,7 +144,11 @@ class InferenceClient:
             etype = reply.get("error_type", "RemoteError")
             exc = _TYPED.get(etype)
             if exc is not None:
-                raise exc(reply["error"])
+                err = exc(reply["error"])
+                hint = reply.get("retry_after")
+                if hint is not None:
+                    err.retry_after = float(hint)
+                raise err
             raise RemoteInferenceError(etype, reply["error"])
         return [np.asarray(o) for o in reply["outputs"]]
 

@@ -47,7 +47,21 @@ class ServingMetrics:
         "padded_rows",      # padding rows executed (bucket slack)
         "replica_deaths",   # replicas marked dead
         "replica_restarts", # replicas restarted after draining
+        "breaker_opens",    # circuit breakers tripped open
+        "breaker_closes",   # breakers closed after preflight + canary
+        "hedges",           # hedged (re-placed) dispatches
+        "hedge_wins",       # hedge attempts that delivered the result
+        "scale_ups",        # autoscaler replicas added
+        "scale_downs",      # autoscaler replicas drained + removed
+        "scale_failures",   # resize attempts that failed (journaled)
+        "late_drops",       # fenced results from removed replicas, dropped
     )
+
+    # `shed` is additionally labeled by cause so the overload runbook can
+    # tell queue pressure from SLO misses from sick replicas from the
+    # admission limiter (docs/serving.md). Mirrored into the registry as
+    # serving.shed_total{reason=...}; snapshot carries shed_<reason> keys.
+    SHED_REASONS = ("queue_full", "deadline", "unhealthy", "admission")
 
     def __init__(self, clock=None):
         self._clock = clock
@@ -68,12 +82,17 @@ class ServingMetrics:
         return _metrics.get_registry()
 
     # -- recording -----------------------------------------------------------
-    def inc(self, name, n=1):
+    def inc(self, name, n=1, reason=None):
         with self._lock:
             self._c[name] = self._c.get(name, 0) + n
+            if reason is not None:
+                key = f"{name}_{reason}"
+                self._c[key] = self._c.get(key, 0) + n
         # always-on mirror: production counters must survive with the
         # profiler disabled (docs/observability.md naming manifest)
-        self._registry().inc_counter(f"serving.{name}_total", n)
+        self._registry().inc_counter(
+            f"serving.{name}_total", n,
+            labels={"reason": reason} if reason is not None else None)
 
     def observe_latency(self, seconds):
         with self._lock:

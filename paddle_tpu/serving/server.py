@@ -46,6 +46,7 @@ from .batcher import (
     BatchQueue, DeadlineExceeded, Request, ServerOverloaded, pow2_buckets,
 )
 from .metrics import ServingMetrics
+from .overload import AdmissionController
 from .scheduler import ReplicaDead, Scheduler
 
 __all__ = ["ServingConfig", "InferenceServer", "SocketFrontend",
@@ -65,7 +66,9 @@ class ServingConfig:
     def __init__(self, max_batch_size=8, buckets=None, max_queue=None,
                  replicas=1, default_deadline=None, batch_wait=0.01,
                  step_timeout=None, max_retries=1, max_cached_executables=32,
-                 warmup_signatures=(), recorder_size=256):
+                 warmup_signatures=(), recorder_size=256,
+                 admission_target_ms=None, admission_initial=None,
+                 admission_max=None, hedge_budget=None):
         self.max_batch_size = int(max_batch_size)
         self.buckets = sorted(buckets) if buckets else \
             pow2_buckets(max_batch_size)
@@ -88,6 +91,16 @@ class ServingConfig:
         # [(signature, ...)] per-row signatures to pre-compile at start
         self.warmup_signatures = list(warmup_signatures)
         self.recorder_size = int(recorder_size)
+        # AIMD admission knobs (None -> FLAGS_serving_admission_target_ms /
+        # derived from max_queue). The limit counts requests *in the
+        # system*; it starts at (and is capped by) 2x the queue bound so a
+        # freshly started server sheds on queue-full, not admission, until
+        # latency evidence says otherwise.
+        self.admission_target_ms = admission_target_ms
+        self.admission_initial = admission_initial
+        self.admission_max = admission_max
+        # hedge budget override (None -> FLAGS_serving_hedge_budget)
+        self.hedge_budget = hedge_budget
 
 
 class InferenceServer:
@@ -104,18 +117,31 @@ class InferenceServer:
         self._clock = clock
         self.metrics = ServingMetrics(clock=clock)
         factory = self._make_factory(predictor_or_config)
-        self.queue = BatchQueue(self.config.max_queue, clock=clock,
-                                metrics=self.metrics)
+        admission_cap = self.config.admission_max or \
+            2 * self.config.max_queue
+        self.admission = AdmissionController(
+            target_ms=self.config.admission_target_ms,
+            initial=self.config.admission_initial or admission_cap,
+            max_limit=admission_cap, metrics=self.metrics, clock=clock)
+        self.queue = BatchQueue(
+            self.config.max_queue, clock=clock, metrics=self.metrics,
+            retry_after_hint=lambda reason: self.admission.retry_after())
         self.metrics.register_gauge("queue_depth", self.queue.depth)
         self.scheduler = Scheduler(
             factory, self.config.replicas, clock=clock,
             step_timeout=self.config.step_timeout, metrics=self.metrics,
-            max_cached=self.config.max_cached_executables)
+            max_cached=self.config.max_cached_executables,
+            hedge_budget=self.config.hedge_budget)
+        self.metrics.register_gauge(
+            "admission_limit", lambda: self.admission.snapshot()["limit"])
+        self.metrics.register_gauge(
+            "replicas", lambda: len(self.scheduler.healthy_replicas()))
         self.recorder = FlightRecorder(size=self.config.recorder_size,
                                        rank=0, clock=clock)
         self._worker = None
         self._stop = threading.Event()
         self._crashed = None
+        self._autoscaler = None
         for sig in self.config.warmup_signatures:
             self.warmup(sig)
 
@@ -148,24 +174,39 @@ class InferenceServer:
         return time.monotonic()
 
     # -- client API ------------------------------------------------------------
-    def submit(self, inputs, deadline=None, timeout=None, request_id=None):
+    def submit(self, inputs, deadline=None, timeout=None, request_id=None,
+               priority=0):
         """Admit one request (non-blocking). ``timeout`` is relative seconds
         (converted to an absolute deadline on the server clock); ``deadline``
-        is already absolute. Raises :class:`ServerOverloaded` when shedding.
+        is already absolute; ``priority`` 0 is highest — lower classes are
+        shed first under overload. Raises :class:`ServerOverloaded` (with a
+        ``retry_after`` hint) when shedding.
         """
         now = self._now()
         if deadline is None:
             rel = timeout if timeout is not None \
                 else self.config.default_deadline
             deadline = now + rel if rel is not None else None
+        # AIMD gate first: it bounds requests in the whole system, the
+        # queue bound below only the waiting room
+        self.admission.admit(priority=priority, now=now)
         req = Request(inputs, deadline=deadline, now=now,
-                      request_id=request_id)
-        self.queue.put(req)
+                      request_id=request_id, priority=priority)
+        # the admission slot is held until the request terminates, however
+        # it terminates (set_result and set_error both fire on_done once)
+        req.on_done = lambda _r: self.admission.note_done()
+        try:
+            self.queue.put(req)
+        except BaseException:
+            # enqueue shed (queue full / unmeetable deadline): the request
+            # never entered the system, give the admission slot back
+            self.admission.note_done()
+            raise
         return req
 
-    def infer(self, inputs, timeout=None):
+    def infer(self, inputs, timeout=None, priority=0):
         """Synchronous convenience: submit + (pump | wait) + unwrap."""
-        req = self.submit(inputs, timeout=timeout)
+        req = self.submit(inputs, timeout=timeout, priority=priority)
         if self._worker is None:
             self.pump_until_done(req)
         else:
@@ -177,11 +218,14 @@ class InferenceServer:
     # -- batching loop ---------------------------------------------------------
     def pump(self, max_batches=1):
         """Run up to ``max_batches`` assemble→dispatch→reply rounds on the
-        calling thread. Returns the number of batches processed. Dead
-        replicas are drained/restarted between rounds."""
+        calling thread. Returns the number of batches processed. Between
+        rounds the scheduler housekeeps (dead-replica restarts, breaker
+        half-open probes) and the autoscaler, if attached, gets a tick."""
         done = 0
         for _ in range(max_batches):
-            self.scheduler.restart_dead()
+            self.scheduler.maintain()
+            if self._autoscaler is not None:
+                self._autoscaler.tick()
             batch = self.queue.assemble(self.config.buckets,
                                         max_rows=self.config.max_batch_size)
             if batch is None:
@@ -222,6 +266,7 @@ class InferenceServer:
                 dtypes=[str(a.dtype) for a in batch.arrays],
                 peer={"batch": batch.id, "attempt": attempt,
                       "requests": [r.id for r in batch.requests]})
+            exec_start = self._now()
             try:
                 # a serving batch has no trainer step around it: the phase
                 # lands in the timer's global accumulators and the
@@ -231,6 +276,11 @@ class InferenceServer:
                     outputs, rep = self.scheduler.dispatch(batch)
             except (ReplicaDead, DistributedTimeout) as e:
                 self.recorder.finish(entry, status=type(e).__name__)
+                # a timeout/death is a congestion signal too: the AIMD loop
+                # sees the full elapsed wall time, not a fabricated latency
+                elapsed = self._now() - exec_start
+                self._observe_exec(elapsed)
+                self.admission.observe(elapsed, now=self._now())
                 last_exc = e
                 self.scheduler.restart_dead()
                 if attempt + 1 < attempts and self._retry_allowed(batch):
@@ -246,6 +296,7 @@ class InferenceServer:
                 last_exc = e
                 break
             self.recorder.finish(entry, status="ok")
+            self._observe_exec(self._now() - exec_start)
             try:
                 self._reply(batch, outputs)
             except Exception as e:
@@ -254,6 +305,25 @@ class InferenceServer:
                 self._fail_batch(batch, e)
             return
         self._fail_batch(batch, last_exc)
+
+    def _observe_exec(self, elapsed_s):
+        """Feed one batch's execution latency to the scheduler's per-server
+        hedge-delay histogram and the global registry's always-on mirror.
+        (The AIMD loop is fed separately: request *sojourn* in `_reply`,
+        because pure execution time is blind to queueing — under overload
+        batches still execute fast while requests age in the queue.)"""
+        self.scheduler.note_exec_latency(elapsed_s)
+        from ..profiler.metrics import get_registry
+        get_registry().observe("serving.batch_exec_ms", elapsed_s * 1e3)
+
+    def attach_autoscaler(self, config=None, journal=None,
+                          job_id="serving-autoscale"):
+        """Enable elastic replica scaling: the pump/threaded loop ticks the
+        controller once per batching round. Returns the Autoscaler."""
+        from .autoscaler import Autoscaler
+        self._autoscaler = Autoscaler(self, config=config, journal=journal,
+                                      clock=self._clock, job_id=job_id)
+        return self._autoscaler
 
     def _retry_allowed(self, batch):
         now = self._now()
@@ -271,8 +341,14 @@ class InferenceServer:
         self.metrics.inc("rows", batch.rows)
         self.metrics.inc("padded_rows", batch.bucket - batch.rows)
         self.metrics.inc("completed", len(batch.requests))
+        sojourn = 0.0
         for req in batch.requests:
-            self.metrics.observe_latency(max(0.0, now - req.enqueued_at))
+            lat = max(0.0, now - req.enqueued_at)
+            self.metrics.observe_latency(lat)
+            sojourn = max(sojourn, lat)
+        # the AIMD congestion signal: worst end-to-end sojourn in the batch
+        # (queue wait + execution) vs the latency target
+        self.admission.observe(sojourn, now=now)
 
     def _fail_batch(self, batch, exc):
         exc = exc if exc is not None else RuntimeError(
@@ -317,7 +393,9 @@ class InferenceServer:
         try:
             while not self._stop.is_set():
                 if not self.queue.wait_nonempty(self.config.batch_wait):
-                    self.scheduler.restart_dead()
+                    self.scheduler.maintain()
+                    if self._autoscaler is not None:
+                        self._autoscaler.tick()
                     continue
                 # brief accumulation window lets concurrent submitters fill
                 # the bucket (classic batching-delay/throughput tradeoff)
@@ -351,6 +429,10 @@ class InferenceServer:
     def stats(self):
         snap = self.metrics.snapshot()
         snap["replicas"] = self.scheduler.describe()
+        snap["admission"] = self.admission.snapshot()
+        snap["hedging"] = self.scheduler.hedge_stats()
+        if self._autoscaler is not None:
+            snap["autoscaler"] = self._autoscaler.describe()
         snap["compiles"] = sum(r.compile_count
                                for r in self.scheduler.replicas)
         snap["crashed"] = repr(self._crashed) if self._crashed else None
@@ -421,15 +503,21 @@ class SocketFrontend:
                 raise ValueError("frame must be {'id', 'inputs', ...}")
             inputs = [np.asarray(a) for a in msg["inputs"]]
             req = self._server.submit(inputs, timeout=msg.get("timeout"),
-                                      request_id=rid)
+                                      request_id=rid,
+                                      priority=int(msg.get("priority", 0)))
             req.wait(msg.get("timeout"))
             if req.error is not None:
                 raise req.error
             return {"id": req.id, "outputs": [np.asarray(o)
                                               for o in req.result]}
         except BaseException as e:
-            return {"id": rid, "error": str(e),
-                    "error_type": type(e).__name__}
+            reply = {"id": rid, "error": str(e),
+                     "error_type": type(e).__name__}
+            # overload sheds carry the server's backoff hint to the client
+            hint = getattr(e, "retry_after", None)
+            if hint is not None:
+                reply["retry_after"] = float(hint)
+            return reply
 
     def close(self):
         self._closing = True

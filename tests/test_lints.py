@@ -116,6 +116,33 @@ def test_injection_lint_covers_checkpoint_entry_points():
         ("paddle_tpu/incubate/checkpoint.py", "class:CheckpointSaver")]
 
 
+def test_injection_lint_covers_overload_entry_points():
+    """The overload-control PR's contract: the hedge boundary
+    (serving.hedge, carried by Scheduler._hedge_site) and elastic resizes
+    (serving.scale in Autoscaler.scale_up/scale_down) must stay
+    chaos-testable, and both dispatch attempts must keep funnelling through
+    the hooked _attempt chokepoint. Guard the MANIFEST and HOOK_CALLS so a
+    refactor can't silently drop the requirement along with the hook."""
+    import ast
+    src = (REPO / "tools" / "check_injection_points.py").read_text()
+    tree = ast.parse(src)
+
+    def _assigned(name):
+        return next(
+            node.value for node in ast.walk(tree)
+            if isinstance(node, ast.Assign)
+            and any(getattr(t, "id", None) == name for t in node.targets))
+
+    manifest = ast.literal_eval(_assigned("REQUIRED"))
+    entries = {(rel, scope): names for rel, scope, names in manifest}
+    assert "_hedge_site" in entries[
+        ("paddle_tpu/serving/scheduler.py", "class:Scheduler")]
+    assert {"scale_up", "scale_down"} <= set(entries[
+        ("paddle_tpu/serving/autoscaler.py", "class:Autoscaler")])
+    hooks = ast.literal_eval(_assigned("HOOK_CALLS"))
+    assert "_attempt" in hooks
+
+
 def test_metric_name_lint_passes_on_tree():
     r = _run(REPO / "tools" / "check_metric_names.py")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -182,3 +209,21 @@ def test_serving_bench_help_smoke():
     r = _run(REPO / "tools" / "serving_bench.py", "--help")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "shed rate" in r.stdout
+
+
+def test_serving_bench_overload_smoke():
+    """The overload sweep must keep demonstrating graceful degradation:
+    at 10x offered load goodput stays positive, every request terminates,
+    and p99 holds under the deadline. Fake clock + synthetic predictor, so
+    this runs in ~2s of wall time despite simulating seconds of traffic."""
+    import json
+    r = _run(REPO / "tools" / "serving_bench.py", "--overload", "--smoke")
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["graceful_degradation"] is True
+    ten_x = [p for p in report["results"] if p["multiplier"] >= 10.0]
+    assert ten_x, report
+    for point in ten_x:
+        assert point["completed"] > 0
+        assert point["unterminated"] == 0
+        assert point["shed"] == point["shed_with_hint"]

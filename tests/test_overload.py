@@ -1,0 +1,811 @@
+"""Overload-control tests (docs/serving.md "Overload & autoscaling").
+
+Covers the ISSUE's overload layer end to end, all on a fake clock with zero
+real sleeps:
+
+- AIMD admission (limit trajectory, priority-class shedding order,
+  retry_after hints riding ServerOverloaded);
+- per-replica circuit breakers (open after K failures in the rolling
+  window, half-open probe gated on preflight + canary, re-open on probe
+  failure) — including the regression the ISSUE names: a replica that
+  keeps timing out no longer stays in dispatch;
+- hedged dispatch (p99-derived delay, budget, injected hang at the hedge
+  boundary re-placing the batch, first result wins);
+- elastic autoscaling (scale-up warms before entering dispatch, scale-down
+  drains first, journaled + generation-fenced resizes, late results from
+  force-removed replicas dropped);
+- the satellites: re-warm after restart, round-robin tie-breaking,
+  shed-reason labels, client backoff, and the overload soak acceptance
+  scenario (sustained 10x pressure + replica death mid-soak).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.profiler import metrics as pmetrics
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.watchdog import DistributedTimeout
+from paddle_tpu.serving import (
+    AdmissionController, Autoscaler, AutoscalerConfig, CircuitBreaker,
+    InferenceServer, ReplicaRetired, Scheduler, ServerOverloaded,
+    ServingConfig,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakePredictor:
+    """Doubles input[0]; optionally advances a clock per call (synthetic
+    service time) and counts distinct signatures (stand-in compiles)."""
+
+    def __init__(self, clock=None, service_s=0.0, on_run=None):
+        self.calls = 0
+        self.signatures = set()
+        self._clock = clock
+        self._service_s = service_s
+        self._on_run = on_run
+
+    def run(self, arrays):
+        self.calls += 1
+        if self._clock is not None and self._service_s:
+            self._clock.advance(self._service_s)
+        if self._on_run is not None:
+            self._on_run(self)
+        self.signatures.add(tuple(
+            (tuple(a.shape), str(a.dtype)) for a in arrays))
+        return [np.asarray(arrays[0]) * 2.0]
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_ARTIFACTS_DIR", str(tmp_path / "artifacts"))
+    faults.reset()
+    pmetrics.reset_registry()
+    yield
+    faults.reset()
+    pmetrics.reset_registry()
+    paddle.set_flags({
+        "FLAGS_serving_step_timeout": 60.0,
+        "FLAGS_serving_max_queue": 256,
+        "FLAGS_serving_admission_target_ms": 100.0,
+        "FLAGS_serving_breaker_failures": 5,
+        "FLAGS_serving_breaker_window": 30.0,
+        "FLAGS_serving_breaker_cooldown": 10.0,
+        "FLAGS_serving_hedge_budget": 0.05,
+        "FLAGS_serving_hedge_min_ms": 10.0,
+        "FLAGS_serving_retry_after": 0.1,
+        "FLAGS_preflight_checks": True,
+    })
+
+
+def make_server(replicas=2, max_batch_size=8, clock=None, service_s=0.0,
+                **kw):
+    clock = clock or FakeClock()
+    cfg = ServingConfig(max_batch_size=max_batch_size, replicas=replicas,
+                        **kw)
+    srv = InferenceServer(
+        lambda i: FakePredictor(clock=clock, service_s=service_s),
+        cfg, clock=clock)
+    return srv, clock
+
+
+def x(rows=1, fill=1.0):
+    return [np.full((rows, 3), fill, "float32")]
+
+
+# -- AIMD admission ----------------------------------------------------------
+
+class TestAdmissionController:
+    def test_additive_increase_under_target(self):
+        clock = FakeClock()
+        ac = AdmissionController(target_ms=100.0, initial=4, max_limit=64,
+                                 clock=clock)
+        for _ in range(100):
+            ac.observe(0.05, now=clock())
+            clock.advance(0.05)
+        assert ac.limit > 4          # crept up...
+        assert ac.limit <= 64        # ...but respects the cap
+
+    def test_multiplicative_decrease_rate_limited(self):
+        clock = FakeClock()
+        ac = AdmissionController(target_ms=100.0, initial=64, max_limit=64,
+                                 clock=clock)
+        # a burst of slow batches inside one target interval = ONE
+        # congestion signal (TCP: one loss event per RTT)
+        for _ in range(10):
+            ac.observe(0.5, now=clock())
+        assert ac.limit == pytest.approx(64 * 0.7)
+        clock.advance(0.2)           # next interval: another cut allowed
+        ac.observe(0.5, now=clock())
+        assert ac.limit == pytest.approx(64 * 0.7 * 0.7)
+
+    def test_limit_never_below_min(self):
+        clock = FakeClock()
+        ac = AdmissionController(target_ms=100.0, initial=4, min_limit=1,
+                                 max_limit=64, clock=clock)
+        for _ in range(50):
+            ac.observe(10.0, now=clock())
+            clock.advance(1.0)
+        assert ac.limit >= 1.0
+
+    def test_priority_shed_order(self):
+        # limit 8: class 2 sees 8*0.5=4 slots, class 0 all 8 — the lowest
+        # class sheds first as the system fills (the ISSUE's order)
+        ac = AdmissionController(target_ms=100.0, initial=8, max_limit=8,
+                                 clock=FakeClock())
+        for _ in range(4):
+            ac.admit(priority=2)
+        with pytest.raises(ServerOverloaded):
+            ac.admit(priority=2)     # class 2 ceiling hit
+        for _ in range(4):
+            ac.admit(priority=0)     # class 0 still has headroom
+        with pytest.raises(ServerOverloaded):
+            ac.admit(priority=0)     # now the whole limit is full
+
+    def test_shed_carries_retry_after(self):
+        ac = AdmissionController(target_ms=100.0, initial=1, max_limit=1,
+                                 clock=FakeClock(), retry_after_base=0.1)
+        ac.admit()
+        with pytest.raises(ServerOverloaded) as ei:
+            ac.admit()
+        assert ei.value.retry_after is not None
+        assert ei.value.retry_after > 0.0
+        assert ac.shed == 1
+
+    def test_note_done_frees_slot(self):
+        ac = AdmissionController(target_ms=100.0, initial=1, max_limit=1,
+                                 clock=FakeClock())
+        ac.admit()
+        ac.note_done()
+        ac.admit()                   # slot was freed
+        assert ac.inflight == 1
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_opens_after_k_failures_in_window(self):
+        br = CircuitBreaker(failures=3, window=10.0, cooldown=5.0)
+        assert not br.record_failure(0.0)
+        assert not br.record_failure(1.0)
+        assert br.state == "closed" and br.allows()
+        assert br.record_failure(2.0)          # K-th failure trips it
+        assert br.state == "open" and not br.allows()
+        assert br.opens == 1
+
+    def test_rolling_window_prunes_old_failures(self):
+        br = CircuitBreaker(failures=3, window=10.0, cooldown=5.0)
+        br.record_failure(0.0)
+        br.record_failure(1.0)
+        # the first two age out: these two are only 2-in-window
+        assert not br.record_failure(20.0)
+        assert not br.record_failure(21.0)
+        assert br.state == "closed"
+
+    def test_half_open_probe_cycle(self):
+        br = CircuitBreaker(failures=1, window=10.0, cooldown=5.0)
+        br.record_failure(0.0)
+        assert br.state == "open"
+        assert not br.probe_due(4.0)           # cooldown not elapsed
+        assert br.probe_due(5.0)
+        assert br.state == "half_open" and not br.allows()
+        # probe failure: straight back to open with a fresh cooldown
+        assert br.record_failure(5.5)
+        assert br.state == "open" and br.opens == 2
+        assert not br.probe_due(9.0)           # new cooldown from 5.5
+        assert br.probe_due(10.5)
+        br.close(10.6)
+        assert br.state == "closed" and br.allows()
+
+
+# -- scheduler: breakers, hedging, round-robin, elasticity -------------------
+
+def make_scheduler(n=2, clock=None, **kw):
+    clock = clock or FakeClock()
+    kw.setdefault("preflight", lambda p: None)
+    sched = Scheduler(lambda i: FakePredictor(clock=clock, service_s=0.001),
+                      n, clock=clock, metrics=serving.ServingMetrics(clock),
+                      **kw)
+    return sched, clock
+
+
+def run_one(srv, clock, **kwargs):
+    req = srv.submit(x(), **kwargs)
+    srv.pump_until_done(req)
+    return req
+
+
+def make_wedgeable_server(cooldown=50.0):
+    """Two replicas; replica 1's predictor can be wedged (every run raises
+    TimeoutError → DistributedTimeout via the watch section) and unwedged —
+    the shape of a sick-but-not-dead device the breaker exists for."""
+    clock = FakeClock()
+    wedged = {"on": False}
+
+    class Wedgeable(FakePredictor):
+        def run(self, arrays):
+            if wedged["on"]:
+                raise TimeoutError("device wedged (injected)")
+            return super().run(arrays)
+
+    def factory(i):
+        cls = Wedgeable if i == 1 else FakePredictor
+        return cls(clock=clock, service_s=0.001)
+
+    cfg = ServingConfig(max_batch_size=8, replicas=2, max_retries=1,
+                        warmup_signatures=[(((3,), "float32"),)])
+    srv = InferenceServer(factory, cfg, clock=clock)
+    paddle.set_flags({"FLAGS_serving_breaker_failures": 2,
+                      "FLAGS_serving_breaker_window": 1000.0,
+                      "FLAGS_serving_breaker_cooldown": cooldown})
+    return srv, clock, wedged
+
+
+def wedge_until_open(srv, clock, wedged):
+    """Drive traffic until replica 1's breaker opens (each batch placed on
+    it times out and is retried on replica 0)."""
+    wedged["on"] = True
+    rep = srv.scheduler.find_replica(1)
+    for _ in range(10):
+        if not rep.breaker.allows():
+            break
+        assert run_one(srv, clock).error is None
+    assert rep.breaker.state == "open"
+    return rep
+
+
+class TestSchedulerBreakers:
+    def test_timeouting_replica_loses_traffic(self):
+        """The ISSUE's regression: a replica that keeps hitting
+        DistributedTimeout used to stay healthy=True and keep receiving
+        batches. Now its breaker opens and pick() skips it."""
+        srv, clock, wedged = make_wedgeable_server()
+        sick = wedge_until_open(srv, clock, wedged)
+        assert sick.healthy                 # not dead — just fenced off
+        assert not sick.breaker.allows()
+        assert srv.metrics.get("breaker_opens") == 1
+        # traffic keeps flowing on the remaining replica only
+        other = srv.scheduler.find_replica(0)
+        before_other, before_sick = other.completed, sick.completed
+        for _ in range(4):
+            assert run_one(srv, clock).error is None
+        assert other.completed == before_other + 4
+        assert sick.completed == before_sick
+
+    def test_breaker_closes_after_preflight_and_canary(self):
+        srv, clock, wedged = make_wedgeable_server(cooldown=5.0)
+        sick = wedge_until_open(srv, clock, wedged)
+        wedged["on"] = False                # device recovered
+        canary_calls = sick.executor.predictor.calls
+        clock.advance(6.0)                  # past cooldown
+        srv.pump(1)                         # maintain() runs the probe
+        assert sick.breaker.state == "closed"
+        assert sick.executor.predictor.calls == canary_calls + 1  # canary
+        assert srv.metrics.get("breaker_closes") == 1
+        # and the replica takes traffic again
+        before = sick.completed
+        for _ in range(6):
+            run_one(srv, clock)
+        assert sick.completed > before
+
+    def test_failed_probes_reopen_breaker(self):
+        srv, clock, wedged = make_wedgeable_server(cooldown=5.0)
+        sick = wedge_until_open(srv, clock, wedged)
+        wedged["on"] = False
+        # probe 1: the preflight KAT fails — straight back to open, no
+        # traffic reached the replica
+        faults.configure("integrity.preflight:#1")
+        clock.advance(6.0)
+        srv.pump(1)
+        assert sick.breaker.state == "open"
+        assert sick.breaker.opens == 2
+        # probe 2: the KAT passes but the canary batch hangs (device still
+        # wedged) — re-open again
+        wedged["on"] = True
+        clock.advance(6.0)
+        srv.pump(1)
+        assert sick.breaker.state == "open"
+        assert sick.breaker.opens == 3
+        # probe 3: genuinely recovered — preflight + canary pass, closed
+        wedged["on"] = False
+        clock.advance(6.0)
+        srv.pump(1)
+        assert sick.breaker.state == "closed"
+
+
+class TestHedging:
+    def prime(self, sched, ms=20.0, n=20):
+        for _ in range(n):
+            sched.note_exec_latency(ms / 1e3)
+
+    def test_no_hedge_without_samples(self):
+        sched, _ = make_scheduler(2)
+        assert sched.hedge_delay() is None
+
+    def test_delay_derives_from_p99_with_floor(self):
+        sched, _ = make_scheduler(2)
+        self.prime(sched, ms=40.0)
+        assert sched.hedge_delay() == pytest.approx(0.04)
+        sched2, _ = make_scheduler(2)
+        self.prime(sched2, ms=1.0)     # p99 below the 10ms floor
+        assert sched2.hedge_delay() == pytest.approx(0.01)
+
+    def test_budget_zero_disables(self):
+        sched, _ = make_scheduler(2, hedge_budget=0.0)
+        self.prime(sched)
+        assert sched.hedge_delay() is None
+
+    def test_single_replica_disables(self):
+        sched, _ = make_scheduler(1)
+        self.prime(sched)
+        assert sched.hedge_delay() is None
+
+    def test_injected_hang_at_hedge_boundary_is_re_placed(self):
+        """serving.hedge chaos site: the primary attempt hangs past its
+        hedge window; the batch re-places on the second replica and the
+        request still succeeds — first completed attempt wins."""
+        srv, clock = make_server(replicas=2, max_retries=1,
+                                 hedge_budget=1.0)
+        for _ in range(20):
+            srv.scheduler.note_exec_latency(0.02)
+        faults.configure("serving.hedge:#1")
+        req = run_one(srv, clock)
+        assert req.error is None
+        np.testing.assert_allclose(req.result[0], req.inputs[0] * 2.0)
+        assert srv.metrics.get("hedges") == 1
+        assert srv.metrics.get("hedge_wins") == 1
+        stats = srv.scheduler.hedge_stats()
+        assert stats["hedges"] == 1
+        # the hung primary fed its replica's breaker
+        assert sum(r.breaker.describe()["recent_failures"]
+                   for r in srv.scheduler.replicas) == 1
+
+    def test_hedge_budget_bounds_hedge_rate(self):
+        srv, clock = make_server(replicas=2, max_retries=1,
+                                 hedge_budget=0.05)
+        for _ in range(20):
+            srv.scheduler.note_exec_latency(0.02)
+        faults.configure("serving.hedge:0.5")  # half the primaries hang
+        for _ in range(60):
+            run_one(srv, clock)
+        stats = srv.scheduler.hedge_stats()
+        # the budget caps re-placement at ~5% of dispatches (+1 rounding)
+        assert stats["hedges"] <= stats["dispatches"] * 0.05 + 1
+
+
+class TestRoundRobinPick:
+    def test_ties_rotate_across_replicas(self):
+        """Satellite: equal-load picks must rotate, not pin to idx 0 the
+        way the old (inflight, idx) key did."""
+        sched, _ = make_scheduler(3)
+        counts = {0: 0, 1: 0, 2: 0}
+        for _ in range(30):
+            rep = sched.pick()      # no dispatch: inflight stays equal
+            counts[rep.idx] += 1
+        assert set(counts) == {0, 1, 2}
+        assert all(c == 10 for c in counts.values()), counts
+
+    def test_load_still_dominates_rotation(self):
+        sched, _ = make_scheduler(3)
+        sched.replicas[0].inflight = 2
+        sched.replicas[1].inflight = 2
+        for _ in range(5):          # least-loaded wins regardless of rr
+            assert sched.pick().idx == 2
+
+
+class TestElasticMembership:
+    def test_add_replica_enters_warm_and_preflighted(self):
+        kats = []
+        sched, clock = make_scheduler(1, preflight=kats.append)
+        sched.warmup((((3,), "float32"),), (1, 2, 4))
+        idx = sched.add_replica()
+        assert idx == 1
+        rep = sched.find_replica(1)
+        # preflighted + every recorded bucket pre-compiled before traffic
+        assert len(kats) == 1
+        assert rep.executor.compile_count == 3
+        assert sched.generation == 2
+
+    def test_remove_refuses_inflight_without_force(self):
+        sched, _ = make_scheduler(2)
+        sched.replicas[0].inflight = 1
+        sched.begin_drain(0)
+        with pytest.raises(RuntimeError, match="in flight"):
+            sched.remove_replica(0)
+        assert sched.remove_replica(0, force=True) is not None
+        assert sched.find_replica(0) is None
+
+    def test_late_result_from_force_removed_replica_dropped(self):
+        """Generation fencing: a replica force-removed while its batch ran
+        must not deliver the result (ReplicaRetired; late_drops counted).
+        The removal happens *inside* predictor.run — exactly the race a
+        drain timeout creates."""
+        clock = FakeClock()
+        cfg = ServingConfig(max_batch_size=4, replicas=2, max_retries=1)
+
+        state = {"armed": False}
+
+        def factory(i):
+            def on_run(pred):
+                if state["armed"]:
+                    state["armed"] = False
+                    victim = next(r.idx for r in srv.scheduler.replicas
+                                  if r.inflight > 0)
+                    srv.scheduler.remove_replica(victim, force=True)
+            return FakePredictor(clock=clock, service_s=0.001,
+                                 on_run=on_run)
+
+        srv = InferenceServer(factory, cfg, clock=clock)
+        gen0 = srv.scheduler.generation
+        state["armed"] = True
+        req = run_one(srv, clock)
+        # the retry delivered from a surviving replica; the fenced result
+        # was dropped, never scattered to the request
+        assert req.error is None
+        assert srv.metrics.get("late_drops") == 1
+        assert srv.metrics.get("retries") == 1
+        assert srv.scheduler.generation == gen0 + 1
+        assert len(srv.scheduler.replicas) == 1
+
+
+# -- autoscaler --------------------------------------------------------------
+
+class TestAutoscaler:
+    def make(self, tmp_path, min_r=1, max_r=3, **kw):
+        # NOT attached to the server: these tests drive tick() by hand
+        # (an attached autoscaler is ticked by every pump round — the soak
+        # test covers that wiring)
+        clock = FakeClock()
+        cfg = ServingConfig(max_batch_size=4, replicas=min_r, max_queue=256)
+        srv = InferenceServer(
+            lambda i: FakePredictor(clock=clock, service_s=0.002),
+            cfg, clock=clock)
+        srv.warmup((((3,), "float32"),))
+        asc = Autoscaler(srv, AutoscalerConfig(
+            min_replicas=min_r, max_replicas=max_r, high_watermark=4.0,
+            low_watermark=1.0, up_stable=2, down_stable=3,
+            drain_timeout=10.0, **kw))
+        return srv, asc, clock
+
+    def journal_events(self, asc):
+        path = asc.journal.path
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    def test_scales_up_under_sustained_pressure(self, tmp_path):
+        srv, asc, clock = self.make(tmp_path)
+        for _ in range(40):
+            srv.submit(x())
+        for _ in range(2):          # two ticks over the high watermark
+            asc.tick()
+            clock.advance(0.1)
+        assert asc.replica_count() == 2
+        assert srv.metrics.get("scale_ups") == 1
+        # the new replica came in warm: zero compiles on live traffic
+        new = srv.scheduler.find_replica(1)
+        warmed = new.executor.compile_count
+        while srv.pump(4):
+            pass
+        assert new.executor.compile_count == warmed
+        events = [e["event"] for e in self.journal_events(asc)]
+        assert "serving_scale_up" in events
+
+    def test_single_spike_does_not_resize(self, tmp_path):
+        srv, asc, clock = self.make(tmp_path)
+        for _ in range(40):
+            srv.submit(x())
+        asc.tick()                  # one tick over watermark: streak = 1
+        while srv.pump(4):          # drain the spike
+            pass
+        asc.tick()                  # back under: streak reset
+        asc.tick()
+        assert asc.replica_count() == 1
+        assert srv.metrics.get("scale_ups") == 0
+
+    def test_scales_down_by_draining_first(self, tmp_path):
+        srv, asc, clock = self.make(tmp_path)
+        for _ in range(40):
+            srv.submit(x())
+        for _ in range(2):
+            asc.tick()
+            clock.advance(0.1)
+        assert asc.replica_count() == 2
+        while srv.pump(4):
+            pass
+        gen_before = srv.scheduler.generation
+        for _ in range(3):          # down_stable idle ticks begin a drain
+            asc.tick()
+            clock.advance(0.1)
+        # idle replica: drain completes on the next tick, not by force
+        asc.tick()
+        assert asc.replica_count() == 1
+        assert srv.metrics.get("scale_downs") == 1
+        assert srv.scheduler.generation == gen_before + 1
+        ev = [e for e in self.journal_events(asc)
+              if e["event"] == "serving_scale_down"]
+        assert ev and ev[0]["forced"] is False
+        assert ev[0]["scheduler_generation"] == srv.scheduler.generation
+
+    def test_drain_timeout_force_fences(self, tmp_path):
+        srv, asc, clock = self.make(tmp_path)
+        srv.scheduler.add_replica()
+        victim = srv.scheduler.replicas[-1].idx
+        asc.scale_down()
+        srv.scheduler.find_replica(victim).inflight = 1   # stuck batch
+        clock.advance(11.0)          # past drain_timeout
+        removed = asc.tick()["removed"]
+        assert removed == [victim]
+        assert srv.scheduler.find_replica(victim) is None
+        ev = [e for e in self.journal_events(asc)
+              if e["event"] == "serving_scale_down"]
+        assert ev and ev[-1]["forced"] is True
+
+    def test_never_leaves_min_max_band(self, tmp_path):
+        srv, asc, clock = self.make(tmp_path, min_r=1, max_r=2)
+        for _ in range(200):
+            srv.submit(x())
+        for _ in range(20):
+            asc.tick()
+            clock.advance(0.1)
+        assert asc.replica_count() <= 2
+        while srv.pump(8):
+            pass
+        for _ in range(20):
+            asc.tick()
+            clock.advance(0.1)
+        assert asc.replica_count() >= 1
+
+    def test_injected_scale_failure_is_journaled_not_raised(self, tmp_path):
+        srv, asc, clock = self.make(tmp_path)
+        faults.configure("serving.scale:#1")
+        for _ in range(40):
+            srv.submit(x())
+        for _ in range(2):
+            asc.tick()
+            clock.advance(0.1)
+        # the injected failure was swallowed, journaled, counted
+        assert asc.replica_count() == 1
+        assert srv.metrics.get("scale_failures") == 1
+        events = [e["event"] for e in self.journal_events(asc)]
+        assert "serving_scale_failed" in events
+        # and the next pressure window retries successfully
+        for _ in range(2):
+            asc.tick()
+            clock.advance(0.1)
+        assert asc.replica_count() == 2
+
+
+# -- satellites --------------------------------------------------------------
+
+class TestRestartRewarms:
+    def test_zero_steady_state_compiles_after_restart(self):
+        """Satellite: restart_dead used to rebuild the executor cold — the
+        restarted replica paid every bucket compile on live traffic. Now it
+        re-warms first."""
+        srv, clock = make_server(replicas=2, max_batch_size=4,
+                                 warmup_signatures=[(((3,), "float32"),)])
+        faults.configure("serving.replica_run:#1")
+        req = run_one(srv, clock)       # kills one replica; retry succeeds
+        assert req.error is None
+        # the pump loop's maintain() already restarted the dead replica
+        [rep] = [r for r in srv.scheduler.replicas if r.restarts == 1]
+        assert rep.healthy
+        warmed = rep.executor.compile_count
+        assert warmed == len(srv.config.buckets)   # re-warmed at restart
+        # steady state across every bucket: zero additional compiles
+        for rows in (1, 2, 3, 4):
+            r = srv.submit(x(rows))
+            srv.pump_until_done(r)
+        assert rep.executor.compile_count == warmed
+
+
+class TestShedReasons:
+    def test_queue_full_reason(self):
+        srv, clock = make_server(replicas=1, max_queue=4)
+        for _ in range(4):
+            srv.submit(x())
+        with pytest.raises(ServerOverloaded, match="queue full") as ei:
+            srv.submit(x())
+        assert ei.value.retry_after is not None
+        assert srv.metrics.get("shed_queue_full") == 1
+        assert pmetrics.get_registry().counter_value(
+            "serving.shed_total", labels={"reason": "queue_full"}) == 1.0
+
+    def test_deadline_reason(self):
+        srv, clock = make_server(replicas=1)
+        with pytest.raises(ServerOverloaded, match="unmeetable"):
+            srv.submit(x(), deadline=clock() - 1.0)
+        assert srv.metrics.get("shed_deadline") == 1
+
+    def test_admission_reason(self):
+        srv, clock = make_server(replicas=1,
+                                 admission_initial=1, admission_max=1)
+        srv.submit(x())
+        with pytest.raises(ServerOverloaded, match="admission") as ei:
+            srv.submit(x())
+        assert ei.value.retry_after is not None
+        assert srv.metrics.get("shed_admission") == 1
+        assert pmetrics.get_registry().counter_value(
+            "serving.shed_total", labels={"reason": "admission"}) == 1.0
+
+    def test_unhealthy_reason(self):
+        sched, _ = make_scheduler(1)
+        sched.replicas[0].healthy = False
+        with pytest.raises(ServerOverloaded, match="no healthy replica"):
+            sched.pick()
+        assert sched._metrics.get("shed_unhealthy") == 1
+
+    def test_admission_slot_freed_on_completion(self):
+        srv, clock = make_server(replicas=1,
+                                 admission_initial=1, admission_max=1)
+        req = run_one(srv, clock)
+        assert req.error is None
+        # terminated request released its slot: next admit succeeds
+        assert srv.admission.inflight == 0
+        srv.submit(x())
+
+
+class TestClientBackoff:
+    def make_client(self, **kw):
+        from paddle_tpu.serving import InferenceClient
+        import random
+        kw.setdefault("rng", random.Random(7))
+        kw.setdefault("sleep", lambda s: None)
+        return InferenceClient(("127.0.0.1", 1), **kw)
+
+    def test_delay_floors_at_server_hint(self):
+        cli = self.make_client(backoff_base=0.01)
+        assert cli.backoff_delay(0, retry_after=5.0) == 5.0
+
+    def test_delay_grows_exponentially_with_jitter(self):
+        import random
+        cli = self.make_client(rng=random.Random(7), backoff_base=0.1,
+                               backoff_cap=10.0)
+        # full jitter: uniform(0, base * 2^attempt)
+        assert 0.0 <= cli.backoff_delay(0) <= 0.1
+        assert 0.0 <= cli.backoff_delay(3) <= 0.8
+        assert cli.backoff_delay(30) <= 10.0        # capped
+
+    def test_deadline_aware_gives_up_instead_of_doomed_retry(self):
+        clock = FakeClock()
+        waits = []
+        cli = self.make_client(sleep=waits.append, clock=clock, retries=5)
+        calls = []
+
+        def fake_infer_once(inputs, timeout, request_id, priority):
+            calls.append(timeout)
+            e = ServerOverloaded("admission limit", retry_after=10.0)
+            raise e
+
+        cli._infer_once = fake_infer_once
+        with pytest.raises(ServerOverloaded) as ei:
+            cli.infer([np.ones((1, 3), "float32")], timeout=1.0)
+        # hint (10s) never fits the 1s budget: exactly one attempt, no
+        # sleeps burned on a doomed retry, hint surfaced to the caller
+        assert len(calls) == 1
+        assert waits == []
+        assert ei.value.retry_after == 10.0
+
+    def test_retries_until_budget_spent(self):
+        clock = FakeClock()
+        waits = []
+
+        def sleeper(s):
+            waits.append(s)
+            clock.advance(s)
+
+        cli = self.make_client(sleep=sleeper, clock=clock, retries=10,
+                               backoff_base=0.05)
+        attempts = []
+
+        def fake_infer_once(inputs, timeout, request_id, priority):
+            attempts.append(timeout)
+            clock.advance(0.05)
+            raise ServerOverloaded("overloaded", retry_after=0.1)
+
+        cli._infer_once = fake_infer_once
+        with pytest.raises(ServerOverloaded):
+            cli.infer([np.ones((1, 3), "float32")], timeout=1.0)
+        assert len(attempts) > 2            # actually retried
+        assert all(w >= 0.1 for w in waits)  # hint honored as the floor
+        # remaining budget shrank monotonically across attempts
+        assert attempts == sorted(attempts, reverse=True)
+
+
+# -- acceptance: overload soak ------------------------------------------------
+
+@pytest.mark.chaos
+class TestOverloadSoak:
+    def test_sustained_10x_with_replica_death_mid_soak(self, tmp_path):
+        """The ISSUE's acceptance scenario, fake clock, zero real sleeps:
+
+        sustained ~10x admission pressure with a replica death and a 5%
+        dispatch-hang rate injected mid-soak. Every accepted request
+        terminates (result or typed error), goodput stays positive,
+        admitted p99 holds under the deadline, a breaker opens AND
+        re-closes, and after the storm the autoscaler converges back to
+        min replicas.
+        """
+        paddle.set_flags({"FLAGS_serving_breaker_failures": 2,
+                          "FLAGS_serving_breaker_window": 1000.0,
+                          "FLAGS_serving_breaker_cooldown": 0.5})
+        clock = FakeClock()
+        service_s = 0.005
+        deadline = 2.0
+        cfg = ServingConfig(max_batch_size=8, replicas=2, max_queue=64,
+                            default_deadline=deadline, max_retries=2,
+                            admission_target_ms=40.0)
+        srv = InferenceServer(
+            lambda i: FakePredictor(clock=clock, service_s=service_s),
+            cfg, clock=clock)
+        srv.warmup((((3,), "float32"),))
+        asc = srv.attach_autoscaler(AutoscalerConfig(
+            min_replicas=2, max_replicas=4, high_watermark=4.0,
+            low_watermark=1.0, up_stable=2, down_stable=4,
+            drain_timeout=5.0))
+        # chaos mid-soak: one replica death, then 5% of dispatches hang
+        faults.configure("serving.replica_run:#40,serving.dispatch:0.05")
+
+        capacity = 2 * 8 / service_s           # rows/s
+        rate = capacity * 10.0
+        dt = 0.005
+        credit, accepted, sheds, hints = 0.0, [], 0, 0
+        while clock() < 3.0:
+            credit += rate * dt
+            while credit >= 1.0:
+                credit -= 1.0
+                try:
+                    accepted.append(srv.submit(x()))
+                except ServerOverloaded as e:
+                    sheds += 1
+                    if e.retry_after is not None:
+                        hints += 1
+            srv.pump(4)
+            clock.advance(dt)
+        # storm over: drain, then idle ticks for the autoscaler
+        rounds = 0
+        while srv.pump(4):
+            rounds += 1
+            assert rounds < 20000
+        for _ in range(30):
+            srv.pump(1)
+            clock.advance(0.5)
+
+        snap = srv.stats()
+        # every accepted request terminated — nothing went silent
+        assert all(r.done() for r in accepted)
+        ok = [r for r in accepted if r.error is None]
+        errs = [r for r in accepted if r.error is not None]
+        assert len(ok) > 0                        # goodput stayed positive
+        for r in errs:                            # typed errors only
+            assert isinstance(r.error, Exception)
+        # overload was actually exercised, and every shed carried a hint
+        assert sheds > 0 and hints == sheds
+        # admitted work held its SLO while excess load was shed
+        assert snap["latency_p99"] <= deadline
+        # the injected hang rate tripped at least one breaker, and the
+        # cooldown + preflight + canary closed it again
+        assert snap["breaker_opens"] >= 1
+        assert snap["breaker_closes"] >= 1
+        # the dead replica restarted and re-warmed
+        assert snap["replica_deaths"] >= 1
+        assert snap["replica_restarts"] >= 1
+        # elastic: scaled up under pressure, converged back to min after
+        assert snap["scale_ups"] >= 1
+        assert asc.replica_count() == 2
+        assert not asc._draining
+        # the AIMD limiter actually cut below its ceiling under overload
+        assert snap["admission"]["limit"] < srv.admission.max_limit

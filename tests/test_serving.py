@@ -592,11 +592,24 @@ class TestSocketServing:
         assert srv.metrics.get("completed") == 8
 
     def test_shed_roundtrips_as_typed_overloaded(self, served):
+        # retries=0: this asserts the typed wire roundtrip itself, not the
+        # client's backoff loop (which would absorb a one-shot shed)
         srv, fe = served
         faults.configure("serving.enqueue:#1")
-        with InferenceClient(fe.address) as cli:
+        with InferenceClient(fe.address, retries=0) as cli:
             with pytest.raises(ServerOverloaded):
                 cli.infer([np.ones((1, 3), "float32")], timeout=10.0)
+
+    def test_shed_retried_by_client_backoff(self, served):
+        # default client policy: a transient shed is retried (with the
+        # server's retry_after hint honored) and the request succeeds
+        srv, fe = served
+        faults.configure("serving.enqueue:#1")
+        waits = []
+        with InferenceClient(fe.address, sleep=waits.append) as cli:
+            [out] = cli.infer([np.ones((1, 3), "float32")], timeout=10.0)
+        np.testing.assert_allclose(out, 2.0)
+        assert len(waits) == 1 and waits[0] >= 0.0
 
     def test_malformed_frame_gets_error_reply(self, served):
         from paddle_tpu.distributed import wire

@@ -51,9 +51,15 @@ REQUIRED = [
     ("paddle_tpu/serving/batcher.py", "class:BatchQueue",
      ["put"]),
     ("paddle_tpu/serving/scheduler.py", "class:Scheduler",
-     ["dispatch"]),
+     ["dispatch", "_hedge_site"]),
     ("paddle_tpu/serving/server.py", "class:InferenceServer",
      ["_reply"]),
+    # overload-control entry points (overload PR): the chaos suite must be
+    # able to hang the primary attempt at the hedge boundary
+    # (serving.hedge, inside Scheduler._hedge_site above) and fail a
+    # replica resize (serving.scale)
+    ("paddle_tpu/serving/autoscaler.py", "class:Autoscaler",
+     ["scale_up", "scale_down"]),
     # hardware health / SDC entry points (integrity PR): the chaos suite
     # must be able to fail the preflight KAT (integrity.preflight), corrupt
     # a replica's digest (device.bitflip, evaluated via should_inject inside
@@ -76,10 +82,12 @@ REQUIRED = [
 ]
 
 # _injected_run is HDFSClient's hook-carrying chokepoint: routing a call
-# through it counts as hooked (its body holds the maybe_inject).
-# should_inject is the non-raising hook for corruption-style faults
-# (device.bitflip perturbs a result instead of failing the call).
-HOOK_CALLS = {"maybe_inject", "fault_point", "_injected_run",
+# through it counts as hooked (its body holds the maybe_inject). _attempt
+# is Scheduler.dispatch's equivalent (both the primary and the hedged
+# attempt funnel through it, so serving.dispatch/serving.replica_run cover
+# hedges too). should_inject is the non-raising hook for corruption-style
+# faults (device.bitflip perturbs a result instead of failing the call).
+HOOK_CALLS = {"maybe_inject", "fault_point", "_injected_run", "_attempt",
               "should_inject"}
 
 

@@ -8,6 +8,16 @@ The open-loop shape matters — a closed loop (wait for each reply before
 sending the next) can never overload the server, so it cannot show the
 backpressure knee this tool exists to find.
 
+``--overload`` switches to the deterministic overload sweep: a fake clock,
+a synthetic predictor with a fixed service time, and offered load at
+multiples of estimated capacity (up to 10x). It asserts **graceful
+degradation** — at every multiplier goodput stays positive, every admitted
+request terminates, and the admitted-latency p99 stays under the deadline
+(excess load is shed with retry_after hints instead of dragging admitted
+work over its SLO). Exit code 1 means the overload-control layer collapsed.
+Zero real sleeps; ``--overload --smoke`` is fast enough for tier-1
+(tests/test_lints.py runs exactly that).
+
 Examples::
 
     # sweep a tiny MLP on whatever backend JAX_PLATFORMS selects
@@ -15,6 +25,9 @@ Examples::
 
     # CPU smoke (the test suite runs exactly this, slow lane)
     JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke
+
+    # deterministic overload sweep, 1x..10x capacity, fake clock
+    python tools/serving_bench.py --overload
 
 Output: one JSON document on stdout (the bench-gate pattern: machines parse
 stdout, humans read the table on stderr).
@@ -140,6 +153,123 @@ def run_rate(server, rate, duration, features):
     }
 
 
+# -- deterministic overload sweep (fake clock, zero real sleeps) -------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def run_overload_point(args, multiplier):
+    """One offered-load point at ``multiplier`` x estimated capacity on a
+    fresh fake-clock server. Returns the point's report dict."""
+    import numpy as np
+
+    from paddle_tpu import serving
+
+    clock = _FakeClock()
+    service_s = args.service_ms / 1e3
+
+    class SyntheticPredictor:
+        # fixed service time: running a batch advances the fake clock —
+        # the only way time moves besides the arrival ticks below
+        def run(self, arrays):
+            clock.advance(service_s)
+            return [np.asarray(arrays[0]) * 2.0]
+
+    deadline = args.deadline if args.deadline is not None else 1.0
+    scfg = serving.ServingConfig(
+        max_batch_size=args.max_batch_size, replicas=args.replicas,
+        max_queue=args.max_queue, default_deadline=deadline,
+        admission_target_ms=args.service_ms * 4)
+    srv = serving.InferenceServer(lambda i: SyntheticPredictor(), scfg,
+                                  clock=clock)
+    autoscaler = srv.attach_autoscaler(serving.AutoscalerConfig(
+        min_replicas=args.replicas, max_replicas=args.replicas * 2,
+        drain_timeout=5.0))
+
+    # capacity: each batch serves up to max_batch_size rows in service_s
+    capacity = args.replicas * args.max_batch_size / service_s
+    rate = capacity * multiplier
+    dt = service_s / 2
+    credit = 0.0
+    accepted, sheds, hints = [], 0, 0
+    t_end = args.duration
+    while clock() < t_end:
+        credit += rate * dt
+        while credit >= 1.0:
+            credit -= 1.0
+            try:
+                accepted.append(srv.submit(
+                    [np.ones((1, args.features), "float32")]))
+            except serving.ServerOverloaded as e:
+                sheds += 1
+                if getattr(e, "retry_after", None) is not None:
+                    hints += 1
+        srv.pump(4)
+        clock.advance(dt)
+    # drain: every accepted request must terminate
+    rounds = 0
+    while srv.pump(4):
+        rounds += 1
+        if rounds > 10000:
+            break
+    clock.advance(deadline + 1.0)
+    srv.pump(1)          # expire anything whose deadline passed in queue
+    snap = srv.stats()
+    ok = [r for r in accepted if r.done() and r.error is None]
+    unterminated = sum(1 for r in accepted if not r.done())
+    offered = len(accepted) + sheds
+    return {
+        "multiplier": multiplier,
+        "offered": offered,
+        "accepted": len(accepted),
+        "completed": len(ok),
+        "shed": sheds,
+        "shed_with_hint": hints,
+        "shed_rate": sheds / offered if offered else 0.0,
+        "unterminated": unterminated,
+        "goodput_rps": len(ok) / args.duration,
+        "latency_ms_p99": snap["latency_p99"] * 1e3,
+        "deadline_ms": deadline * 1e3,
+        "admission_limit": snap["admission"]["limit"],
+        "replicas_final": autoscaler.replica_count(),
+        "scale_ups": snap["scale_ups"],
+        "scale_downs": snap["scale_downs"],
+        "breaker_opens": snap["breaker_opens"],
+    }
+
+
+def run_overload(args):
+    """Fake-clock sweep over load multipliers; the graceful-degradation
+    gate requires, at EVERY point (including 10x): positive goodput, zero
+    unterminated requests, admitted p99 under the deadline, and every shed
+    carrying a retry_after hint."""
+    results = []
+    for multiplier in [float(m) for m in args.multipliers.split(",") if m]:
+        res = run_overload_point(args, multiplier)
+        results.append(res)
+        print(f"load={multiplier:>4.0f}x  offered={res['offered']:>6}"
+              f"  goodput={res['goodput_rps']:>8.1f}/s"
+              f"  p99={res['latency_ms_p99']:>7.2f}ms"
+              f"  shed={res['shed_rate']:>5.1%}"
+              f"  limit={res['admission_limit']:>6.1f}"
+              f"  replicas={res['replicas_final']}",
+              file=sys.stderr)
+    ok = all(r["completed"] > 0
+             and r["unterminated"] == 0
+             and r["latency_ms_p99"] <= r["deadline_ms"]
+             and r["shed_with_hint"] == r["shed"]
+             for r in results)
+    return results, ok
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Offered-load sweep: throughput, p50/p99 latency, "
@@ -157,11 +287,39 @@ def main(argv=None):
     ap.add_argument("--features", type=int, default=16)
     ap.add_argument("--hidden", type=int, default=32)
     ap.add_argument("--smoke", action="store_true",
-                    help="tiny fast run (CI slow-lane smoke)")
+                    help="tiny fast run (CI slow-lane smoke; with "
+                         "--overload: tier-1 fast)")
+    ap.add_argument("--overload", action="store_true",
+                    help="deterministic fake-clock overload sweep "
+                         "(graceful-degradation gate, zero real sleeps)")
+    ap.add_argument("--multipliers", default="1,2,10",
+                    help="overload sweep: offered load as multiples of "
+                         "estimated capacity")
+    ap.add_argument("--service-ms", type=float, default=5.0,
+                    help="overload sweep: synthetic per-batch service time")
     args = ap.parse_args(argv)
     if args.smoke:
         args.rates, args.duration = "100", 0.5
         args.hidden, args.replicas = 8, 1
+        if args.overload:
+            args.duration, args.multipliers = 2.0, "1,10"
+
+    if args.overload:
+        if args.deadline is None:
+            args.deadline = 1.0
+        results, ok = run_overload(args)
+        doc = {"mode": "overload",
+               "config": {"replicas": args.replicas,
+                          "max_batch_size": args.max_batch_size,
+                          "max_queue": args.max_queue,
+                          "service_ms": args.service_ms,
+                          "deadline": args.deadline,
+                          "duration": args.duration},
+               "results": results,
+               "graceful_degradation": ok}
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+        return 0 if ok else 1
 
     server = build_server(args)
     results = []
