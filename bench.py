@@ -111,6 +111,109 @@ _LAST_SPE = {}    # model-name -> steps-per-execution the curve was run with
 _LAST_DISTINCT = {}  # model-name -> number of DISTINCT batches in the run
 _LAST_BREAKDOWN = {}  # model-name -> step_breakdown block (phase attribution)
 _LAST_CKPT_STALL = {}  # ckpt_stall_ms block (zero-stall checkpointing)
+_LAST_COMPILED = {}  # compiled_speedup block (whole-step compilation)
+
+
+def _bench_compiled_speedup():
+    """Compiled-step evidence lane: the SAME toy train step timed per-op
+    (eager oracle — ProgramTranslator disabled) and as one donated jitted
+    program (jit/compiled_step.CompiledTrainStep under FLAGS_compiled_step),
+    recorded as ``extra.compiled_speedup[lane] = eager_s / compiled_s``.
+    Gated higher-is-better (>= 1.15x) by tools/check_bench_regression.py.
+
+    Tiny LM geometries on purpose: the eager leg pays per-op python
+    dispatch, so full-size models would cost minutes for the same ratio
+    evidence (the flagship lanes already measure absolute throughput
+    through the identical StaticFunction machinery). Each lane also
+    asserts the one-steady-state-trace contract straight off the
+    ``compiled_step.compiles_total`` counter: exactly one compile for the
+    single input signature, every timed step a cache hit."""
+    import time as _time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.compiled_step import (
+        CompiledTrainStep, compile_stats, reset_compile_stats)
+
+    steps = max(4, int(os.environ.get("BENCH_COMPILED_STEPS", 24)))
+    batch, seq = 8, 32
+    rng = np.random.RandomState(0)
+
+    def build_bert():
+        from paddle_tpu.text.models import BertForSequenceClassification
+        from paddle_tpu.text.models.bert import BertConfig
+        cfg = BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                         num_heads=4, intermediate_size=128,
+                         max_position=seq, dropout=0.0)
+        model = BertForSequenceClassification(cfg, num_classes=2)
+        xx = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64")
+        yy = rng.randint(0, 2, (batch,)).astype("int64")
+        return model, xx, yy
+
+    def build_gpt():
+        from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                        num_heads=4, max_position_embeddings=seq,
+                        dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        ids = rng.randint(0, cfg.vocab_size,
+                          (batch, seq + 1)).astype("int64")
+        return model, ids[:, :-1].astype("int32"), ids[:, 1:]
+
+    def time_leg(build, compiled):
+        paddle.seed(0)
+        model, xx, yy = build()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+
+        def _step(ins, labs):
+            loss = model(ins, labels=labs)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss.astype("float32")
+
+        ins, labs = paddle.to_tensor(xx), paddle.to_tensor(yy)
+        if compiled:
+            step = CompiledTrainStep(_step, label="bench.compiled_speedup")
+        else:
+            step = _step
+        # warm both legs identically: 2 calls cover discovery + XLA build
+        # on the compiled side and the eager op-executable caches on the
+        # oracle side, so the timed window is steady state for both
+        for _ in range(2):
+            step(ins, labs).numpy()
+        if compiled:
+            reset_compile_stats()
+        t0 = _time.perf_counter()
+        out = None
+        for _ in range(steps):
+            out = step(ins, labs)
+        out.numpy()  # sync
+        dt = _time.perf_counter() - t0
+        if compiled:
+            stats = compile_stats()
+            if stats["compiles"] != 0 or stats["cache_hits"] != steps:
+                raise RuntimeError(
+                    "steady-state trace contract violated: expected 0 "
+                    f"compiles / {steps} cache hits in the timed window, "
+                    f"got {stats}")
+        return dt
+
+    old = paddle.get_flags(["FLAGS_compiled_step"])
+    try:
+        for lane, build in (("bert", build_bert), ("gpt", build_gpt)):
+            paddle.set_flags({"FLAGS_compiled_step": False})
+            eager_s = time_leg(build, compiled=False)
+            _release_bench_state()
+            paddle.set_flags({"FLAGS_compiled_step": True})
+            compiled_s = time_leg(build, compiled=True)
+            _release_bench_state()
+            _LAST_COMPILED.setdefault("compiled_speedup", {})[lane] = \
+                round(eager_s / compiled_s, 3) if compiled_s else 0.0
+            _LAST_COMPILED.setdefault("compiled_step_s", {})[lane] = \
+                round(compiled_s / steps, 5)
+    finally:
+        paddle.set_flags(old)
 
 
 def _bench_ckpt_stall(model, opt):
@@ -701,11 +804,24 @@ def bench_opbench():
     }
 
 
+def bench_compiled():
+    """Standalone driver for the compiled-speedup lane (BENCH_MODEL=
+    compiled): runs the eager-vs-compiled toy LM legs and reports the worst
+    lane's ratio as the headline value (the gate floor applies per lane)."""
+    _bench_compiled_speedup()
+    ratios = _LAST_COMPILED.get("compiled_speedup", {})
+    val = min(ratios.values()) if ratios else 0.0
+    return {"metric": "compiled_step_speedup_min", "value": round(val, 3),
+            "unit": "x", "vs_baseline": round(val, 3), "mfu": 0.0,
+            "precision": "float32"}
+
+
 _BENCHES = {"bert": bench_bert, "resnet50": bench_resnet50,
             "gpt": bench_gpt, "lenet": bench_lenet,
             "ernie": lambda: bench_bert(arch="ernie"),
             "gpt1p3b": lambda: bench_gpt(slice_1p3b=True),
-            "opbench": bench_opbench}
+            "opbench": bench_opbench,
+            "compiled": bench_compiled}
 
 def _release_bench_state():
     """Free the previous bench's device state (params, fp32 masters, f32
@@ -844,6 +960,15 @@ def main():
             except Exception as e5:
                 sys.stderr.write(f"ernie bench failed: {e5!r}\n")
                 result["extra"]["ernie_error"] = repr(e5)[:200]
+            # compiled-step evidence (whole-step compilation, this PR's
+            # tentpole): eager-vs-compiled speedup ratio on toy LM lanes —
+            # cheap enough to ride every default run
+            _release_bench_state()
+            try:
+                _bench_compiled_speedup()
+            except Exception as e6:
+                sys.stderr.write(f"compiled-speedup bench failed: {e6!r}\n")
+                result["extra"]["compiled_speedup_error"] = repr(e6)[:200]
     except Exception as e:
         # no silent workload switching: report the failure itself
         sys.stderr.write(f"bench {which or 'bert'} failed: {e!r}\n")
@@ -860,6 +985,10 @@ def main():
         # blocking portion of one checkpoint save (zero-stall contract) —
         # gated lower-is-better alongside the phase gates
         result.setdefault("extra", {}).update(_LAST_CKPT_STALL)
+    if _LAST_COMPILED:
+        # eager-vs-compiled steps/s ratio per toy LM lane (whole-step
+        # compilation) — gated higher-is-better (>= 1.15x floor)
+        result.setdefault("extra", {}).update(_LAST_COMPILED)
     if _LAST_CURVE and os.environ.get("BENCH_LOSS_CURVES", "1") != "0":
         # loss-curve evidence (BASELINE "loss parity"; precision-regime
         # parity is asserted in tests/test_loss_parity.py — these are the

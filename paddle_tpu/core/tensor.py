@@ -86,15 +86,24 @@ class Tensor:
         "_version",    # in-place mutation counter (tensor_version parity)
         "_degen_cache",  # fused-op degenerate-weight check memo
                          # (ops/fused_conv_bn.py, ops/fused_residual_ln.py)
+        "_donate_unsafe",  # True while _val may be host-imported (numpy-
+                           # backed): PJRT-CPU imports host buffers without
+                           # taking ownership, so DONATING such an array to a
+                           # compiled step corrupts memory (to_static.py
+                           # donation gate). Cleared by the compiled
+                           # write-back, whose arrays are XLA-owned outputs.
         "__weakref__",
     )
 
     def __init__(self, value, dtype=None, place=None, stop_gradient=True,
                  name=None):
+        host_imported = False
         if isinstance(value, Tensor):
+            host_imported = value._donate_unsafe
             value = value._val
         dtype = convert_dtype(dtype)
         if not isinstance(value, jax.Array):
+            host_imported = True
             arr = np.asarray(value)
             if dtype is None and arr.dtype == np.float64:
                 dtype = get_default_dtype()
@@ -120,6 +129,7 @@ class Tensor:
         self.trainable = True
         self._hooks = None
         self._version = 0
+        self._donate_unsafe = host_imported
         if _TraceHooks.on_create is not None:
             _TraceHooks.on_create(self)
 
@@ -140,6 +150,12 @@ class Tensor:
         if _TraceHooks.on_write is not None:
             _TraceHooks.on_write(self, v)
         self._val = v
+        # conservative donation taint: an externally assigned array may be
+        # host-imported (set_state_dict restore, checkpoint load, setitem) —
+        # donating such a buffer to a compiled step corrupts memory on the
+        # PJRT CPU backend. The compiled fast path clears this when it writes
+        # back its own XLA-owned outputs (to_static.py _run).
+        self._donate_unsafe = True
 
     @property
     def value(self):

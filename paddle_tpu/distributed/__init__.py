@@ -21,6 +21,9 @@ from .env import (  # noqa: F401
     parallel_device_count,
 )
 from .mesh import get_mesh, global_mesh, set_mesh  # noqa: F401
+from .spec_layout import (  # noqa: F401
+    SpecLayout, shard_batch, shard_params, shard_stacked_batch, unshard,
+)
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401

@@ -23,6 +23,19 @@ _FLAGS: dict[str, Any] = {
     "FLAGS_max_cached_programs": 64,
     # donate buffers for jitted train steps (memory optimization)
     "FLAGS_donate_state_buffers": True,
+    # whole-step compilation (jit/compiled_step.py, docs/compiled_step.md):
+    # route hapi train_batch/fit and the bench LM lanes through ONE donated,
+    # sharding-annotated jitted program per step (fwd+bwd+optimizer). Off by
+    # default — the eager path is the debug/parity oracle.
+    "FLAGS_compiled_step": False,
+    # distinct input signatures one compiled step fn may trace before the
+    # retrace-storm guard warns through the flight recorder; 0 disables
+    "FLAGS_compiled_step_max_retraces": 8,
+    # double-buffered host->device input prefetch in the hapi fit loop:
+    # step N+1's batch is staged while step N runs (drops step/input_wait +
+    # step/h2d). The loader's exact-resume cursor only advances when a batch
+    # is actually consumed, so checkpoint/resume stays exact.
+    "FLAGS_input_prefetch": True,
     # kernel tier (paddle_tpu/ops/autotune.py, docs/kernels.md):
     # measured fusion policy — auto dispatches whichever of fused/unfused
     # measured faster per (shape-bucket, dtype, direction, placement);

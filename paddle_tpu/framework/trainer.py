@@ -107,8 +107,15 @@ class MultiTrainer:
                 break
             if warm is None:
                 return
-            for _ in range(3):
-                self.workers[0].train_step(warm)
+            # the warm sequence IS the compile: attribute it (step/compile
+            # phase + compiled_step counters) instead of letting minutes of
+            # XLA build land in unattributed time
+            from ..jit.compiled_step import _note_compile
+            from ..profiler import steptimer as _steptimer
+            with _steptimer.get_steptimer().phase("step/compile"):
+                for _ in range(3):
+                    self.workers[0].train_step(warm)
+            _note_compile()
             if prog is not None:
                 try:
                     prog._trainer_warmed = True

@@ -25,6 +25,18 @@ def _to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def _as_tensor(x):
+    """Tensor-wrap one batch leaf. Already-staged device arrays (the input
+    prefetcher's output) wrap directly — ``np.asarray`` on a jax array would
+    pull the value back to the host and redo the transfer."""
+    if isinstance(x, Tensor):
+        return x
+    import jax
+    if isinstance(x, jax.Array):
+        return Tensor(x)
+    return Tensor(np.asarray(x))
+
+
 def _batch_sig(b):
     """Shape signature of one (inputs, labels) pair — a scan group must be
     shape-static, so signatures are computed once per batch on append."""
@@ -48,13 +60,23 @@ class Model:
         self._compiled_train_step = None
         self._compiled_eval_step = None
         self._step_guard = None  # set by fit() under FLAGS_check_nan_inf
+        self._spec_layout = None  # set by prepare(spec_layout=...)
 
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, spec_layout=None):
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
         for m in self._metrics:
             assert isinstance(m, Metric)
+        # declarative GSPMD sharding (distributed/spec_layout.py): place the
+        # parameters per the layout now; batches are sharded at the h2d seam
+        # and jit propagates both through the compiled step — the collectives
+        # the fleet wrappers would dispatch eagerly happen inside the program
+        if spec_layout is not None:
+            from ..distributed.spec_layout import shard_params
+            self._spec_layout = spec_layout
+            shard_params(self.network, spec_layout)
         # distributed fit (reference hapi/model.py:906: DynamicGraphAdapter
         # wraps in DataParallel when nranks>1): multi-process runs get the
         # bucketed-reducer DP wrapper; fit() then shards batches per rank
@@ -79,13 +101,18 @@ class Model:
                 self._optimizer.step()
                 self._optimizer.clear_grad()
                 return total
-            self._compiled_train_step = StaticFunction(_step)
+            from ..jit.compiled_step import CompiledTrainStep, \
+                compiled_step_enabled
+            self._compiled_train_step = (
+                CompiledTrainStep(_step, label="hapi.train_step")
+                if compiled_step_enabled() else StaticFunction(_step))
         st = _steptimer.get_steptimer()
         with st.phase("step/h2d"):
-            ins = [i if isinstance(i, Tensor) else Tensor(np.asarray(i))
-                   for i in _to_list(inputs)]
-            labs = [l if isinstance(l, Tensor) else Tensor(np.asarray(l))
-                    for l in _to_list(labels)]
+            ins = [_as_tensor(i) for i in _to_list(inputs)]
+            labs = [_as_tensor(l) for l in _to_list(labels)]
+            if self._spec_layout is not None:
+                from ..distributed.spec_layout import shard_batch
+                shard_batch(self._spec_layout, *(ins + labs))
         with st.phase("step/compute"):
             loss = self._compiled_train_step(ins, labs)
             st.sync(loss)
@@ -107,10 +134,8 @@ class Model:
             if not batches:
                 return head
         def to_tensors(ins, labs):
-            return ([i if isinstance(i, Tensor) else Tensor(np.asarray(i))
-                     for i in _to_list(ins)],
-                    [l if isinstance(l, Tensor) else Tensor(np.asarray(l))
-                     for l in _to_list(labs)])
+            return ([_as_tensor(i) for i in _to_list(ins)],
+                    [_as_tensor(l) for l in _to_list(labs)])
         st = _steptimer.get_steptimer()
         with st.phase("step/h2d"):
             pairs = [to_tensors(i, l) for i, l in batches]
@@ -119,6 +144,11 @@ class Model:
                            for j in range(n_in)]
             labs_stacked = [Tensor(jnp.stack([p[1][j]._val for p in pairs]))
                             for j in range(len(pairs[0][1]))]
+            if self._spec_layout is not None:
+                # scan inputs carry a leading steps axis: shard dim 1 (batch)
+                from ..distributed.spec_layout import shard_stacked_batch
+                shard_stacked_batch(self._spec_layout,
+                                    *(ins_stacked + labs_stacked))
         with st.phase("step/compute"):
             losses = self._compiled_train_step.run_steps(ins_stacked,
                                                          labs_stacked)
@@ -208,10 +238,16 @@ class Model:
             logs = {}
             step = 0
             group = []
+            _prefetch = None  # set below when FLAGS_input_prefetch is on
 
             def run_group(group, step0):
                 nonlocal logs, it
                 st = _steptimer.get_steptimer()
+                # exact-resume cursor: prefetched batches are uncounted
+                # until the step that trains on them executes (a rolled-back
+                # guard step still consumed its batch, same as eager)
+                if _prefetch is not None:
+                    loader.note_consumed(len(group))
                 if len(group) == 1:
                     # single-step path keeps the begin-before-execute
                     # callback contract (timers/profiler regions)
@@ -266,43 +302,65 @@ class Model:
 
             group_sig = None
             _st = _steptimer.get_steptimer()
-            _loader_it = iter(loader)
+            from ..framework.flags import get_flag as _get_flag
+            if _get_flag("FLAGS_input_prefetch", True) and \
+                    hasattr(loader, "iter_uncounted"):
+                # double-buffered read-ahead: the worker stages step N+1's
+                # arrays while step N runs; the exact-resume cursor advances
+                # in run_group, not at fetch (docs/compiled_step.md)
+                from .prefetch import InputPrefetcher
+                _prefetch = InputPrefetcher(loader, self._split_batch)
+                _loader_it = None
+            else:
+                _loader_it = iter(loader)
             _done = object()
-            while True:
-                # manual iteration so loader blocking is attributable:
-                # time spent waiting on the next batch is step/input_wait
-                with _st.phase("step/input_wait"):
-                    batch = next(_loader_it, _done)
-                if batch is _done:
-                    break
-                ins, labs = self._split_batch(batch)
-                sig = _batch_sig((ins, labs)) if spe > 1 else None
-                if group and spe > 1 and sig != group_sig:
-                    # ragged boundary: flush what we have single-step
-                    for g in group:
-                        run_group([g], step)
-                        step += 1
-                    group = []
-                if not group:
-                    group_sig = sig
-                group.append((ins, labs))
-                # never run past num_iters: cap the group to remaining steps
-                remaining = (None if num_iters is None
-                             else max(0, num_iters - it))
-                if len(group) == spe or (remaining is not None
-                                         and len(group) >= remaining):
-                    if remaining is not None:
-                        group = group[:remaining]
-                    if group:
-                        run_group(group, step)
-                        step += len(group)
-                    group = []
-                if num_iters is not None and it >= num_iters:
-                    break
-            if group:  # tail remainder in one scan (shapes already
-                # uniform; the in-loop cap guarantees len < remaining)
-                run_group(group, step)
-                step += len(group)
+            try:
+                while True:
+                    # manual iteration so loader blocking is attributable:
+                    # time left waiting on the next batch (after overlap)
+                    # is step/input_wait
+                    with _st.phase("step/input_wait"):
+                        if _prefetch is not None:
+                            item = _prefetch.get()
+                            if item is InputPrefetcher.DONE:
+                                item = _done
+                        else:
+                            batch = next(_loader_it, _done)
+                            item = batch if batch is _done \
+                                else self._split_batch(batch)
+                    if item is _done:
+                        break
+                    ins, labs = item
+                    sig = _batch_sig((ins, labs)) if spe > 1 else None
+                    if group and spe > 1 and sig != group_sig:
+                        # ragged boundary: flush what we have single-step
+                        for g in group:
+                            run_group([g], step)
+                            step += 1
+                        group = []
+                    if not group:
+                        group_sig = sig
+                    group.append((ins, labs))
+                    # never run past num_iters: cap the group to what's left
+                    remaining = (None if num_iters is None
+                                 else max(0, num_iters - it))
+                    if len(group) == spe or (remaining is not None
+                                             and len(group) >= remaining):
+                        if remaining is not None:
+                            group = group[:remaining]
+                        if group:
+                            run_group(group, step)
+                            step += len(group)
+                        group = []
+                    if num_iters is not None and it >= num_iters:
+                        break
+                if group:  # tail remainder in one scan (shapes already
+                    # uniform; the in-loop cap guarantees len < remaining)
+                    run_group(group, step)
+                    step += len(group)
+            finally:
+                if _prefetch is not None:
+                    _prefetch.close()
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 eval_result = self.evaluate(eval_data, batch_size=batch_size,
                                             verbose=0, num_workers=num_workers,

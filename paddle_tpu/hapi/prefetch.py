@@ -1,0 +1,129 @@
+"""Double-buffered host→device input prefetch for the fit loop.
+
+ROADMAP item 2 names the async input pipeline as half of the remaining LM
+bench gap: with the step itself compiled (jit/compiled_step.py), the fit
+loop's residual host work is waiting on the loader (``step/input_wait``) and
+staging arrays (``step/h2d``). The :class:`InputPrefetcher` moves both off
+the critical path — a worker thread pulls batches ahead of training, splits
+them, and stages every leaf as a device array (``jnp.asarray`` starts the
+async copy), so step N+1's batch is in flight while step N runs. The queue
+is bounded at `depth` (default 2 = double buffering): read-ahead never runs
+more than one step ahead of the optimizer, keeping host memory and the
+exact-resume window small.
+
+Two contracts the thread must not break:
+
+- **exact resume** (resilience/snapshot.py): the loader's cursor counts
+  batches *trained on*, not batches *fetched*. The worker iterates
+  ``loader.iter_uncounted()`` and the fit loop advances the cursor with
+  ``loader.note_consumed(k)`` only after a group executes, so a mid-epoch
+  save never skips a batch the restored run still needs.
+- **trace discovery** (jit/to_static.py): ``_TraceHooks`` are process-global,
+  so the worker stages raw jax arrays, never Tensors — tensor creation on a
+  foreign thread during a main-thread discovery pass would pollute the
+  capture sets.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["InputPrefetcher"]
+
+_POLL_S = 0.2  # put/get poll so close() can interrupt a full/empty queue
+
+
+class InputPrefetcher:
+    """Background staging of (inputs, labels) batches from a DataLoader.
+
+    ``get()`` returns the next staged ``(ins, labs)`` pair (lists of raw
+    arrays), the ``DONE`` sentinel at end of epoch, or re-raises the
+    worker's exception at the consumption point (a poisoned batch fails the
+    step that would have trained on it, same as the synchronous path).
+    """
+
+    DONE = object()
+
+    def __init__(self, loader, split_fn, depth=2):
+        self._loader = loader
+        self._split = split_fn
+        self._q = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="fit-input-prefetch", daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _stage(v):
+        """Start the host→device copy for one leaf; Tensors (dataset already
+        produced device values) and scalars pass through untouched."""
+        import jax
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        if isinstance(v, (Tensor, jax.Array)):
+            return v
+        arr = np.asarray(v)
+        if arr.dtype == object:
+            return v  # non-numeric payload: let the step's own staging cope
+        return jnp.asarray(arr)
+
+    def _run(self):
+        from ..profiler import steptimer as _steptimer
+        timer = _steptimer.get_steptimer()
+        try:
+            src = (self._loader.iter_uncounted()
+                   if hasattr(self._loader, "iter_uncounted")
+                   else iter(self._loader))
+            for batch in src:
+                if self._stop.is_set():
+                    return
+                ins, labs = self._split(batch)
+                # staging time lands in the io subsystem's histogram (the
+                # overlapped copy must stay observable even though it no
+                # longer shows up in step/h2d)
+                t0 = timer._clock()
+                item = ([self._stage(v) for v in ins],
+                        [self._stage(v) for v in labs])
+                timer._registry.observe(
+                    "io.prefetch_stage_ms", (timer._clock() - t0) * 1e3)
+                self._put(("ok", item))
+            self._put(("done", None))
+        except BaseException as e:  # surfaced at get()
+            self._put(("err", e))
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=_POLL_S)
+                return
+            except queue.Full:
+                continue
+
+    def get(self):
+        while True:
+            try:
+                kind, payload = self._q.get(timeout=_POLL_S)
+            except queue.Empty:
+                if not self._thread.is_alive() and self._q.empty():
+                    # worker died without posting (should not happen — the
+                    # except arm posts) — fail rather than hang
+                    return self.DONE
+                continue
+            if kind == "ok":
+                return payload
+            if kind == "done":
+                return self.DONE
+            raise payload
+
+    def close(self):
+        """Stop the worker and drop any read-ahead (uncounted, so dropping
+        is free: the cursor never saw these batches)."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)

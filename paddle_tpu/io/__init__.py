@@ -481,6 +481,23 @@ class DataLoader:
             self._epoch_batches += 1
             yield batch
 
+    def iter_uncounted(self):
+        """Like ``__iter__`` but the resumable cursor does NOT advance per
+        yield: a prefetch pipeline reads ahead of training, and counting a
+        batch the moment it leaves the loader would make a mid-epoch save
+        skip batches the restored run never trained on. Consumers advance
+        the cursor with :meth:`note_consumed` once a batch's effects are
+        step-boundary visible (hapi fit's prefetcher does this after each
+        executed group)."""
+        skip, self._resume_skip = self._resume_skip, 0
+        self._sampler_epoch = getattr(self.batch_sampler, "epoch", None)
+        self._epoch_batches = skip
+        yield from self._raw_iter(skip)
+
+    def note_consumed(self, n=1):
+        """Advance the exact-resume cursor by `n` trained-on batches."""
+        self._epoch_batches += int(n)
+
     def _raw_iter(self, skip=0):
         if self.num_workers == 0:
             yield from self._iter_batches(skip)

@@ -1,0 +1,177 @@
+"""Whole-step compilation: one donated, sharding-annotated program per step.
+
+``CompiledTrainStep`` wraps a python train step (forward + backward +
+optimizer update) in a :class:`~paddle_tpu.jit.to_static.StaticFunction` and
+makes the compile lifecycle *observable*:
+
+- every call that still has trace/build work ahead of it runs under the
+  ``step/compile`` StepTimer phase, so recompiles land in their own column
+  of the step breakdown instead of ``unattributed``;
+- ``compiled_step.compiles_total`` increments exactly once per signature
+  when its XLA executable is built, and ``compiled_step.cache_hits_total``
+  on every steady-state fast-path call — the bench/parity lanes assert
+  "one steady-state trace per signature" directly off these counters;
+- a retrace-storm guard counts DISTINCT signatures per step function and,
+  past ``FLAGS_compiled_step_max_retraces``, warns once through the flight
+  recorder (op ``compiled_step.retrace_storm``) and ``warnings`` —
+  mirroring the serving compile-cache bound that caught the same pathology
+  on the inference side.
+
+The flag seam: ``FLAGS_compiled_step`` (default off) routes
+``hapi.Model.train_batch``/``fit`` and the bench LM lanes through this
+wrapper; the eager path stays the debug/parity oracle (bit-exact f32 — see
+tests/test_compiled_step.py). Sharding comes in through the inputs:
+parameters placed by ``distributed.spec_layout.shard_params`` and batches by
+``shard_batch`` carry ``NamedSharding``s, and jit propagates them through
+the whole fused program (GSPMD), folding the hand-wired MULTICHIP dp/ZeRO
+collectives into the compiled step.
+
+Autotuner interplay (PR 5): tuned block sizes resolve at *trace* time — the
+kernel seam calls ``ops.autotune.get_tuner().get(...)`` while jax traces
+``pure_fn``, and tracer operands fall through to the memoised winner (or the
+deterministic off-device fallback), so a warm cache means the compiled
+program bakes in the tuned tiles with zero in-trace searches.
+"""
+from __future__ import annotations
+
+import threading
+import warnings
+
+from ..core import autograd
+from ..profiler import metrics as _metrics
+from ..profiler import steptimer as _steptimer
+from .to_static import StaticFunction, _discovery_passes, _sig_of, \
+    _sig_of_step
+
+__all__ = ["CompiledTrainStep", "compiled_step_enabled", "compile_stats",
+           "reset_compile_stats"]
+
+_stats_lock = threading.Lock()
+_STATS = {"compiles": 0, "cache_hits": 0, "retrace_warnings": 0}
+
+
+def compiled_step_enabled():
+    """The FLAGS_compiled_step seam (default off: eager stays the oracle)."""
+    from ..framework.flags import get_flag
+    return bool(get_flag("FLAGS_compiled_step", False))
+
+
+def compile_stats():
+    """Process-wide counters (mirrored into the metrics registry): compiles,
+    cache hits, retrace-storm warnings. Bench/tests read this instead of
+    scraping the registry snapshot."""
+    with _stats_lock:
+        return dict(_STATS)
+
+
+def reset_compile_stats():
+    with _stats_lock:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _note_compile(n=1):
+    with _stats_lock:
+        _STATS["compiles"] += n
+    _metrics.get_registry().inc_counter("compiled_step.compiles_total", n)
+
+
+def _note_cache_hit(n=1):
+    with _stats_lock:
+        _STATS["cache_hits"] += n
+    _metrics.get_registry().inc_counter("compiled_step.cache_hits_total", n)
+
+
+class CompiledTrainStep:
+    """Callable wrapper: StaticFunction + compile attribution + retrace guard.
+
+    Drop-in for the inline ``StaticFunction(_step)`` the hapi Model builds:
+    supports ``__call__`` (one step) and ``run_steps`` (K fused steps via
+    lax.scan). `label` names this step in flight-recorder warnings.
+    """
+
+    def __init__(self, fn, label="train_step"):
+        self._static = fn if isinstance(fn, StaticFunction) \
+            else StaticFunction(fn)
+        self._label = label
+        self._seen_sigs = set()
+        self._storm_warned = False
+
+    @property
+    def static_function(self):
+        return self._static
+
+    # -- retrace-storm guard ---------------------------------------------------
+    def _guard_retrace(self, key):
+        """Count distinct (signature, shapes) keys; past the flag bound this
+        step fn is retracing per batch (ragged shapes, python objects in the
+        signature) — warn loudly once instead of silently recompiling."""
+        if key in self._seen_sigs:
+            return
+        self._seen_sigs.add(key)
+        from ..framework.flags import get_flag
+        bound = int(get_flag("FLAGS_compiled_step_max_retraces", 8))
+        if bound <= 0 or len(self._seen_sigs) <= bound or self._storm_warned:
+            return
+        self._storm_warned = True
+        with _stats_lock:
+            _STATS["retrace_warnings"] += 1
+        try:
+            from ..resilience.recorder import get_recorder
+            rec = get_recorder()
+            entry = rec.start(
+                "compiled_step.retrace_storm", group=self._label,
+                seq=len(self._seen_sigs),
+                shapes=[str(key[0])[:200]])
+            rec.finish(entry, status="warn")
+        except Exception:
+            pass  # observability must not turn a retrace into a crash
+        warnings.warn(
+            f"compiled_step[{self._label}]: {len(self._seen_sigs)} distinct "
+            f"input signatures traced (> FLAGS_compiled_step_max_retraces="
+            f"{bound}). Every new shape compiles a fresh XLA program — pad "
+            "or bucket inputs to a fixed set of shapes "
+            "(docs/compiled_step.md has the runbook).",
+            RuntimeWarning, stacklevel=3)
+
+    # -- single step -----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        st = self._static
+        if not (st._enabled and StaticFunction._default_enabled):
+            return st(*args, **kwargs)  # eager oracle: no counters, no phase
+        key = (_sig_of(args), _sig_of(kwargs), autograd.is_grad_enabled())
+        prog = st._programs.get(key)
+        if prog is not None and prog.stage >= _discovery_passes() \
+                and prog.jitted is not None:
+            _note_cache_hit()
+            return st(*args, **kwargs)
+        self._guard_retrace(key)
+        built_before = prog is not None and prog.jitted is not None
+        timer = _steptimer.get_steptimer()
+        with timer.phase("step/compile"):
+            out = st(*args, **kwargs)
+        prog = st._programs.get(key)
+        if prog is not None and prog.jitted is not None and not built_before:
+            _note_compile()
+        return out
+
+    # -- K fused steps (lax.scan) ----------------------------------------------
+    def run_steps(self, *args, **kwargs):
+        st = self._static
+        if not (st._enabled and StaticFunction._default_enabled):
+            return st.run_steps(*args, **kwargs)
+        key = (_sig_of_step(args), _sig_of_step(kwargs),
+               autograd.is_grad_enabled())
+        prog = st._programs.get(key)
+        if prog is not None and prog.scanned_ready:
+            _note_cache_hit()
+            return st.run_steps(*args, **kwargs)
+        self._guard_retrace(key)
+        ready_before = prog is not None and prog.scanned_ready
+        timer = _steptimer.get_steptimer()
+        with timer.phase("step/compile"):
+            out = st.run_steps(*args, **kwargs)
+        prog = st._programs.get(key)
+        if prog is not None and prog.scanned_ready and not ready_before:
+            _note_compile()
+        return out

@@ -61,6 +61,23 @@ def _sig_of(value):
             else str(type(value)))
 
 
+def _sig_of_step(value):
+    """Per-step signature of a run_steps argument: Tensor signatures drop
+    the leading steps axis. Derived symbolically — actually slicing would
+    dispatch device ops and pull data host-side on EVERY call just to
+    compute a cache key."""
+    if isinstance(value, Tensor):
+        return ("T", tuple(value._val.shape[1:]), str(value._val.dtype))
+    if isinstance(value, (list, tuple)):
+        return (type(value).__name__, tuple(_sig_of_step(v) for v in value))
+    if isinstance(value, dict):
+        return ("dict", tuple(sorted(
+            (k, _sig_of_step(v)) for k, v in value.items())))
+    return ("py", value if isinstance(
+        value, (int, float, str, bool, type(None)))
+        else str(type(value)))
+
+
 def _flatten_tensors(obj, out):
     if isinstance(obj, Tensor):
         out.append(obj)
@@ -355,24 +372,8 @@ class StaticFunction:
             kw2 = sub(kwargs)
             return a2, kw2
 
-        # per-step signature derived symbolically (dropping the leading steps
-        # axis) — actually slicing here would dispatch device ops and pull
-        # data host-side on EVERY call just to compute a cache key
-        def _sig_step(value):
-            if isinstance(value, Tensor):
-                return ("T", tuple(value._val.shape[1:]),
-                        str(value._val.dtype))
-            if isinstance(value, (list, tuple)):
-                return (type(value).__name__,
-                        tuple(_sig_step(v) for v in value))
-            if isinstance(value, dict):
-                return ("dict", tuple(sorted(
-                    (k, _sig_step(v)) for k, v in value.items())))
-            return ("py", value if isinstance(
-                value, (int, float, str, bool, type(None)))
-                else str(type(value)))
-
-        key = (_sig_step(args), _sig_step(kwargs), autograd.is_grad_enabled())
+        key = (_sig_of_step(args), _sig_of_step(kwargs),
+               autograd.is_grad_enabled())
 
         # fast path (default): discover the program on a THROWAWAY batch-1
         # eager pass with full state rollback, so every one of the K steps
@@ -438,11 +439,15 @@ class StaticFunction:
                     mut_vals = put(mut_vals)
                     ro_vals = put(ro_vals)
                     rest = put(rest)
-            exec_fn = (prog.scanned if _donation_paused[0]
-                       else prog.scanned_donate)
+            # same donation gate as _run: host-assigned state buffers
+            # (guard restore / checkpoint load) must not be donated
+            donate = not _donation_paused[0] and not any(
+                getattr(t, "_donate_unsafe", True) for t in prog.mutated)
+            exec_fn = prog.scanned_donate if donate else prog.scanned
             outs, new_state = exec_fn(mut_vals, ro_vals, rest)
             for t, v in zip(prog.mutated, new_state):
                 t._val = v
+                t._donate_unsafe = False
             return outs
 
         # the FIRST execution traces pure_fn (temporarily rebinding shared
@@ -796,11 +801,20 @@ class StaticFunction:
                     diff_tensors.append(t)
 
         if not diff_tensors:
-            exec_fn = prog.jitted if _donation_paused[0] else prog.jitted_donate
+            # donation gate: a mutated tensor whose value was assigned from
+            # the host since the last write-back (guard restore, checkpoint
+            # load) may be backed by an imported numpy buffer — donating it
+            # corrupts memory on the PJRT CPU backend (use-after-free; seen
+            # as silently wrong parameters and occasional segfaults). One
+            # un-donated launch re-homes the state in XLA-owned buffers.
+            donate = not _donation_paused[0] and not any(
+                getattr(t, "_donate_unsafe", True) for t in prog.mutated)
+            exec_fn = prog.jitted_donate if donate else prog.jitted
             flat = exec_fn(mut_vals, ro_vals, arg_vals)
             out_vals, new_state = flat[:n_outs], flat[n_outs:]
             for t, v in zip(prog.mutated, new_state):
                 t._val = v
+                t._donate_unsafe = False
             leaves = [Tensor(v, stop_gradient=True) for v in out_vals]
             if prog.internal_backward and autograd.is_grad_enabled():
                 # the fast path skips outer grad flow; if the caller later
@@ -850,6 +864,7 @@ class StaticFunction:
         out_vals, new_state = flat[:n_outs], flat[n_outs:]
         for t, v in zip(prog.mutated, new_state):
             t._val = v
+            t._donate_unsafe = False  # vjp outputs are XLA-owned
         node = GradNode(
             vjp_fn=vjp_fn,
             inputs=[all_tensors[i] for i in diff_idx],

@@ -4,6 +4,9 @@ A :class:`StepTimer` splits each step into named phases —
 
 - ``step/input_wait``   — blocked on the data loader;
 - ``step/h2d``          — host→device transfer / Tensor staging;
+- ``step/compile``      — trace + XLA build of a compiled train step
+  (jit/compiled_step.py); a steady state that keeps paying this phase is a
+  retrace storm (docs/compiled_step.md);
 - ``step/compute``      — dispatch + execution of the compiled step;
 - ``step/collective_wait`` — eager collective tail (the watch_section wrap
   points in distributed/collective.py);
@@ -46,6 +49,7 @@ __all__ = ["PHASES", "StepTimer", "get_steptimer", "reset_steptimer",
 PHASES = (
     "step/input_wait",
     "step/h2d",
+    "step/compile",
     "step/compute",
     "step/collective_wait",
     "step/optimizer",

@@ -166,7 +166,7 @@ def test_metric_name_lint_manifest_guard():
 
     subsystems = set(ast.literal_eval(_assigned("SUBSYSTEMS")))
     assert {"steptimer", "metrics", "serving", "io",
-            "integrity", "ckpt"} <= subsystems
+            "integrity", "ckpt", "compiled_step"} <= subsystems
     units = set(ast.literal_eval(_assigned("UNITS")))
     assert {"ms", "total", "per_sec"} <= units
     grandfathered = set(ast.literal_eval(_assigned("GRANDFATHERED")))
@@ -174,6 +174,31 @@ def test_metric_name_lint_manifest_guard():
     # pattern instead of being added here
     assert grandfathered <= {"autotune.search/{}", "fusion_policy/{}",
                              "straggler.rank{}", "{}.{}"}
+
+
+def test_compiled_step_flags_registered():
+    """The compiled-step PR's knobs stay registered with their contracted
+    defaults: FLAGS_compiled_step ships OFF (eager is the parity oracle;
+    compilation is an explicit opt-in), the retrace-storm bound stays
+    finite, and prefetch/donation stay on. Parsed from source, not live
+    state, so another test mutating flags can't flake this guard."""
+    import ast
+    src = (REPO / "paddle_tpu" / "framework" / "flags.py").read_text()
+    tree = ast.parse(src)
+    defaults_node = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.AnnAssign)
+        and getattr(node.target, "id", None) == "_FLAGS")
+    defaults = {}
+    for key, val in zip(defaults_node.keys, defaults_node.values):
+        try:
+            defaults[ast.literal_eval(key)] = ast.literal_eval(val)
+        except ValueError:
+            pass  # computed defaults (e.g. 1 << 20) — not ours
+    assert defaults["FLAGS_compiled_step"] is False
+    assert int(defaults["FLAGS_compiled_step_max_retraces"]) >= 1
+    assert defaults["FLAGS_input_prefetch"] is True
+    assert defaults["FLAGS_donate_state_buffers"] is True
 
 
 def test_trace_merge_help_smoke():

@@ -76,6 +76,14 @@ def _flat_metrics(doc):
     for k, v in (doc.get("extra") or {}).items():
         if k in _THROUGHPUT_KEYS and isinstance(v, (int, float)):
             out[k] = float(v)
+    # whole-step compilation ratio (extra.compiled_speedup.{lane},
+    # jit/compiled_step.py): eager s / compiled s, higher-is-better like a
+    # throughput lane — a round where the compiled path stops winning is a
+    # regression even if absolute throughput held
+    sp = (doc.get("extra") or {}).get("compiled_speedup") or {}
+    for lane, v in sorted(sp.items() if isinstance(sp, dict) else ()):
+        if isinstance(v, (int, float)):
+            out[f"compiled_speedup.{lane}"] = float(v)
     return out
 
 
@@ -84,6 +92,12 @@ def _flat_metrics(doc):
 # so a 0.1ms -> 0.2ms phase wiggle never fails CI
 _PHASE_TOL = 0.25
 _PHASE_MIN_MS = 1.0
+
+# absolute floor for extra.compiled_speedup lanes: the compiled step must
+# beat eager per-op dispatch by >= 1.15x on every recorded LM lane — below
+# that the whole-step compiler is not paying for its complexity, regardless
+# of what the previous round measured
+_COMPILED_FLOOR = 1.15
 
 
 def _breakdown_metrics(doc):
@@ -198,6 +212,22 @@ def compare(old_doc, new_doc, tol=0.03, waivers=()):
                 regressions.append(row)
         elif ratio < 1.0 - _PHASE_TOL:
             improvements.append(row)
+    # compiled-speedup absolute floor: checked on the NEW artifact alone
+    # (round-over-round drift is already gated via _flat_metrics above) so
+    # the very first artifact carrying the lane is held to the contract too
+    new_sp = (new_doc.get("extra") or {}).get("compiled_speedup") or {}
+    for lane, v in sorted(new_sp.items() if isinstance(new_sp, dict) else ()):
+        if not isinstance(v, (int, float)) or v >= _COMPILED_FLOOR:
+            continue
+        k = f"compiled_speedup.{lane}"
+        row = {"metric": k, "old": _COMPILED_FLOOR, "new": float(v),
+               "ratio": round(float(v) / _COMPILED_FLOOR, 4),
+               "direction": "absolute_floor"}
+        if k in waived_metrics:
+            row["waiver"] = waived_metrics[k]
+            waived.append(row)
+        else:
+            regressions.append(row)
     return regressions, waived, improvements
 
 

@@ -40,6 +40,7 @@ SCAN = ["paddle_tpu", "bench.py"]
 SUBSYSTEMS = [
     "autotune",      # kernel-tier block autotuning
     "ckpt",          # zero-stall checkpointing (resilience/snapshot.py)
+    "compiled_step", # whole-step compilation (jit/compiled_step.py)
     "fusion_policy", # measured fusion decisions
     "integrity",     # SDC defense (checksum consensus, replay)
     "io",            # input pipeline / data workers
