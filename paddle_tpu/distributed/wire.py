@@ -22,7 +22,8 @@ import numpy as np
 from ..resilience.faults import maybe_inject
 
 __all__ = ["encode", "decode", "send_frame", "recv_frame", "FrameError",
-           "IdleTimeout", "stamp_generation", "frame_generation"]
+           "IdleTimeout", "stamp_generation", "frame_generation",
+           "stamp_model_version", "frame_model_version"]
 
 _MAX_FRAME = 1 << 33  # 8 GiB sanity bound
 _MAX_DEPTH = 64
@@ -338,3 +339,29 @@ def frame_generation(frame):
         except (TypeError, ValueError):
             return 0
     return 0
+
+
+# -- model-version stamping (serving/rollout.py) ------------------------------
+
+def stamp_model_version(frame, version):
+    """Stamp the serving model version into an outgoing reply frame dict.
+
+    A server with no rollout controller attached stamps nothing, so
+    pre-rollout deployments keep producing byte-identical frames; like the
+    generation fence above, the stamp rides inside the frame dict (no
+    header change) and peers that predate it simply ignore the extra key.
+    """
+    if version is not None and isinstance(frame, dict):
+        frame["model_version"] = version
+    return frame
+
+
+def frame_model_version(frame):
+    """The model version stamped into a received frame (None when
+    unstamped or mangled — an unversioned server must read as 'no
+    version', not crash the client)."""
+    if isinstance(frame, dict):
+        v = frame.get("model_version")
+        if isinstance(v, (int, float, str)):
+            return v
+    return None

@@ -95,6 +95,20 @@ _FLAGS: dict[str, Any] = {
     # disables hedging
     "FLAGS_serving_hedge_budget": 0.05,
     "FLAGS_serving_hedge_min_ms": 10.0,
+    # live rollout (serving/rollout.py, docs/serving.md "Live rollout"):
+    # seconds between manifest-watcher polls of the checkpoint root
+    "FLAGS_rollout_poll_interval": 30.0,
+    # golden-request gate: max relative drift of canary outputs vs the
+    # incumbent's captured outputs (NaN/Inf always fail). Generous default
+    # — a legitimately retrained model moves its outputs; pass a custom
+    # golden_check for model-specific quality gates
+    "FLAGS_rollout_golden_max_drift": 1.0,
+    # bound on waiting for one stale-version replica to drain during a
+    # roll before it is force-removed (fenced: late results dropped)
+    "FLAGS_rollout_drain_timeout": 60.0,
+    # consecutive failed controller steps mid-ROLLING before the roll is
+    # abandoned and rolled back to the incumbent version
+    "FLAGS_rollout_max_step_failures": 3,
     # hardware health & SDC defense (resilience/{integrity,health}.py):
     # steps between cross-replica parameter-checksum consensus rounds;
     # 0 disables in-training SDC detection

@@ -59,11 +59,19 @@ __all__ = [
     "AsyncCheckpointer", "CheckpointCommitError", "capture_train_state",
     "restore_train_state", "save_model", "checkpointer_for", "flush_all",
     "list_manifests", "read_manifest", "verify_manifest", "load_blob",
-    "protected_files", "serialize_file",
+    "load_manifest_blob", "protected_files", "serialize_file",
+    "pin_path", "write_pin", "clear_pin", "read_pins", "pinned_manifests",
+    "manifest_name",
 ]
 
 MANIFEST_RE = re.compile(r"^manifest-(\d+)\.json$")
 DATA_DIR_RE = re.compile(r"^data-(\d+)$")
+PINS_DIR = "pins"
+
+
+def manifest_name(seq):
+    """The basename of the commit record for sequence ``seq``."""
+    return f"manifest-{int(seq):010d}.json"
 
 
 def _data_dir(seq):
@@ -253,6 +261,82 @@ def protected_files(root):
     return out
 
 
+# -- retention pins ----------------------------------------------------------
+#
+# Keep-K retention alone can delete the manifest a consumer still depends
+# on: the serving rollout controller needs the incumbent and prior versions
+# on disk for instant rollback, and K new commits mid-roll would otherwise
+# age them out. A consumer pins manifests by atomically writing
+# ``pins/<consumer>.json`` under the checkpoint root; ``gc()`` treats every
+# pinned manifest as kept (manifest + referenced files survive).
+
+def pin_path(root, consumer):
+    return os.path.join(os.path.abspath(root), PINS_DIR,
+                        f"{consumer}.json")
+
+
+def write_pin(root, consumer, manifests, meta=None):
+    """Atomically pin manifest basenames under ``root`` against keep-K GC.
+    ``manifests`` may hold paths or basenames; the whole pin file is
+    replaced in one ``os.replace`` so a reader (or ``gc``) never sees a
+    torn pin. Returns the pin path."""
+    path = pin_path(root, consumer)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    names = sorted({os.path.basename(str(m)) for m in manifests if m})
+    doc = {"consumer": str(consumer), "manifests": names,
+           "ts": time.time()}
+    if meta:
+        doc.update(meta)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def clear_pin(root, consumer):
+    """Drop a consumer's pin (its manifests become ordinary GC fodder)."""
+    try:
+        os.remove(pin_path(root, consumer))
+    except OSError:
+        pass
+
+
+def read_pins(root):
+    """All pins under ``root`` as {consumer: [manifest basenames]}.
+    Unreadable or foreign files are skipped — writers use atomic replace,
+    so a skip means a corrupt/alien file, and a pin that cannot be read
+    pins nothing (fail-open keeps GC functional)."""
+    d = os.path.join(os.path.abspath(root), PINS_DIR)
+    out = {}
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for n in sorted(names):
+        if not n.endswith(".json") or ".tmp." in n:
+            continue
+        try:
+            with open(os.path.join(d, n)) as f:
+                doc = json.load(f)
+            mans = [os.path.basename(str(m))
+                    for m in (doc.get("manifests") or [])]
+        except (OSError, ValueError, AttributeError, TypeError):
+            continue
+        out[n[:-len(".json")]] = sorted(set(mans))
+    return out
+
+
+def pinned_manifests(root):
+    """The union of every consumer's pinned manifest basenames."""
+    out = set()
+    for names in read_pins(root).values():
+        out.update(names)
+    return out
+
+
 def _blob_from_manifest(mpath, man):
     """Assemble a hybrid-checkpoint-shaped blob ({model, optimizer, meta,
     train_state}) from a verified manifest's files."""
@@ -332,6 +416,16 @@ def load_blob(path, journal=None):
             _skip(p, e)
     raise FileNotFoundError(
         f"{root}: no committed manifest or readable .old fallback")
+
+
+def load_manifest_blob(path):
+    """Verify ONE manifest and assemble its blob — no newest→oldest
+    fallback. The serving rollout loader goes through here: it must load
+    exactly the version it was asked for or fail typed
+    (:class:`CheckpointCommitError`), never silently substitute an older
+    checkpoint under a version stamp that claims otherwise."""
+    man = verify_manifest(path)
+    return _blob_from_manifest(path, man)
 
 
 # -- the async checkpointer --------------------------------------------------
@@ -587,6 +681,16 @@ class AsyncCheckpointer:
         keep = max(1, self.keep)  # the newest committed manifest survives
         mans = list_manifests(self.root)
         kept, doomed = mans[:keep], mans[keep:]
+        # consumer pins (pins/<consumer>.json): the serving rollout
+        # controller pins the incumbent + prior manifests it would roll
+        # back to — they move to the kept set no matter how far past the
+        # keep-K window the committer has advanced
+        pinned = pinned_manifests(self.root)
+        if pinned:
+            kept = kept + [(s, mp) for s, mp in doomed
+                           if os.path.basename(mp) in pinned]
+            doomed = [(s, mp) for s, mp in doomed
+                      if os.path.basename(mp) not in pinned]
         protected = set()
         kept_aliases = set()
         for _, mp in kept:

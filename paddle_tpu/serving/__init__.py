@@ -31,6 +31,10 @@ from .autoscaler import Autoscaler, AutoscalerConfig  # noqa: F401
 from .client import InferenceClient, RemoteInferenceError  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .overload import AdmissionController, CircuitBreaker  # noqa: F401
+from .rollout import (  # noqa: F401
+    GoldenMismatch, ManifestWatcher, RolloutConfig, RolloutController,
+    RolloutError,
+)
 from .scheduler import (  # noqa: F401
     Replica, ReplicaDead, ReplicaRetired, Scheduler,
 )
@@ -42,5 +46,7 @@ __all__ = [
     "Batch", "BatchQueue", "BucketedExecutor", "Scheduler", "Replica",
     "ReplicaDead", "ReplicaRetired", "RemoteInferenceError",
     "AdmissionController", "CircuitBreaker", "Autoscaler",
-    "AutoscalerConfig", "bucket_for", "pow2_buckets", "signature_of",
+    "AutoscalerConfig", "RolloutController", "RolloutConfig",
+    "ManifestWatcher", "RolloutError", "GoldenMismatch",
+    "bucket_for", "pow2_buckets", "signature_of",
 ]

@@ -98,6 +98,16 @@ class Autoscaler:
         now = self._now() if now is None else now
         action = {"scaled_up": False, "scaled_down": False, "removed": []}
         action["removed"] = self._finish_drains(now)
+        if getattr(self.server, "rollout_active", lambda: False)():
+            # a rollout/rollback is converging the fleet: hold resizes so
+            # the roll's capacity math (and which replica scale_down would
+            # pick — highest idx = the just-added new-version one) can't
+            # fight the controller. Streaks reset: demand evidence from
+            # during the roll is polluted by the extra canary capacity.
+            self._up_streak = 0
+            self._down_streak = 0
+            action["held_for_rollout"] = True
+            return action
         depth = self.server.queue.depth()
         n = self.replica_count()
         per_replica = depth / n if n else float("inf")

@@ -118,7 +118,7 @@ class Request:
 
     __slots__ = ("id", "inputs", "rows", "signature", "deadline",
                  "enqueued_at", "result", "error", "_done", "priority",
-                 "on_done")
+                 "on_done", "version")
 
     def __init__(self, inputs, deadline=None, now=0.0, request_id=None,
                  priority=0):
@@ -140,6 +140,10 @@ class Request:
         self.result = None
         self.error = None
         self.on_done = None
+        # model version of the replica that produced the result (set by
+        # the server before scatter; None until then / for failures) —
+        # rides the wire frame so a client A/B is attributable
+        self.version = None
         self._done = threading.Event()
 
     def done(self):

@@ -75,6 +75,9 @@ class InferenceClient:
         self._clock = clock if clock is not None else time.monotonic
         self._sock = None
         self._lock = threading.Lock()
+        # model version stamped on the most recent successful reply (the
+        # serving fleet's manifest seq; None = unstamped/launch weights)
+        self.last_model_version = None
 
     def _conn(self):
         if self._sock is None:
@@ -140,6 +143,7 @@ class InferenceClient:
                 raise
         if not isinstance(reply, dict):
             raise RemoteInferenceError("BadReply", repr(reply))
+        self.last_model_version = wire.frame_model_version(reply)
         if reply.get("error") is not None:
             etype = reply.get("error_type", "RemoteError")
             exc = _TYPED.get(etype)

@@ -94,6 +94,20 @@ class ServingMetrics:
             f"serving.{name}_total", n,
             labels={"reason": reason} if reason is not None else None)
 
+    def note_version(self, version, n=1):
+        """Per-model-version reply accounting (rollout attribution): the
+        snapshot carries ``requests_v<version>`` keys and the registry
+        mirror carries ``serving.requests_total{version=...}``, so a
+        client A/B split is attributable to the exact manifest seq that
+        served it. ``None`` (no rollout attached / launch weights) counts
+        under the "unset" label."""
+        label = "unset" if version is None else str(version)
+        with self._lock:
+            key = f"requests_v{label}"
+            self._c[key] = self._c.get(key, 0) + n
+        self._registry().inc_counter("serving.requests_total", n,
+                                     labels={"version": label})
+
     def observe_latency(self, seconds):
         with self._lock:
             if len(self._lat) >= _RESERVOIR:

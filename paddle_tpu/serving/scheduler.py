@@ -88,9 +88,10 @@ class Replica:
 
     __slots__ = ("idx", "executor", "healthy", "inflight", "completed",
                  "failures", "restarts", "last_error", "breaker",
-                 "draining", "fenced_out")
+                 "draining", "fenced_out", "version")
 
-    def __init__(self, idx, predictor, max_cached=32, breaker=None):
+    def __init__(self, idx, predictor, max_cached=32, breaker=None,
+                 version=None):
         self.idx = idx
         self.executor = BucketedExecutor(predictor, max_cached=max_cached)
         self.healthy = True
@@ -102,6 +103,10 @@ class Replica:
         self.breaker = breaker or CircuitBreaker()
         self.draining = False
         self.fenced_out = False
+        # model version this replica's predictor was built from (the
+        # rollout controller's manifest seq; None = launch weights).
+        # Stamped into every reply the replica produces.
+        self.version = version
 
     @property
     def compile_count(self):
@@ -118,6 +123,7 @@ class Replica:
                 "compiles": self.executor.compile_count,
                 "breaker": self.breaker.describe(),
                 "draining": self.draining,
+                "version": self.version,
                 "last_error": (str(self.last_error)
                                if self.last_error else None)}
 
@@ -173,6 +179,12 @@ class Scheduler:
         # hedge accounting: budget = hedges / dispatches
         self._dispatches = 0
         self._hedges = 0
+        # current-version loader (set by the rollout controller): when set,
+        # restart_dead and default add_replica builds go through it instead
+        # of the launch-time factory, so a replica rebuilt mid- or
+        # post-rollout never resurrects stale weights
+        self._current_factory = None
+        self._current_version = None
         self.replicas = [Replica(i, predictor_factory(i),
                                  max_cached=max_cached,
                                  breaker=self._breaker_factory())
@@ -226,6 +238,38 @@ class Scheduler:
         if self._step_timeout is not None:
             return self._step_timeout
         return float(_flag("FLAGS_serving_step_timeout", 60.0))
+
+    # -- versioned builds ------------------------------------------------------
+    def set_version_loader(self, factory, version):
+        """Route every future replica build (``restart_dead``, default
+        ``add_replica``, autoscaler scale-ups) through ``factory``,
+        stamping the result with ``version``. The rollout controller sets
+        this when a version is proven (canary pass) or restored
+        (rollback), fixing the restart-resurrects-launch-weights bug."""
+        with self._lock:
+            self._current_factory = factory
+            self._current_version = version
+
+    def current_version(self):
+        with self._lock:
+            return self._current_version
+
+    def _build_factory(self):
+        """(factory, version) a rebuilt replica should use: the current
+        version loader when set, else the launch factory (version None)."""
+        with self._lock:
+            if self._current_factory is not None:
+                return self._current_factory, self._current_version
+            return self._factory, None
+
+    def stamp_versions(self, version, only_unversioned=True):
+        """Label live replicas with a model version (rollout resume: a
+        restarted server's launch-built replicas adopt the incumbent
+        version recorded in the journal)."""
+        with self._lock:
+            for r in self.replicas:
+                if not only_unversioned or r.version is None:
+                    r.version = version
 
     # -- hedging ---------------------------------------------------------------
     def hedge_budget(self):
@@ -388,8 +432,12 @@ class Scheduler:
             dead = [r for r in self.replicas
                     if not r.healthy and r.inflight == 0]
         for rep in dead:
+            # rebuild through the CURRENT version loader, not the launch
+            # factory: a replica restarted mid-rollout must come back with
+            # the weights the fleet is converging to, correctly stamped
+            factory, version = self._build_factory()
             try:
-                predictor = self._factory(rep.idx)
+                predictor = factory(rep.idx)
             except Exception as e:  # keep serving on survivors
                 with self._lock:
                     rep.last_error = e
@@ -416,6 +464,7 @@ class Scheduler:
                 rep.executor = executor
                 rep.healthy = True
                 rep.restarts += 1
+                rep.version = version
                 rep.breaker = self._breaker_factory()
                 if self._metrics:
                     self._metrics.inc("replica_restarts")
@@ -473,17 +522,22 @@ class Scheduler:
         serving_preflight(predictor)
 
     # -- elastic membership ----------------------------------------------------
-    def add_replica(self):
+    def add_replica(self, factory=None, version=None):
         """Scale-up: build, preflight, and warm a new replica, then admit
         it to the dispatch set under a bumped generation. The replica never
-        sees traffic before it is warm and proven."""
+        sees traffic before it is warm and proven. ``factory``/``version``
+        default to the current version loader (autoscaler scale-ups join
+        at the fleet's live version, never launch-time weights); the
+        rollout controller passes them explicitly for canary/roll adds."""
+        if factory is None:
+            factory, version = self._build_factory()
         with self._lock:
             idx = self._next_idx
             self._next_idx += 1
-        predictor = self._factory(idx)
+        predictor = factory(idx)
         self._run_preflight(predictor)
         rep = Replica(idx, predictor, max_cached=self._max_cached,
-                      breaker=self._breaker_factory())
+                      breaker=self._breaker_factory(), version=version)
         for sig, buckets in self._warmup_list():
             rep.executor.warmup(sig, buckets)
         with self._lock:

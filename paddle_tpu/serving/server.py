@@ -142,6 +142,7 @@ class InferenceServer:
         self._stop = threading.Event()
         self._crashed = None
         self._autoscaler = None
+        self._rollout = None
         for sig in self.config.warmup_signatures:
             self.warmup(sig)
 
@@ -226,6 +227,8 @@ class InferenceServer:
             self.scheduler.maintain()
             if self._autoscaler is not None:
                 self._autoscaler.tick()
+            if self._rollout is not None:
+                self._rollout.tick()
             batch = self.queue.assemble(self.config.buckets,
                                         max_rows=self.config.max_batch_size)
             if batch is None:
@@ -298,7 +301,7 @@ class InferenceServer:
             self.recorder.finish(entry, status="ok")
             self._observe_exec(self._now() - exec_start)
             try:
-                self._reply(batch, outputs)
+                self._reply(batch, outputs, version=rep.version)
             except Exception as e:
                 # a failed reply must still terminate every request — an
                 # accepted request never goes silent
@@ -325,6 +328,25 @@ class InferenceServer:
                                       clock=self._clock, job_id=job_id)
         return self._autoscaler
 
+    def attach_rollout(self, root, loader, goldens=(), config=None,
+                       journal=None, job_id="serving-rollout"):
+        """Enable live model rollout: watch ``root`` for newly committed
+        checkpoints and hot-swap the fleet through canary → roll, with
+        instant rollback (docs/serving.md "Live rollout"). ``loader(path,
+        idx)`` builds a predictor from one exact manifest. Returns the
+        RolloutController (ticked once per batching round, like the
+        autoscaler)."""
+        from .rollout import RolloutController
+        self._rollout = RolloutController(
+            self, root, loader, goldens=goldens, config=config,
+            journal=journal, clock=self._clock, job_id=job_id)
+        return self._rollout
+
+    def rollout_active(self):
+        """True while a rollout/rollback is converging the fleet — the
+        autoscaler suspends resizes so the roll's capacity math holds."""
+        return self._rollout is not None and self._rollout.active()
+
     def _retry_allowed(self, batch):
         now = self._now()
         for req in batch.requests:
@@ -332,11 +354,16 @@ class InferenceServer:
                 return False
         return bool(self.scheduler.healthy_replicas())
 
-    def _reply(self, batch, outputs):
-        """Complete every request in the batch from the padded outputs."""
+    def _reply(self, batch, outputs, version=None):
+        """Complete every request in the batch from the padded outputs,
+        stamping each with the model version of the replica that served it
+        (rollout attribution; rides the wire frame as ``model_version``)."""
         maybe_inject("serving.reply", ConnectionError)
         now = self._now()
+        for req in batch.requests:
+            req.version = version
         batch.scatter_outputs(outputs)
+        self.metrics.note_version(version, len(batch.requests))
         self.metrics.inc("batches")
         self.metrics.inc("rows", batch.rows)
         self.metrics.inc("padded_rows", batch.bucket - batch.rows)
@@ -396,6 +423,8 @@ class InferenceServer:
                     self.scheduler.maintain()
                     if self._autoscaler is not None:
                         self._autoscaler.tick()
+                    if self._rollout is not None:
+                        self._rollout.tick()
                     continue
                 # brief accumulation window lets concurrent submitters fill
                 # the bucket (classic batching-delay/throughput tradeoff)
@@ -433,6 +462,8 @@ class InferenceServer:
         snap["hedging"] = self.scheduler.hedge_stats()
         if self._autoscaler is not None:
             snap["autoscaler"] = self._autoscaler.describe()
+        if self._rollout is not None:
+            snap["rollout"] = self._rollout.describe()
         snap["compiles"] = sum(r.compile_count
                                for r in self.scheduler.replicas)
         snap["crashed"] = repr(self._crashed) if self._crashed else None
@@ -497,6 +528,7 @@ class SocketFrontend:
                 pass
 
     def _serve_one(self, msg):
+        from ..distributed import wire
         rid = msg.get("id") if isinstance(msg, dict) else None
         try:
             if not isinstance(msg, dict) or "inputs" not in msg:
@@ -508,8 +540,12 @@ class SocketFrontend:
             req.wait(msg.get("timeout"))
             if req.error is not None:
                 raise req.error
-            return {"id": req.id, "outputs": [np.asarray(o)
-                                              for o in req.result]}
+            reply = {"id": req.id, "outputs": [np.asarray(o)
+                                               for o in req.result]}
+            # absent key = unstamped (pre-rollout server / launch weights):
+            # same tolerant-reader contract as the generation stamp
+            return wire.stamp_model_version(
+                reply, getattr(req, "version", None))
         except BaseException as e:
             reply = {"id": rid, "error": str(e),
                      "error_type": type(e).__name__}

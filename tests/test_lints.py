@@ -143,6 +143,27 @@ def test_injection_lint_covers_overload_entry_points():
     assert "_attempt" in hooks
 
 
+def test_injection_lint_covers_rollout_entry_points():
+    """The live-rollout PR's contract: the manifest watch, the weight load,
+    the replica swap, and the canary verify must stay chaos-testable (sites
+    rollout.watch / rollout.load / rollout.swap / rollout.verify). Guard the
+    MANIFEST so a refactor can't silently drop the requirement along with
+    the hook."""
+    import ast
+    src = (REPO / "tools" / "check_injection_points.py").read_text()
+    tree = ast.parse(src)
+    required = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "REQUIRED" for t in node.targets))
+    manifest = ast.literal_eval(required)
+    entries = {(rel, scope): names for rel, scope, names in manifest}
+    assert "poll" in entries[
+        ("paddle_tpu/serving/rollout.py", "class:ManifestWatcher")]
+    assert {"_load", "_swap_one", "_verify_canary"} <= set(entries[
+        ("paddle_tpu/serving/rollout.py", "class:RolloutController")])
+
+
 def test_metric_name_lint_passes_on_tree():
     r = _run(REPO / "tools" / "check_metric_names.py")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -166,7 +187,7 @@ def test_metric_name_lint_manifest_guard():
 
     subsystems = set(ast.literal_eval(_assigned("SUBSYSTEMS")))
     assert {"steptimer", "metrics", "serving", "io",
-            "integrity", "ckpt", "compiled_step"} <= subsystems
+            "integrity", "ckpt", "compiled_step", "rollout"} <= subsystems
     units = set(ast.literal_eval(_assigned("UNITS")))
     assert {"ms", "total", "per_sec"} <= units
     grandfathered = set(ast.literal_eval(_assigned("GRANDFATHERED")))
@@ -252,3 +273,20 @@ def test_serving_bench_overload_smoke():
         assert point["completed"] > 0
         assert point["unterminated"] == 0
         assert point["shed"] == point["shed_with_hint"]
+
+
+def test_serving_bench_rollout_soak_smoke():
+    """The rollout soak must keep demonstrating zero-downtime hot-swap:
+    traffic flows while checkpoints commit mid-stream (one of them
+    poisoned), the fleet converges to the newest good version, the poison
+    rolls back, and not a single request is shed or mis-stamped. Fake clock,
+    so this simulates seconds of traffic in ~2s of wall time."""
+    import json
+    r = _run(REPO / "tools" / "serving_bench.py", "--rollout-soak", "--smoke")
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["rollout_soak_ok"] is True
+    gates = report["results"]["gates"]
+    for gate in ("zero_shed", "zero_unterminated", "stamps_match_outputs",
+                 "converged_to_newest_good", "poison_rolled_back"):
+        assert gates[gate] is True, (gate, report["results"])

@@ -79,6 +79,15 @@ REQUIRED = [
      ["save", "_commit", "_remove"]),
     ("paddle_tpu/resilience/snapshot.py", "module",
      ["serialize_file"]),
+    # live rollout (rollout PR): the chaos suite must be able to fail
+    # manifest discovery (rollout.watch), a canary/roll predictor build
+    # (rollout.load), a replica swap step (rollout.swap), and the golden
+    # quality gate (rollout.verify) — each must land as a typed, journaled,
+    # shed-free outcome (retry or rollback, never a raise into the loop)
+    ("paddle_tpu/serving/rollout.py", "class:ManifestWatcher",
+     ["poll"]),
+    ("paddle_tpu/serving/rollout.py", "class:RolloutController",
+     ["_load", "_swap_one", "_verify_canary"]),
 ]
 
 # _injected_run is HDFSClient's hook-carrying chokepoint: routing a call

@@ -46,6 +46,7 @@ SUBSYSTEMS = [
     "io",            # input pipeline / data workers
     "metrics",       # the registry/exporter's own health
     "profiler",      # profiler-internal (samples/sec, ...)
+    "rollout",       # live model rollout (serving/rollout.py)
     "serving",       # inference server
     "steptime",      # per-rank step-time health beacons
     "steptimer",     # phase attribution (docs/observability.md)

@@ -15,7 +15,10 @@ This tool answers that from the operator side of an incident:
   (``--no-verify`` skips the hashing for a quick listing);
 - prints which manifest a restore would pick (the newest that verifies) —
   the same walk ``load_blob`` performs, so the answer matches what
-  ``RecoveryManager.restore`` / ``load_hybrid_checkpoint`` would do.
+  ``RecoveryManager.restore`` / ``load_hybrid_checkpoint`` would do;
+- reads retention pins (``pins/<consumer>.json``, written by the serving
+  rollout controller) and marks pinned manifests — the ones keep-K GC will
+  NOT delete because a consumer's instant rollback depends on them.
 
 Usage::
 
@@ -46,6 +49,31 @@ def _sha256_file(path):
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+def _read_pins(root):
+    """Pin files under ``root/pins/`` → {consumer: {"manifests": [...],
+    ...}}. Stdlib re-implementation of ``snapshot.read_pins`` (this tool
+    must not import paddle_tpu); unreadable pins are skipped fail-open,
+    matching GC's behavior."""
+    pins = {}
+    pdir = os.path.join(root, "pins")
+    try:
+        names = os.listdir(pdir)
+    except OSError:
+        return pins
+    for n in sorted(names):
+        if not n.endswith(".json") or ".tmp." in n:
+            continue
+        try:
+            with open(os.path.join(pdir, n)) as f:
+                doc = json.load(f)
+            mans = doc.get("manifests")
+            if isinstance(doc, dict) and isinstance(mans, list):
+                pins[n[:-len(".json")]] = doc
+        except Exception:  # noqa: BLE001 — damaged pin: skip, don't crash
+            continue
+    return pins
 
 
 def _list_manifests(root):
@@ -97,7 +125,7 @@ def _inspect_manifest(root, mpath, verify=True):
 
 
 def inspect_root(path, verify=True):
-    """Returns (reports newest-first, restore_pick_or_None)."""
+    """Returns (reports newest-first, restore_pick_or_None, pins)."""
     if os.path.isdir(path):
         root, only = path, None
     else:
@@ -109,9 +137,13 @@ def inspect_root(path, verify=True):
     mans = _list_manifests(root)
     if only is not None:
         mans = [(s, p) for s, p in mans if os.path.basename(p) == only]
+    pins = _read_pins(root)
+    pinned = {m for doc in pins.values() for m in doc.get("manifests", [])}
     reports = [_inspect_manifest(root, mp, verify=verify) for _, mp in mans]
+    for r in reports:
+        r["pinned"] = r["manifest"] in pinned
     pick = next((r["manifest"] for r in reports if not r["problems"]), None)
-    return reports, pick
+    return reports, pick, pins
 
 
 def _fmt_bytes(n):
@@ -134,10 +166,14 @@ def main(argv=None):
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
 
-    reports, pick = inspect_root(args.path, verify=not args.no_verify)
+    reports, pick, pins = inspect_root(args.path, verify=not args.no_verify)
     corrupt = [r for r in reports if r["problems"]]
     if args.json:
+        pinned = sorted({m for doc in pins.values()
+                         for m in doc.get("manifests", [])})
         print(json.dumps({"manifests": reports, "restore_pick": pick,
+                          "newest_committed": pick, "pins": pins,
+                          "pinned": pinned,
                           "verified": not args.no_verify}, indent=1))
     else:
         if not reports:
@@ -155,6 +191,8 @@ def main(argv=None):
                 head = r["manifest"]
             mark = "OK " if not r["problems"] else \
                 ("??? " if args.no_verify else "BAD")
+            if r.get("pinned"):
+                head += "  PIN"
             print(f"  {mark:4s}{head}")
             for p in r["problems"]:
                 print(f"        - {p}")

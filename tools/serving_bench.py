@@ -270,6 +270,149 @@ def run_overload(args):
     return results, ok
 
 
+# -- deterministic rollout soak (fake clock, zero real sleeps) ---------------
+
+def run_rollout_soak(args):
+    """Live-rollout soak: traffic flows while checkpoints commit mid-stream
+    every ``--commit-every`` fake seconds (one of them NaN-poisoned). The
+    acceptance gate requires: the fleet converges to every good version,
+    ZERO sheds and zero unterminated requests attributable to the rolls,
+    every completed reply's output matches the version it is stamped with,
+    and the poisoned version journals ``rollout_rolled_back`` with 100%
+    incumbent serving restored. Returns (report, ok)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu import serving
+    from paddle_tpu.resilience.snapshot import (
+        AsyncCheckpointer, load_manifest_blob,
+    )
+
+    clock = _FakeClock()
+    service_s = args.service_ms / 1e3
+    workdir = tempfile.mkdtemp(prefix="rollout_soak_")
+    os.environ.setdefault("PADDLE_TPU_ARTIFACTS_DIR", workdir)
+    root = os.path.join(workdir, "ckpt")
+
+    launch_scale = 2.0
+    scales = {None: launch_scale}   # version stamp -> expected output scale
+
+    class VersionedPredictor:
+        # output = input * scale: the reply itself proves which weights
+        # served it, so the version stamp can be cross-checked per request
+        def __init__(self, scale):
+            self.scale = scale
+
+        def run(self, arrays):
+            clock.advance(service_s)
+            return [np.asarray(arrays[0]) * self.scale]
+
+    def loader(path, idx):
+        blob = load_manifest_blob(path)
+        return VersionedPredictor(blob["model"]["scale"])
+
+    scfg = serving.ServingConfig(
+        max_batch_size=args.max_batch_size, replicas=args.replicas,
+        max_queue=args.max_queue, default_deadline=None)
+    srv = serving.InferenceServer(lambda i: VersionedPredictor(launch_scale),
+                                  scfg, clock=clock)
+    ckpt = AsyncCheckpointer(root, keep=args.keep, background=False)
+    rc = srv.attach_rollout(
+        root, loader,
+        goldens=[[np.ones((1, args.features), "float32")]],
+        config=serving.RolloutConfig(
+            poll_interval=max(args.commit_every / 4.0, 1e-3),
+            golden_max_drift=10.0, drain_timeout=5.0))
+
+    total_commits = args.versions + 1          # + one poisoned commit
+    poison_at = (total_commits + 1) // 2       # mid-soak, never the last
+    committed = []
+    next_commit = args.commit_every
+    made = 0
+    # half of estimated capacity: headroom so ANY shed is the roll's fault
+    rate = 0.5 * args.replicas * args.max_batch_size / service_s
+    dt = service_s / 2
+    credit = 0.0
+    accepted, sheds = [], 0
+    x = np.ones((1, args.features), "float32")
+    while clock() < args.duration or made < total_commits:
+        if made < total_commits and clock() >= next_commit:
+            made += 1
+            poisoned = made == poison_at
+            scale = float("nan") if poisoned else 2.0 + made
+            path = ckpt.save({"model.pdparams": ({"scale": scale}, "model")})
+            seq = int(os.path.basename(path).split("-")[1].split(".")[0])
+            committed.append({"seq": seq, "scale": scale,
+                              "poisoned": poisoned})
+            if not poisoned:
+                scales[seq] = scale
+            next_commit += args.commit_every
+        credit += rate * dt
+        while credit >= 1.0:
+            credit -= 1.0
+            try:
+                accepted.append(srv.submit([x]))
+            except serving.ServerOverloaded:
+                sheds += 1
+        srv.pump(4)
+        clock.advance(dt)
+    # drain traffic AND let the last roll converge (pump ticks the
+    # controller even when the queue is empty; the newest good commit may
+    # still be waiting on the watcher's next poll when traffic stops)
+    target_seq = max(c["seq"] for c in committed if not c["poisoned"])
+    for _ in range(20000):
+        ran = srv.pump(4)
+        clock.advance(dt)
+        if not ran and not rc.active() and rc.version == target_seq \
+                and all(r.done() for r in accepted):
+            break
+
+    wrong, stamped = 0, {}
+    for req in accepted:
+        if not req.done() or req.error is not None:
+            continue
+        v = req.version
+        stamped[str(v)] = stamped.get(str(v), 0) + 1
+        exp = scales.get(v)
+        if exp is None or not np.allclose(np.asarray(req.result[0]), exp):
+            wrong += 1
+    good = [c for c in committed if not c["poisoned"]]
+    rolled_back = [e for e in rc.journal.entries()
+                   if e.get("event") == "rollout_rolled_back"]
+    completed_rolls = [e.get("version") for e in rc.journal.entries()
+                       if e.get("event") == "rollout_completed"]
+    poison_seqs = [c["seq"] for c in committed if c["poisoned"]]
+    unterminated = sum(1 for r in accepted if not r.done())
+    failed = sum(1 for r in accepted
+                 if r.done() and r.error is not None)
+    gates = {
+        "zero_shed": sheds == 0,
+        "zero_unterminated": unterminated == 0,
+        "zero_failed": failed == 0,
+        "stamps_match_outputs": wrong == 0,
+        "converged_to_newest_good":
+            bool(good) and rc.version == good[-1]["seq"]
+            and all(r.version == good[-1]["seq"]
+                    for r in srv.scheduler.replicas),
+        "poison_rolled_back":
+            all(any(r.get("failed") == s for r in rolled_back)
+                for s in poison_seqs),
+    }
+    report = {
+        "offered": len(accepted) + sheds, "accepted": len(accepted),
+        "shed": sheds, "failed": failed, "unterminated": unterminated,
+        "wrong_version_outputs": wrong, "stamped_counts": stamped,
+        "commits": committed, "completed_rolls": completed_rolls,
+        "rolled_back": [r.get("failed") for r in rolled_back],
+        "final_version": rc.version, "gates": gates,
+    }
+    ckpt.close()
+    shutil.rmtree(workdir, ignore_errors=True)
+    return report, all(gates.values())
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Offered-load sweep: throughput, p50/p99 latency, "
@@ -297,12 +440,48 @@ def main(argv=None):
                          "estimated capacity")
     ap.add_argument("--service-ms", type=float, default=5.0,
                     help="overload sweep: synthetic per-batch service time")
+    ap.add_argument("--rollout-soak", action="store_true",
+                    help="deterministic fake-clock rollout soak: traffic + "
+                         "mid-stream checkpoint commits (one poisoned), "
+                         "gated on zero sheds / correct version stamps / "
+                         "rollback of the poison")
+    ap.add_argument("--commit-every", type=float, default=4.0,
+                    help="rollout soak: fake seconds between checkpoint "
+                         "commits")
+    ap.add_argument("--versions", type=int, default=4,
+                    help="rollout soak: number of good versions committed")
+    ap.add_argument("--keep", type=int, default=3,
+                    help="rollout soak: checkpoint keep-K retention")
     args = ap.parse_args(argv)
     if args.smoke:
         args.rates, args.duration = "100", 0.5
         args.hidden, args.replicas = 8, 1
         if args.overload:
             args.duration, args.multipliers = 2.0, "1,10"
+        if args.rollout_soak:
+            args.duration, args.versions, args.commit_every = 6.0, 2, 1.5
+
+    if args.rollout_soak:
+        report, ok = run_rollout_soak(args)
+        print(f"rollout soak: accepted={report['accepted']}"
+              f"  shed={report['shed']}"
+              f"  wrong_stamps={report['wrong_version_outputs']}"
+              f"  rolls={len(report['completed_rolls'])}"
+              f"  rollbacks={len(report['rolled_back'])}"
+              f"  final=v{report['final_version']}",
+              file=sys.stderr)
+        doc = {"mode": "rollout_soak",
+               "config": {"replicas": args.replicas,
+                          "max_batch_size": args.max_batch_size,
+                          "service_ms": args.service_ms,
+                          "commit_every": args.commit_every,
+                          "versions": args.versions, "keep": args.keep,
+                          "duration": args.duration},
+               "results": report,
+               "rollout_soak_ok": ok}
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+        return 0 if ok else 1
 
     if args.overload:
         if args.deadline is None:
