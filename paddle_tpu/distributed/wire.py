@@ -23,7 +23,9 @@ from ..resilience.faults import maybe_inject
 
 __all__ = ["encode", "decode", "send_frame", "recv_frame", "FrameError",
            "IdleTimeout", "stamp_generation", "frame_generation",
-           "stamp_model_version", "frame_model_version"]
+           "stamp_model_version", "frame_model_version",
+           "stamp_stream", "frame_stream_seq", "frame_stream_end",
+           "StreamReader"]
 
 _MAX_FRAME = 1 << 33  # 8 GiB sanity bound
 _MAX_DEPTH = 64
@@ -365,3 +367,68 @@ def frame_model_version(frame):
         if isinstance(v, (int, float, str)):
             return v
     return None
+
+
+# -- streaming replies (serving/decode/) --------------------------------------
+
+def stamp_stream(frame, seq, end=False):
+    """Stamp a multi-frame streaming reply: a monotonically increasing
+    ``stream_seq`` (0-based, contiguous per stream) plus ``stream_end`` on
+    the final frame. Like the generation / model-version stamps above, the
+    markers ride inside the frame dict — the single-frame request/reply
+    protocol is untouched, and peers that predate streaming simply ignore
+    the extra keys."""
+    if isinstance(frame, dict):
+        frame["stream_seq"] = int(seq)
+        if end:
+            frame["stream_end"] = True
+    return frame
+
+
+def frame_stream_seq(frame):
+    """The stream sequence number of a received frame, or None when
+    unstamped/mangled (a non-streaming frame must read as 'not part of a
+    stream', not crash the reader)."""
+    if isinstance(frame, dict):
+        v = frame.get("stream_seq")
+        if isinstance(v, bool):
+            return None
+        if isinstance(v, (int, float)):
+            return int(v)
+    return None
+
+
+def frame_stream_end(frame):
+    """True when the frame carries the end-of-stream marker."""
+    return bool(isinstance(frame, dict) and frame.get("stream_end"))
+
+
+class StreamReader:
+    """Per-stream reassembly check: feeds must arrive with contiguous
+    sequence numbers starting at 0 and stop at the end marker.
+
+    Any gap, regression, unstamped frame, or frame after end means the
+    stream is torn — the reader raises :class:`FrameError` and the caller
+    must drop the connection, exactly like a mid-frame socket timeout.
+    """
+
+    __slots__ = ("next_seq", "ended")
+
+    def __init__(self):
+        self.next_seq = 0
+        self.ended = False
+
+    def feed(self, frame):
+        """Validate one frame; returns ``(seq, end)``."""
+        if self.ended:
+            raise FrameError("torn stream: frame after end-of-stream marker")
+        seq = frame_stream_seq(frame)
+        if seq is None:
+            raise FrameError("torn stream: unstamped frame inside a stream")
+        if seq != self.next_seq:
+            raise FrameError(
+                f"torn stream: expected seq {self.next_seq}, got {seq}")
+        self.next_seq = seq + 1
+        end = frame_stream_end(frame)
+        self.ended = end
+        return seq, end

@@ -109,6 +109,19 @@ _FLAGS: dict[str, Any] = {
     # consecutive failed controller steps mid-ROLLING before the roll is
     # abandoned and rolled back to the incumbent version
     "FLAGS_rollout_max_step_failures": 3,
+    # continuous-batching decode (serving/decode/, docs/serving.md
+    # "Continuous-batching decode"): paged KV-cache pool geometry —
+    # tokens per block, blocks in the fixed pool
+    "FLAGS_decode_block_size": 16,
+    "FLAGS_decode_kv_blocks": 256,
+    # prefill ration: at most this many prompt tokens absorbed per engine
+    # step (one stream per step) so long prompts never stall decode
+    "FLAGS_decode_prefill_chunk": 64,
+    # default generation length cap when the request doesn't set one
+    "FLAGS_decode_max_new_tokens": 64,
+    # weight-only quantization for decode replicas at load time
+    # ("" = off, "int8" = per-channel absmax int8; slim/ptq.py)
+    "FLAGS_decode_quantize": "",
     # hardware health & SDC defense (resilience/{integrity,health}.py):
     # steps between cross-replica parameter-checksum consensus rounds;
     # 0 disables in-training SDC detection

@@ -38,6 +38,10 @@ from .rollout import (  # noqa: F401
 from .scheduler import (  # noqa: F401
     Replica, ReplicaDead, ReplicaRetired, Scheduler,
 )
+from .decode import (  # noqa: F401
+    CompiledDecodeBackend, CompiledDecodeStep, DecodeConfig, DecodeEngine,
+    DecodeStream, KVBlockPool, KVCacheExhausted,
+)
 from .server import InferenceServer, ServingConfig, SocketFrontend  # noqa: F401
 
 __all__ = [
@@ -48,5 +52,7 @@ __all__ = [
     "AdmissionController", "CircuitBreaker", "Autoscaler",
     "AutoscalerConfig", "RolloutController", "RolloutConfig",
     "ManifestWatcher", "RolloutError", "GoldenMismatch",
+    "DecodeEngine", "DecodeConfig", "DecodeStream", "KVBlockPool",
+    "KVCacheExhausted", "CompiledDecodeStep", "CompiledDecodeBackend",
     "bucket_for", "pow2_buckets", "signature_of",
 ]

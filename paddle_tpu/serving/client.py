@@ -156,6 +156,55 @@ class InferenceClient:
             raise RemoteInferenceError(etype, reply["error"])
         return [np.asarray(o) for o in reply["outputs"]]
 
+    def generate(self, prompt, max_new_tokens=None, timeout=None,
+                 request_id=None, priority=0):
+        """Stream one generation: yields ``int`` tokens as the server emits
+        them (seq-validated — a torn stream raises ``FrameError``, a typed
+        server error raises as itself with any ``retry_after`` hint
+        attached). The generator returns after the end-of-stream frame;
+        ``timeout`` travels as the request deadline and bounds each frame
+        wait. Holds the client's lock for the whole stream — use one
+        client per concurrent stream."""
+        from ..distributed import wire
+        frame = {"op": "generate", "id": request_id, "timeout": timeout,
+                 "prompt": np.ascontiguousarray(
+                     np.asarray(prompt, dtype=np.int64).reshape(-1))}
+        if max_new_tokens is not None:
+            frame["max_new_tokens"] = int(max_new_tokens)
+        if priority:
+            frame["priority"] = int(priority)
+        io_timeout = (timeout + 10.0) if timeout is not None else ...
+        reader = wire.StreamReader()
+        with self._lock:
+            sock = self._conn()
+            try:
+                wire.send_frame(sock, frame, timeout=(
+                    None if io_timeout is ... else io_timeout))
+                while True:
+                    reply = wire.recv_frame(sock, timeout=(
+                        ... if io_timeout is ... else io_timeout))
+                    if not isinstance(reply, dict):
+                        raise wire.FrameError(
+                            "stream frame must be a dict, got "
+                            f"{type(reply).__name__}")
+                    _, end = reader.feed(reply)
+                    if reply.get("error") is not None:
+                        etype = reply.get("error_type", "RemoteError")
+                        exc = _TYPED.get(etype)
+                        if exc is None:
+                            raise RemoteInferenceError(etype, reply["error"])
+                        err = exc(reply["error"])
+                        hint = reply.get("retry_after")
+                        if hint is not None:
+                            err.retry_after = float(hint)
+                        raise err
+                    if end:
+                        return
+                    yield int(reply["token"])
+            except (wire.FrameError, ConnectionError, OSError):
+                self.close()   # desynced/torn stream: reconnect next call
+                raise
+
     def close(self):
         if self._sock is not None:
             try:

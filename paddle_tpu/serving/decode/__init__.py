@@ -1,0 +1,45 @@
+"""Continuous-batching autoregressive decode serving.
+
+Layered under :class:`paddle_tpu.serving.server.InferenceServer`:
+
+- :mod:`.kv_cache` — paged KV-cache allocator (fixed block pool,
+  per-stream block tables, OOM-safe admission);
+- :mod:`.engine` — the continuous-batching scheduler (per-step
+  join/leave, rationed chunked prefill, deadline/priority admission,
+  replica-death replay);
+- :mod:`.compiled_decode` — donated jitted decode programs, one per
+  (bucket, signature), under PR 10's taint contract.
+
+See docs/serving.md, "Continuous-batching decode".
+"""
+from __future__ import annotations
+
+from .compiled_decode import CompiledDecodeBackend, CompiledDecodeStep
+from .engine import DecodeConfig, DecodeEngine, DecodeStream
+from .kv_cache import BlockTable, KVBlockPool, KVCacheExhausted
+
+__all__ = [
+    "BlockTable",
+    "CompiledDecodeBackend",
+    "CompiledDecodeStep",
+    "DecodeConfig",
+    "DecodeEngine",
+    "DecodeStream",
+    "KVBlockPool",
+    "KVCacheExhausted",
+    "load_decode_model",
+]
+
+
+def load_decode_model(builder, quantize=None):
+    """Build a decode-replica model, applying the weight-only int8 path
+    when ``FLAGS_decode_quantize=int8`` (default off).
+
+    ``builder`` is a zero-arg callable returning the model (so the
+    un-quantized weights never need to exist twice). Returns
+    ``(model, n_quantized_layers)``.
+    """
+    from ...slim.ptq import quantize_decode_weights
+    model = builder()
+    n = quantize_decode_weights(model, mode=quantize)
+    return model, n

@@ -1,0 +1,253 @@
+"""Compiled decode step: one donated jitted program per (bucket, signature).
+
+The decode loop executes the same tiny program millions of times, so its two
+compile-side pathologies are fatal at serving scale:
+
+- **unbounded retraces** — every distinct batch shape is a fresh XLA
+  compilation (seconds). The engine therefore pads the running set to a
+  fixed bucket set (``serving.batcher`` discipline) and this wrapper keys
+  its program cache by ``(bucket, signature)``, so the steady state compiles
+  at most once per key. Compiles/cache-hits land in the same process-wide
+  counters the training side uses (``compiled_step.compiles_total`` /
+  ``cache_hits_total`` via :mod:`paddle_tpu.jit.compiled_step`), and the
+  same retrace-storm guard warns through the flight recorder when the key
+  set outgrows ``FLAGS_compiled_step_max_retraces``;
+- **KV copies** — the KV state is by far the largest operand and is dead
+  the moment the step returns its successor. The jitted program donates it
+  (``donate_argnums``) under PR 10's taint contract: a host-imported buffer
+  (numpy, or a Tensor value flagged ``_donate_unsafe``) may still be aliased
+  by the caller, so it is copied onto the device first and the *copy* is
+  donated — donation never aliases host memory.
+
+``CompiledDecodeBackend`` is the reference engine backend built on this
+wrapper: a deterministic token stepper whose per-stream state rides a
+fixed-width KV row, bucket-padded per decode round. The chaos soak and
+``serving_bench --decode`` drive it to prove the compile bound end to end.
+"""
+from __future__ import annotations
+
+import threading
+import warnings
+
+import numpy as np
+
+from ...jit.compiled_step import _note_cache_hit, _note_compile
+from ..batcher import bucket_for, pow2_buckets
+
+__all__ = ["CompiledDecodeStep", "CompiledDecodeBackend"]
+
+
+def _flag(name, default):
+    from ...framework.flags import get_flag
+    v = get_flag(name, default)
+    return default if v is None else v
+
+
+def _import_safe(leaf):
+    """PR 10 donation-taint gate: a numpy array or a host-assigned buffer
+    (``_donate_unsafe``) may still be aliased by the caller — donating it
+    would let XLA scribble over host memory the caller reads later. Import
+    such leaves as a fresh device copy (the copy is XLA-owned and safe to
+    donate); pass through everything already device-resident and clean."""
+    import jax
+    import jax.numpy as jnp
+    val = getattr(leaf, "_val", leaf)   # unwrap paddle Tensor
+    if isinstance(val, np.ndarray) or getattr(leaf, "_donate_unsafe", False):
+        return jnp.array(np.asarray(val))
+    if not isinstance(val, jax.Array):
+        return jnp.asarray(val)
+    return val
+
+
+class CompiledDecodeStep:
+    """Callable cache of donated decode programs, one per (bucket, signature).
+
+    ``step_fn(tokens, positions, kv) -> (next_tokens, new_kv)`` must be pure
+    (jax-traceable); ``tokens``/``positions`` are bucket-padded int vectors
+    and ``kv`` an arbitrary pytree of arrays with a leading bucket dim. The
+    cache is LRU-bounded like :class:`~paddle_tpu.serving.batcher.
+    BucketedExecutor` (``max_cached``, ``compile_count``), so even a caller
+    that bypasses bucketing cannot grow it without bound.
+    """
+
+    def __init__(self, step_fn, label="decode_step", max_cached=16,
+                 donate_kv=True):
+        self._fn = step_fn
+        self._label = label
+        self.max_cached = int(max_cached)
+        self.donate_kv = bool(donate_kv)
+        self.compile_count = 0
+        self.cache_hits = 0
+        self._programs = {}   # key -> jitted fn
+        self._last_use = {}   # key -> tick (LRU)
+        self._tick = 0
+        self._seen_sigs = set()
+        self._storm_warned = False
+        self._lock = threading.Lock()
+
+    # -- retrace-storm guard (same contract as jit/compiled_step.py) ---------
+    def _guard_retrace(self, key):
+        if key in self._seen_sigs:
+            return
+        self._seen_sigs.add(key)
+        bound = int(_flag("FLAGS_compiled_step_max_retraces", 8))
+        if bound <= 0 or len(self._seen_sigs) <= bound or self._storm_warned:
+            return
+        self._storm_warned = True
+        try:
+            from ...resilience.recorder import get_recorder
+            rec = get_recorder()
+            entry = rec.start("compiled_step.retrace_storm", group=self._label,
+                              seq=len(self._seen_sigs),
+                              shapes=[str(key)[:200]])
+            rec.finish(entry, status="warn")
+        except Exception:
+            pass  # observability must not turn a retrace into a crash
+        warnings.warn(
+            f"decode_step[{self._label}]: {len(self._seen_sigs)} distinct "
+            f"(bucket, signature) keys compiled (> "
+            f"FLAGS_compiled_step_max_retraces={bound}). The engine should "
+            "be padding the running set to a fixed bucket set "
+            "(docs/serving.md, 'Continuous-batching decode').",
+            RuntimeWarning, stacklevel=3)
+
+    def _key(self, tokens, positions, kv):
+        import jax
+        leaves = jax.tree_util.tree_leaves(kv)
+        sig = tuple((tuple(np.shape(v)), str(np.asarray(v).dtype) if
+                     isinstance(v, np.ndarray) else str(v.dtype))
+                    for v in (tokens, positions, *leaves))
+        return (int(np.shape(tokens)[0]), sig)
+
+    def run(self, tokens, positions, kv):
+        """One decode step at the caller-chosen bucket. Returns
+        ``(next_tokens, new_kv)``; ``kv``'s device buffers are consumed
+        (donated) — the caller must thread ``new_kv`` into the next call."""
+        import jax
+
+        kv = jax.tree_util.tree_map(_import_safe, kv)
+        key = self._key(tokens, positions, kv)
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self._tick += 1
+                self._last_use[key] = self._tick
+                self.cache_hits += 1
+        if prog is not None:
+            _note_cache_hit()
+            return self._call(prog, tokens, positions, kv)
+        # build: counted once per key, attributed to the step/compile phase
+        with self._lock:
+            self._guard_retrace(key)
+            prog = jax.jit(self._fn,
+                           donate_argnums=(2,) if self.donate_kv else ())
+            self.compile_count += 1
+            self._tick += 1
+            self._last_use[key] = self._tick
+            if len(self._programs) >= self.max_cached:
+                victim = min(self._last_use, key=self._last_use.get)
+                self._programs.pop(victim, None)
+                self._last_use.pop(victim, None)
+            self._programs[key] = prog
+        from ...profiler.steptimer import get_steptimer
+        with get_steptimer().phase("step/compile"):
+            out = self._call(prog, tokens, positions, kv)
+        _note_compile()
+        return out
+
+    @staticmethod
+    def _call(prog, tokens, positions, kv):
+        with warnings.catch_warnings():
+            # CPU backends can't honor donation; jax warns per dispatch —
+            # the donation request is still correct on TPU
+            warnings.filterwarnings(
+                "ignore", message=".*[Dd]onat.*", category=UserWarning)
+            return prog(tokens, positions, kv)
+
+
+def _toy_step_fn(vocab):
+    """Deterministic reference stepper (bench + chaos soak): the KV row
+    accumulates ``token + position`` per consumed token, and the next token
+    is a pure function of that sum — so a stream replayed after a replica
+    death (prefill of prompt + already-emitted tokens) lands on the exact
+    same continuation, which the recovery test asserts token-for-token."""
+    import jax.numpy as jnp
+
+    def step(tokens, positions, kv):
+        new_kv = kv.at[:, 0].add(tokens.astype(kv.dtype)
+                                 + positions.astype(kv.dtype))
+        nxt = (new_kv[:, 0].astype(jnp.int32) + positions + 1) % vocab
+        return nxt.astype(jnp.int32), new_kv
+    return step
+
+
+class CompiledDecodeBackend:
+    """Reference :class:`~.engine.DecodeEngine` backend over a compiled,
+    donated step. Per-stream state is one KV row (width ``kv_width``);
+    each decode round gathers the running streams' rows, pads to the
+    smallest bucket, and runs one (bucket, signature)-cached program.
+    """
+
+    def __init__(self, step_fn=None, buckets=None, max_running=8,
+                 kv_width=8, vocab=50257, max_cached=16, service=None):
+        self.vocab = int(vocab)
+        self.kv_width = int(kv_width)
+        self.buckets = sorted(buckets) if buckets else \
+            pow2_buckets(max_running)
+        self.step = CompiledDecodeStep(
+            step_fn if step_fn is not None else _toy_step_fn(self.vocab),
+            label="decode_backend", max_cached=max_cached)
+        # optional cost hook: called (kind, n_tokens) so fake-clock harnesses
+        # charge prefill/decode work to the injected clock
+        self._service = service
+        self._rows = {}   # stream id -> (np kv row [kv_width], consumed pos)
+
+    # -- engine backend protocol --------------------------------------------
+    def prefill_chunk(self, stream, tokens, start):
+        """Consume one prompt chunk into the stream's KV row; when the
+        stream has nothing left to fill, return its next token."""
+        row, pos = self._rows.get(stream.id, (None, 0))
+        if row is None:
+            row = np.zeros((self.kv_width,), dtype="float32")
+        assert pos == start, f"prefill out of order: {pos} != {start}"
+        for t in tokens:
+            row[0] += float(int(t) + pos)
+            pos += 1
+        self._rows[stream.id] = (row, pos)
+        if self._service is not None:
+            self._service("prefill", len(tokens))
+        if stream.remaining_fill() == 0:
+            return int(row[0] + pos) % self.vocab
+        return None
+
+    def decode(self, streams):
+        """One token for every running stream, through the compiled step."""
+        n = len(streams)
+        bucket = bucket_for(n, self.buckets)
+        tokens = np.zeros((bucket,), dtype="int32")
+        positions = np.zeros((bucket,), dtype="int32")
+        kv = np.zeros((bucket, self.kv_width), dtype="float32")
+        for i, s in enumerate(streams):
+            row, pos = self._rows[s.id]
+            tokens[i] = s.tokens[-1]
+            positions[i] = pos
+            kv[i] = row
+        nxt, new_kv = self.step.run(tokens, positions, kv)
+        nxt = np.asarray(nxt)
+        new_kv = np.asarray(new_kv)
+        out = []
+        for i, s in enumerate(streams):
+            _, pos = self._rows[s.id]
+            self._rows[s.id] = (new_kv[i].copy(), pos + 1)
+            out.append(int(nxt[i]))
+        if self._service is not None:
+            self._service("decode", n)
+        return out
+
+    def release(self, stream):
+        self._rows.pop(stream.id, None)
+
+    def reset(self):
+        """Replica death: all device-side KV state is lost. The engine
+        re-prefills every live stream (prompt + emitted tokens)."""
+        self._rows.clear()

@@ -1,0 +1,416 @@
+"""Continuous-batching decode scheduler: per-step join/leave, prefill split.
+
+Static batching amortizes compiles but wastes the accelerator on decode
+traffic: requests in one batch finish at different lengths, so the batch
+runs at the speed of its longest member while finished slots burn cycles.
+Continuous batching (ORCA, OSDI'22) reschedules at **token granularity** —
+every engine step assembles the currently-running streams, decodes one token
+for each, and lets streams join or leave between steps. Three rules keep it
+production-shaped:
+
+- **prefill is chunked and rationed.** A long prompt is consumed at most
+  ``prefill_chunk`` tokens per engine step, one stream per step, while the
+  decode tick still runs for everyone else — an arriving 10k-token prompt
+  cannot stall in-flight token streams (the soak asserts in-flight TPOT p99
+  stays within tolerance of a no-long-prompt baseline);
+- **admission is refusal, not collapse.** Joins pass PR 9's
+  :class:`~paddle_tpu.serving.overload.AdmissionController` (priority
+  shedding + retry-after hints) and then reserve KV blocks from the paged
+  pool (:mod:`.kv_cache`); either failing refuses the join with a typed
+  error. Mid-stream block exhaustion evicts the *newest* claimant with
+  :class:`~.kv_cache.KVCacheExhausted` — accepted streams always terminate
+  with tokens or a typed error, never a silent stall;
+- **replica death is a replay, not a loss.** On an injected/real step
+  failure the engine resets the backend and re-prefills every live stream
+  (prompt + tokens already emitted), so a deterministic backend resumes the
+  exact continuation. Chaos sites ``decode.{join,prefill,step,evict}`` make
+  the whole lifecycle drivable from :mod:`paddle_tpu.resilience.faults`.
+
+The clock is injectable; the chaos soak and ``serving_bench --decode`` run
+entirely on a fake clock with zero real sleeps.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ...resilience.faults import maybe_inject
+from ..batcher import DeadlineExceeded, ServerOverloaded
+from ..metrics import percentile
+from ..scheduler import ReplicaDead
+from .kv_cache import BlockTable, KVBlockPool, KVCacheExhausted
+
+__all__ = ["DecodeConfig", "DecodeStream", "DecodeEngine"]
+
+_ids = itertools.count()
+
+
+def _flag(name, default):
+    from ...framework.flags import get_flag
+    v = get_flag(name, default)
+    return default if v is None else v
+
+
+class DecodeConfig:
+    """Engine knobs. ``None`` means "read the FLAGS_decode_* default"."""
+
+    def __init__(self, max_running=8, num_blocks=None, block_size=None,
+                 prefill_chunk=None, max_new_tokens=None, eos_token=None):
+        self.max_running = int(max_running)
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.prefill_chunk = int(prefill_chunk if prefill_chunk is not None
+                                 else _flag("FLAGS_decode_prefill_chunk", 64))
+        self.max_new_tokens = int(
+            max_new_tokens if max_new_tokens is not None
+            else _flag("FLAGS_decode_max_new_tokens", 64))
+        self.eos_token = eos_token
+        if self.max_running < 1 or self.prefill_chunk < 1 \
+                or self.max_new_tokens < 1:
+            raise ValueError("max_running, prefill_chunk and max_new_tokens "
+                             "must all be >= 1")
+
+
+class DecodeStream:
+    """One in-flight generation: prompt in, tokens out, typed error on
+    failure. Termination is observable two ways — ``on_token`` fires per
+    token on the engine thread, and ``wait()`` blocks a caller thread until
+    the stream finishes (tokens) or fails (``error`` set)."""
+
+    __slots__ = ("id", "prompt", "max_new_tokens", "deadline", "priority",
+                 "enqueued_at", "first_token_at", "last_token_at", "tokens",
+                 "seq", "on_token", "table", "error", "done",
+                 "_fill", "_fill_pos", "_done_evt", "_admitted")
+
+    def __init__(self, prompt, max_new_tokens, deadline, priority,
+                 enqueued_at, on_token=None, request_id=None):
+        self.id = request_id if request_id is not None \
+            else f"gen-{next(_ids)}"
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = deadline
+        self.priority = int(priority)
+        self.enqueued_at = enqueued_at
+        self.first_token_at = None
+        self.last_token_at = None
+        self.tokens = []
+        self.seq = 0
+        self.on_token = on_token
+        self.table = None
+        self.error = None
+        self.done = False
+        self._fill = list(self.prompt)   # tokens still to absorb into KV
+        self._fill_pos = 0               # absolute position of next fill
+        self._done_evt = threading.Event()
+        self._admitted = False
+
+    def remaining_fill(self):
+        """Prompt (or replay) tokens not yet absorbed into the KV cache."""
+        return len(self._fill)
+
+    def wait(self, timeout=None):
+        """Block until the stream terminates. True iff it did in time."""
+        return self._done_evt.wait(timeout)
+
+    def describe(self):
+        return {"id": self.id, "prompt_len": len(self.prompt),
+                "tokens": len(self.tokens), "done": self.done,
+                "error": type(self.error).__name__ if self.error else None}
+
+
+class DecodeEngine:
+    """The continuous-batching loop. Drive it by calling :meth:`step` —
+    the server's pump does this once per idle/batch tick; tests call it
+    directly under a fake clock.
+    """
+
+    def __init__(self, backend, config=None, clock=None, admission=None):
+        self.config = config or DecodeConfig()
+        self.backend = backend
+        self.pool = KVBlockPool(num_blocks=self.config.num_blocks,
+                                block_size=self.config.block_size)
+        self._clock = clock or time.monotonic
+        self._admission = admission
+        self._streams = {}          # id -> live DecodeStream
+        self._prefill_rr = []       # ids queued for the prefill ration
+        self._ttft_ms = []
+        self._tpot_ms = []
+        self._emitted = 0
+        self._lock = threading.RLock()
+        from ...profiler.metrics import get_registry
+        get_registry().register_gauge_fn(
+            "decode.running_count", lambda: len(self._streams))
+
+    # -- admission -----------------------------------------------------------
+    def _retry_after(self, priority):
+        if self._admission is not None:
+            return self._admission.retry_after(priority)
+        return 0.05
+
+    def join(self, prompt, max_new_tokens=None, timeout=None, priority=1,
+             on_token=None, request_id=None):
+        """Admit one generation request into the running batch.
+
+        Refusals are typed and carry a retry-after hint: the admission
+        controller sheds first (load), then the running-set cap, then the
+        KV pool (memory). A refused join holds no blocks and no admission
+        slot — there is nothing to clean up.
+        """
+        from ...profiler.metrics import get_registry
+        now = self._clock()
+        with self._lock:
+            maybe_inject("decode.join", ServerOverloaded)
+            if self._admission is not None:
+                self._admission.admit(priority, now=now)
+            try:
+                if len(self._streams) >= self.config.max_running:
+                    raise ServerOverloaded(
+                        f"decode running set full "
+                        f"({self.config.max_running} streams)",
+                        retry_after=self._retry_after(priority))
+                stream = DecodeStream(
+                    prompt, max_new_tokens if max_new_tokens is not None
+                    else self.config.max_new_tokens,
+                    deadline=(now + timeout) if timeout else None,
+                    priority=priority, enqueued_at=now,
+                    on_token=on_token, request_id=request_id)
+                table = BlockTable(self.pool)
+                if not table.ensure(len(stream.prompt) + 1):
+                    raise ServerOverloaded(
+                        f"KV pool exhausted ({self.pool.free()} free blocks,"
+                        f" prompt needs "
+                        f"{self.pool.blocks_for(len(stream.prompt) + 1)})",
+                        retry_after=self._retry_after(priority))
+            except ServerOverloaded:
+                if self._admission is not None:
+                    self._admission.note_done()
+                get_registry().inc_counter("decode.sheds_total")
+                raise
+            stream.table = table
+            stream._admitted = True
+            self._streams[stream.id] = stream
+            self._prefill_rr.append(stream.id)
+            get_registry().inc_counter("decode.joins_total")
+            return stream
+
+    # -- the engine tick -----------------------------------------------------
+    def step(self):
+        """One scheduling round: expire deadlines, ration one prefill
+        chunk, decode one token for every running stream. A replica death
+        mid-round resets the backend and replays live streams. Returns the
+        number of tokens emitted this round."""
+        with self._lock:
+            before = self._emitted
+            now = self._clock()
+            try:
+                maybe_inject("decode.step", ReplicaDead)
+                self._expire(now)
+                self._prefill_tick(now)
+                self._decode_tick(now)
+            except ReplicaDead:
+                self._restart(now)
+            return self._emitted - before
+
+    def _expire(self, now):
+        for stream in list(self._streams.values()):
+            if stream.deadline is not None and now > stream.deadline:
+                self._evict(stream, DeadlineExceeded(
+                    f"{stream.id}: deadline exceeded after "
+                    f"{len(stream.tokens)} tokens"))
+
+    # -- prefill (rationed: one chunk, one stream, per step) -----------------
+    def _prefill_tick(self, now):
+        while self._prefill_rr:
+            sid = self._prefill_rr[0]
+            stream = self._streams.get(sid)
+            if stream is None or stream.done or not stream._fill:
+                self._prefill_rr.pop(0)
+                continue
+            self._prefill(stream, now)
+            if stream.done or not stream._fill:
+                if self._prefill_rr and self._prefill_rr[0] == sid:
+                    self._prefill_rr.pop(0)
+            else:
+                # ration spent; rotate so concurrent prefills interleave
+                self._prefill_rr.append(self._prefill_rr.pop(0))
+            return
+
+    def _prefill(self, stream, now):
+        """Absorb at most one ``prefill_chunk`` of this stream's pending
+        tokens into the KV cache; emits the first new token when the fill
+        completes (fresh join → TTFT; replay → resumed continuation)."""
+        from ...profiler.metrics import get_registry
+        maybe_inject("decode.prefill", ReplicaDead)
+        n = min(len(stream._fill), self.config.prefill_chunk)
+        if not stream.table.ensure(stream._fill_pos + n):
+            self._evict(stream, KVCacheExhausted(
+                f"{stream.id}: KV pool exhausted mid-prefill",
+                retry_after=self._retry_after(stream.priority)))
+            return
+        chunk, stream._fill = stream._fill[:n], stream._fill[n:]
+        start = stream._fill_pos
+        stream._fill_pos += n
+        token = self.backend.prefill_chunk(stream, chunk, start)
+        get_registry().inc_counter("decode.prefill_chunks_total")
+        if token is not None:
+            # re-read the clock: the backend's work (and a fake-clock
+            # harness's service charge) happened since `now` was taken
+            self._emit(stream, token, self._clock())
+            self._maybe_finish(stream, token)
+
+    # -- decode (every running stream, every step) ---------------------------
+    def _decode_tick(self, now):
+        runnable = [s for s in self._streams.values()
+                    if not s.done and not s._fill and s.tokens]
+        ready = []
+        for stream in runnable:
+            # the consumed prefix grows by one token this round
+            if stream.table.ensure(stream._fill_pos + 1):
+                ready.append(stream)
+            else:
+                self._evict(stream, KVCacheExhausted(
+                    f"{stream.id}: KV pool exhausted at "
+                    f"{len(stream.tokens)} tokens",
+                    retry_after=self._retry_after(stream.priority)))
+        if not ready:
+            return
+        out = self.backend.decode(ready)
+        now = self._clock()   # include the round's service time
+        for stream, token in zip(ready, out):
+            if stream.done:
+                continue   # evicted by a mid-round callback failure
+            stream._fill_pos += 1
+            self._emit(stream, int(token), now)
+            self._maybe_finish(stream, int(token))
+
+    # -- emission & termination ----------------------------------------------
+    def _emit(self, stream, token, now):
+        from ...profiler.metrics import get_registry
+        stream.tokens.append(int(token))
+        seq = stream.seq
+        stream.seq += 1
+        if stream.first_token_at is None:
+            stream.first_token_at = now
+            ttft_ms = max(0.0, (now - stream.enqueued_at) * 1000.0)
+            self._ttft_ms.append(ttft_ms)
+            get_registry().observe("decode.ttft_ms", ttft_ms)
+            if self._admission is not None:
+                self._admission.observe(ttft_ms / 1000.0, now=now)
+        else:
+            tpot_ms = max(0.0, (now - stream.last_token_at) * 1000.0)
+            self._tpot_ms.append(tpot_ms)
+            get_registry().observe("decode.tpot_ms", tpot_ms)
+        stream.last_token_at = now
+        self._emitted += 1
+        get_registry().inc_counter("decode.tokens_total")
+        for res in (self._ttft_ms, self._tpot_ms):
+            if len(res) > 8192:
+                del res[:4096]
+        if stream.on_token is not None:
+            try:
+                stream.on_token(stream, int(token), seq)
+            except Exception as exc:
+                # the consumer is gone (torn socket, cancelled client):
+                # reclaim the slot instead of decoding into the void
+                self._evict(stream, exc if isinstance(exc, ConnectionError)
+                            else ConnectionError(f"on_token failed: {exc}"))
+
+    def _maybe_finish(self, stream, token):
+        if stream.done:
+            return
+        if len(stream.tokens) >= stream.max_new_tokens or (
+                self.config.eos_token is not None
+                and token == self.config.eos_token):
+            self._finish(stream)
+
+    def _finish(self, stream):
+        from ...profiler.metrics import get_registry
+        self._release(stream)
+        stream.done = True
+        get_registry().inc_counter("decode.streams_completed_total")
+        stream._done_evt.set()
+
+    def _evict(self, stream, error):
+        """Terminate a stream with a typed error. Eviction must always
+        complete — a fault injected here is recorded and swallowed."""
+        from ...profiler.metrics import get_registry
+        try:
+            maybe_inject("decode.evict", ConnectionError)
+        except ConnectionError:
+            pass   # eviction is the cleanup path; it cannot itself fail
+        if stream.done:
+            return
+        self._release(stream)
+        stream.error = error
+        stream.done = True
+        get_registry().inc_counter("decode.streams_failed_total",
+                                   labels={"reason": type(error).__name__})
+        get_registry().inc_counter("decode.evictions_total")
+        stream._done_evt.set()
+
+    def _release(self, stream):
+        self._streams.pop(stream.id, None)
+        try:
+            self.backend.release(stream)
+        except Exception:
+            pass   # backend state for a dead stream is best-effort
+        if stream.table is not None:
+            stream.table.release()
+        if stream._admitted and self._admission is not None:
+            stream._admitted = False
+            self._admission.note_done()
+
+    # -- replica death -------------------------------------------------------
+    def _restart(self, now):
+        """The backend lost its device state. Reset it and queue every live
+        stream for replay: re-prefill prompt + already-emitted tokens, after
+        which a deterministic backend resumes the identical continuation."""
+        from ...profiler.metrics import get_registry
+        get_registry().inc_counter("decode.restarts_total")
+        try:
+            self.backend.reset()
+        except Exception:
+            pass   # a half-dead backend still gets fresh prefills
+        self._prefill_rr = []
+        for stream in self._streams.values():
+            if stream.done:
+                continue
+            stream._fill = list(stream.prompt) + list(stream.tokens)
+            stream._fill_pos = 0
+            self._prefill_rr.append(stream.id)
+
+    def drain(self, error=None):
+        """Terminate every live stream with ``error`` (server shutdown).
+        Returns the number of streams evicted."""
+        with self._lock:
+            live = list(self._streams.values())
+            for stream in live:
+                self._evict(stream, error if error is not None
+                            else ServerOverloaded("decode engine drained"))
+            return len(live)
+
+    # -- observability -------------------------------------------------------
+    def running(self):
+        with self._lock:
+            return len(self._streams)
+
+    def stats(self):
+        with self._lock:
+            snap = {
+                "running": len(self._streams),
+                "pending_prefill": sum(1 for s in self._streams.values()
+                                       if s._fill),
+                "tokens_emitted": self._emitted,
+                "kv_blocks_used": self.pool.used(),
+                "kv_blocks_free": self.pool.free(),
+                "ttft_p50_ms": percentile(self._ttft_ms, 50),
+                "ttft_p99_ms": percentile(self._ttft_ms, 99),
+                "tpot_p50_ms": percentile(self._tpot_ms, 50),
+                "tpot_p99_ms": percentile(self._tpot_ms, 99),
+            }
+            step = getattr(self.backend, "step", None)
+            if step is not None and hasattr(step, "compile_count"):
+                snap["compiles"] = step.compile_count
+                snap["compile_cache_hits"] = step.cache_hits
+            return snap

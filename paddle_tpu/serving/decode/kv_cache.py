@@ -1,0 +1,161 @@
+"""Paged KV-cache allocator: fixed block pool + per-stream block tables.
+
+The decode-serving memory problem (vLLM, SOSP'23): a naive per-request KV
+cache reserves ``max_seq_len`` worth of memory per stream up front, so
+occupancy collapses to the worst-case prompt. Paging fixes it the way an OS
+does — the cache is a fixed pool of equal-size **blocks** (``block_size``
+tokens each) and every stream holds a **block table**, growing one block at
+a time as tokens are appended.
+
+This allocator is the admission side of that design, mirroring the bucket
+discipline of :mod:`~paddle_tpu.serving.batcher`: capacity is claimed in
+fixed quanta (blocks, like bucket padding) so the pool's state space is
+small and exhaustively testable. Exhaustion is **OOM-safe by construction**:
+
+- :meth:`KVBlockPool.try_allocate` returns None instead of raising when the
+  pool is short — the engine turns a short *join* into a typed
+  :class:`~paddle_tpu.serving.batcher.ServerOverloaded` refusal (with a
+  retry_after hint) and a short mid-stream *grow* into a typed
+  :class:`KVCacheExhausted` eviction. Nothing in this module ever crashes
+  the serving loop;
+- every block is freed exactly once (double-free raises — that's a server
+  bug, not load);
+- occupancy is observable: ``decode.kv_blocks_used_count`` /
+  ``decode.kv_blocks_free_count`` gauges in the always-on metrics registry.
+"""
+from __future__ import annotations
+
+import threading
+
+from ...framework.errors import ResourceExhaustedError
+
+__all__ = ["KVCacheExhausted", "KVBlockPool", "BlockTable"]
+
+
+def _flag(name, default):
+    from ...framework.flags import get_flag
+    v = get_flag(name, default)
+    return default if v is None else v
+
+
+class KVCacheExhausted(ResourceExhaustedError):
+    """A running stream needed one more KV block and the pool was empty.
+    The engine evicts the stream with this error (typed, carries the
+    admission controller's ``retry_after`` hint) — accepted streams
+    terminate, they never silently stall."""
+
+    def __init__(self, message="", retry_after=None):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class KVBlockPool:
+    """Fixed pool of ``num_blocks`` KV pages, ``block_size`` tokens each.
+
+    Pure accounting — the tensor storage the block ids index lives with the
+    decode backend. Allocation is LIFO over the free list so recently freed
+    (cache-warm) blocks are reused first, the same recency discipline the
+    batcher's executor LRU applies to compiled programs.
+    """
+
+    def __init__(self, num_blocks=None, block_size=None):
+        self.num_blocks = int(num_blocks if num_blocks is not None
+                              else _flag("FLAGS_decode_kv_blocks", 256))
+        self.block_size = int(block_size if block_size is not None
+                              else _flag("FLAGS_decode_block_size", 16))
+        if self.num_blocks < 1 or self.block_size < 1:
+            raise ValueError(
+                f"need >= 1 block of >= 1 token: num_blocks="
+                f"{self.num_blocks} block_size={self.block_size}")
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._lock = threading.Lock()
+        from ...profiler.metrics import get_registry
+        get_registry().register_gauge_fn(
+            "decode.kv_blocks_used_count", self.used)
+        get_registry().register_gauge_fn(
+            "decode.kv_blocks_free_count", self.free)
+
+    # -- accounting ----------------------------------------------------------
+    def blocks_for(self, tokens):
+        """Blocks needed to hold ``tokens`` token slots (ceil division)."""
+        if tokens <= 0:
+            return 0
+        return -(-int(tokens) // self.block_size)
+
+    def free(self):
+        with self._lock:
+            return len(self._free)
+
+    def used(self):
+        with self._lock:
+            return self.num_blocks - len(self._free)
+
+    def can_allocate(self, n):
+        with self._lock:
+            return len(self._free) >= n
+
+    # -- allocation ----------------------------------------------------------
+    def try_allocate(self, n):
+        """Claim ``n`` blocks; returns their ids, or None when the pool is
+        short — never raises on exhaustion (the caller owns the refusal /
+        eviction policy)."""
+        n = int(n)
+        with self._lock:
+            if n > len(self._free):
+                return None
+            taken = [self._free.pop() for _ in range(n)]
+        return taken
+
+    def release(self, block_ids):
+        """Return blocks to the pool. Double-free is a server bug and
+        raises — silent double-frees corrupt the table-to-storage mapping."""
+        with self._lock:
+            live = set(self._free)
+            for b in block_ids:
+                if b in live or not (0 <= b < self.num_blocks):
+                    raise ValueError(f"double/invalid free of KV block {b}")
+                self._free.append(b)
+                live.add(b)
+
+
+class BlockTable:
+    """One stream's page table: the ordered block ids holding its KV cache.
+
+    ``ensure(tokens)`` grows the table to cover ``tokens`` token slots,
+    claiming blocks from the pool; it returns False (stream must be evicted
+    or refused) instead of raising when the pool is exhausted.
+    """
+
+    __slots__ = ("pool", "blocks", "num_tokens")
+
+    def __init__(self, pool):
+        self.pool = pool
+        self.blocks = []
+        self.num_tokens = 0
+
+    def capacity(self):
+        return len(self.blocks) * self.pool.block_size
+
+    def ensure(self, tokens):
+        """Grow to hold ``tokens`` slots. True on success; False when the
+        pool can't supply the missing blocks (nothing is claimed then —
+        a partial grow would leak on the eviction that must follow)."""
+        need = self.pool.blocks_for(tokens) - len(self.blocks)
+        if need > 0:
+            got = self.pool.try_allocate(need)
+            if got is None:
+                return False
+            self.blocks.extend(got)
+        self.num_tokens = max(self.num_tokens, int(tokens))
+        return True
+
+    def release(self):
+        """Free every block exactly once (idempotent per table)."""
+        blocks, self.blocks = self.blocks, []
+        self.num_tokens = 0
+        if blocks:
+            self.pool.release(blocks)
+
+    def describe(self):
+        return {"blocks": list(self.blocks), "tokens": self.num_tokens,
+                "capacity": self.capacity()}

@@ -143,6 +143,7 @@ class InferenceServer:
         self._crashed = None
         self._autoscaler = None
         self._rollout = None
+        self._decode = None
         for sig in self.config.warmup_signatures:
             self.warmup(sig)
 
@@ -229,6 +230,8 @@ class InferenceServer:
                 self._autoscaler.tick()
             if self._rollout is not None:
                 self._rollout.tick()
+            if self._decode is not None:
+                self._decode.step()
             batch = self.queue.assemble(self.config.buckets,
                                         max_rows=self.config.max_batch_size)
             if batch is None:
@@ -342,6 +345,34 @@ class InferenceServer:
             journal=journal, clock=self._clock, job_id=job_id)
         return self._rollout
 
+    def attach_decode(self, backend, config=None):
+        """Enable continuous-batching autoregressive decode (serving/decode/,
+        docs/serving.md "Continuous-batching decode"). The engine shares
+        this server's clock and admission controller, and is stepped once
+        per batching round (pump and threaded loop alike) — decode streams
+        make progress even when the batch queue is empty. Returns the
+        DecodeEngine."""
+        from .decode import DecodeEngine
+        self._decode = DecodeEngine(backend, config=config,
+                                    clock=self._clock,
+                                    admission=self.admission)
+        return self._decode
+
+    def submit_generate(self, prompt, max_new_tokens=None, timeout=None,
+                        priority=0, on_token=None, request_id=None):
+        """Admit one generation request (non-blocking). Token-level results
+        arrive via ``on_token(stream, token, seq)`` on the engine thread;
+        call ``stream.wait()`` for termination. Raises
+        :class:`ServerOverloaded` (with ``retry_after``) when shedding."""
+        if self._decode is None:
+            raise RuntimeError("no decode engine: call attach_decode() "
+                               "before submit_generate()")
+        if timeout is None:
+            timeout = self.config.default_deadline
+        return self._decode.join(prompt, max_new_tokens=max_new_tokens,
+                                 timeout=timeout, priority=priority,
+                                 on_token=on_token, request_id=request_id)
+
     def rollout_active(self):
         """True while a rollout/rollback is converging the fleet — the
         autoscaler suspends resizes so the roll's capacity math holds."""
@@ -425,6 +456,8 @@ class InferenceServer:
                         self._autoscaler.tick()
                     if self._rollout is not None:
                         self._rollout.tick()
+                    if self._decode is not None:
+                        self._decode.step()
                     continue
                 # brief accumulation window lets concurrent submitters fill
                 # the bucket (classic batching-delay/throughput tradeoff)
@@ -445,6 +478,8 @@ class InferenceServer:
         n = self.queue.drain(ServerOverloaded("server stopped"))
         if n:
             self.metrics.inc("shed", n)
+        if self._decode is not None:
+            self._decode.drain(ServerOverloaded("server stopped"))
         return self
 
     def __enter__(self):
@@ -464,6 +499,8 @@ class InferenceServer:
             snap["autoscaler"] = self._autoscaler.describe()
         if self._rollout is not None:
             snap["rollout"] = self._rollout.describe()
+        if self._decode is not None:
+            snap["decode"] = self._decode.stats()
         snap["compiles"] = sum(r.compile_count
                                for r in self.scheduler.replicas)
         snap["crashed"] = repr(self._crashed) if self._crashed else None
@@ -516,6 +553,10 @@ class SocketFrontend:
                     continue          # stream still framed; keep waiting
                 except (wire.FrameError, ConnectionError):
                     return            # desynced or closed: drop connection
+                if isinstance(msg, dict) and msg.get("op") == "generate":
+                    if not self._serve_stream(conn, msg):
+                        return
+                    continue
                 reply = self._serve_one(msg)
                 try:
                     wire.send_frame(conn, reply)
@@ -526,6 +567,74 @@ class SocketFrontend:
                 conn.close()
             except OSError:
                 pass
+
+    def _serve_stream(self, conn, msg):
+        """One streaming generation over this connection: every emitted
+        token rides its own seq-stamped frame (sent from the engine thread,
+        serialized by a per-stream lock) and the terminal frame — the full
+        token list on success, a typed error otherwise — carries the
+        end-of-stream marker. Returns False when the connection is torn
+        (caller drops it)."""
+        from ..distributed import wire
+        rid = msg.get("id")
+        lock = threading.Lock()
+        state = {"alive": True, "sent": 0}
+
+        def send(frame):
+            try:
+                wire.send_frame(conn, frame)
+                return True
+            except (wire.FrameError, ConnectionError, OSError):
+                state["alive"] = False
+                return False
+
+        def on_token(stream, token, seq):
+            with lock:
+                # raising here tells the engine the consumer is gone; it
+                # evicts the stream instead of decoding into the void
+                if not state["alive"]:
+                    raise ConnectionError("stream consumer gone")
+                if not send(wire.stamp_stream(
+                        {"id": stream.id, "token": int(token)}, seq)):
+                    raise ConnectionError("stream send failed")
+                state["sent"] = seq + 1
+
+        def error_frame(exc, seq):
+            frame = {"id": rid, "error": str(exc),
+                     "error_type": type(exc).__name__}
+            hint = getattr(exc, "retry_after", None)
+            if hint is not None:
+                frame["retry_after"] = float(hint)
+            return wire.stamp_stream(frame, seq, end=True)
+
+        try:
+            if "prompt" not in msg:
+                raise ValueError("generate frame must carry 'prompt'")
+            prompt = [int(t) for t in np.asarray(msg["prompt"]).reshape(-1)]
+            stream = self._server.submit_generate(
+                prompt, max_new_tokens=msg.get("max_new_tokens"),
+                timeout=msg.get("timeout"),
+                priority=int(msg.get("priority", 0)),
+                on_token=on_token, request_id=rid)
+        except BaseException as e:
+            with lock:
+                return send(error_frame(e, 0))
+        timeout = msg.get("timeout")
+        finished = stream.wait(timeout + 5.0 if timeout is not None
+                               else None)
+        with lock:
+            if not state["alive"]:
+                return False
+            if not finished:
+                state["alive"] = False   # further on_token calls evict
+                return send(error_frame(
+                    DeadlineExceeded(f"{stream.id}: stream wait timed out"),
+                    state["sent"]))
+            if stream.error is not None:
+                return send(error_frame(stream.error, state["sent"]))
+            return send(wire.stamp_stream(
+                {"id": stream.id, "tokens": [int(t) for t in stream.tokens]},
+                state["sent"], end=True))
 
     def _serve_one(self, msg):
         from ..distributed import wire

@@ -16,7 +16,7 @@ from .quant_ops import cal_kl_threshold, dequantize_weight, quantize_weight
 __all__ = [
     "AbsmaxQuantizer", "PerChannelAbsmaxQuantizer", "HistQuantizer",
     "KLQuantizer", "PTQConfig", "default_ptq_config", "ImperativePTQ",
-    "PostTrainingQuantization",
+    "PostTrainingQuantization", "quantize_decode_weights",
 ]
 
 
@@ -193,6 +193,61 @@ class ImperativePTQ:
         finally:
             if was_training:
                 model.train()
+
+
+def quantize_decode_weights(model, quantizable_layer_type=_QUANTIZABLE,
+                            mode=None):
+    """Weight-only int8 for decode replicas (serving/decode/).
+
+    Decode serving is memory-bandwidth bound — every emitted token re-reads
+    the full weight set — so weight-only quantization buys tokens/sec
+    directly, and needs no calibration data (weights are known at load
+    time, unlike activations). Scales come from the same observers offline
+    PTQ uses: :class:`PerChannelAbsmaxQuantizer` over the output channel
+    for matrix weights, :class:`AbsmaxQuantizer` for 1-D ones. Weights are
+    quantize-dequantized in place (fake-quant: the arithmetic stays f32 on
+    TPU, only the representable values change) and scales are attached for
+    an export path that wants real int8 storage.
+
+    ``mode`` defaults to ``FLAGS_decode_quantize``; "" leaves the model
+    untouched (default off). Returns the number of layers rewritten.
+    """
+    if mode is None:
+        from ..framework.flags import get_flag
+        mode = get_flag("FLAGS_decode_quantize", "") or ""
+    if mode == "":
+        return 0
+    if mode != "int8":
+        raise ValueError(
+            f"FLAGS_decode_quantize={mode!r}: only '' (off) and 'int8' are "
+            "supported")
+    import jax.numpy as jnp
+    count = 0
+    for _, sub in model.named_sublayers(include_self=True):
+        if type(sub).__name__ not in quantizable_layer_type:
+            continue
+        w = sub.weight.numpy()
+        quant_axis = 0 if type(sub).__name__ == "Conv2D" else -1
+        if w.ndim >= 2:
+            wt_q = PerChannelAbsmaxQuantizer(bits=8, quant_axis=quant_axis)
+        else:
+            wt_q = AbsmaxQuantizer(bits=8)
+        wt_q.sample(w)
+        thr = np.asarray(wt_q.cal_thresholds(), dtype=np.float64)
+        qmax = float(2 ** (wt_q.bits - 1) - 1)
+        scale = np.where(thr > 0, thr, 1.0)
+        if w.ndim >= 2:
+            shape = [1] * w.ndim
+            shape[quant_axis % w.ndim] = -1
+            scale_b = scale.reshape(shape)
+        else:
+            scale_b = scale
+        q = np.clip(np.round(w / scale_b * qmax), -qmax, qmax)
+        sub.weight._value = jnp.asarray((q * scale_b / qmax).astype(w.dtype))
+        sub._quant_weight_scales = scale
+        sub._quant_bits = wt_q.bits
+        count += 1
+    return count
 
 
 class PostTrainingQuantization:

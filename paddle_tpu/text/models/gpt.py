@@ -90,14 +90,25 @@ class GPTAttention(nn.Layer):
                                       weight_attr=w_res)
 
     def forward(self, x, cache=None):
+        """Self-attention; ``cache`` switches on incremental decode.
+
+        ``cache`` is a ``(k, v)`` pair of [b, past, heads, dim] tensors —
+        or ``(None, None)`` to start a stream. The new keys/values are
+        appended and the grown pair returned, so a caller decoding token
+        by token passes x of length 1 and threads the cache forward. The
+        causal mask is offset-aware for q shorter than k (the query rows
+        sit at the *end* of the key timeline), which is exactly the cached
+        step's geometry — the parity test in tests/test_decode.py asserts
+        full forward == prefill + N cached steps, token for token."""
         b, s, _ = x.shape
         qkv = self.qkv(x)
         qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         parts = M.unstack(qkv, axis=2)
         q, k, v = parts[0], parts[1], parts[2]
         if cache is not None:
-            k = M.concat([cache[0], k], axis=1)
-            v = M.concat([cache[1], v], axis=1)
+            if cache[0] is not None:
+                k = M.concat([cache[0], k], axis=1)
+                v = M.concat([cache[1], v], axis=1)
             cache = (k, v)
         from ...ops.attention import scaled_dot_product_attention
         out = scaled_dot_product_attention(
@@ -152,7 +163,7 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x, pending=None):
+    def forward(self, x, pending=None, cache=None):
         """Carried-residual form: the stream value entering this block is
         x + pending (pending = the previous block's MLP branch output, not
         yet added). Each residual add is materialized inside
@@ -164,14 +175,22 @@ class GPTBlock(nn.Layer):
         (stream, pending_mlp_out) — GPTModel folds the last pending into
         ln_f the same way. PADDLE_TPU_FUSED_RESIDUAL_LN=0 restores the
         plain composition (zero-init LN-scale recipes under jit — see
-        ops/fused_residual_ln.fuse_enabled)."""
+        ops/fused_residual_ln.fuse_enabled).
+
+        With ``cache`` (incremental decode) the return grows to
+        (stream, pending, new_cache); the 2-tuple arity is unchanged for
+        every existing caller."""
         from ...ops.fused_residual_ln import fused_residual_ln, fuse_enabled
+        has_cache = cache is not None
         if not fuse_enabled():
             if pending is not None:
                 x = x + pending
-            x = x + self.dropout(self.attn(self.ln1(x)))
+            a = self.attn(self.ln1(x), cache=cache)
+            if has_cache:
+                a, cache = a
+            x = x + self.dropout(a)
             x = x + self.mlp(self.ln2(x))
-            return x, None
+            return (x, None, cache) if has_cache else (x, None)
         if pending is None:
             x1, h1 = x, self.ln1(x)
         else:
@@ -179,10 +198,15 @@ class GPTBlock(nn.Layer):
                                        self.ln1.bias,
                                        epsilon=self.ln1._epsilon,
                                        return_residual=True)
-        a = self.dropout(self.attn(h1))
+        a = self.attn(h1, cache=cache)
+        if has_cache:
+            a, cache = a
+        a = self.dropout(a)
         x2, h2 = fused_residual_ln(x1, a, self.ln2.weight, self.ln2.bias,
                                    epsilon=self.ln2._epsilon,
                                    return_residual=True)
+        if has_cache:
+            return x2, self.mlp(h2), cache
         return x2, self.mlp(h2)
 
 
@@ -206,15 +230,31 @@ class GPTModel(nn.Layer):
         self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
 
-    def forward(self, input_ids, position_ids=None):
+    def init_decode_caches(self):
+        """Empty per-layer KV caches for a fresh decode stream — pass to
+        ``forward(caches=...)`` and thread the returned caches onward."""
+        return [(None, None) for _ in range(len(self.h))]
+
+    def forward(self, input_ids, position_ids=None, caches=None):
         b, s = input_ids.shape
+        past = 0
+        if caches is not None and caches[0][0] is not None:
+            past = caches[0][0].shape[1]
         if position_ids is None:
             import jax.numpy as jnp
-            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+            # cached decode: these tokens sit at absolute positions
+            # [past, past+s) — wpe must be looked up there, not at [0, s)
+            position_ids = Tensor(
+                jnp.arange(past, past + s, dtype=jnp.int32)[None, :])
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         pending = None
-        if self.config.recompute and self.training:
+        if caches is not None:
+            new_caches = []
+            for block, c in zip(self.h, caches):
+                x, pending, c = block(x, pending, cache=c)
+                new_caches.append(c)
+        elif self.config.recompute and self.training:
             from ...distributed.fleet.utils import recompute as _ckpt
             for block in self.h:
                 x, pending = _ckpt(block, x, pending)
@@ -222,10 +262,14 @@ class GPTModel(nn.Layer):
             for block in self.h:
                 x, pending = block(x, pending)
         if pending is None:
-            return self.ln_f(x)
-        from ...ops.fused_residual_ln import fused_residual_ln
-        return fused_residual_ln(x, pending, self.ln_f.weight,
-                                 self.ln_f.bias, epsilon=self.ln_f._epsilon)
+            h = self.ln_f(x)
+        else:
+            from ...ops.fused_residual_ln import fused_residual_ln
+            h = fused_residual_ln(x, pending, self.ln_f.weight,
+                                  self.ln_f.bias, epsilon=self.ln_f._epsilon)
+        if caches is not None:
+            return h, new_caches
+        return h
 
 
 class GPTForCausalLM(nn.Layer):
@@ -235,7 +279,10 @@ class GPTForCausalLM(nn.Layer):
         # weight tying with the token embedding (standard GPT head)
         self.config = self.gpt.config
 
-    def forward(self, input_ids, labels=None):
+    def forward(self, input_ids, labels=None, caches=None):
+        if caches is not None:
+            h, caches = self.gpt(input_ids, caches=caches)
+            return F.linear(h, self.gpt.wte.weight.t()), caches
         h = self.gpt(input_ids)
         logits = F.linear(h, self.gpt.wte.weight.t())
         if labels is not None:

@@ -164,6 +164,26 @@ def test_injection_lint_covers_rollout_entry_points():
         ("paddle_tpu/serving/rollout.py", "class:RolloutController")])
 
 
+def test_injection_lint_covers_decode_entry_points():
+    """The continuous-batching decode PR's contract: the join admission
+    (decode.join), the prefill chunk and the decode round (decode.prefill /
+    decode.step — replica death mid-either must resolve as a replay), and
+    the eviction cleanup (decode.evict) must stay chaos-testable. Guard the
+    MANIFEST so a refactor can't silently drop the requirement along with
+    the hook."""
+    import ast
+    src = (REPO / "tools" / "check_injection_points.py").read_text()
+    tree = ast.parse(src)
+    required = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "REQUIRED" for t in node.targets))
+    manifest = ast.literal_eval(required)
+    entries = {(rel, scope): names for rel, scope, names in manifest}
+    assert {"join", "_prefill", "step", "_evict"} <= set(entries[
+        ("paddle_tpu/serving/decode/engine.py", "class:DecodeEngine")])
+
+
 def test_metric_name_lint_passes_on_tree():
     r = _run(REPO / "tools" / "check_metric_names.py")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -186,8 +206,8 @@ def test_metric_name_lint_manifest_guard():
             and any(getattr(t, "id", None) == name for t in node.targets))
 
     subsystems = set(ast.literal_eval(_assigned("SUBSYSTEMS")))
-    assert {"steptimer", "metrics", "serving", "io",
-            "integrity", "ckpt", "compiled_step", "rollout"} <= subsystems
+    assert {"steptimer", "metrics", "serving", "io", "integrity",
+            "ckpt", "compiled_step", "rollout", "decode"} <= subsystems
     units = set(ast.literal_eval(_assigned("UNITS")))
     assert {"ms", "total", "per_sec"} <= units
     grandfathered = set(ast.literal_eval(_assigned("GRANDFATHERED")))
@@ -220,6 +240,31 @@ def test_compiled_step_flags_registered():
     assert int(defaults["FLAGS_compiled_step_max_retraces"]) >= 1
     assert defaults["FLAGS_input_prefetch"] is True
     assert defaults["FLAGS_donate_state_buffers"] is True
+
+
+def test_decode_flags_registered():
+    """The decode PR's knobs stay registered with their contracted
+    defaults: weight-only quantization ships OFF (opt-in via
+    FLAGS_decode_quantize=int8), and the KV pool / prefill-ration geometry
+    stays positive. Parsed from source, not live state."""
+    import ast
+    src = (REPO / "paddle_tpu" / "framework" / "flags.py").read_text()
+    tree = ast.parse(src)
+    defaults_node = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.AnnAssign)
+        and getattr(node.target, "id", None) == "_FLAGS")
+    defaults = {}
+    for key, val in zip(defaults_node.keys, defaults_node.values):
+        try:
+            defaults[ast.literal_eval(key)] = ast.literal_eval(val)
+        except ValueError:
+            pass
+    assert defaults["FLAGS_decode_quantize"] == ""
+    assert int(defaults["FLAGS_decode_block_size"]) >= 1
+    assert int(defaults["FLAGS_decode_kv_blocks"]) >= 1
+    assert int(defaults["FLAGS_decode_prefill_chunk"]) >= 1
+    assert int(defaults["FLAGS_decode_max_new_tokens"]) >= 1
 
 
 def test_trace_merge_help_smoke():
@@ -273,6 +318,29 @@ def test_serving_bench_overload_smoke():
         assert point["completed"] > 0
         assert point["unterminated"] == 0
         assert point["shed"] == point["shed_with_hint"]
+
+
+def test_serving_bench_decode_smoke():
+    """The decode sweep must keep demonstrating continuous-batching SLOs:
+    at every offered-load multiplier all streams terminate, sheds carry
+    retry hints, compiles stay bounded by the bucket set, and goodput plus
+    TTFT/TPOT percentiles land in extra.* for the bench regression gate.
+    Fake clock, so this runs in ~1s of wall time."""
+    import json
+    r = _run(REPO / "tools" / "serving_bench.py", "--decode", "--smoke")
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["decode_ok"] is True
+    for point in report["results"]:
+        assert point["completed"] > 0
+        assert point["unterminated"] == 0
+        assert point["shed"] == point["shed_with_hint"]
+        assert point["compiles"] <= point["compile_bound"]
+    extra = report["extra"]
+    assert extra["decode_goodput_tokens_per_sec"] > 0
+    for k in ("decode_ttft_p50_ms", "decode_ttft_p99_ms",
+              "decode_tpot_p50_ms", "decode_tpot_p99_ms"):
+        assert isinstance(extra[k], (int, float)), (k, extra)
 
 
 def test_serving_bench_rollout_soak_smoke():

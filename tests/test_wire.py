@@ -145,6 +145,86 @@ class TestWireFuzz:
                 pass
 
 
+class TestStreamFraming:
+    """Multi-frame streaming (decode token replies): stamped frames carry a
+    contiguous sequence number and an end-of-stream marker; the reader turns
+    every torn/reordered/duplicated stream into a typed FrameError instead
+    of silently delivering a gapped token sequence."""
+
+    def test_stamp_and_accessors_roundtrip(self):
+        f = wire.stamp_stream({"id": "g1", "token": 42}, 3)
+        got = wire.decode(wire.encode(f))
+        assert wire.frame_stream_seq(got) == 3
+        assert wire.frame_stream_end(got) is False
+        last = wire.decode(wire.encode(
+            wire.stamp_stream({"id": "g1", "tokens": [1, 2]}, 4, end=True)))
+        assert wire.frame_stream_seq(last) == 4
+        assert wire.frame_stream_end(last) is True
+
+    def test_reader_accepts_ordered_stream(self):
+        r = wire.StreamReader()
+        for i in range(5):
+            assert r.feed(wire.stamp_stream({"t": i}, i)) == (i, False)
+        assert r.feed(wire.stamp_stream({}, 5, end=True)) == (5, True)
+
+    def test_reader_rejects_gap(self):
+        r = wire.StreamReader()
+        r.feed(wire.stamp_stream({}, 0))
+        with pytest.raises(wire.FrameError, match="seq"):
+            r.feed(wire.stamp_stream({}, 2))
+
+    def test_reader_rejects_duplicate(self):
+        r = wire.StreamReader()
+        r.feed(wire.stamp_stream({}, 0))
+        with pytest.raises(wire.FrameError, match="seq"):
+            r.feed(wire.stamp_stream({}, 0))
+
+    def test_reader_rejects_unstamped_frame(self):
+        with pytest.raises(wire.FrameError):
+            wire.StreamReader().feed({"token": 1})
+
+    def test_reader_rejects_frames_after_end(self):
+        r = wire.StreamReader()
+        r.feed(wire.stamp_stream({}, 0, end=True))
+        with pytest.raises(wire.FrameError):
+            r.feed(wire.stamp_stream({}, 1))
+
+    def test_truncated_stream_frames_always_raise(self):
+        """A stream torn mid-frame (killed server) must surface as a typed
+        error at the codec layer, for every possible cut point."""
+        frames = [wire.stamp_stream({"id": "g", "token": 7 * i}, i)
+                  for i in range(3)]
+        frames.append(wire.stamp_stream({"id": "g", "tokens": [0, 7, 14]},
+                                        3, end=True))
+        for f in frames:
+            enc = wire.encode(f)
+            for i in range(len(enc)):
+                with pytest.raises((wire.FrameError, ValueError)):
+                    wire.decode(enc[:i])
+
+    def test_bitflipped_stream_never_crashes_reader(self):
+        """Seeded corruption over a whole token stream: each frame either
+        decodes and feeds cleanly, or raises in the FrameError/ValueError
+        family — the reader never delivers an out-of-order token and never
+        raises anything untyped."""
+        rng = random.Random(0xDEC0DE)
+        frames = [wire.encode(wire.stamp_stream({"id": "g", "token": i}, i))
+                  for i in range(6)]
+        for _ in range(200):
+            r = wire.StreamReader()
+            delivered = []
+            for enc in frames:
+                buf = bytearray(enc)
+                if rng.random() < 0.5:
+                    buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+                try:
+                    f = wire.decode(bytes(buf))
+                    delivered.append(r.feed(f)[0])
+                except (ValueError, TypeError):
+                    break   # typed failure tears the stream; reader stops
+            assert delivered == list(range(len(delivered)))
+
+
 class TestSocketTimeouts:
     def _pair(self):
         srv = socket.socket()

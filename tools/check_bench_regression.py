@@ -46,6 +46,17 @@ _THROUGHPUT_KEYS = (
     "gpt_tokens_per_sec_per_chip", "gpt_mfu",
     "ernie_tokens_per_sec_per_chip", "ernie_mfu",
     "gpt1p3b_slice_tokens_per_sec_per_chip", "gpt1p3b_slice_mfu",
+    # continuous-batching decode (tools/serving_bench.py --decode):
+    # completed-in-deadline token throughput
+    "decode_goodput_tokens_per_sec",
+)
+
+# decode latency extras (LOWER is better, ms): gated with the same wide
+# tolerance + absolute floor as phase times — TTFT/TPOT on a fake clock are
+# deterministic, but sub-ms values are still scheduling-order noise
+_DECODE_LATENCY_KEYS = (
+    "decode_ttft_p50_ms", "decode_ttft_p99_ms",
+    "decode_tpot_p50_ms", "decode_tpot_p99_ms",
 )
 
 
@@ -104,6 +115,10 @@ def _breakdown_metrics(doc):
     """Flatten extra.step_breakdown into {metric_name: ms} — per-lane
     per-phase totals plus the p50/p99 step times."""
     out = {}
+    for k in _DECODE_LATENCY_KEYS:
+        v = (doc.get("extra") or {}).get(k)
+        if isinstance(v, (int, float)):
+            out[k] = float(v)
     bd = (doc.get("extra") or {}).get("step_breakdown") or {}
     for lane, b in sorted(bd.items()):
         if not isinstance(b, dict):

@@ -88,6 +88,13 @@ REQUIRED = [
      ["poll"]),
     ("paddle_tpu/serving/rollout.py", "class:RolloutController",
      ["_load", "_swap_one", "_verify_canary"]),
+    # continuous-batching decode (decode PR): the chaos suite must be able
+    # to shed a join at the door (decode.join), kill the replica during a
+    # prefill chunk or a decode round (decode.prefill / decode.step — both
+    # must resolve as a replay, not a loss), and fail the eviction cleanup
+    # itself (decode.evict — termination must still complete)
+    ("paddle_tpu/serving/decode/engine.py", "class:DecodeEngine",
+     ["join", "_prefill", "step", "_evict"]),
 ]
 
 # _injected_run is HDFSClient's hook-carrying chokepoint: routing a call

@@ -41,6 +41,7 @@ SUBSYSTEMS = [
     "autotune",      # kernel-tier block autotuning
     "ckpt",          # zero-stall checkpointing (resilience/snapshot.py)
     "compiled_step", # whole-step compilation (jit/compiled_step.py)
+    "decode",        # continuous-batching decode (serving/decode/)
     "fusion_policy", # measured fusion decisions
     "integrity",     # SDC defense (checksum consensus, replay)
     "io",            # input pipeline / data workers

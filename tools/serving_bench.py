@@ -270,6 +270,119 @@ def run_overload(args):
     return results, ok
 
 
+# -- deterministic decode sweep (fake clock, zero real sleeps) ---------------
+
+def run_decode_point(args, multiplier):
+    """One open-loop decode point at ``multiplier`` x estimated stream
+    capacity on a fresh fake-clock engine. Time advances only through the
+    backend's service hook (prefill/decode work) and the arrival ticks."""
+    from paddle_tpu.serving.decode import (
+        CompiledDecodeBackend, DecodeConfig, DecodeEngine,
+    )
+    from paddle_tpu.serving.overload import AdmissionController
+
+    clock = _FakeClock()
+    round_s = args.token_ms / 1e3
+
+    def service(kind, n):
+        # one decode round costs token_ms regardless of occupancy (the
+        # bucket-padded program); prefill is compute-dense and amortized
+        clock.advance(round_s if kind == "decode"
+                      else n * round_s / 32.0)
+
+    backend = CompiledDecodeBackend(max_running=args.max_running,
+                                    service=service)
+    admission = AdmissionController(
+        target_ms=args.deadline * 250.0, initial=args.max_running * 4,
+        max_limit=args.max_running * 4, clock=clock)
+    eng = DecodeEngine(
+        backend,
+        DecodeConfig(max_running=args.max_running,
+                     num_blocks=args.kv_blocks,
+                     prefill_chunk=args.prefill_chunk,
+                     max_new_tokens=args.gen_tokens),
+        clock=clock, admission=admission)
+
+    from paddle_tpu.serving.batcher import ServerOverloaded
+    stream_service_s = (args.prompt_len * round_s / 32.0
+                        + args.gen_tokens * round_s)
+    capacity = args.max_running / stream_service_s     # streams/sec
+    rate = capacity * multiplier
+    dt = round_s / 2
+    credit = 0.0
+    joined, sheds, hints = [], 0, 0
+    prompt = list(range(1, args.prompt_len + 1))
+    while clock() < args.duration:
+        credit += rate * dt
+        while credit >= 1.0:
+            credit -= 1.0
+            try:
+                joined.append(eng.join(prompt, timeout=args.deadline))
+            except ServerOverloaded as e:
+                sheds += 1
+                if getattr(e, "retry_after", None) is not None:
+                    hints += 1
+        eng.step()
+        clock.advance(dt)
+    # drain: every joined stream must terminate (tokens or typed error)
+    rounds = 0
+    while eng.running() and rounds < 100000:
+        eng.step()
+        clock.advance(dt)
+        rounds += 1
+    snap = eng.stats()
+    ok = [s for s in joined if s.done and s.error is None]
+    unterminated = sum(1 for s in joined if not s.done)
+    goodput = sum(len(s.tokens) for s in ok) / clock()
+    offered = len(joined) + sheds
+    return {
+        "multiplier": multiplier,
+        "offered": offered,
+        "joined": len(joined),
+        "completed": len(ok),
+        "shed": sheds,
+        "shed_with_hint": hints,
+        "shed_rate": sheds / offered if offered else 0.0,
+        "unterminated": unterminated,
+        "goodput_tokens_per_sec": goodput,
+        "ttft_ms_p50": snap["ttft_p50_ms"],
+        "ttft_ms_p99": snap["ttft_p99_ms"],
+        "tpot_ms_p50": snap["tpot_p50_ms"],
+        "tpot_ms_p99": snap["tpot_p99_ms"],
+        "deadline_ms": args.deadline * 1e3,
+        "compiles": snap.get("compiles"),
+        "compile_bound": len(backend.buckets),
+    }
+
+
+def run_decode(args):
+    """Fake-clock open-loop decode sweep. The gate requires, at EVERY
+    multiplier: positive completions + goodput, zero unterminated streams,
+    every shed carrying a retry_after hint, compiles bounded by the bucket
+    set, and (at nominal load) TTFT p99 under the deadline."""
+    results = []
+    for multiplier in [float(m) for m in args.multipliers.split(",") if m]:
+        res = run_decode_point(args, multiplier)
+        results.append(res)
+        print(f"load={multiplier:>4.0f}x  offered={res['offered']:>6}"
+              f"  goodput={res['goodput_tokens_per_sec']:>8.1f} tok/s"
+              f"  ttft_p99={res['ttft_ms_p99'] or -1:>7.2f}ms"
+              f"  tpot_p99={res['tpot_ms_p99'] or -1:>7.2f}ms"
+              f"  shed={res['shed_rate']:>5.1%}"
+              f"  compiles={res['compiles']}",
+              file=sys.stderr)
+    nominal = results[0]
+    ok = all(r["completed"] > 0
+             and r["goodput_tokens_per_sec"] > 0
+             and r["unterminated"] == 0
+             and r["shed_with_hint"] == r["shed"]
+             and (r["compiles"] is None
+                  or r["compiles"] <= r["compile_bound"])
+             for r in results) \
+        and (nominal["ttft_ms_p99"] or 0.0) <= nominal["deadline_ms"]
+    return results, ok
+
+
 # -- deterministic rollout soak (fake clock, zero real sleeps) ---------------
 
 def run_rollout_soak(args):
@@ -440,6 +553,22 @@ def main(argv=None):
                          "estimated capacity")
     ap.add_argument("--service-ms", type=float, default=5.0,
                     help="overload sweep: synthetic per-batch service time")
+    ap.add_argument("--decode", action="store_true",
+                    help="deterministic fake-clock continuous-batching "
+                         "decode sweep: open-loop stream arrivals, gated on "
+                         "TTFT/TPOT + goodput + bounded compiles")
+    ap.add_argument("--token-ms", type=float, default=5.0,
+                    help="decode sweep: synthetic per-round decode time")
+    ap.add_argument("--prompt-len", type=int, default=32,
+                    help="decode sweep: prompt tokens per stream")
+    ap.add_argument("--gen-tokens", type=int, default=16,
+                    help="decode sweep: tokens generated per stream")
+    ap.add_argument("--max-running", type=int, default=8,
+                    help="decode sweep: continuous-batch running-set cap")
+    ap.add_argument("--kv-blocks", type=int, default=256,
+                    help="decode sweep: KV pool size in blocks")
+    ap.add_argument("--prefill-chunk", type=int, default=64,
+                    help="decode sweep: prompt tokens absorbed per step")
     ap.add_argument("--rollout-soak", action="store_true",
                     help="deterministic fake-clock rollout soak: traffic + "
                          "mid-stream checkpoint commits (one poisoned), "
@@ -458,8 +587,41 @@ def main(argv=None):
         args.hidden, args.replicas = 8, 1
         if args.overload:
             args.duration, args.multipliers = 2.0, "1,10"
+        if args.decode:
+            args.duration, args.multipliers = 2.0, "1,8"
+            args.gen_tokens, args.prompt_len = 8, 16
         if args.rollout_soak:
             args.duration, args.versions, args.commit_every = 6.0, 2, 1.5
+
+    if args.decode:
+        if args.deadline is None:
+            args.deadline = 2.0
+        results, ok = run_decode(args)
+        nominal = results[0]
+        doc = {"mode": "decode",
+               "config": {"max_running": args.max_running,
+                          "kv_blocks": args.kv_blocks,
+                          "prefill_chunk": args.prefill_chunk,
+                          "token_ms": args.token_ms,
+                          "prompt_len": args.prompt_len,
+                          "gen_tokens": args.gen_tokens,
+                          "deadline": args.deadline,
+                          "duration": args.duration},
+               "results": results,
+               # extra.* keys gated by tools/check_bench_regression.py:
+               # goodput higher-is-better, TTFT/TPOT lower-is-better
+               "extra": {
+                   "decode_goodput_tokens_per_sec":
+                       nominal["goodput_tokens_per_sec"],
+                   "decode_ttft_p50_ms": nominal["ttft_ms_p50"],
+                   "decode_ttft_p99_ms": nominal["ttft_ms_p99"],
+                   "decode_tpot_p50_ms": nominal["tpot_ms_p50"],
+                   "decode_tpot_p99_ms": nominal["tpot_ms_p99"],
+               },
+               "decode_ok": ok}
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+        return 0 if ok else 1
 
     if args.rollout_soak:
         report, ok = run_rollout_soak(args)
