@@ -1,0 +1,29 @@
+"""paddle-lint: the repo's unified static-analysis framework.
+
+Stdlib-only by design — importable without jax, so the ``tools/``
+CLIs (``tools/lint.py`` and the ``check_*`` shims) can load it through
+an alias loader without executing ``paddle_tpu/__init__.py``. See
+``docs/static_analysis.md`` for the pass catalog and the annotation
+contracts, and ``tools/lint.py`` for the CLI.
+
+Importing this package registers every pass (the ``passes`` subpackage
+is imported for its ``@register_pass`` side effects).
+"""
+from .core import (  # noqa: F401
+    AnalysisContext,
+    Finding,
+    WAIVERS_FILE,
+    all_passes,
+    get_pass,
+    load_waivers,
+    register_pass,
+    run_pass,
+    split_waived,
+)
+from . import passes  # noqa: F401  (registers the built-in passes)
+
+__all__ = [
+    "AnalysisContext", "Finding", "WAIVERS_FILE", "all_passes",
+    "get_pass", "load_waivers", "register_pass", "run_pass",
+    "split_waived", "passes",
+]

@@ -1,0 +1,302 @@
+"""Core of the paddle-lint analysis framework.
+
+Everything here is stdlib-only on purpose: the lint CLIs must run in any
+environment (CI boxes, pre-commit hooks) without importing ``paddle_tpu``
+itself — and therefore without jax. Tools load this package through
+:func:`tools.lint.load_analysis`, which registers it under a standalone
+alias so ``paddle_tpu/__init__.py`` never executes.
+
+Concepts
+--------
+``Finding``
+    One lint hit: pass name, file, line, a short machine-readable code,
+    a human message, and a stable ``ident()`` used by the waiver baseline.
+``AnalysisContext``
+    The shared module loader + per-file AST cache. Passes never call
+    ``open``/``ast.parse`` themselves; they ask the context, so a file
+    scanned by three passes is read and parsed once. The ``overlay``
+    mapping lets tests (and the mutation suite) analyze modified file
+    contents without touching the working tree.
+``register_pass`` / ``all_passes``
+    The visitor registry. A pass is a class with ``name``,
+    ``description`` and ``run(ctx) -> list[Finding]``.
+``load_waivers`` / ``split_waived``
+    The frozen-baseline mechanism, modeled on ``BENCH_WAIVERS.json``:
+    ``LINT_WAIVERS.json`` at the repo root lists finding idents that are
+    tolerated; everything else is "new" and fails the build. The file
+    ships empty — the tree itself is lint-clean.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import tokenize
+import io
+
+SEVERITIES = ("error", "warning")
+
+
+class Finding:
+    """One lint finding.
+
+    ``symbol`` is a pass-chosen stable token (attribute name, function
+    name, flag name, ...) folded into :meth:`ident` so waivers survive
+    line-number drift from unrelated edits.
+    """
+
+    __slots__ = ("pass_name", "path", "line", "code", "message",
+                 "symbol", "severity")
+
+    def __init__(self, pass_name, path, line, code, message,
+                 symbol=None, severity="error"):
+        assert severity in SEVERITIES, severity
+        self.pass_name = pass_name
+        self.path = path  # repo-relative, forward slashes
+        self.line = int(line)
+        self.code = code
+        self.message = message
+        self.symbol = symbol or ""
+        self.severity = severity
+
+    def ident(self):
+        return f"{self.pass_name}:{self.path}:{self.code}:{self.symbol}"
+
+    def format(self):
+        return (f"{self.path}:{self.line}: "
+                f"[{self.pass_name}/{self.code}] {self.message}")
+
+    def to_dict(self):
+        return {"pass": self.pass_name, "path": self.path,
+                "line": self.line, "code": self.code,
+                "message": self.message, "symbol": self.symbol,
+                "severity": self.severity, "ident": self.ident()}
+
+    def __repr__(self):
+        return f"Finding({self.format()!r})"
+
+
+class SourceFile:
+    """A parsed source file: text, AST, and the line→comment map the
+    annotation-driven passes (guarded-by, inline waivers) consume."""
+
+    __slots__ = ("rel", "path", "text", "_tree", "_lines", "_comments")
+
+    def __init__(self, rel, path, text):
+        self.rel = rel
+        self.path = path
+        self.text = text
+        self._tree = None
+        self._lines = None
+        self._comments = None
+
+    @property
+    def tree(self):
+        if self._tree is None:
+            self._tree = ast.parse(self.text, filename=self.rel)
+        return self._tree
+
+    @property
+    def lines(self):
+        if self._lines is None:
+            self._lines = self.text.splitlines()
+        return self._lines
+
+    @property
+    def comments(self):
+        """{lineno: comment text (without '#')} via tokenize, so string
+        literals containing '#' never masquerade as annotations."""
+        if self._comments is None:
+            out = {}
+            try:
+                toks = tokenize.generate_tokens(
+                    io.StringIO(self.text).readline)
+                for tok in toks:
+                    if tok.type == tokenize.COMMENT:
+                        out[tok.start[0]] = tok.string.lstrip("#").strip()
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                pass
+            self._comments = out
+        return self._comments
+
+    def comment_on(self, lineno):
+        return self.comments.get(lineno, "")
+
+
+class AnalysisContext:
+    """Shared loader + AST cache handed to every pass.
+
+    ``root``     repo root (absolute).
+    ``overlay``  optional {rel: text} overriding on-disk contents —
+                 tests and the mutation suite lint hypothetical trees
+                 without writing files.
+    ``restrict`` optional set of rels; when set, passes report findings
+                 only for these files (``--changed`` mode). Whole-repo
+                 passes still *scan* everything so cross-file rules
+                 (flag hygiene) stay sound.
+    """
+
+    SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build",
+                 "node_modules", ".eggs"}
+
+    def __init__(self, root, overlay=None, restrict=None):
+        self.root = os.path.abspath(root)
+        self.overlay = dict(overlay or {})
+        self.restrict = set(restrict) if restrict is not None else None
+        self._cache = {}
+
+    # -- file access -----------------------------------------------------------
+    def source(self, rel):
+        """SourceFile for a repo-relative path, or None if unreadable."""
+        rel = rel.replace(os.sep, "/")
+        sf = self._cache.get(rel)
+        if sf is not None:
+            return sf
+        if rel in self.overlay:
+            text = self.overlay[rel]
+        else:
+            path = os.path.join(self.root, rel)
+            if not os.path.isfile(path):
+                return None
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+            except (OSError, UnicodeDecodeError):
+                return None
+        sf = SourceFile(rel, os.path.join(self.root, rel), text)
+        self._cache[rel] = sf
+        return sf
+
+    def exists(self, rel):
+        rel = rel.replace(os.sep, "/")
+        return rel in self.overlay \
+            or os.path.isfile(os.path.join(self.root, rel))
+
+    def py_files(self, under=()):
+        """Yield repo-relative paths of .py files under the given
+        top-level entries (files or directories). Overlay-only files
+        (tests injecting synthetic rels) are included when they match."""
+        seen = set()
+        for entry in under:
+            path = os.path.join(self.root, entry)
+            if os.path.isfile(path):
+                if entry.endswith(".py"):
+                    seen.add(entry.replace(os.sep, "/"))
+                continue
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in self.SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), self.root)
+                        seen.add(rel.replace(os.sep, "/"))
+        for rel in self.overlay:
+            if rel.endswith(".py") and any(
+                    rel == e or rel.startswith(e.rstrip("/") + "/")
+                    for e in under):
+                seen.add(rel)
+        return sorted(seen)
+
+    def reported(self, findings):
+        """Apply the ``restrict`` filter (``--changed`` mode)."""
+        if self.restrict is None:
+            return findings
+        return [f for f in findings if f.path in self.restrict]
+
+
+# -- pass registry -------------------------------------------------------------
+_PASSES = {}
+
+
+def register_pass(cls):
+    """Class decorator: register a pass under its ``name``."""
+    name = getattr(cls, "name", None)
+    if not name:
+        raise ValueError(f"pass {cls!r} has no name")
+    _PASSES[name] = cls
+    return cls
+
+
+def all_passes():
+    """{name: pass class}, in registration order."""
+    return dict(_PASSES)
+
+
+def get_pass(name):
+    return _PASSES[name]
+
+
+def run_pass(name, ctx):
+    return ctx.reported(_PASSES[name]().run(ctx))
+
+
+# -- waiver baseline -----------------------------------------------------------
+WAIVERS_FILE = "LINT_WAIVERS.json"
+
+
+def load_waivers(root):
+    """Load the frozen baseline. Returns {ident: reason}. A missing file
+    is an empty baseline; a malformed one is an error (a corrupt baseline
+    silently waiving everything would defeat the lint)."""
+    path = os.path.join(root, WAIVERS_FILE)
+    if not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out = {}
+    for entry in data.get("waivers", []):
+        if not isinstance(entry, dict) or "ident" not in entry:
+            raise ValueError(
+                f"{WAIVERS_FILE}: waiver entries must be objects with an "
+                f"'ident' key, got {entry!r}")
+        out[entry["ident"]] = entry.get("reason", "")
+    return out
+
+
+def split_waived(findings, waivers):
+    """(new, waived) partition by baseline ident."""
+    new, waived = [], []
+    for f in findings:
+        (waived if f.ident() in waivers else new).append(f)
+    return new, waived
+
+
+# -- shared AST helpers --------------------------------------------------------
+def call_name(func):
+    """Trailing name of a call target: ``a.b.c(...)`` -> 'c'."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def dotted_name(node):
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def has_kwarg(call, name):
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def waived(sf, lineno, marker):
+    """True when an inline waiver ``marker`` comment covers ``lineno`` —
+    trailing on the line itself, or on the line directly above (for
+    expressions too long to carry a trailing comment)."""
+    return marker in sf.comment_on(lineno) \
+        or marker in sf.comment_on(lineno - 1)
+
+
+def iter_class_functions(cls_node):
+    for sub in cls_node.body:
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield sub

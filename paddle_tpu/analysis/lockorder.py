@@ -1,0 +1,250 @@
+"""Runtime lock-order tracker: deterministic deadlock-potential detection.
+
+Chaos tests only hit a real ABBA deadlock when two threads interleave
+just wrong — probabilistically, and then the suite *hangs* instead of
+failing. This tracker turns the ordering bug itself into a deterministic
+failure: while enabled, every lock created through ``threading.Lock`` /
+``threading.RLock`` is wrapped; each acquisition records a directed edge
+from every lock the thread already holds to the one being acquired, and
+an acquisition that would close a cycle in that graph is reported *before
+blocking* — thread 1 doing A→B and thread 2 doing B→A is flagged the
+moment the second order is attempted, whether or not the threads ever
+actually contend.
+
+Usage (tests — see the ``chaos``-marker fixture in tests/conftest.py)::
+
+    with lockorder.tracking() as tracker:          # mode="record"
+        ... run the scenario ...
+    assert not tracker.violations
+
+    with lockorder.tracking(mode="raise"):         # direct assertions
+        ...  # a cycle-closing acquire raises LockOrderViolation
+
+Only locks *created while tracking is enabled* are observed — wrapping
+pre-existing locks (jax internals, module-level registries) would risk
+false edges from state we did not watch from the start. ``RLock``
+re-entry does not add edges. ``threading.Condition`` over a tracked lock
+works: the wrapper implements ``_release_save`` / ``_acquire_restore`` /
+``_is_owned`` so the tracker's held-set stays accurate across
+``cv.wait()``.
+"""
+from __future__ import annotations
+
+import threading
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+
+
+class LockOrderViolation(RuntimeError):
+    """Two code paths acquire the same locks in opposite orders."""
+
+    def __init__(self, cycle):
+        self.cycle = list(cycle)
+        super().__init__(
+            "lock-order cycle (deadlock potential): "
+            + " -> ".join(cycle) + " -> " + cycle[0])
+
+
+class _TrackedLock:
+    """Wraps a real Lock/RLock; reports acquisition order to the tracker.
+
+    Not a subclass — delegation keeps the wrapper honest about which
+    methods the tracker must intercept. ``__getattr__`` forwards the
+    rest (``locked``, ...).
+    """
+
+    def __init__(self, inner, tracker, name, reentrant):
+        self._inner = inner
+        self._tracker = tracker
+        self._name = name
+        self._reentrant = reentrant
+
+    # -- core protocol ---------------------------------------------------------
+    def acquire(self, blocking=True, timeout=-1):
+        self._tracker._before_acquire(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._tracker._acquired(self)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._tracker._released(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # -- Condition support -----------------------------------------------------
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        # plain Lock (Condition's fallback probe): owned if not acquirable
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        self._tracker._released(self)
+        if hasattr(self._inner, "_release_save"):
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        self._tracker._acquired(self)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __repr__(self):
+        return f"<tracked {self._name} of {self._inner!r}>"
+
+
+class Tracker:
+    """Per-thread held stacks + a global acquired-after graph."""
+
+    def __init__(self, mode="record"):
+        assert mode in ("record", "raise"), mode
+        self.mode = mode
+        self.violations = []          # LockOrderViolation instances
+        self._tls = threading.local()
+        self._graph_lock = _real_lock()
+        self._edges = {}              # id(lock) -> set(id(lock))
+        self._names = {}              # id(lock) -> display name
+        self._counter = 0
+
+    # -- factory side ----------------------------------------------------------
+    def _make(self, reentrant, caller):
+        inner = _real_rlock() if reentrant else _real_lock()
+        self._counter += 1
+        kind = "RLock" if reentrant else "Lock"
+        name = f"{kind}#{self._counter}@{caller}"
+        lk = _TrackedLock(inner, self, name, reentrant)
+        with self._graph_lock:
+            self._names[id(lk)] = name
+        return lk
+
+    # -- hold bookkeeping ------------------------------------------------------
+    def _held(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _before_acquire(self, lk):
+        held = self._held()
+        if any(h is lk for h in held):
+            return  # RLock re-entry: no new ordering information
+        me = id(lk)
+        with self._graph_lock:
+            new_edges = [(id(h), me) for h in held]
+            for a, b in new_edges:
+                self._edges.setdefault(a, set()).add(b)
+            cycle = self._find_cycle(me) if new_edges else None
+        if cycle is not None:
+            v = LockOrderViolation([self._names.get(i, f"lock#{i}")
+                                    for i in cycle])
+            self.violations.append(v)
+            if self.mode == "raise":
+                raise v
+
+    def _acquired(self, lk):
+        self._held().append(lk)
+
+    def _released(self, lk):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lk:
+                del held[i]
+                return
+
+    # -- cycle detection (graph lock held) -------------------------------------
+    def _find_cycle(self, start):
+        """DFS from ``start``: a path back to ``start`` is a cycle.
+        Returns the node ids along the path, or None."""
+        stack = [(start, [start])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            for nxt in self._edges.get(node, ()):
+                if nxt == start:
+                    return path
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+
+class _Handle:
+    def __init__(self, tracker):
+        self.tracker = tracker
+
+    def __enter__(self):
+        return self.tracker
+
+    def __exit__(self, *exc):
+        disable()
+        return False
+
+
+_active = [None]  # the currently-installed tracker, if any
+_install_lock = _real_lock()
+
+
+def enable(mode="record"):
+    """Install the tracker: threading.Lock/RLock created from now on are
+    wrapped. Returns the Tracker. Nested enables are rejected — the
+    factory patch is process-global state."""
+    with _install_lock:
+        if _active[0] is not None:
+            raise RuntimeError("lock-order tracking already enabled")
+        tracker = Tracker(mode=mode)
+        _active[0] = tracker
+
+        def _lock_factory():
+            return tracker._make(False, _caller())
+
+        def _rlock_factory():
+            return tracker._make(True, _caller())
+
+        threading.Lock = _lock_factory
+        threading.RLock = _rlock_factory
+        return tracker
+
+
+def disable():
+    """Restore the real factories. Idempotent."""
+    with _install_lock:
+        threading.Lock = _real_lock
+        threading.RLock = _real_rlock
+        _active[0] = None
+
+
+def tracking(mode="record"):
+    """Context manager: ``with tracking() as tracker: ...``."""
+    return _Handle(enable(mode=mode))
+
+
+def _caller():
+    """file:line of the lock constructor call, for readable cycle
+    reports."""
+    import sys
+    f = sys._getframe(2)
+    # walk out of this module
+    while f is not None and f.f_globals.get("__name__") == __name__:
+        f = f.f_back
+    if f is None:
+        return "?"
+    fn = f.f_code.co_filename.rsplit("/", 1)[-1]
+    return f"{fn}:{f.f_lineno}"

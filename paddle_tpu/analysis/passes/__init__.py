@@ -1,0 +1,9 @@
+"""Built-in lint passes. Importing this package registers all of them
+with the core registry (``@register_pass``), in the order tools/lint.py
+reports them."""
+from . import lock_discipline   # noqa: F401
+from . import blocking_calls    # noqa: F401
+from . import typed_errors      # noqa: F401
+from . import flag_hygiene      # noqa: F401
+from . import injection_points  # noqa: F401
+from . import metric_names      # noqa: F401

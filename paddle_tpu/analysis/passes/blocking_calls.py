@@ -1,0 +1,218 @@
+"""Blocking-call pass.
+
+Mechanizes two rules every PR so far has enforced by hand review:
+
+1. **Zero real sleeps in tests.** Under ``tests/`` the pass forbids
+   ``time.sleep``, zero-argument ``.join()`` / ``.wait()`` / ``.get()``
+   without a ``timeout=``, and ``subprocess`` run-family calls without a
+   ``timeout=``. A test that needs to wait polls a condition with a
+   deadline (fake clock or ``wait_until``-style helper) — an untimeouted
+   wait is exactly the shape that turns one hung thread into a hung CI
+   lane.
+
+2. **No blocking inside lock scopes or hot paths.** Lexically inside a
+   ``with self._lock:`` / ``with ...cv:`` block, or inside a function
+   listed in ``HOT_PATHS`` (the serving dispatch/pump/decode-tick
+   chokepoints), the pass additionally forbids blocking socket
+   operations (``create_connection``, ``.accept()``, ``.connect()``)
+   and *any* ``subprocess`` use. Holding a lock across a sleep or a
+   connect turns every other thread's bounded wait into an unbounded
+   one.
+
+The canonical condition-variable pattern is exempt: ``self._cv.wait()``
+inside ``with self._cv:`` is how a Condition is *supposed* to be used —
+the wait releases the lock — so an untimeouted wait on the very lock
+being held is not flagged.
+
+Waive a reviewed exception inline::
+
+    data = wire.recv_frame(sock)   # blocking-ok: this lock serializes the socket
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import (Finding, register_pass, call_name, dotted_name,
+                    has_kwarg, waived)
+
+SCAN = ["paddle_tpu", "tests", "bench.py"]
+
+# Functions on the serving hot path: one slow call here stalls every
+# queued request, so blocking primitives are banned outright. (rel,
+# "Class.method" or "function").
+HOT_PATHS = [
+    ("paddle_tpu/serving/scheduler.py", "Scheduler.dispatch"),
+    ("paddle_tpu/serving/server.py", "InferenceServer.pump"),
+    ("paddle_tpu/serving/decode/engine.py", "DecodeEngine.step"),
+    ("paddle_tpu/serving/overload.py", "AdmissionController.admit"),
+]
+
+_WAIVE = "blocking-ok"
+_SUBPROCESS_RUN = {"run", "call", "check_call", "check_output"}
+_WAITLIKE = {"get", "join", "wait"}
+_SOCKET_OPS = {"create_connection", "accept", "connect"}
+
+
+def _lockish(expr):
+    """Is this with-item a lock acquisition? self._lock / module _LOCK /
+    cv-style condition objects."""
+    name = dotted_name(expr)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1].lower()
+    return "lock" in last or last.endswith("_cv") or last == "cv" \
+        or last.endswith("cond") or "condition" in last
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, pass_name, sf, in_tests, from_time_sleep):
+        self.pass_name = pass_name
+        self.sf = sf
+        self.in_tests = in_tests
+        self.from_time_sleep = from_time_sleep
+        self.lock_items = []   # ast.dump of held with-item exprs
+        self.hot = False
+        self.findings = []
+
+    # -- scope tracking --------------------------------------------------------
+    def visit_With(self, node):
+        got = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            if _lockish(item.context_expr):
+                got.append(ast.dump(item.context_expr))
+        self.lock_items.extend(got)
+        for stmt in node.body:
+            self.visit(stmt)
+        if got:
+            del self.lock_items[-len(got):]
+
+    visit_AsyncWith = visit_With
+
+    # -- classification --------------------------------------------------------
+    def _flag(self, node, code, msg, symbol):
+        if waived(self.sf, node.lineno, _WAIVE):
+            return
+        self.findings.append(Finding(
+            self.pass_name, self.sf.rel, node.lineno, code, msg,
+            symbol=symbol))
+
+    def _where(self):
+        if self.lock_items:
+            return "inside a lock scope"
+        if self.hot:
+            return "on a registered hot path"
+        return "under tests/"
+
+    def visit_Call(self, node):
+        in_lock = bool(self.lock_items)
+        restricted = in_lock or self.hot
+        anywhere = restricted or self.in_tests
+        name = call_name(node.func)
+        dn = dotted_name(node.func) or ""
+
+        if anywhere and (dn == "time.sleep"
+                         or (self.from_time_sleep and dn == "sleep")):
+            self._flag(node, "sleep",
+                       f"time.sleep {self._where()} — use a fake clock, "
+                       "an injectable sleep, or poll a condition with a "
+                       "deadline",
+                       symbol=f"sleep@{self.sf.rel}:{node.lineno}")
+
+        elif anywhere and name in _SUBPROCESS_RUN \
+                and dn.startswith("subprocess."):
+            if restricted:
+                self._flag(node, "subprocess",
+                           f"subprocess.{name} {self._where()}",
+                           symbol=f"subprocess@{self.sf.rel}:{node.lineno}")
+            elif not has_kwarg(node, "timeout"):
+                self._flag(node, "subprocess-no-timeout",
+                           f"subprocess.{name} without timeout= under "
+                           "tests/ — a wedged child hangs the suite",
+                           symbol=f"subprocess@{self.sf.rel}:{node.lineno}")
+
+        elif restricted and (dn == "socket.create_connection"
+                             or (isinstance(node.func, ast.Attribute)
+                                 and name in _SOCKET_OPS
+                                 and name != "create_connection"
+                                 and not node.args and not node.keywords)
+                             ):
+            self._flag(node, "socket",
+                       f"blocking socket op '{name}' {self._where()}",
+                       symbol=f"socket@{self.sf.rel}:{node.lineno}")
+
+        elif anywhere and name in _WAITLIKE \
+                and isinstance(node.func, ast.Attribute) \
+                and not node.args and not has_kwarg(node, "timeout"):
+            # dict.get / str.join take positional args, so a
+            # zero-argument call is (queue|thread|event)-shaped.
+            recv = ast.dump(node.func.value)
+            if name == "wait" and recv in self.lock_items:
+                pass  # cv.wait() inside `with cv:` — the canonical pattern
+            else:
+                self._flag(node, "untimeouted-wait",
+                           f".{name}() without timeout= {self._where()} — "
+                           "bound it so a lost notification cannot hang "
+                           "the caller forever",
+                           symbol=f"{name}@{self.sf.rel}:{node.lineno}")
+
+        self.generic_visit(node)
+
+
+def _qualnames(tree):
+    """Yield (qualname, fn_node) for module functions and class methods."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", sub
+
+
+@register_pass
+class BlockingCallPass:
+    name = "blocking-call"
+    description = ("no sleeps/untimeouted waits in tests; no blocking "
+                   "calls in lock scopes or hot paths")
+
+    def run(self, ctx):
+        findings = []
+        hot = {}
+        for rel, qual in HOT_PATHS:
+            hot.setdefault(rel, set()).add(qual)
+        for rel in ctx.py_files(SCAN):
+            sf = ctx.source(rel)
+            if sf is None:
+                continue
+            try:
+                tree = sf.tree
+            except SyntaxError as e:
+                findings.append(Finding(
+                    self.name, rel, getattr(e, "lineno", 1) or 1,
+                    "unparseable", f"unparseable ({e})", symbol=rel))
+                continue
+            in_tests = rel.startswith("tests/")
+            from_time_sleep = any(
+                isinstance(n, ast.ImportFrom) and n.module == "time"
+                and any(a.name == "sleep" for a in n.names)
+                for n in ast.walk(tree))
+            checker = _Checker(self.name, sf, in_tests, from_time_sleep)
+            hot_here = hot.get(rel, set())
+            if in_tests or "with" in sf.text or hot_here:
+                for qual, fn in _qualnames(tree):
+                    checker.hot = qual in hot_here
+                    for stmt in fn.body:
+                        checker.visit(stmt)
+                checker.hot = False
+                # module-level statements (rare, but `with lock:` at
+                # import time exists in tests)
+                for stmt in tree.body:
+                    if not isinstance(stmt, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef,
+                                             ast.ClassDef)):
+                        checker.visit(stmt)
+            findings.extend(checker.findings)
+        return findings

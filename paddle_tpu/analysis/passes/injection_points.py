@@ -1,0 +1,107 @@
+"""Fault-injection coverage pass (ported from
+``tools/check_injection_points.py``).
+
+The manifest of required entry points stays as a plain literal in the
+tools shim — ``tests/test_lints.py`` ast-parses ``REQUIRED`` and
+``HOOK_CALLS`` out of that file to guard the manifest itself, and the
+shim remains the one place reviewers add entries. This pass loads the
+manifest the same way (no import, works under overlay) and reproduces
+the legacy messages byte-for-byte so the shim's CLI output is unchanged.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass
+
+MANIFEST_FILE = "tools/check_injection_points.py"
+
+
+def load_manifest(ctx):
+    """(REQUIRED, HOOK_CALLS) literals out of the tools shim."""
+    sf = ctx.source(MANIFEST_FILE)
+    if sf is None:
+        raise FileNotFoundError(MANIFEST_FILE)
+    required = hook_calls = None
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "REQUIRED":
+                    required = ast.literal_eval(node.value)
+                elif getattr(t, "id", None) == "HOOK_CALLS":
+                    hook_calls = ast.literal_eval(node.value)
+    if required is None or hook_calls is None:
+        raise ValueError(
+            f"{MANIFEST_FILE}: REQUIRED/HOOK_CALLS literals not found")
+    return required, set(hook_calls)
+
+
+def _has_hook(fn_node, hook_calls):
+    for deco in fn_node.decorator_list:
+        call = deco if isinstance(deco, ast.Call) else None
+        name = call.func if call else deco
+        if isinstance(name, ast.Attribute) and name.attr in hook_calls:
+            return True
+        if isinstance(name, ast.Name) and name.id in hook_calls:
+            return True
+    for node in ast.walk(fn_node):
+        # direct calls AND hook callables passed to retry_call(...)
+        if isinstance(node, ast.Attribute) and node.attr in hook_calls:
+            return True
+        if isinstance(node, ast.Name) and node.id in hook_calls:
+            return True
+    return False
+
+
+def _functions(tree, scope):
+    if scope == "module":
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+        return
+    cls_name = scope.split(":", 1)[1]
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield sub
+
+
+@register_pass
+class InjectionPointPass:
+    name = "injection-points"
+    description = ("every FS/collective/serving entry point carries a "
+                   "fault-injection hook")
+
+    def run(self, ctx):
+        required, hook_calls = load_manifest(ctx)
+        self.entry_points_checked = sum(len(n) for _, _, n in required)
+        findings = []
+        for rel, scope, names in required:
+            sf = ctx.source(rel)
+            if sf is None:
+                findings.append(Finding(
+                    self.name, rel, 1, "file-missing",
+                    f"{rel}: file missing (lint manifest stale?)",
+                    symbol=rel))
+                continue
+            try:
+                tree = sf.tree
+            except SyntaxError as e:
+                findings.append(Finding(
+                    self.name, rel, getattr(e, "lineno", 1) or 1,
+                    "unparseable", f"unparseable ({e})", symbol=rel))
+                continue
+            fns = {fn.name: fn for fn in _functions(tree, scope)}
+            for name in names:
+                fn = fns.get(name)
+                if fn is None:
+                    continue  # entry point not defined in this scope
+                if not _has_hook(fn, hook_calls):
+                    findings.append(Finding(
+                        self.name, rel, fn.lineno, "missing-hook",
+                        f"{rel}: {scope} {name}() has no fault-injection "
+                        "hook (call resilience.faults.maybe_inject or "
+                        "decorate with @fault_point)",
+                        symbol=f"{scope}:{name}"))
+        return findings

@@ -1,0 +1,274 @@
+"""Lock-discipline race detector.
+
+Classes declare which lock guards which field with a trailing comment on
+the assignment that introduces the field::
+
+    self._streams = {}        # guarded-by: _lock
+
+From then on every ``self._streams`` access (read or write, including
+``self._streams.append(...)``) must happen
+
+- lexically inside a ``with self._lock:`` block, or
+- inside a method whose name ends in ``_locked``, or
+- inside a method annotated ``# requires-lock: _lock`` on its ``def``
+  line (for helpers whose names are pinned by other manifests and whose
+  callers always hold the lock).
+
+``__init__`` bodies are exempt (the object is not shared yet) — but
+functions and lambdas *defined inside* ``__init__`` are not: a gauge
+callback registered at construction time runs on the exporter thread
+later, so ``lambda: len(self._streams)`` is exactly the kind of race
+this pass exists to catch.
+
+Nested functions/lambdas inside ordinary methods are analyzed with an
+empty lock set (conservative: closures may escape to other threads);
+annotate the inner def or waive the line if the closure provably cannot.
+
+A deliberate, reviewed unguarded access is waived inline::
+
+    return self.shed            # unguarded-ok: racy read for logging
+
+Limitations (documented in docs/static_analysis.md): guarding is
+per-class and syntactic — ``self.X`` only. Cross-object guarding (a
+``Replica``'s fields guarded by the owning ``Scheduler``'s lock) and
+aliased locks (``lk = self._lock``) are out of scope.
+
+The SEEDED manifest lists files whose threaded classes are contracted to
+carry annotations; a seeded file with no ``guarded-by`` at all fails the
+pass, so the contract cannot be silently deleted.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, register_pass, waived
+
+# Files whose lock-owning classes are contracted to declare guarded
+# state. Removing every annotation from one of these is itself a finding
+# ("unseeded") — the mutation suite relies on that.
+SEEDED = [
+    "paddle_tpu/profiler/metrics.py",
+    "paddle_tpu/resilience/snapshot.py",
+    "paddle_tpu/resilience/watchdog.py",
+    "paddle_tpu/serving/scheduler.py",
+    "paddle_tpu/serving/overload.py",
+    "paddle_tpu/serving/rollout.py",
+    "paddle_tpu/serving/decode/engine.py",
+    "paddle_tpu/hapi/prefetch.py",
+    "paddle_tpu/distributed/p2p.py",
+]
+
+SCAN = ["paddle_tpu"]
+
+_GUARDED_RE = re.compile(r"guarded-by:\s*([A-Za-z_]\w*)")
+_REQUIRES_RE = re.compile(r"requires-lock:\s*([A-Za-z_]\w*)")
+_WAIVE = "unguarded-ok"
+
+
+def _self_attr(node):
+    """'x' for ``self.x`` attribute nodes, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _assigned_self_attrs(stmt):
+    """Attrs bound by an assignment statement: ``self.a = self.b = ...``."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    out = []
+    for t in targets:
+        a = _self_attr(t)
+        if a is not None:
+            out.append(a)
+        elif isinstance(t, ast.Tuple):
+            out.extend(a for a in map(_self_attr, t.elts) if a)
+    return out
+
+
+class _ClassContract:
+    def __init__(self, cls_node):
+        self.node = cls_node
+        self.name = cls_node.name
+        self.guards = {}        # attr -> lock attr name
+        self.locks = set()      # lock names referenced by guards
+        self.assigned = set()   # every self.X ever assigned in the class
+
+
+def _collect_contract(sf, cls_node):
+    """Read guarded-by annotations off assignment lines anywhere in the
+    class body (typically __init__)."""
+    c = _ClassContract(cls_node)
+    for node in ast.walk(cls_node):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            attrs = _assigned_self_attrs(node)
+            if not attrs:
+                continue
+            c.assigned.update(attrs)
+            comment = sf.comment_on(node.lineno)
+            if not comment and node.end_lineno != node.lineno:
+                comment = sf.comment_on(node.end_lineno)
+            m = _GUARDED_RE.search(comment)
+            if m:
+                lock = m.group(1)
+                for a in attrs:
+                    c.guards[a] = lock
+                c.locks.add(lock)
+    return c
+
+
+def _held_at_entry(sf, cls, fn):
+    """Locks a method body may assume held: _locked suffix => every
+    declared lock; # requires-lock: X on the def line => {X}."""
+    if fn.name.endswith("_locked"):
+        return set(cls.locks)
+    m = _REQUIRES_RE.search(sf.comment_on(fn.lineno))
+    if m:
+        return {m.group(1)}
+    return set()
+
+
+class _MethodChecker(ast.NodeVisitor):
+    def __init__(self, pass_name, sf, cls, method_name, held,
+                 skip_top_level=False):
+        self.pass_name = pass_name
+        self.sf = sf
+        self.cls = cls
+        self.method = method_name
+        self.held = set(held)
+        # __init__ mode: ignore accesses at function depth, but analyze
+        # nested defs/lambdas (they outlive construction)
+        self.skip = skip_top_level
+        self.findings = []
+
+    # -- lock scopes -----------------------------------------------------------
+    def _with_locks(self, node):
+        got = set()
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and (attr in self.cls.locks
+                                     or "lock" in attr.lower()
+                                     or attr.endswith("_cv")
+                                     or attr == "_cv"):
+                got.add(attr)
+        return got
+
+    def visit_With(self, node):
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        got = self._with_locks(node)
+        saved = set(self.held)
+        self.held |= got
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    visit_AsyncWith = visit_With
+
+    # -- nested callables: conservative fresh scope ----------------------------
+    def _visit_nested(self, node, body):
+        inner = _MethodChecker(
+            self.pass_name, self.sf, self.cls,
+            f"{self.method}.<nested>", _held_at_entry(
+                self.sf, self.cls, node) if hasattr(node, "name") else (),
+            skip_top_level=False)
+        for stmt in body:
+            inner.visit(stmt)
+        self.findings.extend(inner.findings)
+
+    def visit_FunctionDef(self, node):
+        self._visit_nested(node, node.body)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._visit_nested(node, [ast.Expr(value=node.body)])
+
+    # -- the check -------------------------------------------------------------
+    def visit_Attribute(self, node):
+        attr = _self_attr(node)
+        if attr is not None and not self.skip:
+            lock = self.cls.guards.get(attr)
+            if lock is not None and lock not in self.held:
+                if not waived(self.sf, node.lineno, _WAIVE):
+                    self.findings.append(Finding(
+                        self.pass_name, self.sf.rel, node.lineno,
+                        "unguarded",
+                        f"{self.cls.name}.{self.method} accesses "
+                        f"'{attr}' (guarded-by: {lock}) without holding "
+                        f"'with self.{lock}' — annotate the method with "
+                        f"'# requires-lock: {lock}', take the lock, or "
+                        f"waive with '# unguarded-ok: <reason>'",
+                        symbol=f"{self.cls.name}.{self.method}:{attr}"))
+        self.generic_visit(node)
+
+
+@register_pass
+class LockDisciplinePass:
+    name = "lock-discipline"
+    description = ("guarded-by annotated fields are only touched under "
+                   "their lock")
+
+    def run(self, ctx):
+        findings = []
+        for rel in ctx.py_files(SCAN):
+            sf = ctx.source(rel)
+            if sf is None:
+                continue
+            if "guarded-by:" not in sf.text and rel not in SEEDED:
+                continue  # cheap pre-filter: nothing to enforce here
+            try:
+                tree = sf.tree
+            except SyntaxError as e:
+                findings.append(Finding(
+                    self.name, rel, getattr(e, "lineno", 1) or 1,
+                    "unparseable", f"unparseable ({e})", symbol=rel))
+                continue
+            seeded_hit = False
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                cls = _collect_contract(sf, node)
+                if not cls.guards:
+                    continue
+                seeded_hit = True
+                for lock in sorted(cls.locks):
+                    if lock not in cls.assigned:
+                        findings.append(Finding(
+                            self.name, rel, node.lineno, "unknown-lock",
+                            f"{cls.name}: guarded-by names '{lock}' but "
+                            f"the class never assigns 'self.{lock}'",
+                            symbol=f"{cls.name}:{lock}"))
+                for fn in _iter_methods(node):
+                    if fn.name == "__init__":
+                        checker = _MethodChecker(
+                            self.name, sf, cls, fn.name, (),
+                            skip_top_level=True)
+                    else:
+                        checker = _MethodChecker(
+                            self.name, sf, cls, fn.name,
+                            _held_at_entry(sf, cls, fn))
+                    for stmt in fn.body:
+                        checker.visit(stmt)
+                    findings.extend(checker.findings)
+            if rel in SEEDED and not seeded_hit:
+                findings.append(Finding(
+                    self.name, rel, 1, "unseeded",
+                    f"{rel} is contracted to declare guarded state "
+                    "(# guarded-by: <lock>) for its threaded classes but "
+                    "carries no annotations — see "
+                    "docs/static_analysis.md", symbol=rel))
+        return findings
+
+
+def _iter_methods(cls_node):
+    for sub in cls_node.body:
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield sub

@@ -1,0 +1,165 @@
+"""Metric-name convention pass (ported from
+``tools/check_metric_names.py``).
+
+``SUBSYSTEMS`` / ``UNITS`` / ``GRANDFATHERED`` stay as plain literals in
+the tools shim — ``tests/test_lints.py`` guards those manifests by
+ast-parsing the shim, and the shim remains where a new subsystem is
+registered (a one-line reviewed diff). This pass loads them the same way
+and reproduces the legacy messages byte-for-byte.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, register_pass
+
+MANIFEST_FILE = "tools/check_metric_names.py"
+_MANIFEST_NAMES = ("SCAN", "SUBSYSTEMS", "UNITS", "GRANDFATHERED",
+                   "NAME_CALLS", "PAIRS_CALLS", "REGISTRY_ONLY")
+
+
+def load_manifest(ctx):
+    sf = ctx.source(MANIFEST_FILE)
+    if sf is None:
+        raise FileNotFoundError(MANIFEST_FILE)
+    out = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) in _MANIFEST_NAMES:
+                    out[t.id] = ast.literal_eval(node.value)
+    missing = [n for n in _MANIFEST_NAMES if n not in out]
+    if missing:
+        raise ValueError(f"{MANIFEST_FILE}: missing literals {missing}")
+    return out
+
+
+def _template(node):
+    """Extract a name template from an ast expression: literal strings
+    stay, dynamic fields become ``{}``. Returns None when not
+    extractable."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                parts.append("{}")
+            else:
+                return None
+        return "".join(parts)
+    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod)
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.left.value, str)):
+        return re.sub(r"%[#0\- +]*[\d*]*(?:\.[\d*]+)?[diouxXeEfFgGrsa]",
+                      "{}", node.left.value)
+    return None
+
+
+def _is_registry_receiver(node):
+    """Heuristic: does this expression denote the metrics registry?"""
+    if isinstance(node, ast.Call):
+        return _is_registry_receiver(node.func)
+    if isinstance(node, ast.Attribute):
+        return "registry" in node.attr.lower() \
+            or _is_registry_receiver(node.value)
+    if isinstance(node, ast.Name):
+        return "registry" in node.id.lower()
+    return False
+
+
+def _call_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _iter_templates(call, pairs_calls):
+    """Yield every extractable name template minted by this call."""
+    name = _call_name(call.func)
+    if name in pairs_calls:
+        for arg in call.args:
+            for node in ast.walk(arg):
+                if isinstance(node, ast.Tuple) and node.elts:
+                    t = _template(node.elts[0])
+                    if t is not None:
+                        yield t
+        return
+    if call.args:
+        t = _template(call.args[0])
+        if t is not None:
+            yield t
+
+
+@register_pass
+class MetricNamePass:
+    name = "metric-names"
+    description = "always-on metric names follow subsystem.noun_unit"
+
+    def run(self, ctx):
+        m = load_manifest(ctx)
+        units = m["UNITS"]
+        name_re = re.compile(
+            r"^(?P<subsystem>[a-z0-9_]+|\{\})\."
+            r"[a-z0-9_{}./]*_(?P<unit>%s)$" % "|".join(units))
+        name_calls = set(m["NAME_CALLS"])
+        pairs_calls = set(m["PAIRS_CALLS"])
+        registry_only = set(m["REGISTRY_ONLY"])
+        grandfathered = set(m["GRANDFATHERED"])
+        subsystems = set(m["SUBSYSTEMS"])
+        checked = 0
+        findings = []
+        for rel in ctx.py_files(m["SCAN"]):
+            sf = ctx.source(rel)
+            if sf is None:
+                continue
+            try:
+                tree = sf.tree
+            except SyntaxError as e:
+                findings.append(Finding(
+                    self.name, rel, getattr(e, "lineno", 1) or 1,
+                    "unparseable", f"{rel}: unparseable ({e})",
+                    symbol=rel))
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _call_name(node.func)
+                if name not in name_calls and name not in pairs_calls:
+                    continue
+                if name in registry_only:
+                    recv = node.func.value \
+                        if isinstance(node.func, ast.Attribute) else None
+                    if recv is None or not _is_registry_receiver(recv):
+                        continue
+                for tmpl in _iter_templates(node, pairs_calls):
+                    checked += 1
+                    if tmpl in grandfathered:
+                        continue
+                    mt = name_re.match(tmpl)
+                    if mt is None:
+                        findings.append(Finding(
+                            self.name, rel, node.lineno, "bad-name",
+                            f"{rel}:{node.lineno}: metric name {tmpl!r} "
+                            "does not match subsystem.noun_unit (unit "
+                            f"suffix one of {'/'.join(units)})",
+                            symbol=tmpl))
+                        continue
+                    sub = mt.group("subsystem")
+                    if sub != "{}" and sub not in subsystems:
+                        findings.append(Finding(
+                            self.name, rel, node.lineno,
+                            "unregistered-subsystem",
+                            f"{rel}:{node.lineno}: metric name {tmpl!r} "
+                            f"uses unregistered subsystem {sub!r} (add "
+                            "it to SUBSYSTEMS in "
+                            "tools/check_metric_names.py)",
+                            symbol=tmpl))
+        self.templates_checked = checked
+        self.subsystems_registered = len(subsystems)
+        return findings

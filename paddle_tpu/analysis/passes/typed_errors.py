@@ -1,0 +1,97 @@
+"""Typed-error pass.
+
+Every ``raise`` in the serving, distributed, and resilience trees must
+raise a *typed* error — the project hierarchy rooted at
+``framework.errors.EnforceNotMet`` (all of which remain ``RuntimeError``
+subclasses, so existing broad handlers keep working), the subsystem
+exceptions built on it (``ServerOverloaded``, ``PeerAbort``,
+``StaleGeneration``, ...), or a concrete stdlib type that callers can
+meaningfully catch (``TimeoutError``, ``ConnectionError``, ``KeyError``,
+``ValueError``, ...).
+
+What it forbids is the two catch-all shapes that turn a serving boundary
+into guesswork for the caller: ``raise Exception(...)`` and
+``raise RuntimeError(...)``. A bare ``raise`` (re-raise) is always fine.
+
+Waive a reviewed exception inline with ``# typed-ok: <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass, waived
+
+SCAN = [
+    "paddle_tpu/serving",
+    "paddle_tpu/distributed",
+    "paddle_tpu/resilience",
+]
+
+FORBIDDEN = {"Exception", "BaseException", "RuntimeError"}
+_WAIVE = "typed-ok"
+
+
+def _raised_name(exc):
+    """Name of the exception class in ``raise X(...)`` / ``raise X``."""
+    node = exc
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+@register_pass
+class TypedErrorPass:
+    name = "typed-error"
+    description = ("serving/distributed/resilience raise the typed "
+                   "hierarchy, never bare Exception/RuntimeError")
+
+    def run(self, ctx):
+        findings = []
+        for rel in ctx.py_files(SCAN):
+            sf = ctx.source(rel)
+            if sf is None:
+                continue
+            try:
+                tree = sf.tree
+            except SyntaxError as e:
+                findings.append(Finding(
+                    self.name, rel, getattr(e, "lineno", 1) or 1,
+                    "unparseable", f"unparseable ({e})", symbol=rel))
+                continue
+            func = "<module>"
+            for qual, node in _walk_with_owner(tree):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                func = qual
+                name = _raised_name(node.exc)
+                if name in FORBIDDEN:
+                    if waived(sf, node.lineno, _WAIVE):
+                        continue
+                    findings.append(Finding(
+                        self.name, rel, node.lineno, "untyped-raise",
+                        f"raise {name} in {func} — use the typed "
+                        "hierarchy (framework.errors.*, or the "
+                        "subsystem's own exceptions); see "
+                        "docs/static_analysis.md",
+                        symbol=f"{func}:{name}"))
+        return findings
+
+
+def _walk_with_owner(tree):
+    """Yield (enclosing qualname, node) for every node in the module."""
+    def rec(node, owner):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from rec(child, f"{owner}.{child.name}"
+                               if owner != "<module>" else child.name)
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, f"{owner}.{child.name}"
+                               if owner != "<module>" else child.name)
+            else:
+                yield owner, child
+                yield from rec(child, owner)
+    yield from rec(tree, "<module>")

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ...framework.errors import PreconditionNotMetError
+
 from ...core.dispatch import apply
 from ...core.tensor import Tensor
 
@@ -155,7 +157,7 @@ class DistAttr:
 def _resolve(process_mesh, shard_spec, ndim):
     pm = process_mesh or get_default_process_mesh()
     if pm is None:
-        raise RuntimeError(
+        raise PreconditionNotMetError(
             "no ProcessMesh: pass process_mesh= or enter a `with "
             "ProcessMesh(...)` scope")
     spec = list(shard_spec) if shard_spec is not None else [None] * ndim

@@ -29,6 +29,8 @@ from __future__ import annotations
 from collections import deque
 
 import jax
+
+from ...framework.errors import FatalError
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -333,7 +335,7 @@ class PipelineEngine:
                 remaining -= 1
                 progressed = True
             if not progressed:
-                raise RuntimeError(
+                raise FatalError(
                     "1F1B schedule deadlocked (internal error): "
                     f"queues={[list(q) for q in queues]}")
 

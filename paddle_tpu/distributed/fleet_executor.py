@@ -23,6 +23,7 @@ import socketserver
 import threading
 
 from .wire import read_frame_from, recv_frame, send_frame  # noqa: F401
+from ..framework.errors import FatalError
 
 __all__ = ["TaskNode", "Interceptor", "ComputeInterceptor", "Carrier",
            "MessageBus", "FleetExecutor"]
@@ -320,7 +321,7 @@ class Carrier:
             err = self._error
         if err is not None:
             exc, iid = err
-            raise RuntimeError(
+            raise FatalError(
                 f"interceptor {iid} failed: {exc!r}") from exc
         if not ok:
             raise TimeoutError(

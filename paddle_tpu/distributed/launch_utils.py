@@ -21,6 +21,8 @@ import subprocess
 import sys
 import time
 
+from ..framework.errors import FatalError
+
 __all__ = ["Trainer", "Pod", "Cluster", "find_free_ports",
            "get_cluster", "get_cluster_from_args", "start_local_trainers",
            "watch_local_trainers", "supervise_local_trainers",
@@ -152,6 +154,8 @@ def _trainer_env(cluster, pod, trainer, extra_env=None):
         "PADDLE_COORDINATOR_ADDR": eps[0],
         "JAX_PROCESS_ID": str(trainer.rank),
         "JAX_NUM_PROCESSES": str(cluster.trainers_nranks()),
+        # reference env contract for spawned trainers (launch_utils.py:470
+        # parity), not a registry flag — flag-ok: env name, not a read
         "FLAGS_selected_accelerators": ",".join(
             str(a) for a in trainer.accelerators),
     })
@@ -303,7 +307,7 @@ def supervise_local_trainers(cluster, pod, training_script,
                     journal.record("recovery_exhausted", rank=tp.rank,
                                    code=ret, restarts=restarts - 1,
                                    cause=f"exit code {ret}{hint}")
-                    raise RuntimeError(
+                    raise FatalError(
                         f"trainer rank {tp.rank} exited with code {ret} "
                         f"and the restart budget ({max_restarts}) is spent"
                         f"{hint} | recovery journal: {journal.path}")
@@ -337,7 +341,7 @@ def watch_local_trainers(procs, nranks=None, poll_interval=0.5):
                     continue
                 alive.remove(tp)
                 if ret != 0:
-                    raise RuntimeError(
+                    raise FatalError(
                         f"trainer rank {tp.rank} exited with code {ret} "
                         f"(cmd: {' '.join(tp.cmd)})"
                         f"{_flight_recorder_hint(tp.rank)}")

@@ -114,9 +114,9 @@ class _Channel:
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.listener.bind((bind_host, int(port)))
         self.listener.listen(max(8, self.world * 2))
-        self.inbox = {}
+        self.inbox = {}  # guarded-by: inbox_lock ((src, tag) -> Queue)
         self.inbox_lock = threading.Lock()
-        self.out = {}
+        self.out = {}    # guarded-by: out_lock (dst rank -> socket)
         self.out_lock = threading.Lock()
         self.closing = False
         self.aborts = {}  # src rank -> {"section", "reason", ...}
@@ -245,27 +245,35 @@ class _Channel:
 
     # -- send side ------------------------------------------------------------
     def _sock_to(self, dst, connect_timeout=None):
+        # Connect OUTSIDE out_lock: holding it across the retry loop would
+        # stall every concurrent send (to any peer) behind one slow dial.
         with self.out_lock:
             s = self.out.get(dst)
             if s is not None:
                 return s
-            host, port = self.eps[dst].rsplit(":", 1)
-            budget = _CONNECT_TIMEOUT if connect_timeout is None \
-                else connect_timeout
-            deadline = time.time() + budget
-            last = None
-            while time.time() < deadline:
+        host, port = self.eps[dst].rsplit(":", 1)
+        budget = _CONNECT_TIMEOUT if connect_timeout is None \
+            else connect_timeout
+        deadline = time.time() + budget
+        last = None
+        while time.time() < deadline:
+            try:
+                s = socket.create_connection((host, int(port)), timeout=10)
+            except OSError as e:  # peer listener may not be up yet
+                last = e
+                time.sleep(0.1)
+                continue
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self.out_lock:
+                won = self.out.setdefault(dst, s)
+            if won is not s:  # lost a connect race; keep the cached one
                 try:
-                    s = socket.create_connection((host, int(port)),
-                                                 timeout=10)
-                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    self.out[dst] = s
-                    return s
-                except OSError as e:  # peer listener may not be up yet
-                    last = e
-                    time.sleep(0.1)
-            raise ConnectionError(
-                f"p2p connect to rank {dst} ({self.eps[dst]}) failed: {last}")
+                    s.close()
+                except OSError:
+                    pass
+            return won
+        raise ConnectionError(
+            f"p2p connect to rank {dst} ({self.eps[dst]}) failed: {last}")
 
     def _drop_sock(self, dst):
         with self.out_lock:

@@ -13,6 +13,7 @@ import socketserver
 import threading
 
 from ..wire import recv_frame as _recv_frame, send_frame as _send_frame
+from ...framework.errors import ExternalError
 
 __all__ = ["PsServer", "PsClient"]
 
@@ -130,8 +131,8 @@ class PsClient:
             _send_frame(sock, req)
             resp = _recv_frame(sock)
         if not resp.get("ok"):
-            raise RuntimeError(f"ps call {req['cmd']} failed: "
-                               f"{resp.get('error')}")
+            raise ExternalError(f"ps call {req['cmd']} failed: "
+                                f"{resp.get('error')}")
         return resp
 
     # -- dense ------------------------------------------------------------

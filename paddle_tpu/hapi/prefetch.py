@@ -51,6 +51,8 @@ class InputPrefetcher:
         self._split = split_fn
         self._q = queue.Queue(maxsize=max(1, int(depth)))
         self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._staged = 0  # guarded-by: _lock (batches staged so far)
         self._thread = threading.Thread(
             target=self._run, name="fit-input-prefetch", daemon=True)
         self._thread.start()
@@ -88,6 +90,8 @@ class InputPrefetcher:
                         [self._stage(v) for v in labs])
                 timer._registry.observe(
                     "io.prefetch_stage_ms", (timer._clock() - t0) * 1e3)
+                with self._lock:
+                    self._staged += 1
                 self._put(("ok", item))
             self._put(("done", None))
         except BaseException as e:  # surfaced at get()
@@ -116,6 +120,11 @@ class InputPrefetcher:
             if kind == "done":
                 return self.DONE
             raise payload
+
+    def staged(self):
+        """Batches staged by the worker so far (tests/observability)."""
+        with self._lock:
+            return self._staged
 
     def close(self):
         """Stop the worker and drop any read-ahead (uncounted, so dropping

@@ -579,8 +579,23 @@ class StaticFunction:
         prog.ro = [t for t in ctx.captured if id(t) not in mutated_ids]
         prog.out_tree = _build_tree(out)
         prog.n_outs = len(_flatten_tensors(out, []))
-        self._programs[key] = prog
+        self._cache_program(key, prog)
         return True
+
+    def _cache_program(self, key, prog):
+        """Insert under the FLAGS_max_cached_programs bound: a
+        signature-churning caller (e.g. varying python scalars) retraces
+        forever but must not grow the cache without bound. FIFO eviction
+        — an evicted signature simply re-traces on its next call."""
+        self._programs[key] = prog
+        from ..framework.flags import get_flag
+        cap = int(get_flag("FLAGS_max_cached_programs", 64) or 0)
+        if cap > 0:
+            while len(self._programs) > cap:
+                oldest = next(iter(self._programs))
+                if oldest == key:
+                    break  # never evict the program just inserted
+                del self._programs[oldest]
 
     def _build_scan(self, prog):
         pure_fn = prog.pure_fn
@@ -651,7 +666,7 @@ class StaticFunction:
         prog.ro = [t for t in ctx.captured if id(t) not in mutated_ids]
         prog.out_tree = _build_tree(out)
         prog.n_outs = len(_flatten_tensors(out, []))
-        self._programs[key] = prog
+        self._cache_program(key, prog)
         return out
 
     # -- phase B ---------------------------------------------------------------

@@ -132,16 +132,17 @@ class MetricsRegistry:
                  sample_ring=_SAMPLE_RING):
         self._lock = threading.Lock()
         self._max_label_sets = int(max_label_sets)
-        self._counters = {}      # (name, labels_key) -> float
-        self._gauges = {}        # (name, labels_key) -> float
-        self._gauge_fns = {}     # name -> callable() -> number
-        self._histograms = {}    # name -> _Histogram
-        self._label_sets = {}    # name -> set of labels_key
-        self._dropped_label_sets = 0
-        self._samples = collections.deque(maxlen=int(sample_ring))
+        self._counters = {}      # guarded-by: _lock ((name, labels_key) -> float)
+        self._gauges = {}        # guarded-by: _lock ((name, labels_key) -> float)
+        self._gauge_fns = {}     # guarded-by: _lock (name -> callable() -> number)
+        self._histograms = {}    # guarded-by: _lock (name -> _Histogram)
+        self._label_sets = {}    # guarded-by: _lock (name -> set of labels_key)
+        self._dropped_label_sets = 0  # guarded-by: _lock
+        self._samples = collections.deque(
+            maxlen=int(sample_ring))  # guarded-by: _lock
 
     # -- label bounding --------------------------------------------------------
-    def _bound(self, name, labels_key):
+    def _bound(self, name, labels_key):  # requires-lock: _lock
         """Admit a labels_key for `name`, folding overflow past the cap.
         Caller holds the lock."""
         seen = self._label_sets.setdefault(name, set())
@@ -350,13 +351,14 @@ class MetricsExporter:
         self._directory = directory
         self._rank = rank
         self._clock = clock or time.monotonic
-        self._history = collections.deque(maxlen=int(history))
-        self._last = None
+        self._history = collections.deque(
+            maxlen=int(history))  # guarded-by: _export_lock
+        self._last = None        # guarded-by: _export_lock
         self._thread = None
         self._stop = threading.Event()
         self._export_lock = threading.Lock()
-        self.exports = 0
-        self.export_failures = 0
+        self.exports = 0           # guarded-by: _export_lock
+        self.export_failures = 0   # guarded-by: _export_lock
 
     @property
     def interval(self):
@@ -408,13 +410,15 @@ class MetricsExporter:
         if interval <= 0:
             return False
         now = self._clock() if now is None else now
-        if self._last is not None and now - self._last < interval:
-            return False
-        self._last = now
+        with self._export_lock:
+            if self._last is not None and now - self._last < interval:
+                return False
+            self._last = now
         try:
             self.export_once()
         except OSError:
-            self.export_failures += 1
+            with self._export_lock:
+                self.export_failures += 1
             self._registry.inc_counter("metrics.export_failures_total")
             return False
         return True
@@ -442,7 +446,8 @@ class MetricsExporter:
             try:
                 self.export_once()
             except OSError:
-                self.export_failures += 1
+                with self._export_lock:
+                    self.export_failures += 1
 
 
 _registry = MetricsRegistry()

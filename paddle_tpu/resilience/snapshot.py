@@ -456,13 +456,16 @@ class AsyncCheckpointer:
         self.background = bool(background)
         self._journal_obj = journal
         self._cv = threading.Condition()
-        self._queue = deque()
-        self._inflight = 0
-        self._errors = []
-        self._staging = set()  # seqs mid-commit: the orphan sweep skips them
-        self._closed = False
-        self._thread = None
-        self._seq = max([s for s, _ in list_manifests(self.root)], default=0)
+        self._queue = deque()  # guarded-by: _cv
+        self._inflight = 0     # guarded-by: _cv
+        self._errors = []      # guarded-by: _cv
+        self._staging = set()  # guarded-by: _cv (seqs mid-commit: orphan
+        #                        sweep skips them)
+        self._closed = False   # guarded-by: _cv
+        self._thread = None    # guarded-by: _cv
+        self._seq = max(
+            [s for s, _ in list_manifests(self.root)],
+            default=0)  # guarded-by: _cv
         _LIVE.add(self)
 
     # -- foreground --------------------------------------------------------
@@ -477,7 +480,9 @@ class AsyncCheckpointer:
         the commit also runs inline and raises on failure. Returns the
         manifest path this save commits (present once the commit lands)."""
         from .faults import maybe_inject
-        if self._closed:
+        with self._cv:
+            closed = self._closed
+        if closed:
             raise CheckpointCommitError(f"{self.root}: checkpointer closed")
         t0 = time.perf_counter()
         maybe_inject("ckpt.snapshot", CheckpointCommitError)
@@ -524,7 +529,7 @@ class AsyncCheckpointer:
         return self._manifest_path(seq)
 
     # -- background committer ----------------------------------------------
-    def _ensure_committer(self):
+    def _ensure_committer(self):  # requires-lock: _cv
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
                 target=self._run, name="ckpt-committer", daemon=True)
@@ -644,8 +649,9 @@ class AsyncCheckpointer:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            t = self._thread
+        if t is not None:  # join OUTSIDE _cv: the committer needs it to exit
+            t.join(timeout=5.0)
 
     # -- discovery / restore ------------------------------------------------
     def latest_manifest(self):

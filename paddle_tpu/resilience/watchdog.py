@@ -149,11 +149,12 @@ class Watchdog:
         self._recorder = recorder
         self.artifacts = artifacts
         self._interval = interval
-        self._sections = {}
+        self._sections = {}          # guarded-by: _lock
         self._lock = threading.Lock()
-        self._health_marker = None
-        self._monitor = None
-        self._stop = threading.Event()
+        self._health_marker = None   # guarded-by: _lock
+        self._monitor = None         # guarded-by: _lock
+        self._stop = threading.Event()  # guarded-by: _lock (the reference:
+        #                               _ensure_monitor re-arms it)
 
     # -- plumbing ----------------------------------------------------------
     def _now(self):
@@ -168,7 +169,8 @@ class Watchdog:
     def set_health_marker(self, fn):
         """fn(section_name) called once per expired section — e.g. write an
         `unhealthy.<rank>` key into the elastic store."""
-        self._health_marker = fn
+        with self._lock:
+            self._health_marker = fn
 
     # -- section lifecycle -------------------------------------------------
     def register(self, name, timeout=None):
@@ -211,9 +213,11 @@ class Watchdog:
         except OSError:
             pass
         self._dump_stacks(rec.rank)
-        if self._health_marker is not None:
+        with self._lock:
+            marker = self._health_marker
+        if marker is not None:
             try:
-                self._health_marker(sec.name)
+                marker(sec.name)
             except Exception:
                 pass  # diagnostics must not mask the hang itself
         # wake peers blocked on us: they get "rank N aborted in <section>"
@@ -252,12 +256,15 @@ class Watchdog:
         while True:
             interval = self._interval if self._interval is not None else \
                 float(_flag("FLAGS_watchdog_interval", 5.0))
-            if self._stop.wait(max(interval, 0.05)):
+            with self._lock:
+                stop = self._stop
+            if stop.wait(max(interval, 0.05)):
                 return
             self.poll()
 
     def stop(self):
-        self._stop.set()
+        with self._lock:
+            self._stop.set()
 
 
 _WATCHDOG = [None]

@@ -132,15 +132,17 @@ class DecodeEngine:
                                 block_size=self.config.block_size)
         self._clock = clock or time.monotonic
         self._admission = admission
-        self._streams = {}          # id -> live DecodeStream
-        self._prefill_rr = []       # ids queued for the prefill ration
-        self._ttft_ms = []
-        self._tpot_ms = []
-        self._emitted = 0
+        self._streams = {}     # guarded-by: _lock (id -> live stream)
+        self._prefill_rr = []  # guarded-by: _lock (prefill-ration queue)
+        self._ttft_ms = []     # guarded-by: _lock
+        self._tpot_ms = []     # guarded-by: _lock
+        self._emitted = 0      # guarded-by: _lock
         self._lock = threading.RLock()
         from ...profiler.metrics import get_registry
+        # the gauge fn runs on the exporter thread — go through the
+        # locked accessor, never the raw dict
         get_registry().register_gauge_fn(
-            "decode.running_count", lambda: len(self._streams))
+            "decode.running_count", lambda: self.running())
 
     # -- admission -----------------------------------------------------------
     def _retry_after(self, priority):
@@ -212,7 +214,7 @@ class DecodeEngine:
                 self._restart(now)
             return self._emitted - before
 
-    def _expire(self, now):
+    def _expire(self, now):  # requires-lock: _lock
         for stream in list(self._streams.values()):
             if stream.deadline is not None and now > stream.deadline:
                 self._evict(stream, DeadlineExceeded(
@@ -220,7 +222,7 @@ class DecodeEngine:
                     f"{len(stream.tokens)} tokens"))
 
     # -- prefill (rationed: one chunk, one stream, per step) -----------------
-    def _prefill_tick(self, now):
+    def _prefill_tick(self, now):  # requires-lock: _lock
         while self._prefill_rr:
             sid = self._prefill_rr[0]
             stream = self._streams.get(sid)
@@ -236,7 +238,7 @@ class DecodeEngine:
                 self._prefill_rr.append(self._prefill_rr.pop(0))
             return
 
-    def _prefill(self, stream, now):
+    def _prefill(self, stream, now):  # requires-lock: _lock
         """Absorb at most one ``prefill_chunk`` of this stream's pending
         tokens into the KV cache; emits the first new token when the fill
         completes (fresh join → TTFT; replay → resumed continuation)."""
@@ -260,7 +262,7 @@ class DecodeEngine:
             self._maybe_finish(stream, token)
 
     # -- decode (every running stream, every step) ---------------------------
-    def _decode_tick(self, now):
+    def _decode_tick(self, now):  # requires-lock: _lock
         runnable = [s for s in self._streams.values()
                     if not s.done and not s._fill and s.tokens]
         ready = []
@@ -285,7 +287,7 @@ class DecodeEngine:
             self._maybe_finish(stream, int(token))
 
     # -- emission & termination ----------------------------------------------
-    def _emit(self, stream, token, now):
+    def _emit(self, stream, token, now):  # requires-lock: _lock
         from ...profiler.metrics import get_registry
         stream.tokens.append(int(token))
         seq = stream.seq
@@ -316,7 +318,7 @@ class DecodeEngine:
                 self._evict(stream, exc if isinstance(exc, ConnectionError)
                             else ConnectionError(f"on_token failed: {exc}"))
 
-    def _maybe_finish(self, stream, token):
+    def _maybe_finish(self, stream, token):  # requires-lock: _lock
         if stream.done:
             return
         if len(stream.tokens) >= stream.max_new_tokens or (
@@ -324,14 +326,14 @@ class DecodeEngine:
                 and token == self.config.eos_token):
             self._finish(stream)
 
-    def _finish(self, stream):
+    def _finish(self, stream):  # requires-lock: _lock
         from ...profiler.metrics import get_registry
         self._release(stream)
         stream.done = True
         get_registry().inc_counter("decode.streams_completed_total")
         stream._done_evt.set()
 
-    def _evict(self, stream, error):
+    def _evict(self, stream, error):  # requires-lock: _lock
         """Terminate a stream with a typed error. Eviction must always
         complete — a fault injected here is recorded and swallowed."""
         from ...profiler.metrics import get_registry
@@ -349,7 +351,7 @@ class DecodeEngine:
         get_registry().inc_counter("decode.evictions_total")
         stream._done_evt.set()
 
-    def _release(self, stream):
+    def _release(self, stream):  # requires-lock: _lock
         self._streams.pop(stream.id, None)
         try:
             self.backend.release(stream)
@@ -362,7 +364,7 @@ class DecodeEngine:
             self._admission.note_done()
 
     # -- replica death -------------------------------------------------------
-    def _restart(self, now):
+    def _restart(self, now):  # requires-lock: _lock
         """The backend lost its device state. Reset it and queue every live
         stream for replay: re-prefill prompt + already-emitted tokens, after
         which a deterministic backend resumes the identical continuation."""

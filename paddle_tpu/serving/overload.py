@@ -70,7 +70,7 @@ class AdmissionController:
                  retry_after_base=None, decrease=0.7, headroom=None):
         self._target_ms = target_ms
         self.limit = float(initial if initial is not None
-                           else (max_limit or 64))
+                           else (max_limit or 64))  # guarded-by: _lock
         self.min_limit = float(min_limit)
         self.max_limit = float(max_limit) if max_limit else self.limit
         self.limit = min(self.limit, self.max_limit)
@@ -79,9 +79,9 @@ class AdmissionController:
         self._retry_after_base = retry_after_base
         self._decrease = float(decrease)
         self._headroom = tuple(headroom) if headroom else PRIORITY_HEADROOM
-        self.inflight = 0            # admitted, not yet terminated
-        self.shed = 0
-        self._last_decrease = None
+        self.inflight = 0  # guarded-by: _lock (admitted, not terminated)
+        self.shed = 0      # guarded-by: _lock
+        self._last_decrease = None  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # -- config read per call so paddle.set_flags retunes a live server ----
@@ -101,7 +101,7 @@ class AdmissionController:
         import time
         return time.monotonic()
 
-    def ceiling(self, priority):
+    def ceiling(self, priority):  # requires-lock: _lock
         """The priority class's share of the current limit."""
         p = max(0, min(int(priority), len(self._headroom) - 1))
         return self.limit * self._headroom[p]
@@ -124,6 +124,7 @@ class AdmissionController:
             ceil = self.ceiling(priority)
             if self.inflight + 1 > ceil:
                 self.shed += 1
+                in_system, limit = self.inflight, self.limit
                 hint = self.retry_after_base() * (
                     1.0 + (self.inflight + 1 - ceil) / max(ceil, 1.0)) \
                     + self.target_s() * min(
@@ -135,8 +136,8 @@ class AdmissionController:
             self._metrics.inc("shed", reason="admission")
         raise ServerOverloaded(
             f"admission limit reached for priority {priority} "
-            f"({self.inflight} in system, class ceiling {ceil:.1f} of "
-            f"limit {self.limit:.1f}); retry after {hint:.3f}s",
+            f"({in_system} in system, class ceiling {ceil:.1f} of "
+            f"limit {limit:.1f}); retry after {hint:.3f}s",
             retry_after=hint)
 
     def note_done(self):
@@ -188,10 +189,10 @@ class CircuitBreaker:
         self._failures = failures
         self._window = window
         self._cooldown = cooldown
-        self._events = collections.deque()
-        self.state = "closed"
-        self.opened_at = None
-        self.opens = 0
+        self._events = collections.deque()  # guarded-by: _lock
+        self.state = "closed"  # guarded-by: _lock
+        self.opened_at = None  # guarded-by: _lock
+        self.opens = 0         # guarded-by: _lock
         self._lock = threading.Lock()
 
     def max_failures(self):
@@ -206,7 +207,7 @@ class CircuitBreaker:
         return float(self._cooldown if self._cooldown is not None
                      else _flag("FLAGS_serving_breaker_cooldown", 10.0))
 
-    def _prune(self, now):
+    def _prune(self, now):  # requires-lock: _lock
         horizon = now - self.window()
         while self._events and self._events[0] < horizon:
             self._events.popleft()
@@ -259,8 +260,10 @@ class CircuitBreaker:
     def allows(self):
         """Normal placement allowed? (Half-open traffic goes through the
         scheduler's probe, never through ``pick``.)"""
-        return self.state == "closed"
+        with self._lock:
+            return self.state == "closed"
 
     def describe(self):
-        return {"state": self.state, "opens": self.opens,
-                "recent_failures": len(self._events)}
+        with self._lock:
+            return {"state": self.state, "opens": self.opens,
+                    "recent_failures": len(self._events)}

@@ -49,6 +49,7 @@ fakes. docs/serving.md "Live rollout" has the runbook.
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -169,22 +170,27 @@ class RolloutController:
             journal = RecoveryJournal(job_id=job_id, clock=self._clock)
         self.journal = journal
         self.watcher = ManifestWatcher(self.root)
-        self.state = self.IDLE
-        self.version = None        # incumbent manifest seq (None = launch)
-        self.prior = None          # the version before the incumbent
-        self.target = None         # seq being rolled out (while active)
-        self._target_path = None
-        self._goal_factory = None  # factory the fleet is converging to
-        self._goal_version = None
-        self._canary_idx = None
-        self._golden_ref = None    # incumbent outputs for the quality gate
-        self._capacity0 = None     # placeable replicas when the roll began
-        self._draining = {}        # replica idx -> drain start time
-        self._rejected = set()     # seqs that failed canary/roll: never retried
-        self._next_poll = None     # None = poll on the next tick
-        self._step_failures = 0
+        # serializes tick() (the pump/serve thread) against describe()/
+        # active() (stats endpoints on request threads)
+        self._lock = threading.Lock()
+        self.state = self.IDLE     # guarded-by: _lock
+        self.version = None        # guarded-by: _lock (incumbent seq;
+        #                            None = launch weights)
+        self.prior = None          # guarded-by: _lock (version before it)
+        self.target = None         # guarded-by: _lock (seq being rolled)
+        self._target_path = None   # guarded-by: _lock
+        self._goal_factory = None  # guarded-by: _lock (converging to)
+        self._goal_version = None  # guarded-by: _lock
+        self._canary_idx = None    # guarded-by: _lock
+        self._golden_ref = None    # guarded-by: _lock (quality-gate ref)
+        self._capacity0 = None     # guarded-by: _lock (placeable at start)
+        self._draining = {}        # guarded-by: _lock (idx -> drain start)
+        self._rejected = set()     # guarded-by: _lock (failed seqs)
+        self._next_poll = None     # guarded-by: _lock (None = poll now)
+        self._step_failures = 0    # guarded-by: _lock
         if resume:
-            self._resume()
+            with self._lock:
+                self._resume()
 
     def _now(self):
         if self._clock is not None:
@@ -195,15 +201,17 @@ class RolloutController:
     def active(self):
         """True while a roll (or rollback) is converging the fleet — the
         autoscaler holds resizes and ``stats()`` shows the transition."""
-        return self.state != self.IDLE
+        with self._lock:
+            return self.state != self.IDLE
 
     def describe(self):
-        return {"state": self.state, "version": self.version,
-                "prior": self.prior, "target": self.target,
-                "canary": self._canary_idx,
-                "draining": sorted(self._draining),
-                "rejected": sorted(self._rejected),
-                "step_failures": self._step_failures}
+        with self._lock:
+            return {"state": self.state, "version": self.version,
+                    "prior": self.prior, "target": self.target,
+                    "canary": self._canary_idx,
+                    "draining": sorted(self._draining),
+                    "rejected": sorted(self._rejected),
+                    "step_failures": self._step_failures}
 
     # -- the drive loop ------------------------------------------------------
     def tick(self, now=None):
@@ -212,18 +220,19 @@ class RolloutController:
         and retried, or — in CANARY, or past ``max_step_failures`` in
         ROLLING — triggers rollback. Returns the state after the round."""
         now = self._now() if now is None else now
-        try:
-            if self.state == self.IDLE:
-                self._tick_idle(now)
-            elif self.state == self.CANARY:
-                self._tick_canary(now)
-            else:
-                self._tick_roll(now)
-        except Exception as e:  # noqa: BLE001 — the serving loop survives
-            self._note_step_failure(e, now)
-        return self.state
+        with self._lock:
+            try:
+                if self.state == self.IDLE:
+                    self._tick_idle(now)
+                elif self.state == self.CANARY:
+                    self._tick_canary(now)
+                else:
+                    self._tick_roll(now)
+            except Exception as e:  # noqa: BLE001 — serving loop survives
+                self._note_step_failure(e, now)
+            return self.state
 
-    def _tick_idle(self, now):
+    def _tick_idle(self, now):  # requires-lock: _lock
         if self._next_poll is not None and now < self._next_poll:
             return
         self._next_poll = now + self.config.poll_interval
@@ -231,10 +240,10 @@ class RolloutController:
         if found is not None:
             self._start(found[0], found[1], now)
 
-    def _seq(self):
+    def _seq(self):  # requires-lock: _lock
         return self.version if self.version is not None else 0
 
-    def _start(self, seq, path, now, resumed=False):
+    def _start(self, seq, path, now, resumed=False):  # requires-lock: _lock
         self.target, self._target_path = int(seq), path
         self._canary_idx = None
         self._step_failures = 0
@@ -255,7 +264,7 @@ class RolloutController:
         self.state = self.CANARY
 
     # -- CANARY --------------------------------------------------------------
-    def _tick_canary(self, now):
+    def _tick_canary(self, now):  # requires-lock: _lock
         rep = self.scheduler.find_replica(self._canary_idx) \
             if self._canary_idx is not None else None
         if rep is None:
@@ -278,7 +287,7 @@ class RolloutController:
         self._step_failures = 0
         self.state = self.ROLLING
 
-    def _verify_canary(self, rep):
+    def _verify_canary(self, rep):  # requires-lock: _lock
         """The golden-request quality gate (fault site ``rollout.verify``):
         run every pinned golden request through the canary's executor and
         compare against the incumbent's captured outputs. Non-finite
@@ -323,7 +332,7 @@ class RolloutController:
         return [np.asarray(o)
                 for o in rep.executor.run([np.asarray(a) for a in arrays])]
 
-    def _incumbent_golden_outputs(self):
+    def _incumbent_golden_outputs(self):  # requires-lock: _lock
         if not self.goldens:
             return None
         rep = self._pick_incumbent()
@@ -331,7 +340,7 @@ class RolloutController:
             return None
         return [self._run_golden(rep, g) for g in self.goldens]
 
-    def _pick_incumbent(self):
+    def _pick_incumbent(self):  # requires-lock: _lock
         for r in self.scheduler.replicas:
             if r.placeable() and r.version == self.version:
                 return r
@@ -341,7 +350,7 @@ class RolloutController:
         return None
 
     # -- ROLLING / ROLLBACK --------------------------------------------------
-    def _tick_roll(self, now):
+    def _tick_roll(self, now):  # requires-lock: _lock
         self._finish_drains(now)
         if self.state == self.ROLLING and self._goal_unhealthy():
             self._begin_rollback(
@@ -358,7 +367,7 @@ class RolloutController:
             self._swap_one(stale[0], now)
         self._step_failures = 0
 
-    def _goal_unhealthy(self):
+    def _goal_unhealthy(self):  # requires-lock: _lock
         """Mid-roll health gate: a goal-version replica that died (its
         restart counter moved), went unhealthy, or tripped its breaker is
         evidence against the target version — roll back."""
@@ -369,7 +378,7 @@ class RolloutController:
                     return True
         return False
 
-    def _swap_one(self, old, now):
+    def _swap_one(self, old, now):  # requires-lock: _lock
         """One replica-by-replica roll step (fault site ``rollout.swap``):
         add a goal-version replica, then begin draining one stale one.
         The add lands before the drain and the autoscaler holds resizes,
@@ -385,11 +394,11 @@ class RolloutController:
         self.scheduler.begin_drain(old.idx)
         self._draining[old.idx] = now
 
-    def _placeable_count(self):
+    def _placeable_count(self):  # requires-lock: _lock
         return len([r for r in self.scheduler.replicas
                     if r.healthy and not r.draining and not r.fenced_out])
 
-    def _finish_drains(self, now):
+    def _finish_drains(self, now):  # requires-lock: _lock
         """Remove drained replicas whose in-flight work finished; past
         ``drain_timeout`` force-remove (the scheduler fences them — a late
         result is dropped and the batch retried, never delivered)."""
@@ -407,7 +416,7 @@ class RolloutController:
             removed.append(idx)
         return removed
 
-    def _finish(self, now):
+    def _finish(self, now):  # requires-lock: _lock
         if self.state == self.ROLLING:
             self.prior, self.version = self.version, self.target
             self._write_pins()
@@ -433,7 +442,7 @@ class RolloutController:
         self.state = self.IDLE
 
     # -- failure handling ----------------------------------------------------
-    def _note_step_failure(self, exc, now):
+    def _note_step_failure(self, exc, now):  # requires-lock: _lock
         self._step_failures += 1
         try:
             self.journal.record("rollout_step_failed", state=self.state,
@@ -458,7 +467,7 @@ class RolloutController:
         # ROLLBACK step failures: keep retrying — restoring incumbent
         # serving is never abandoned
 
-    def _fail_canary(self, exc, now):
+    def _fail_canary(self, exc, now):  # requires-lock: _lock
         self.journal.record("rollout_canary_failed", target=self.target,
                             replica=self._canary_idx, error=repr(exc))
         _registry().inc_counter("rollout.canary_failures_total")
@@ -473,7 +482,7 @@ class RolloutController:
                 self._draining[rep.idx] = now
         self._begin_rollback(f"canary failed: {exc}", now)
 
-    def _begin_rollback(self, reason, now):
+    def _begin_rollback(self, reason, now):  # requires-lock: _lock
         """Flip the roll into reverse: the goal becomes the incumbent
         version again, loaded from its still-pinned manifest (or the
         launch factory when the incumbent IS the launch weights). The
@@ -486,7 +495,7 @@ class RolloutController:
         self._step_failures = 0
         self.state = self.ROLLBACK
 
-    def _incumbent_factory(self):
+    def _incumbent_factory(self):  # requires-lock: _lock
         if self.version is not None:
             path = os.path.join(self.root, manifest_name(self.version))
             if os.path.exists(path):
@@ -498,7 +507,7 @@ class RolloutController:
     def _make_factory(self, path):
         return lambda idx: self._load(path, idx)
 
-    def _load(self, path, idx):
+    def _load(self, path, idx):  # requires-lock: _lock
         """Build one predictor from one exact manifest (fault site
         ``rollout.load``): an injected or real load failure is typed and
         journaled, and the replica is never half-admitted (add_replica
@@ -506,7 +515,7 @@ class RolloutController:
         maybe_inject("rollout.load", RolloutError)
         return self._loader(path, idx)
 
-    def _write_pins(self, extra=None):
+    def _write_pins(self, extra=None):  # requires-lock: _lock
         """Pin the manifests instant rollback depends on — incumbent,
         prior, and any in-flight roll target — against keep-K retention.
         Best-effort: a pin write failure must not fail the roll."""
@@ -520,7 +529,7 @@ class RolloutController:
             pass
 
     # -- resume --------------------------------------------------------------
-    def _resume(self):
+    def _resume(self):  # requires-lock: _lock
         """Re-arm from the recovery journal after a server restart: adopt
         the last completed (or rollback-restored) incumbent version, keep
         failed targets rejected, and re-enter an in-flight roll — a

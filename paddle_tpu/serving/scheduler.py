@@ -55,6 +55,7 @@ import numpy as np
 from ..resilience.faults import maybe_inject
 from ..resilience.watchdog import DistributedTimeout, Watchdog
 from ..resilience.watchdog import watch_section as _watch_section
+from ..framework.errors import PreconditionNotMetError
 from .batcher import BucketedExecutor, ServerOverloaded
 from .overload import CircuitBreaker
 
@@ -168,27 +169,27 @@ class Scheduler:
                                 else None)
         # monotonic membership generation: bumped on every add/remove so
         # resizes are fenced the way PR 4 fences re-rendezvous
-        self.generation = 1
-        self._next_idx = size
+        self.generation = 1   # guarded-by: _lock
+        self._next_idx = size  # guarded-by: _lock
         # warmup signatures seen so far — replayed on restart / scale-up so
         # a (re)joining replica never pays bucket compiles on live traffic
-        self._warmup = []
+        self._warmup = []  # guarded-by: _lock
         # round-robin cursor: breaks (inflight, ...) ties so equal-load
         # traffic rotates instead of pinning to low indices
-        self._rr = 0
+        self._rr = 0  # guarded-by: _lock
         # hedge accounting: budget = hedges / dispatches
-        self._dispatches = 0
-        self._hedges = 0
+        self._dispatches = 0  # guarded-by: _lock
+        self._hedges = 0      # guarded-by: _lock
         # current-version loader (set by the rollout controller): when set,
         # restart_dead and default add_replica builds go through it instead
         # of the launch-time factory, so a replica rebuilt mid- or
         # post-rollout never resurrects stale weights
-        self._current_factory = None
-        self._current_version = None
+        self._current_factory = None  # guarded-by: _lock
+        self._current_version = None  # guarded-by: _lock
         self.replicas = [Replica(i, predictor_factory(i),
                                  max_cached=max_cached,
                                  breaker=self._breaker_factory())
-                         for i in range(size)]
+                         for i in range(size)]  # guarded-by: _lock
 
     def _now(self):
         if self._clock is not None:
@@ -381,9 +382,11 @@ class Scheduler:
             # result belongs to a dead membership generation — drop it
             if self._metrics:
                 self._metrics.inc("late_drops")
+            with self._lock:
+                gen = self.generation
             raise ReplicaRetired(
                 f"replica {rep.idx} was removed (generation "
-                f"{self.generation}) while batch#{batch.id} ran; "
+                f"{gen}) while batch#{batch.id} ran; "
                 "late result dropped, not delivered")
         rep.breaker.record_success(self._now())
         with self._lock:
@@ -565,7 +568,7 @@ class Scheduler:
             return None
         with self._lock:
             if rep.inflight > 0 and not force:
-                raise RuntimeError(
+                raise PreconditionNotMetError(
                     f"replica {idx} still has {rep.inflight} batch(es) in "
                     "flight; drain first or pass force=True")
             rep.fenced_out = True

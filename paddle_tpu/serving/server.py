@@ -39,6 +39,7 @@ import threading
 
 import numpy as np
 
+from ..framework.errors import FatalError, PreconditionNotMetError
 from ..resilience.faults import maybe_inject
 from ..resilience.recorder import FlightRecorder
 from ..resilience.watchdog import DistributedTimeout
@@ -245,11 +246,11 @@ class InferenceServer:
             if request.done():
                 return
             if self.pump(1) == 0 and not request.done():
-                raise RuntimeError(
+                raise FatalError(
                     f"request {request.id} not completed but queue is empty "
                     "(lost request — this is a server bug)")
-        raise RuntimeError(f"request {request.id} still pending after "
-                           f"{max_batches} batches")
+        raise FatalError(f"request {request.id} still pending after "
+                         f"{max_batches} batches")
 
     def _run_batch(self, batch):
         """Dispatch one batch with bounded retries; every request terminates.
@@ -365,8 +366,9 @@ class InferenceServer:
         call ``stream.wait()`` for termination. Raises
         :class:`ServerOverloaded` (with ``retry_after``) when shedding."""
         if self._decode is None:
-            raise RuntimeError("no decode engine: call attach_decode() "
-                               "before submit_generate()")
+            raise PreconditionNotMetError(
+                "no decode engine: call attach_decode() before "
+                "submit_generate()")
         if timeout is None:
             timeout = self.config.default_deadline
         return self._decode.join(prompt, max_new_tokens=max_new_tokens,
@@ -437,8 +439,9 @@ class InferenceServer:
         """Spawn the batching worker (real-clock servers only — deterministic
         fake-clock instances are pump-driven by design)."""
         if self._clock is not None:
-            raise RuntimeError("fake-clock server is pump-driven; call "
-                               "pump() instead of start()")
+            raise PreconditionNotMetError(
+                "fake-clock server is pump-driven; call pump() instead "
+                "of start()")
         if self._worker is not None and self._worker.is_alive():
             return self
         self._stop.clear()

@@ -39,3 +39,20 @@ def _seed():
     import paddle_tpu
     paddle_tpu.seed(1234)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _lock_order(request):
+    """Chaos tests run under the runtime lock-order tracker: every lock
+    created during the test is wrapped, per-thread acquisition order is
+    recorded, and a cyclic order (ABBA) fails the test deterministically
+    — no contention or sleeps needed (docs/static_analysis.md)."""
+    if request.node.get_closest_marker("chaos") is None:
+        yield
+        return
+    from paddle_tpu.analysis import lockorder
+    with lockorder.tracking(mode="record") as tracker:
+        yield
+    assert not tracker.violations, (
+        "lock-order inversion(s) recorded during chaos test:\n" +
+        "\n".join(v.args[0] for v in tracker.violations))

@@ -1,0 +1,613 @@
+"""The paddle-lint analysis framework's own test suite.
+
+Three layers, mirroring docs/static_analysis.md:
+
+- **framework**: Finding identity/formatting, waiver baseline round-trip,
+  the overlay/restrict mechanics every other test here leans on.
+- **per-pass fixtures**: each pass gets a known-bad overlay that must
+  fire and a known-good twin that must stay silent — including the
+  waiver markers, so a typo'd marker can't silently stop waiving.
+- **mutation tests**: overlay a *real* tree file with one protective
+  line removed (a lock annotation, a typed raise, a flag registration, a
+  subprocess timeout) and assert the pass catches exactly that. This is
+  the proof that the clean `tools/lint.py` run is load-bearing and not
+  vacuous.
+
+Plus the runtime lock-order tracker: a seeded ABBA inversion must be
+detected deterministically — no contention, no sleeps.
+
+Everything runs in-process via ``load_analysis`` (the ``_paddle_lint``
+alias), so none of these tests import paddle_tpu or jax.
+"""
+import json
+import sys
+import textwrap
+import threading
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+sys.path.insert(0, str(REPO / "tools"))
+try:
+    from lint import load_analysis
+finally:
+    sys.path.pop(0)
+
+analysis = load_analysis(str(REPO))
+
+# Built at runtime so the flag-hygiene pass (which scans tests/ for
+# FLAGS_* string literals) does not see these fixture-only names as
+# unregistered reads in THIS file.
+BOGUS_FLAG = "FLAGS" + "_lint_selftest_bogus"
+KNOB_FLAG = "FLAGS" + "_lint_selftest_knob"
+
+
+def _ctx(overlay, restrict=None):
+    return analysis.AnalysisContext(
+        str(REPO), overlay=overlay,
+        restrict=set(restrict if restrict is not None else overlay))
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# framework: Finding, waivers, context mechanics
+# ---------------------------------------------------------------------------
+
+def test_finding_identity_and_formatting():
+    f = analysis.Finding("typed-error", "paddle_tpu/x.py", 12,
+                         "untyped-raise", "raise RuntimeError in f",
+                         symbol="f:RuntimeError")
+    # identity is line-number free so it survives drift
+    assert f.ident() == \
+        "typed-error:paddle_tpu/x.py:untyped-raise:f:RuntimeError"
+    assert f.format() == \
+        "paddle_tpu/x.py:12: [typed-error/untyped-raise] " \
+        "raise RuntimeError in f"
+    d = f.to_dict()
+    assert d["line"] == 12 and d["ident"] == f.ident()
+
+
+def test_waiver_baseline_round_trip(tmp_path):
+    # missing file => empty baseline (the shipped state)
+    assert analysis.load_waivers(str(tmp_path)) == {}
+    f1 = analysis.Finding("p", "a.py", 1, "c", "m", symbol="s1")
+    f2 = analysis.Finding("p", "a.py", 2, "c", "m", symbol="s2")
+    (tmp_path / analysis.WAIVERS_FILE).write_text(json.dumps(
+        {"waivers": [{"ident": f1.ident(), "reason": "bulk migration"}]}))
+    waivers = analysis.load_waivers(str(tmp_path))
+    new, waived = analysis.split_waived([f1, f2], waivers)
+    assert [f.symbol for f in new] == ["s2"]
+    assert [f.symbol for f in waived] == ["s1"]
+
+
+def test_waiver_baseline_rejects_malformed(tmp_path):
+    (tmp_path / analysis.WAIVERS_FILE).write_text(
+        json.dumps({"waivers": ["p:a.py:c:s"]}))  # strings, not dicts
+    with pytest.raises(ValueError):
+        analysis.load_waivers(str(tmp_path))
+
+
+def test_overlay_and_restrict_mechanics():
+    rel = "paddle_tpu/serving/_fx_overlay.py"
+    ctx = _ctx({rel: "x = 1\n"})
+    assert ctx.source(rel).text == "x = 1\n"
+    assert rel in ctx.py_files(["paddle_tpu/serving"])
+    # restrict filters reported findings down to the fixture file
+    inside = analysis.Finding("p", rel, 1, "c", "m")
+    outside = analysis.Finding("p", "paddle_tpu/other.py", 1, "c", "m")
+    assert ctx.reported([inside, outside]) == [inside]
+
+
+def test_registry_has_all_six_passes():
+    assert set(analysis.all_passes()) == {
+        "lock-discipline", "blocking-call", "typed-error",
+        "flag-hygiene", "injection-points", "metric-names"}
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline: fixtures
+# ---------------------------------------------------------------------------
+
+_LOCK_FIXTURE_BAD = textwrap.dedent("""\
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = []   # guarded-by: _lock
+
+        def add(self, x):
+            with self._lock:
+                self.items.append(x)
+
+        def size(self):
+            return len(self.items)
+    """)
+
+
+def test_lock_discipline_flags_unguarded_access():
+    rel = "paddle_tpu/serving/_fx_lock.py"
+    found = analysis.run_pass("lock-discipline",
+                              _ctx({rel: _LOCK_FIXTURE_BAD}))
+    assert _codes(found) == ["unguarded"]
+    assert found[0].symbol == "Box.size:items"
+
+
+def test_lock_discipline_accepts_guarded_twin():
+    good = _LOCK_FIXTURE_BAD.replace(
+        "    def size(self):\n        return len(self.items)\n",
+        "    def size(self):\n        with self._lock:\n"
+        "            return len(self.items)\n")
+    assert good != _LOCK_FIXTURE_BAD
+    rel = "paddle_tpu/serving/_fx_lock.py"
+    assert analysis.run_pass("lock-discipline", _ctx({rel: good})) == []
+
+
+def test_lock_discipline_honors_annotations_and_waiver():
+    src = textwrap.dedent("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0   # guarded-by: _lock
+
+            def _bump(self):  # requires-lock: _lock
+                self.n += 1
+
+            def _drain_locked(self):
+                self.n = 0
+
+            def peek(self):
+                return self.n   # unguarded-ok: racy read for logging
+        """)
+    rel = "paddle_tpu/serving/_fx_lock2.py"
+    assert analysis.run_pass("lock-discipline", _ctx({rel: src})) == []
+
+
+def test_lock_discipline_checks_lambda_defined_in_init():
+    src = textwrap.dedent("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0   # guarded-by: _lock
+                self.m = 1   # plain init write: exempt
+                self.gauge = lambda: self.n
+        """)
+    rel = "paddle_tpu/serving/_fx_lock3.py"
+    found = analysis.run_pass("lock-discipline", _ctx({rel: src}))
+    # only the lambda (it outlives construction), not the init writes
+    assert _codes(found) == ["unguarded"]
+    assert found[0].symbol.endswith(":n")
+
+
+def test_lock_discipline_reports_unknown_lock():
+    src = textwrap.dedent("""\
+        class Box:
+            def __init__(self):
+                self.n = 0   # guarded-by: _missing_lock
+        """)
+    rel = "paddle_tpu/serving/_fx_lock4.py"
+    found = analysis.run_pass("lock-discipline", _ctx({rel: src}))
+    assert "unknown-lock" in _codes(found)
+
+
+# ---------------------------------------------------------------------------
+# typed-error: fixtures
+# ---------------------------------------------------------------------------
+
+def test_typed_error_flags_runtime_error_and_accepts_typed():
+    bad = "def f():\n    raise RuntimeError('boom')\n"
+    rel = "paddle_tpu/serving/_fx_typed.py"
+    found = analysis.run_pass("typed-error", _ctx({rel: bad}))
+    assert _codes(found) == ["untyped-raise"]
+    assert found[0].symbol == "f:RuntimeError"
+
+    good = textwrap.dedent("""\
+        from ..framework.errors import FatalError
+
+        def f(x):
+            if x is None:
+                raise ValueError('x required')
+            try:
+                return 1 / x
+            except ZeroDivisionError:
+                raise          # bare re-raise is always fine
+            raise FatalError('unreachable')
+
+        def legacy():
+            raise RuntimeError('cli contract')  # typed-ok: legacy CLI
+        """)
+    assert analysis.run_pass("typed-error", _ctx({rel: good})) == []
+
+
+def test_typed_error_only_scans_contracted_trees():
+    # the same bad raise OUTSIDE serving/distributed/resilience is fine
+    bad = "def f():\n    raise RuntimeError('boom')\n"
+    rel = "paddle_tpu/hapi/_fx_typed.py"
+    assert analysis.run_pass("typed-error", _ctx({rel: bad})) == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-call: fixtures
+# ---------------------------------------------------------------------------
+
+def test_blocking_call_flags_sleeps_and_waits_in_tests():
+    src = textwrap.dedent("""\
+        import queue
+        import subprocess
+        import time
+
+        def test_x():
+            time.sleep(0.5)
+            q = queue.Queue()
+            q.get()
+            subprocess.run(['true'])
+        """)
+    rel = "tests/_fx_blocking.py"
+    found = analysis.run_pass("blocking-call", _ctx({rel: src}))
+    assert _codes(found) == \
+        ["sleep", "subprocess-no-timeout", "untimeouted-wait"]
+
+
+def test_blocking_call_accepts_bounded_twin():
+    src = textwrap.dedent("""\
+        import queue
+        import subprocess
+        import time
+
+        def test_x():
+            time.sleep(0.01)   # blocking-ok: negative check interval
+            q = queue.Queue()
+            q.get(timeout=5)
+            subprocess.run(['true'], timeout=30)
+        """)
+    rel = "tests/_fx_blocking.py"
+    assert analysis.run_pass("blocking-call", _ctx({rel: src})) == []
+
+
+def test_blocking_call_flags_sleep_inside_lock_scope():
+    src = textwrap.dedent("""\
+        import threading
+        import time
+
+        _LOCK = threading.Lock()
+
+        def refresh():
+            with _LOCK:
+                time.sleep(0.1)
+        """)
+    rel = "paddle_tpu/serving/_fx_blocking.py"
+    found = analysis.run_pass("blocking-call", _ctx({rel: src}))
+    assert _codes(found) == ["sleep"]
+    assert "lock scope" in found[0].message
+
+
+def test_blocking_call_exempts_canonical_cv_wait():
+    src = textwrap.dedent("""\
+        class Box:
+            def drain(self):
+                with self._cv:
+                    self._cv.wait()      # canonical: wait releases _cv
+                with self._cv:
+                    self._done.wait()    # a DIFFERENT primitive: flagged
+        """)
+    rel = "paddle_tpu/serving/_fx_cv.py"
+    found = analysis.run_pass("blocking-call", _ctx({rel: src}))
+    assert _codes(found) == ["untimeouted-wait"]
+    assert found[0].line == 6
+
+
+def test_blocking_call_bans_subprocess_on_hot_path():
+    # HOT_PATHS is keyed by real rels: overlay the scheduler with a stub
+    # whose dispatch shells out — timeout or not, the hot path bans it.
+    src = textwrap.dedent("""\
+        import subprocess
+
+        class Scheduler:
+            def dispatch(self, req):
+                subprocess.run(['true'], timeout=1)
+        """)
+    rel = "paddle_tpu/serving/scheduler.py"
+    found = analysis.run_pass("blocking-call", _ctx({rel: src}))
+    assert _codes(found) == ["subprocess"]
+    assert "hot path" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# flag-hygiene: fixtures
+# ---------------------------------------------------------------------------
+
+def test_flag_hygiene_flags_unregistered_read():
+    src = f'x = get_flag("{BOGUS_FLAG}", 1)\n'
+    rel = "paddle_tpu/serving/_fx_flags.py"
+    found = analysis.run_pass("flag-hygiene", _ctx({rel: src}))
+    assert _codes(found) == ["read-unregistered"]
+    assert found[0].symbol == BOGUS_FLAG
+
+
+def test_flag_hygiene_honors_inline_waiver():
+    src = f'ENV = "{BOGUS_FLAG}"  # flag-ok: env contract, not a read\n'
+    rel = "paddle_tpu/serving/_fx_flags.py"
+    assert analysis.run_pass("flag-hygiene", _ctx({rel: src})) == []
+
+
+def test_flag_hygiene_registered_unread_and_docs_round_trip():
+    flags_rel = "paddle_tpu/framework/flags.py"
+    real = (REPO / flags_rel).read_text()
+    anchor = '    "FLAGS_max_cached_programs": 64,\n'
+    assert anchor in real
+    with_knob = real.replace(
+        anchor, anchor + f'    "{KNOB_FLAG}": 1,\n')
+    # registered but never read and never documented: two findings
+    found = analysis.run_pass(
+        "flag-hygiene", _ctx({flags_rel: with_knob}, restrict={flags_rel}))
+    mine = [f for f in found if f.symbol == KNOB_FLAG]
+    assert _codes(mine) == ["registered-unread", "undocumented"]
+    # a docs overlay row cures 'undocumented' but not 'registered-unread'
+    found = analysis.run_pass("flag-hygiene", _ctx(
+        {flags_rel: with_knob,
+         "docs/_fx_flags.md": f"| `{KNOB_FLAG}` | `1` | fixture |\n"},
+        restrict={flags_rel}))
+    mine = [f for f in found if f.symbol == KNOB_FLAG]
+    assert _codes(mine) == ["registered-unread"]
+
+
+# ---------------------------------------------------------------------------
+# mutation tests: remove one protective line from a REAL file, the pass
+# must fire. These prove the clean tree run is not vacuous.
+# ---------------------------------------------------------------------------
+
+def test_mutation_removing_lock_annotations_trips_unseeded():
+    rel = "paddle_tpu/hapi/prefetch.py"
+    real = (REPO / rel).read_text()
+    assert analysis.run_pass("lock-discipline",
+                             _ctx({}, restrict={rel})) == []
+    mutated = real.replace("guarded-by:", "guarded by ")
+    assert mutated != real
+    found = analysis.run_pass("lock-discipline", _ctx({rel: mutated}))
+    assert "unseeded" in _codes(found)
+
+
+def test_mutation_removing_requires_lock_trips_unguarded():
+    rel = "paddle_tpu/profiler/metrics.py"
+    real = (REPO / rel).read_text()
+    assert analysis.run_pass("lock-discipline",
+                             _ctx({}, restrict={rel})) == []
+    mutated = real.replace("requires-lock:", "requires nothing ")
+    assert mutated != real
+    found = analysis.run_pass("lock-discipline", _ctx({rel: mutated}))
+    assert "unguarded" in _codes(found)
+
+
+def test_mutation_untyping_a_raise_trips_typed_error():
+    rel = "paddle_tpu/serving/server.py"
+    real = (REPO / rel).read_text()
+    assert analysis.run_pass("typed-error", _ctx({}, restrict={rel})) == []
+    mutated = real.replace("raise FatalError(", "raise RuntimeError(")
+    assert mutated != real
+    found = analysis.run_pass("typed-error", _ctx({rel: mutated}))
+    assert found and all(f.code == "untyped-raise" for f in found)
+
+
+def test_mutation_dropping_subprocess_timeout_trips_blocking():
+    rel = "tests/test_lints.py"
+    real = (REPO / rel).read_text()
+    assert analysis.run_pass("blocking-call",
+                             _ctx({}, restrict={rel})) == []
+    mutated = real.replace(", timeout=120", "")
+    assert mutated != real
+    found = analysis.run_pass("blocking-call", _ctx({rel: mutated}))
+    assert "subprocess-no-timeout" in _codes(found)
+
+
+def test_mutation_deleting_flag_registration_trips_hygiene():
+    flags_rel = "paddle_tpu/framework/flags.py"
+    consumer = "paddle_tpu/jit/to_static.py"
+    real = (REPO / flags_rel).read_text()
+    mutated = real.replace('    "FLAGS_max_cached_programs": 64,\n', "")
+    assert mutated != real
+    found = analysis.run_pass(
+        "flag-hygiene", _ctx({flags_rel: mutated}, restrict={consumer}))
+    assert "read-unregistered" in _codes(found)
+    assert any(f.symbol == "FLAGS_max_cached_programs" for f in found)
+
+
+def test_metric_names_flags_bad_mints_and_accepts_conforming():
+    src = textwrap.dedent("""\
+        from .metrics import get_registry
+
+        def record():
+            get_registry().inc_counter("bogus_subsystem.thing_total", 1)
+            get_registry().inc_counter("serving.thing", 1)
+        """)
+    rel = "paddle_tpu/profiler/_fx_metric.py"
+    found = analysis.run_pass("metric-names", _ctx({rel: src}))
+    assert _codes(found) == ["bad-name", "unregistered-subsystem"]
+
+    good = src.replace('"bogus_subsystem.thing_total"',
+                       '"serving.thing_total"') \
+              .replace('"serving.thing"', '"serving.other_total"')
+    assert analysis.run_pass("metric-names", _ctx({rel: good})) == []
+
+
+def test_mutation_removing_injection_hook_trips_pass():
+    rel = "paddle_tpu/distributed/wire.py"
+    real = (REPO / rel).read_text()
+    assert analysis.run_pass("injection-points",
+                             _ctx({}, restrict={rel})) == []
+    mutated = real.replace("maybe_inject(", "_noop(")
+    assert mutated != real
+    found = analysis.run_pass("injection-points", _ctx({rel: mutated}))
+    assert found, "de-hooked wire.py must fail the injection pass"
+
+
+# ---------------------------------------------------------------------------
+# shim parity: the legacy CLIs report through the same passes
+# ---------------------------------------------------------------------------
+
+def test_legacy_shims_agree_with_framework():
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import check_injection_points
+        import check_metric_names
+    finally:
+        sys.path.pop(0)
+    assert check_injection_points.check(str(REPO)) == []
+    problems, checked = check_metric_names.check(str(REPO))
+    assert problems == []
+    assert checked > 0
+
+
+# ---------------------------------------------------------------------------
+# CLI: --root on a synthetic tree
+# ---------------------------------------------------------------------------
+
+def test_lint_cli_exit_codes_on_synthetic_tree(tmp_path):
+    import subprocess
+    (tmp_path / "tests").mkdir()
+    bad = tmp_path / "tests" / "test_bad.py"
+    bad.write_text("import subprocess\n\n"
+                   "def test_x():\n"
+                   "    subprocess.run(['true'])\n")
+    argv = [sys.executable, str(REPO / "tools" / "lint.py"),
+            "--root", str(tmp_path), "--pass", "blocking-call"]
+    r = subprocess.run(argv, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "subprocess-no-timeout" in r.stdout
+    bad.write_text("import subprocess\n\n"
+                   "def test_x():\n"
+                   "    subprocess.run(['true'], timeout=5)\n")
+    r = subprocess.run(argv, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "paddle-lint OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order tracker
+# ---------------------------------------------------------------------------
+
+def _lockorder():
+    # submodule of the aliased package: still no paddle_tpu/jax import
+    import importlib
+    return importlib.import_module("_paddle_lint.lockorder")
+
+
+def test_lockorder_detects_abba_deterministically():
+    """Thread 1 takes A then B and EXITS; only then does the main thread
+    take B then A. The threads never contend — a real deadlock is
+    impossible here — yet the inversion is still reported, because the
+    tracker flags the cyclic *order* at acquire time, not a hang."""
+    lockorder = _lockorder()
+    with lockorder.tracking(mode="raise") as tracker:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def a_then_b():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=a_then_b)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        with b:
+            with pytest.raises(lockorder.LockOrderViolation) as exc:
+                with a:
+                    pass
+        assert "deadlock potential" in str(exc.value)
+        assert len(tracker.violations) == 1
+    # factories restored on exit
+    assert threading.Lock is lockorder._real_lock
+    assert threading.RLock is lockorder._real_rlock
+
+
+def test_lockorder_record_mode_collects_without_raising():
+    lockorder = _lockorder()
+    with lockorder.tracking(mode="record") as tracker:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def a_then_b():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=a_then_b)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        with b:
+            with a:       # recorded, not raised
+                pass
+        assert len(tracker.violations) == 1
+        assert isinstance(tracker.violations[0],
+                          lockorder.LockOrderViolation)
+
+
+def test_lockorder_consistent_order_and_rlock_reentry_are_clean():
+    lockorder = _lockorder()
+    with lockorder.tracking() as tracker:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def a_then_b():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=a_then_b)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        a_then_b()        # same order from a second thread: fine
+        r = threading.RLock()
+        with r:
+            with r:       # re-entry adds no edge
+                pass
+        assert tracker.violations == []
+
+
+def test_lockorder_condition_over_tracked_lock():
+    """Condition(wrapped Lock) round-trips _release_save /
+    _acquire_restore, so the held-set stays accurate across wait()."""
+    lockorder = _lockorder()
+    with lockorder.tracking() as tracker:
+        cv = threading.Condition(threading.Lock())
+        done = []
+
+        def waiter():
+            with cv:
+                while not done:
+                    cv.wait(timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cv:
+            done.append(1)
+            cv.notify()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        # the wait dropped cv from the held set: a later inner lock
+        # acquisition must not see a phantom cv->X edge
+        inner = threading.Lock()
+        with inner:
+            pass
+        assert tracker.violations == []
+
+
+def test_lockorder_nested_enable_rejected_and_disable_idempotent():
+    lockorder = _lockorder()
+    with lockorder.tracking():
+        with pytest.raises(RuntimeError):
+            lockorder.enable()
+    lockorder.disable()   # already disabled by the context: no-op
+    assert threading.Lock is lockorder._real_lock

@@ -124,14 +124,14 @@ class TestCLI:
         new.write_text(json.dumps({"n": 2, "parsed": _doc(value=90.0)}))
         p = subprocess.run(
             [sys.executable, str(REPO / "tools/check_bench_regression.py"),
-             str(old), str(new)], capture_output=True, text=True)
+             str(old), str(new)], capture_output=True, text=True, timeout=120)
         assert p.returncode == 1
         report = json.loads(p.stdout)
         assert report["status"] == "fail"
         new.write_text(json.dumps({"n": 2, "parsed": _doc(value=101.0)}))
         p = subprocess.run(
             [sys.executable, str(REPO / "tools/check_bench_regression.py"),
-             str(old), str(new)], capture_output=True, text=True)
+             str(old), str(new)], capture_output=True, text=True, timeout=120)
         assert p.returncode == 0
 
     def test_explicit_mode_ignores_cwd_waiver_file(self, tmp_path):
@@ -149,7 +149,7 @@ class TestCLI:
         p = subprocess.run(
             [sys.executable, str(REPO / "tools/check_bench_regression.py"),
              str(old), str(new)],
-            capture_output=True, text=True, cwd=tmp_path)
+            capture_output=True, text=True, timeout=120, cwd=tmp_path)
         assert p.returncode == 1, p.stdout
         assert json.loads(p.stdout)["status"] == "fail"
 
@@ -165,7 +165,7 @@ class TestCLI:
         p = subprocess.run(
             [sys.executable, str(REPO / "tools/check_bench_regression.py"),
              str(old), str(new), "--waivers", str(wf)],
-            capture_output=True, text=True)
+            capture_output=True, text=True, timeout=120)
         assert p.returncode == 0, p.stdout
         report = json.loads(p.stdout)
         assert report["waived"] and report["regressions"] == []

@@ -433,6 +433,8 @@ class _SeqDS:
     def __getitem__(self, i):
         if self.delay_s:
             import time
+            # the slow-dataset stand-in proving prefetch overlap:
+            # blocking-ok: the delay IS the fixture
             time.sleep(self.delay_s)
         rng = np.random.RandomState(i)
         return (rng.randn(self.din).astype("float32"),

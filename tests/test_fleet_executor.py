@@ -47,6 +47,8 @@ class TestFleetExecutor:
             return x
 
         def consume(x):
+            # proves the bounded-buffer backpressure:
+            # blocking-ok: the slow consumer IS the fixture
             time.sleep(0.02)
             inflight["cur"] -= 1
             return x

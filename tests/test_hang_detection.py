@@ -404,7 +404,7 @@ class TestP2PTransport:
         # bounded wait until the recv has parked on its queue
         deadline = time.monotonic() + 5
         while not chan.inbox and time.monotonic() < deadline:
-            time.sleep(0.01)
+            time.sleep(0.01)  # blocking-ok: poll interval, deadline above
         return th, out
 
     def test_roundtrip(self, chan_pair):

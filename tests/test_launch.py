@@ -203,8 +203,9 @@ class TestElastic:
         m1.register()
         assert m0.poll() in ("ok", ElasticStatus.RESTART)
         m0.poll()
-        # node 1 dies (stops heartbeating) → lease expires
-        time.sleep(0.8)
+        # node 1 dies (stops heartbeating) → lease expires (wall-clock
+        # TTL, so a real bounded wait is the only way to observe it)
+        time.sleep(0.8)  # blocking-ok: lease TTL expiry is wall-clock
         m0.heartbeat()
         assert m0.np() == 1
         assert m0.poll() == ElasticStatus.RESTART

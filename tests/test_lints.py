@@ -16,6 +16,47 @@ def _run(*argv):
                           capture_output=True, text=True, timeout=120)
 
 
+LINT_PASSES = ("lock-discipline", "blocking-call", "typed-error",
+               "flag-hygiene", "injection-points", "metric-names")
+
+
+def test_paddle_lint_clean():
+    """The tier-1 gate (docs/static_analysis.md): the full paddle-lint
+    run — all six passes over the whole tree — must be clean with the
+    shipped (empty) waiver baseline."""
+    r = _run(REPO / "tools" / "lint.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "paddle-lint OK" in r.stdout
+    for name in LINT_PASSES:
+        assert f"{name}: 0 finding(s)" in r.stdout, r.stdout
+
+
+def test_paddle_lint_json_clean():
+    import json
+    r = _run(REPO / "tools" / "lint.py", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["findings"] == []
+    assert set(report["passes"]) == set(LINT_PASSES)
+
+
+def test_paddle_lint_changed_smoke():
+    """--changed restricts reporting to git-dirty files (the fast
+    pre-push hook); a dirty-but-clean tree must still exit 0."""
+    r = _run(REPO / "tools" / "lint.py", "--changed")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_paddle_lint_pass_selection():
+    r = _run(REPO / "tools" / "lint.py", "--list")
+    assert r.returncode == 0, r.stdout + r.stderr
+    for name in LINT_PASSES:
+        assert name in r.stdout
+    r = _run(REPO / "tools" / "lint.py", "--pass", "no-such-pass")
+    assert r.returncode == 2
+    assert "unknown pass" in r.stderr
+
+
 def test_fault_injection_lint_passes_on_tree():
     r = _run(REPO / "tools" / "check_injection_points.py")
     assert r.returncode == 0, r.stdout + r.stderr

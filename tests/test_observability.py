@@ -85,7 +85,8 @@ def test_registry_concurrent_writers():
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        t.join(timeout=30)
+        assert not t.is_alive()
     total = n_threads * n_iter
     assert reg.counter_value("io.batches_total") == float(total)
     assert reg.histogram_summary("io.worker_fetch_ms")["count"] == total
@@ -249,6 +250,8 @@ def test_profiler_scheduler_validation():
 def test_profiler_step_instants_and_samples_gauge():
     with profiler.Profiler(timer_only=True) as prof:
         prof.step(num_samples=32)
+        # samples/sec uses the real clock, so a nonzero gap between
+        # steps is the quantity under test — blocking-ok: real-clock rate
         time.sleep(0.001)
         prof.step(num_samples=32)
     rate = pmetrics.get_registry().gauge_value("profiler.samples_per_sec")

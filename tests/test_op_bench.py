@@ -57,7 +57,7 @@ def test_cli_small_run_and_check(tmp_path):
         [sys.executable, str(REPO / "tools/op_bench.py"), "--small",
          "--dtypes", "f32", "--iters", "1", "--inner", "1",
          "--filter", "fused_ffn", "--out", str(out)],
-        capture_output=True, text=True)
+        capture_output=True, text=True, timeout=240)
     assert p.returncode == 0, p.stderr[-2000:]
     doc = json.loads(out.read_text())
     assert len(doc["ops"]) == 2  # fwd + fwd_bwd
@@ -74,7 +74,7 @@ def test_cli_small_run_and_check(tmp_path):
          "--dtypes", "f32", "--iters", "1", "--inner", "1",
          "--filter", "fused_ffn", "--out", str(out),
          "--check-against", str(old)],
-        capture_output=True, text=True)
+        capture_output=True, text=True, timeout=240)
     assert p.returncode == 1
     report = json.loads(p.stdout.strip().splitlines()[-1])
     assert report["status"] == "fail" and report["regressions"]
@@ -85,7 +85,7 @@ class TestOpbenchDiff:
     def _run(self, *argv):
         return subprocess.run(
             [sys.executable, str(REPO / "tools/opbench_diff.py"), *map(str, argv)],
-            capture_output=True, text=True)
+            capture_output=True, text=True, timeout=240)
 
     def test_checked_in_artifact_passes(self):
         # acceptance: under auto, no measured-slower path is dispatched in
@@ -121,7 +121,7 @@ class TestOpbenchDiff:
                "FLAGS_fusion_policy": "always", "JAX_PLATFORMS": "cpu"}
         p = subprocess.run(
             [sys.executable, str(REPO / "tools/opbench_diff.py"), str(legacy)],
-            capture_output=True, text=True, env=env)
+            capture_output=True, text=True, timeout=240, env=env)
         assert p.returncode == 1
         assert json.loads(p.stdout)["policy_failures"]
 
@@ -144,7 +144,7 @@ def test_cli_smoke_mode_records_policy(tmp_path):
     p = subprocess.run(
         [sys.executable, str(REPO / "tools/op_bench.py"), "--smoke",
          "--dtypes", "f32", "--filter", "fused_ffn", "--out", str(out)],
-        capture_output=True, text=True)
+        capture_output=True, text=True, timeout=240)
     assert p.returncode == 0, p.stderr[-2000:]
     doc = json.loads(out.read_text())
     assert doc["smoke"] is True
@@ -155,5 +155,5 @@ def test_cli_smoke_mode_records_policy(tmp_path):
         assert row["effective_speedup"] >= 1.0  # auto never picks a loser
     p = subprocess.run(
         [sys.executable, str(REPO / "tools/opbench_diff.py"), str(out)],
-        capture_output=True, text=True)
+        capture_output=True, text=True, timeout=240)
     assert p.returncode == 0, p.stdout + p.stderr

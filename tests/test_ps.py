@@ -79,6 +79,7 @@ class TestService:
 
         t = threading.Thread(target=w1)
         t.start()
+        # blocking-ok: negative check — prove the barrier did NOT release
         time.sleep(0.2)
         assert order == []  # still blocked
         c2.barrier("b", 2)
@@ -203,6 +204,7 @@ class TestBarrierReuse:
             t = threading.Thread(
                 target=lambda: (c1.barrier("epoch", 2), done.append(1)))
             t.start()
+            # blocking-ok: negative check — barrier must NOT have released
             time.sleep(0.1)
             assert done == []  # second rank not arrived → still blocked
             c2.barrier("epoch", 2)

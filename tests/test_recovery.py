@@ -466,7 +466,7 @@ class TestP2PGenerationFence:
     def _wait(self, cond, timeout=10):
         deadline = time.monotonic() + timeout
         while not cond() and time.monotonic() < deadline:
-            time.sleep(0.01)
+            time.sleep(0.01)  # blocking-ok: poll interval, deadline above
         assert cond()
 
     def test_generation_zero_frames_roundtrip_unstamped(self, chan_pair):

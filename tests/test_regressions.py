@@ -111,7 +111,10 @@ def test_dataloader_early_break_no_thread_leak():
         for _b in DataLoader(ds, batch_size=2, num_workers=2):
             break
     import time
-    time.sleep(0.5)
+    deadline = time.monotonic() + 5
+    while threading.active_count() > before + 1 \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)  # blocking-ok: poll interval, deadline above
     assert threading.active_count() <= before + 1
 
 

@@ -511,6 +511,7 @@ class TestMultiTrainerFaults:
 
         class FailingWorker(DeviceWorker):
             def train_step(self, feed):
+                # blocking-ok: Barrier(2, timeout=10) bounds this wait
                 barrier.wait()  # both workers are mid-step before failing
                 raise ValueError(f"boom{self.worker_id}")
 
@@ -534,12 +535,12 @@ class TestMultiTrainerFaults:
 
         class FailFast(DeviceWorker):
             def train_step(self, feed):
-                barrier.wait()
+                barrier.wait()  # blocking-ok: Barrier timeout=10 bounds it
                 raise ValueError("boom")
 
         class Survivor(DeviceWorker):
             def train_step(self, feed):
-                barrier.wait()
+                barrier.wait()  # blocking-ok: Barrier timeout=10 bounds it
                 assert trainer_ref[0].stop_event.wait(10)
                 return {}
 

@@ -301,7 +301,7 @@ class TestFramedSockets:
             t = threading.Thread(target=wire.send_frame, args=(cli, payload))
             t.start()
             got = wire.recv_frame(conn)
-            t.join()
+            t.join(timeout=10)
             assert got["cmd"] == "pull"
             np.testing.assert_array_equal(got["vals"], payload["vals"])
         finally:
@@ -316,7 +316,7 @@ class TestFramedSockets:
                                  args=(cli, {"x": 1}))
             t.start()
             # receiver with a different secret must reject
-            t.join()
+            t.join(timeout=10)
             monkeypatch.setenv("PADDLE_TPU_WIRE_SECRET", "other")
             with pytest.raises(wire.FrameError, match="HMAC"):
                 wire.recv_frame(conn)

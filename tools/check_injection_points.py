@@ -4,14 +4,17 @@ fault-injection hook.
 
 The resilience subsystem's guarantee — "any storage or collective failure
 mode can be simulated deterministically" — only holds if new entry points
-keep calling ``maybe_inject``. This checker parses the source with ast (no
-imports, no jax) and fails CI when a required entry point has neither a
-``maybe_inject(...)`` call in its body nor a ``@fault_point(...)``
-decorator. Run directly or via tests/test_resilience.py.
+keep calling ``maybe_inject``. The check itself now lives in the unified
+analysis framework (paddle_tpu/analysis/passes/injection_points.py, run
+with the rest of the passes by ``tools/lint.py``); this shim keeps the
+standalone CLI, its exit codes, and — deliberately — the manifest:
+``REQUIRED``/``HOOK_CALLS`` stay as plain literals HERE because
+tests/test_lints.py ast-parses them to guard the manifest, and this file
+remains the one place reviewers add entries. Run directly or via
+tests/test_resilience.py.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
@@ -107,57 +110,21 @@ HOOK_CALLS = {"maybe_inject", "fault_point", "_injected_run", "_attempt",
               "should_inject"}
 
 
-def _has_hook(fn_node):
-    for deco in fn_node.decorator_list:
-        call = deco if isinstance(deco, ast.Call) else None
-        name = call.func if call else deco
-        if isinstance(name, ast.Attribute) and name.attr in HOOK_CALLS:
-            return True
-        if isinstance(name, ast.Name) and name.id in HOOK_CALLS:
-            return True
-    for node in ast.walk(fn_node):
-        # direct calls AND hook callables passed to retry_call(...)
-        if isinstance(node, ast.Attribute) and node.attr in HOOK_CALLS:
-            return True
-        if isinstance(node, ast.Name) and node.id in HOOK_CALLS:
-            return True
-    return False
-
-
-def _functions(tree, scope):
-    if scope == "module":
-        for node in tree.body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                yield node
-        return
-    cls_name = scope.split(":", 1)[1]
-    for node in tree.body:
-        if isinstance(node, ast.ClassDef) and node.name == cls_name:
-            for sub in node.body:
-                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    yield sub
+def _analysis():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from lint import load_analysis
+    finally:
+        sys.path.pop(0)
+    return load_analysis(REPO)
 
 
 def check(repo=REPO):
-    problems = []
-    for rel, scope, names in REQUIRED:
-        path = os.path.join(repo, rel)
-        if not os.path.exists(path):
-            problems.append(f"{rel}: file missing (lint manifest stale?)")
-            continue
-        with open(path) as f:
-            tree = ast.parse(f.read(), filename=rel)
-        fns = {fn.name: fn for fn in _functions(tree, scope)}
-        for name in names:
-            fn = fns.get(name)
-            if fn is None:
-                continue  # entry point not defined in this scope
-            if not _has_hook(fn):
-                problems.append(
-                    f"{rel}: {scope} {name}() has no fault-injection hook "
-                    "(call resilience.faults.maybe_inject or decorate with "
-                    "@fault_point)")
-    return problems
+    """Legacy API: list of problem strings (framework-backed)."""
+    analysis = _analysis()
+    ctx = analysis.AnalysisContext(repo)
+    findings = analysis.get_pass("injection-points")().run(ctx)
+    return [f.message for f in findings]
 
 
 def main():

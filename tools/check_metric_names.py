@@ -3,14 +3,14 @@
 
 The metrics registry (paddle_tpu/profiler/metrics.py) accepts any string, so
 nothing stops ``serving.latency`` today and ``serving.request_latency_ms``
-tomorrow from coexisting as two dashboards' worth of orphaned series. This
-checker parses the source with ast (no imports, no jax) and fails CI when a
-metric-recording call site uses a name that either
-
-- names a subsystem missing from ``SUBSYSTEMS`` (typo, or a new subsystem
-  that must be registered here — one line, reviewed like an API), or
-- lacks a unit suffix from ``UNITS`` (``_ms``, ``_total``, ...), so every
-  series is self-describing on a dashboard.
+tomorrow from coexisting as two dashboards' worth of orphaned series. The
+check itself now lives in the unified analysis framework
+(paddle_tpu/analysis/passes/metric_names.py, run with the rest of the
+passes by ``tools/lint.py``); this shim keeps the standalone CLI, its exit
+codes, and — deliberately — the manifests: ``SUBSYSTEMS`` / ``UNITS`` /
+``GRANDFATHERED`` stay as plain literals HERE because tests/test_lints.py
+ast-parses them to guard the naming contract, and this file remains where
+a new subsystem is registered (a one-line reviewed diff).
 
 Dynamic segments (f-string fields, %-format specs) are normalized to ``{}``
 and allowed inside the noun — ``steptime.rank{}_ms`` is one metric family.
@@ -24,9 +24,7 @@ Run directly or via tests/test_lints.py / tests/test_observability.py.
 """
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -82,131 +80,23 @@ PAIRS_CALLS = {"observe_many"}
 REGISTRY_ONLY = {"inc_counter", "set_gauge", "observe", "register_gauge_fn",
                  "observe_many"}
 
-_NAME_RE = re.compile(
-    r"^(?P<subsystem>[a-z0-9_]+|\{\})\."
-    r"[a-z0-9_{}./]*_(?P<unit>%s)$" % "|".join(UNITS))
 
-
-def _template(node):
-    """Extract a name template from an ast expression: literal strings stay,
-    dynamic fields become ``{}``. Returns None when not extractable."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    if isinstance(node, ast.JoinedStr):
-        parts = []
-        for v in node.values:
-            if isinstance(v, ast.Constant) and isinstance(v.value, str):
-                parts.append(v.value)
-            elif isinstance(v, ast.FormattedValue):
-                parts.append("{}")
-            else:
-                return None
-        return "".join(parts)
-    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod)
-            and isinstance(node.left, ast.Constant)
-            and isinstance(node.left.value, str)):
-        return re.sub(r"%[#0\- +]*[\d*]*(?:\.[\d*]+)?[diouxXeEfFgGrsa]",
-                      "{}", node.left.value)
-    return None
-
-
-def _is_registry_receiver(node):
-    """Heuristic: does this expression denote the metrics registry?
-    Recognizes get_registry()/_registry() call results and any name or
-    attribute containing 'registry'."""
-    if isinstance(node, ast.Call):
-        return _is_registry_receiver(node.func)
-    if isinstance(node, ast.Attribute):
-        return "registry" in node.attr.lower() \
-            or _is_registry_receiver(node.value)
-    if isinstance(node, ast.Name):
-        return "registry" in node.id.lower()
-    return False
-
-
-def _call_name(func):
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return None
-
-
-def _iter_templates(call):
-    """Yield every extractable name template minted by this call."""
-    name = _call_name(call.func)
-    if name in PAIRS_CALLS:
-        # observe_many(items): walk the argument for (name, value) tuples
-        for arg in call.args:
-            for node in ast.walk(arg):
-                if isinstance(node, ast.Tuple) and node.elts:
-                    t = _template(node.elts[0])
-                    if t is not None:
-                        yield t
-        return
-    if call.args:
-        t = _template(call.args[0])
-        if t is not None:
-            yield t
-
-
-def _py_files(repo):
-    for entry in SCAN:
-        path = os.path.join(repo, entry)
-        if os.path.isfile(path):
-            yield path
-            continue
-        for dirpath, dirnames, filenames in os.walk(path):
-            dirnames[:] = [d for d in dirnames
-                           if d not in ("__pycache__", ".git")]
-            for fn in sorted(filenames):
-                if fn.endswith(".py"):
-                    yield os.path.join(dirpath, fn)
+def _analysis():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from lint import load_analysis
+    finally:
+        sys.path.pop(0)
+    return load_analysis(REPO)
 
 
 def check(repo=REPO):
-    """Returns ([problems], names_checked)."""
-    problems = []
-    checked = 0
-    grandfathered = set(GRANDFATHERED)
-    subsystems = set(SUBSYSTEMS)
-    for path in _py_files(repo):
-        rel = os.path.relpath(path, repo)
-        with open(path) as f:
-            try:
-                tree = ast.parse(f.read(), filename=rel)
-            except SyntaxError as e:
-                problems.append(f"{rel}: unparseable ({e})")
-                continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            name = _call_name(node.func)
-            if name not in NAME_CALLS and name not in PAIRS_CALLS:
-                continue
-            if name in REGISTRY_ONLY:
-                recv = node.func.value \
-                    if isinstance(node.func, ast.Attribute) else None
-                if recv is None or not _is_registry_receiver(recv):
-                    continue
-            for tmpl in _iter_templates(node):
-                checked += 1
-                if tmpl in grandfathered:
-                    continue
-                m = _NAME_RE.match(tmpl)
-                if m is None:
-                    problems.append(
-                        f"{rel}:{node.lineno}: metric name {tmpl!r} does "
-                        "not match subsystem.noun_unit (unit suffix one of "
-                        f"{'/'.join(UNITS)})")
-                    continue
-                sub = m.group("subsystem")
-                if sub != "{}" and sub not in subsystems:
-                    problems.append(
-                        f"{rel}:{node.lineno}: metric name {tmpl!r} uses "
-                        f"unregistered subsystem {sub!r} (add it to "
-                        "SUBSYSTEMS in tools/check_metric_names.py)")
-    return problems, checked
+    """Legacy API: ([problems], names_checked) (framework-backed)."""
+    analysis = _analysis()
+    ctx = analysis.AnalysisContext(repo)
+    p = analysis.get_pass("metric-names")()
+    findings = p.run(ctx)
+    return [f.message for f in findings], p.templates_checked
 
 
 def main():

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""paddle-lint: run every registered analysis pass over the tree.
+
+One entrypoint for all six passes (lock-discipline, blocking-call,
+typed-error, flag-hygiene, injection-points, metric-names). Exits
+nonzero when any finding is not covered by the frozen baseline
+(``LINT_WAIVERS.json`` at the repo root — ships empty; the tree is
+lint-clean). See docs/static_analysis.md for the pass catalog, the
+annotation contracts, and the "lint failed — now what?" runbook.
+
+Like the older check_* tools this parses source with ast only — no
+paddle_tpu import, no jax — so it runs anywhere in about a second.
+
+    python tools/lint.py                  # all passes, whole tree
+    python tools/lint.py --changed        # only files in git diff
+    python tools/lint.py --json           # machine-readable findings
+    python tools/lint.py --pass typed-error --pass flag-hygiene
+    python tools/lint.py --list           # show the pass catalog
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_analysis(repo=REPO):
+    """Import paddle_tpu/analysis as a standalone package (alias
+    ``_paddle_lint``) so ``paddle_tpu/__init__.py`` — and therefore jax
+    — never executes. The analysis package is stdlib-only and uses
+    relative imports, so it works identically under the alias."""
+    import importlib.util
+    alias = "_paddle_lint"
+    if alias in sys.modules:
+        return sys.modules[alias]
+    pkgdir = os.path.join(repo, "paddle_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        alias, os.path.join(pkgdir, "__init__.py"),
+        submodule_search_locations=[pkgdir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        del sys.modules[alias]
+        raise
+    return mod
+
+
+def _changed_files(root):
+    """Repo-relative paths touched per git (unstaged + staged +
+    untracked). Returns None when git is unavailable — caller falls back
+    to a full run rather than silently linting nothing."""
+    try:
+        r = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=root,
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if r.returncode != 0:
+        return None
+    out = set()
+    for line in r.stdout.splitlines():
+        path = line[3:].strip()
+        if " -> " in path:  # rename: take the new side
+            path = path.split(" -> ", 1)[1]
+        if path:
+            out.add(path.strip('"'))
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="run the paddle-lint analysis passes "
+                    "(docs/static_analysis.md)")
+    ap.add_argument("--root", default=REPO,
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("--changed", action="store_true",
+                    help="report findings only for files in git diff "
+                         "(all passes still scan the whole tree so "
+                         "cross-file rules stay sound)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--pass", dest="passes", action="append", default=[],
+                    metavar="NAME", help="run only this pass (repeatable)")
+    ap.add_argument("--baseline", default=None,
+                    help="waiver baseline path (default: "
+                         "LINT_WAIVERS.json under --root)")
+    ap.add_argument("--list", action="store_true", dest="list_passes",
+                    help="list registered passes and exit")
+    args = ap.parse_args(argv)
+
+    analysis = load_analysis()
+    registry = analysis.all_passes()
+
+    if args.list_passes:
+        for name, cls in registry.items():
+            print(f"{name:18s} {cls.description}")
+        return 0
+
+    selected = args.passes or list(registry)
+    unknown = [p for p in selected if p not in registry]
+    if unknown:
+        print(f"unknown pass(es): {', '.join(unknown)} "
+              f"(have: {', '.join(registry)})", file=sys.stderr)
+        return 2
+
+    root = os.path.abspath(args.root)
+    restrict = None
+    if args.changed:
+        changed = _changed_files(root)
+        if changed is not None:
+            restrict = changed
+    ctx = analysis.AnalysisContext(root, restrict=restrict)
+
+    if args.baseline is not None:
+        with open(args.baseline, encoding="utf-8") as f:
+            data = json.load(f)
+        waivers = {e["ident"]: e.get("reason", "")
+                   for e in data.get("waivers", [])}
+    else:
+        waivers = analysis.load_waivers(root)
+
+    all_new, all_waived = [], []
+    summaries = []
+    for name in selected:
+        p = registry[name]()
+        findings = ctx.reported(p.run(ctx))
+        new, waived = analysis.split_waived(findings, waivers)
+        all_new.extend(new)
+        all_waived.extend(waived)
+        extra = ""
+        if name == "injection-points":
+            extra = (f", {getattr(p, 'entry_points_checked', 0)} "
+                     "entry points checked")
+        elif name == "metric-names":
+            extra = (f", {getattr(p, 'templates_checked', 0)} "
+                     "name templates checked")
+        summaries.append(
+            f"{name}: {len(new)} finding(s)"
+            + (f", {len(waived)} waived" if waived else "") + extra)
+
+    if args.as_json:
+        print(json.dumps({
+            "root": root,
+            "passes": selected,
+            "changed_only": bool(args.changed),
+            "findings": [f.to_dict() for f in all_new],
+            "waived": [f.to_dict() for f in all_waived],
+        }, indent=2, sort_keys=True))
+        return 1 if all_new else 0
+
+    for line in summaries:
+        print("paddle-lint", line)
+    if all_new:
+        print(f"paddle-lint FAILED: {len(all_new)} new finding(s) "
+              "(see docs/static_analysis.md for the runbook)")
+        for f in sorted(all_new, key=lambda f: (f.path, f.line)):
+            print("  -", f.format())
+        return 1
+    scope = "changed files" if args.changed else "tree"
+    print(f"paddle-lint OK ({len(selected)} passes clean over the "
+          f"{scope}"
+          + (f"; {len(all_waived)} baselined finding(s) waived"
+             if all_waived else "") + ")")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
