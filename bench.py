@@ -186,8 +186,17 @@ def _bench_compiled_speedup():
             reset_compile_stats()
         t0 = _time.perf_counter()
         out = None
-        for _ in range(steps):
-            out = step(ins, labs)
+        if compiled:
+            # runtime trace sanitizer on the timed window: any compile at
+            # steady state raises AT the violating call (the counter
+            # assert below cross-checks the same contract in aggregate)
+            from paddle_tpu.analysis import tracesan
+            with tracesan.tracking(mode="raise"):
+                for _ in range(steps):
+                    out = step(ins, labs)
+        else:
+            for _ in range(steps):
+                out = step(ins, labs)
         out.numpy()  # sync
         dt = _time.perf_counter() - t0
         if compiled:

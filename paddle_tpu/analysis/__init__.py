@@ -20,10 +20,11 @@ from .core import (  # noqa: F401
     run_pass,
     split_waived,
 )
+from . import cache  # noqa: F401  (per-file result cache for the CLI)
 from . import passes  # noqa: F401  (registers the built-in passes)
 
 __all__ = [
     "AnalysisContext", "Finding", "WAIVERS_FILE", "all_passes",
-    "get_pass", "load_waivers", "register_pass", "run_pass",
+    "cache", "get_pass", "load_waivers", "register_pass", "run_pass",
     "split_waived", "passes",
 ]

@@ -1,0 +1,256 @@
+"""Per-file lint result cache (tools/lint.py warm runs in well under 2s).
+
+Findings are a pure function of (file contents, pass implementation), so
+they cache. Each pass gets one JSON blob under
+``$PADDLE_TPU_ARTIFACTS_DIR/lint_cache/`` (same artifacts root as the
+flight-recorder dumps) holding findings grouped per file and keyed by
+
+- the file's **content sha1** — any edit invalidates exactly that file;
+- the pass **version** class attr *and* the sha1 of the pass's own
+  source (plus core.py): editing a SEEDED/PAIRS manifest without
+  remembering a version bump still invalidates, so the cache can never
+  serve findings computed under an older contract.
+
+Two reuse granularities, declared by the pass class:
+
+``file_local = True``
+    Findings for file F depend only on F (every per-rel loop pass:
+    lock-discipline, blocking-call, typed-error, donation-taint,
+    jit-hygiene, host-sync, resource-lifecycle). Unchanged files reuse
+    their cached findings; only stale files re-run, through a narrowed
+    context whose ``py_files`` yields just those rels.
+``file_local = False``
+    Findings mix cross-file state (flag-hygiene's read/registry join,
+    the manifest-driven passes). The whole result set is reused only
+    when the digest over *every* scanned file matches; otherwise the
+    pass runs in full.
+
+Writes are atomic (tmp file + ``os.replace``) so a killed run never
+leaves a torn blob; a torn/alien blob is treated as a miss, never an
+error. ``tools/lint.py --no-cache`` bypasses everything, and a context
+with an ``overlay`` (the mutation tests) is never cached — hypothetical
+trees must not poison real results.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+
+from .core import Finding
+
+CACHE_SUBDIR = "lint_cache"
+
+# counters some passes expose for the summary line; captured alongside
+# the findings so a cache hit reports the same numbers as a real run
+_COUNTERS = ("entry_points_checked", "templates_checked")
+
+
+def default_cache_dir():
+    """$PADDLE_TPU_ARTIFACTS_DIR/lint_cache (same root the resilience
+    recorder and trace tools use for their artifacts)."""
+    base = os.environ.get(
+        "PADDLE_TPU_ARTIFACTS_DIR",
+        os.path.join(tempfile.gettempdir(), "paddle_tpu_artifacts"))
+    return os.path.join(base, CACHE_SUBDIR)
+
+
+def _sha1(text):
+    return hashlib.sha1(text.encode("utf-8", "replace")).hexdigest()
+
+
+def _finding_from_dict(d):
+    return Finding(d["pass"], d["path"], d["line"], d["code"],
+                   d["message"], symbol=d.get("symbol") or None,
+                   severity=d.get("severity", "error"))
+
+
+class _NarrowedContext:
+    """Delegate everything to the real context but restrict py_files to
+    the stale set — a file-local pass re-analyzes only changed files."""
+
+    def __init__(self, ctx, keep):
+        self._ctx = ctx
+        self._keep = keep
+
+    def __getattr__(self, name):
+        return getattr(self._ctx, name)
+
+    def py_files(self, under=()):
+        return [r for r in self._ctx.py_files(under) if r in self._keep]
+
+
+class ResultCache:
+    """One instance per lint run; shares the context's file reads."""
+
+    def __init__(self, ctx, directory=None):
+        self.ctx = ctx
+        self.dir = directory or default_cache_dir()
+        self._sha = {}       # rel -> content sha1 memo
+        self._impl = {}      # pass module file -> sha1 memo
+        self.hits = 0        # files served from cache (all passes)
+
+    # -- hashing ---------------------------------------------------------------
+    def file_sha1(self, rel):
+        h = self._sha.get(rel)
+        if h is None:
+            sf = self.ctx.source(rel)
+            h = self._sha[rel] = _sha1(sf.text) if sf is not None else ""
+        return h
+
+    def _text_sha1(self, path):
+        h = self._impl.get(path)
+        if h is None:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    h = _sha1(f.read())
+            except OSError:
+                h = ""
+            self._impl[path] = h
+        return h
+
+    def _impl_digest(self, cls):
+        """version + pass source + core.py: the 'pass version' half of
+        the key, robust to manifest edits without a version bump."""
+        parts = [str(getattr(cls, "version", ""))]
+        import sys
+        mod = sys.modules.get(cls.__module__)
+        if mod is not None and getattr(mod, "__file__", None):
+            parts.append(self._text_sha1(mod.__file__))
+        parts.append(self._text_sha1(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "core.py")))
+        return _sha1("\n".join(parts))
+
+    def _docs_digest(self, entries):
+        """Digest over non-.py inputs (flag-hygiene reads docs/*.md)."""
+        items = []
+        for entry in entries:
+            path = os.path.join(self.ctx.root, entry)
+            if os.path.isfile(path):
+                items.append((entry, self._text_sha1(path)))
+                continue
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in self.ctx.SKIP_DIRS)
+                for fn in sorted(filenames):
+                    p = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(p, self.ctx.root)
+                    items.append((rel, self._text_sha1(p)))
+        return _sha1("\n".join(f"{r} {h}" for r, h in sorted(items)))
+
+    # -- storage ---------------------------------------------------------------
+    def _path(self, pass_name):
+        return os.path.join(self.dir, f"{pass_name}.json")
+
+    def load(self, pass_name):
+        try:
+            with open(self._path(pass_name), encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return data if isinstance(data, dict) else None
+
+    def store(self, pass_name, payload):
+        """Atomic tmp + os.replace; an unwritable dir degrades to a
+        cache-less run, never an error."""
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                prefix=f".{pass_name}.", suffix=".tmp", dir=self.dir)
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    json.dump(payload, f, sort_keys=True)
+                os.replace(tmp, self._path(pass_name))
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except OSError:
+            pass
+
+    # -- the cached run --------------------------------------------------------
+    def run(self, p, ctx):
+        """Run pass instance `p` with caching. Returns (findings,
+        stats) where stats = {"files", "cached", "ran"}."""
+        cls = type(p)
+        version = getattr(cls, "version", None)
+        scan = getattr(cls, "scan", None)
+        if version is None or scan is None or ctx.overlay:
+            return p.run(ctx), {"files": 0, "cached": 0, "ran": True}
+
+        rels = ctx.py_files(scan)
+        impl = self._impl_digest(cls)
+        docs = getattr(cls, "scan_docs", None)
+        if docs:
+            impl = _sha1(impl + "\n" + self._docs_digest(docs))
+        entry = self.load(cls.name)
+        prev = {}
+        if entry and entry.get("impl") == impl:
+            prev = entry.get("files", {})
+
+        if getattr(cls, "file_local", False):
+            return self._run_file_local(p, ctx, rels, impl, prev)
+        return self._run_monolithic(p, ctx, rels, impl, prev, entry)
+
+    def _run_file_local(self, p, ctx, rels, impl, prev):
+        stale = [r for r in rels
+                 if prev.get(r, {}).get("sha1") != self.file_sha1(r)]
+        stale_set = set(stale)
+        fresh_by = {}
+        if stale:
+            for f in p.run(_NarrowedContext(ctx, stale_set)):
+                fresh_by.setdefault(f.path, []).append(f)
+        findings, files_out = [], {}
+        for r in rels:
+            if r in stale_set:
+                fl = fresh_by.get(r, [])
+            else:
+                fl = [_finding_from_dict(d)
+                      for d in prev[r]["findings"]]
+            files_out[r] = {"sha1": self.file_sha1(r),
+                            "findings": [f.to_dict() for f in fl]}
+            findings.extend(fl)
+        # a file-local pass reporting outside its scanned rel set would
+        # be a contract break — surface those findings, never drop them
+        for path, fl in fresh_by.items():
+            if path not in files_out:
+                findings.extend(fl)
+        cached = len(rels) - len(stale)
+        self.hits += cached
+        self.store(type(p).name,
+                   {"pass": type(p).name, "impl": impl,
+                    "files": files_out})
+        return findings, {"files": len(rels), "cached": cached,
+                          "ran": bool(stale)}
+
+    def _run_monolithic(self, p, ctx, rels, impl, prev, entry):
+        digest = _sha1("\n".join(
+            f"{r} {self.file_sha1(r)}" for r in rels))
+        if entry and entry.get("impl") == impl \
+                and entry.get("scan_digest") == digest:
+            findings = [_finding_from_dict(d)
+                        for r in sorted(prev)
+                        for d in prev[r]["findings"]]
+            for k, v in (entry.get("counters") or {}).items():
+                if k in _COUNTERS:
+                    setattr(p, k, v)
+            self.hits += len(rels)
+            return findings, {"files": len(rels), "cached": len(rels),
+                              "ran": False}
+        findings = p.run(ctx)
+        files_out = {}
+        for f in findings:
+            files_out.setdefault(
+                f.path, {"sha1": self.file_sha1(f.path),
+                         "findings": []})["findings"].append(f.to_dict())
+        counters = {k: getattr(p, k) for k in _COUNTERS
+                    if hasattr(p, k)}
+        self.store(type(p).name,
+                   {"pass": type(p).name, "impl": impl,
+                    "scan_digest": digest, "files": files_out,
+                    "counters": counters})
+        return findings, {"files": len(rels), "cached": 0, "ran": True}

@@ -177,6 +177,9 @@ class BlockingCallPass:
     name = "blocking-call"
     description = ("no sleeps/untimeouted waits in tests; no blocking "
                    "calls in lock scopes or hot paths")
+    version = "1"
+    scan = SCAN
+    file_local = True
 
     def run(self, ctx):
         findings = []

@@ -75,6 +75,10 @@ class FlagHygienePass:
     name = "flag-hygiene"
     description = ("every FLAGS_* read is registered + documented; every "
                    "registered flag is read")
+    version = "1"
+    scan = CODE_SCAN
+    scan_docs = DOCS_SCAN       # .md inputs fold into the cache key
+    file_local = False          # reads/registry join is cross-file
 
     def run(self, ctx):
         findings = []

@@ -1,0 +1,166 @@
+"""Host-sync pass: no implicit device→host syncs on registered hot paths.
+
+``.numpy()``, ``np.asarray(tensor)``, ``.item()`` and
+``block_until_ready`` all block the host until the device catches up.
+On a hot path — the compiled step body, the decode engine tick, the
+serving dispatch chokepoint, prefetch staging — one such call serializes
+the pipeline jax dispatch exists to keep full (docs/compiled_step.md,
+docs/observability.md: that stall shows up as a step/compute cliff).
+
+A hot path registers itself with an annotation on its ``def`` line (or
+the line above)::
+
+    def step(self):   # hot-path: decode tick — every running stream waits
+
+The pass scans the annotated function lexically (its own body and nested
+defs). The ``SEEDED`` manifest pins the contracted hot paths, so
+*de-registering* one (deleting the annotation) is itself a finding
+(``unseeded``) — the check cannot be silently disarmed — and a vanished
+function is ``stale-path``.
+
+Deliberate syncs (a sampled ``StepTimer.sync``, an emission boundary
+where tokens must reach the host) are waived inline with a reason::
+
+    arr = np.asarray(v)   # sync-ok: loader leaves are host-resident here
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass, call_name, dotted_name, waived
+
+SCAN = ["paddle_tpu", "bench.py"]
+
+_ANNOTATION = "hot-path:"
+_WAIVE = "sync-ok"
+
+# Contracted hot paths: must stay registered (annotated). (rel, qualname).
+SEEDED = [
+    ("paddle_tpu/jit/compiled_step.py", "CompiledTrainStep.__call__"),
+    ("paddle_tpu/jit/compiled_step.py", "CompiledTrainStep.run_steps"),
+    ("paddle_tpu/serving/decode/compiled_decode.py",
+     "CompiledDecodeStep.run"),
+    ("paddle_tpu/serving/decode/engine.py", "DecodeEngine.step"),
+    ("paddle_tpu/serving/scheduler.py", "Scheduler.dispatch"),
+    ("paddle_tpu/serving/scheduler.py", "Scheduler._attempt"),
+    ("paddle_tpu/hapi/prefetch.py", "InputPrefetcher._stage"),
+]
+
+_SYNC_ATTR_CALLS = {"numpy", "item", "block_until_ready", "tolist"}
+_SYNC_DOTTED = {"np.asarray", "numpy.asarray", "jax.device_get",
+                "jax.block_until_ready"}
+
+
+def _qualnames(tree):
+    out = []
+
+    def walk(node, prefix):
+        for sub in ast.iter_child_nodes(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{sub.name}"
+                out.append((qual, sub))
+                walk(sub, f"{qual}.")
+            elif isinstance(sub, ast.ClassDef):
+                walk(sub, f"{prefix}{sub.name}.")
+            else:
+                walk(sub, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def _annotated(sf, fn):
+    """Annotated on the def line or in the contiguous comment block
+    directly above it (multi-line lead comments are one registration)."""
+    if _ANNOTATION in sf.comment_on(fn.lineno):
+        return True
+    line = fn.lineno - 1
+    while line > 0 and sf.comment_on(line):
+        if _ANNOTATION in sf.comment_on(line):
+            return True
+        line -= 1
+    return False
+
+
+@register_pass
+class HostSyncPass:
+    name = "host-sync"
+    description = ("no .numpy()/.item()/np.asarray/block_until_ready "
+                   "inside registered '# hot-path:' functions")
+    version = "1"
+    scan = SCAN
+    file_local = True
+
+    def run(self, ctx):
+        findings = []
+        seeded = {}
+        for rel, qual in SEEDED:
+            seeded.setdefault(rel, set()).add(qual)
+
+        for rel in ctx.py_files(SCAN):
+            if rel.startswith("paddle_tpu/analysis/"):
+                continue
+            sf = ctx.source(rel)
+            if sf is None:
+                continue
+            if _ANNOTATION not in sf.text and rel not in seeded:
+                continue
+            try:
+                tree = sf.tree
+            except SyntaxError as e:
+                findings.append(Finding(
+                    self.name, rel, getattr(e, "lineno", 1) or 1,
+                    "unparseable", f"unparseable ({e})", symbol=rel))
+                continue
+            quals = _qualnames(tree)
+            by_qual = dict(quals)
+
+            for qual in sorted(seeded.get(rel, ())):
+                fn = by_qual.get(qual)
+                if fn is None:
+                    findings.append(Finding(
+                        self.name, rel, 1, "stale-path",
+                        f"contracted hot path {qual} no longer exists in "
+                        "this file — update SEEDED in passes/host_sync.py "
+                        "with the successor",
+                        symbol=qual))
+                elif not _annotated(sf, fn):
+                    findings.append(Finding(
+                        self.name, rel, fn.lineno, "unseeded",
+                        f"{qual} is a contracted hot path but lost its "
+                        f"'# {_ANNOTATION}' annotation — host syncs inside "
+                        "it are no longer checked",
+                        symbol=qual))
+
+            for qual, fn in quals:
+                if not _annotated(sf, fn):
+                    continue
+                findings.extend(self._scan_fn(sf, qual, fn))
+        return findings
+
+    def _scan_fn(self, sf, qual, fn):
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func) or ""
+            n = call_name(node.func)
+            hit = None
+            if dn in _SYNC_DOTTED:
+                hit = dn
+            elif isinstance(node.func, ast.Attribute) \
+                    and n in _SYNC_ATTR_CALLS and not node.args \
+                    and not node.keywords:
+                hit = f".{n}()"
+            if hit is None:
+                continue
+            if waived(sf, node.lineno, _WAIVE):
+                continue
+            out.append(Finding(
+                self.name, sf.rel, node.lineno, "host-sync",
+                f"implicit device→host sync '{hit}' inside registered "
+                f"hot path {qual} — hoist it off the hot path, sample it "
+                "via StepTimer.sync, or waive with '# sync-ok: <reason>' "
+                "after review (docs/static_analysis.md)",
+                symbol=f"{n}@{qual}"))
+        return out

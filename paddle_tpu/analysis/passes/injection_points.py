@@ -72,6 +72,9 @@ class InjectionPointPass:
     name = "injection-points"
     description = ("every FS/collective/serving entry point carries a "
                    "fault-injection hook")
+    version = "1"
+    scan = ["paddle_tpu", MANIFEST_FILE]
+    file_local = False          # manifest-driven: findings mix files
 
     def run(self, ctx):
         required, hook_calls = load_manifest(ctx)

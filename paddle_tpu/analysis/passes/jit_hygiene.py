@@ -1,0 +1,265 @@
+"""Jit-hygiene pass: retrace hazards in traced function bodies.
+
+A compiled step (``CompiledTrainStep`` / ``CompiledDecodeStep``) traces
+its function once per input signature and replays the XLA program from
+then on. Anything in the traced body that produces a *different Python
+value per call* either bakes a stale constant into the program
+(``time.time()``, ``np.random`` draws) or forces a fresh trace / host
+round-trip every step (``.item()`` / ``.numpy()`` branches) — the
+retrace storms and silent staleness docs/compiled_step.md warns about.
+
+Registration mirrors the donation-taint pass: a traced body carries a
+
+    def pure_fn(mut_vals, ro_vals, arg_vals):   # traced-fn: <what jits it>
+
+annotation on its ``def`` line (or the line above). The pass scans the
+annotated function, its nested defs (they execute inside the trace), and
+— best-effort, same module only, bounded depth — functions it calls by
+name. The ``SEEDED`` manifest pins the repo's contracted trace roots so
+deleting an annotation is an ``unseeded`` finding and a vanished root is
+``stale-root``.
+
+Hazards:
+
+- ``impure-time``    — ``time.time/perf_counter/monotonic``,
+  ``datetime.now``: traces a constant timestamp.
+- ``impure-random``  — ``random.*`` / ``np.random.*``: traces one fixed
+  draw (jax randomness must flow through explicit keys).
+- ``host-value``     — ``.item()`` / ``.numpy()`` / ``.tolist()`` /
+  ``np.asarray`` inside a trace: concretizes a tracer (TracerError at
+  best, a baked-in Python branch at worst).
+- ``fresh-step-in-loop`` — constructing a ``CompiledTrainStep`` /
+  ``CompiledDecodeStep`` / ``to_static`` wrapper inside a loop: every
+  iteration gets a fresh program cache, so every iteration compiles.
+
+Unhashable / freshly-constructed *static argument* hazards are dynamic
+by nature (they depend on the caller's objects) — the runtime trace
+sanitizer (``analysis/tracesan.py``) catches them as steady-state
+retraces instead; see docs/compiled_step.md.
+
+Waive a reviewed line inline::
+
+    t0 = time.perf_counter()   # trace-ok: outside jit, timing the build
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass, call_name, dotted_name, waived
+
+SCAN = ["paddle_tpu", "bench.py"]
+
+_ANNOTATION = "traced-fn:"
+_WAIVE = "trace-ok"
+_DEPTH = 3
+
+# Contracted trace roots: the bodies jax.jit actually traces.
+SEEDED = [
+    ("paddle_tpu/jit/to_static.py", "StaticFunction._make_pure_fn.pure_fn"),
+    ("paddle_tpu/jit/to_static.py", "StaticFunction._build_scan.scan_fn"),
+]
+
+_TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+               "time.time_ns", "datetime.now", "datetime.utcnow",
+               "datetime.datetime.now", "datetime.datetime.utcnow"}
+_HOST_ATTR_CALLS = {"item", "numpy", "tolist"}
+_STEP_FACTORIES = {"CompiledTrainStep", "CompiledDecodeStep", "to_static"}
+
+
+def _qualnames(tree):
+    out = []
+
+    def walk(node, prefix):
+        for sub in ast.iter_child_nodes(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{sub.name}"
+                out.append((qual, sub))
+                walk(sub, f"{qual}.")
+            elif isinstance(sub, ast.ClassDef):
+                walk(sub, f"{prefix}{sub.name}.")
+            else:
+                walk(sub, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def _annotated(sf, fn):
+    """Annotated on the def line or in the contiguous comment block
+    directly above it (multi-line lead comments are one registration)."""
+    if _ANNOTATION in sf.comment_on(fn.lineno):
+        return True
+    line = fn.lineno - 1
+    while line > 0 and sf.comment_on(line):
+        if _ANNOTATION in sf.comment_on(line):
+            return True
+        line -= 1
+    return False
+
+
+def _called_names(fn):
+    """Trailing names of calls in `fn` (nested defs included — they run
+    inside the trace when called)."""
+    names = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            n = call_name(node.func)
+            if n:
+                names.add(n)
+    return names
+
+
+class _HazardChecker:
+    def __init__(self, pass_name, sf, root_qual):
+        self.pass_name = pass_name
+        self.sf = sf
+        self.root = root_qual
+        self.findings = []
+
+    def check(self, fn, qual):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func) or ""
+            n = call_name(node.func)
+            if waived(self.sf, node.lineno, _WAIVE):
+                continue
+            if dn in _TIME_CALLS:
+                self._flag(node, "impure-time",
+                           f"'{dn}()' in traced code ({qual}, reachable "
+                           f"from {self.root}) — the trace bakes in one "
+                           "timestamp forever; take times outside the "
+                           "compiled step")
+            elif dn.startswith(("np.random.", "numpy.random.",
+                                "random.")):
+                self._flag(node, "impure-random",
+                           f"'{dn}()' in traced code ({qual}, reachable "
+                           f"from {self.root}) — one draw is traced and "
+                           "replayed; thread an explicit jax PRNG key "
+                           "instead")
+            elif isinstance(node.func, ast.Attribute) \
+                    and n in _HOST_ATTR_CALLS and not node.args:
+                self._flag(node, "host-value",
+                           f"'.{n}()' in traced code ({qual}, reachable "
+                           f"from {self.root}) — concretizes a tracer; "
+                           "keep values on-device inside the compiled "
+                           "step")
+            elif dn in ("np.asarray", "numpy.asarray", "np.array",
+                        "numpy.array"):
+                self._flag(node, "host-value",
+                           f"'{dn}()' in traced code ({qual}, reachable "
+                           f"from {self.root}) — forces a host "
+                           "round-trip / concrete value inside the trace")
+
+    def _flag(self, node, code, msg):
+        self.findings.append(Finding(
+            self.pass_name, self.sf.rel, node.lineno, code, msg,
+            symbol=f"{code}@{self.sf.rel}:{node.lineno}"))
+
+
+@register_pass
+class JitHygienePass:
+    name = "jit-hygiene"
+    description = ("no impure time/random calls or host-value reads in "
+                   "'# traced-fn:' bodies; no step wrappers built in "
+                   "loops")
+    version = "1"
+    scan = SCAN
+    file_local = True
+
+    def run(self, ctx):
+        findings = []
+        seeded = {}
+        for rel, qual in SEEDED:
+            seeded.setdefault(rel, set()).add(qual)
+
+        for rel in ctx.py_files(SCAN):
+            if rel.startswith("paddle_tpu/analysis/"):
+                continue
+            sf = ctx.source(rel)
+            if sf is None:
+                continue
+            try:
+                tree = sf.tree
+            except SyntaxError as e:
+                findings.append(Finding(
+                    self.name, rel, getattr(e, "lineno", 1) or 1,
+                    "unparseable", f"unparseable ({e})", symbol=rel))
+                continue
+
+            quals = _qualnames(tree)
+            by_qual = dict(quals)
+            by_leaf = {}
+            for qual, fn in quals:
+                by_leaf.setdefault(qual.rsplit(".", 1)[-1], []).append(
+                    (qual, fn))
+
+            # -- seeded-root guards --------------------------------------------
+            for qual in sorted(seeded.get(rel, ())):
+                fn = by_qual.get(qual)
+                if fn is None:
+                    findings.append(Finding(
+                        self.name, rel, 1, "stale-root",
+                        f"contracted trace root {qual} no longer exists "
+                        "in this file — update SEEDED in "
+                        "passes/jit_hygiene.py with the successor",
+                        symbol=qual))
+                elif not _annotated(sf, fn):
+                    findings.append(Finding(
+                        self.name, rel, fn.lineno, "unseeded",
+                        f"{qual} is a contracted trace root but lost its "
+                        f"'# {_ANNOTATION}' annotation — retrace hazards "
+                        "in its body are no longer checked",
+                        symbol=qual))
+
+            # -- hazard scan over annotated roots + same-module callees --------
+            roots = [(qual, fn) for qual, fn in quals
+                     if _annotated(sf, fn)]
+            for root_qual, root_fn in roots:
+                checker = _HazardChecker(self.name, sf, root_qual)
+                seen = {root_qual}
+                frontier = [(root_qual, root_fn)]
+                depth = 0
+                while frontier and depth <= _DEPTH:
+                    nxt = []
+                    for qual, fn in frontier:
+                        checker.check(fn, qual)
+                        for leaf in _called_names(fn):
+                            for cq, cf in by_leaf.get(leaf, ()):
+                                # a call by trailing name may reach any
+                                # same-module def of that name; nested
+                                # defs of the root are already in its walk
+                                if cq in seen or cq.startswith(
+                                        root_qual + "."):
+                                    continue
+                                seen.add(cq)
+                                nxt.append((cq, cf))
+                    frontier = nxt
+                    depth += 1
+                findings.extend(checker.findings)
+
+            # -- step wrappers built in loops ----------------------------------
+            findings.extend(self._loops(sf, tree))
+        return findings
+
+    def _loops(self, sf, tree):
+        out = []
+        loops = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+        for loop in loops:
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                n = call_name(node.func)
+                if n not in _STEP_FACTORIES:
+                    continue
+                if waived(sf, node.lineno, _WAIVE):
+                    continue
+                out.append(Finding(
+                    self.name, sf.rel, node.lineno, "fresh-step-in-loop",
+                    f"{n}(...) constructed inside a loop — each iteration "
+                    "gets an empty program cache, so each iteration "
+                    "re-traces and re-compiles; hoist the wrapper out of "
+                    "the loop",
+                    symbol=f"{n}@{sf.rel}:{node.lineno}"))
+        return out

@@ -215,6 +215,9 @@ class LockDisciplinePass:
     name = "lock-discipline"
     description = ("guarded-by annotated fields are only touched under "
                    "their lock")
+    version = "1"
+    scan = SCAN
+    file_local = True
 
     def run(self, ctx):
         findings = []

@@ -100,6 +100,11 @@ def _iter_templates(call, pairs_calls):
 class MetricNamePass:
     name = "metric-names"
     description = "always-on metric names follow subsystem.noun_unit"
+    version = "1"
+    # over-approximates the manifest's dynamic SCAN: a broader key only
+    # costs invalidation, never staleness
+    scan = ["paddle_tpu", "tools", "tests", "bench.py", MANIFEST_FILE]
+    file_local = False          # manifest-driven: findings mix files
 
     def run(self, ctx):
         m = load_manifest(ctx)

@@ -1,0 +1,415 @@
+"""Resource-lifecycle pass: allocate/release pairing on every exit path.
+
+The serving stack hands out resources that outlive the statement that
+acquired them: KV-cache blocks (``KVBlockPool.try_allocate`` /
+``BlockTable.ensure``), scheduler membership (``add_replica``), and
+flight-recorder ring entries (``start``/``finish``). A caller that
+acquires and then raises before the resource reaches its owner leaks it
+— blocks vanish from the pool until restart, ring entries stay pending
+forever. PR 9's eviction bugs were exactly this class.
+
+For each ``PAIRS`` entry the pass finds acquire calls and walks the
+statements that execute *after* the acquire (climbing out of enclosing
+blocks, in execution order). The acquire is covered when one of:
+
+- a matching release runs in a ``finally`` block enclosing the
+  post-acquire region;
+- every statement between the acquire and the release/ownership-transfer
+  is exception-safe — either non-raising, or inside a ``try`` whose
+  handlers all release and include a catch-all;
+- ownership transfers first (the resource is stored into an attribute,
+  passed into a call, or returned) with no unprotected raising statement
+  before the transfer.
+
+``if`` statements whose test mentions the resource (or contains the
+acquire itself) are guard clauses on the *failed* acquire — nothing is
+held on that edge — and are skipped. Acquires on attribute receivers
+(``stream.table.ensure(...)``) are exempt: the owner object's teardown
+releases them (``DecodeEngine._release``). ``admit``-mode pairs
+(``add_replica``) only require that the result is captured/returned or
+a drain/remove runs — membership transfers to the callee's registry on
+return, so exception edges cannot leak it.
+
+Findings: ``leak-on-exception`` (a raise between acquire and release
+escapes without releasing) and ``unpaired-acquire`` (no release and no
+transfer at all). Waive a reviewed site on the acquire line::
+
+    entry = rec.start(...)   # lifecycle-ok: ring overwrite is the bound
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass, call_name, dotted_name, waived
+
+SCAN = ["paddle_tpu"]
+
+_WAIVE = "lifecycle-ok"
+
+# (scope prefix, acquire attr, release attrs, receiver-name hints, mode)
+# mode "strict": exception-edge analysis; "admit": existence analysis
+# (ownership transfers to the callee's registry at return).
+PAIRS = [
+    ("paddle_tpu/serving/", "try_allocate", ("release",),
+     ("pool",), "strict"),
+    ("paddle_tpu/serving/", "ensure", ("release",),
+     ("table",), "strict"),
+    ("paddle_tpu/", "start", ("finish",),
+     ("rec", "recorder"), "strict"),
+    ("paddle_tpu/serving/", "add_replica",
+     ("remove_replica", "begin_drain"),
+     ("scheduler", "sched", "self"), "admit"),
+]
+
+
+def _recv_parts(func):
+    """Dotted parts of a call's receiver: ``self.recorder.start`` ->
+    ["self", "recorder"]; None when the receiver is not a name chain."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    parts = []
+    node = func.value
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _hint_match(func, hints):
+    parts = _recv_parts(func)
+    if not parts:
+        return False
+    return any(h in parts or any(h in p for p in parts) for h in hints)
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _stmt_lists(fn):
+    """Every (owner, field, stmtlist) in `fn`, excluding nested defs."""
+    out = []
+
+    def walk(owner):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(owner, field, None)
+            if not isinstance(stmts, list) or not stmts:
+                continue
+            if not all(isinstance(s, ast.stmt) for s in stmts):
+                continue
+            out.append((owner, field, stmts))
+            for s in stmts:
+                if not isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    walk(s)
+        for h in getattr(owner, "handlers", ()) or ():
+            out.append((owner, "handler", h.body))
+            for s in h.body:
+                if not isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    walk(s)
+
+    walk(fn)
+    return out
+
+
+class _FnAnalysis:
+    """Per-function statement geometry: where each statement lives, and
+    which try statements enclose it."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.loc = {}        # id(stmt) -> (owner, field, stmts, idx)
+        for owner, field, stmts in _stmt_lists(fn):
+            for i, s in enumerate(stmts):
+                self.loc[id(s)] = (owner, field, stmts, i)
+
+    def top_stmt(self, node_lineno, candidates):
+        """The statement (from `candidates`) with the given line."""
+        for s in candidates:
+            if s.lineno <= node_lineno and (
+                    getattr(s, "end_lineno", s.lineno) >= node_lineno):
+                return s
+        return None
+
+    def enclosing_trys(self, stmt):
+        """Try statements whose *body* (or orelse) contains `stmt`,
+        innermost first."""
+        out = []
+        cur = stmt
+        while id(cur) in self.loc:
+            owner, field, _, _ = self.loc[id(cur)]
+            if isinstance(owner, ast.Try) and field in ("body", "orelse"):
+                out.append(owner)
+            if owner is self.fn:
+                break
+            cur = owner
+        return out
+
+    def following(self, stmt):
+        """Statements executing after `stmt` completes normally, in
+        order, climbing out of enclosing blocks up to the function. Loop
+        back-edges and except-handler entry are ignored (conservative:
+        the pass only reasons about the straight-line continuation)."""
+        cur = stmt
+        while id(cur) in self.loc:
+            owner, field, stmts, idx = self.loc[id(cur)]
+            for s in stmts[idx + 1:]:
+                yield s
+            if owner is self.fn:
+                return
+            cur = owner
+
+
+def _calls_release(node, releases, resource, hints):
+    """Does this statement call a release? Matches by attr name plus
+    either the resource flowing in (receiver or argument) or — when the
+    resource is unknown — the receiver hint."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        if call_name(sub.func) not in releases:
+            continue
+        if not isinstance(sub.func, ast.Attribute):
+            continue
+        recv = dotted_name(sub.func.value) or ""
+        if resource is not None:
+            arg_names = set()
+            for a in sub.args:
+                arg_names |= _names_in(a)
+            if recv.split(".")[0] == resource or resource in arg_names \
+                    or recv == resource:
+                return True
+        elif _hint_match(sub.func, hints):
+            return True
+    return False
+
+
+def _is_transfer(stmt, resource):
+    """Ownership leaves this function: the resource is stored into an
+    attribute/subscript, passed into a call, or returned/yielded."""
+    if resource is None:
+        return False
+    if isinstance(stmt, ast.Assign):
+        if any(isinstance(t, (ast.Attribute, ast.Subscript))
+               for t in stmt.targets) \
+                and resource in _names_in(stmt.value):
+            return True
+    if isinstance(stmt, (ast.Return, ast.Expr)) and stmt.value is not None:
+        if isinstance(stmt, ast.Return):
+            if resource in _names_in(stmt.value):
+                return True
+        else:
+            for sub in ast.walk(stmt.value):
+                if isinstance(sub, ast.Call):
+                    for a in list(sub.args) + [kw.value
+                                               for kw in sub.keywords]:
+                        if resource in _names_in(a):
+                            return True
+    return False
+
+
+def _can_raise(stmt):
+    for sub in ast.walk(stmt):
+        if isinstance(sub, (ast.Raise, ast.Call, ast.Assert)):
+            return True
+    return False
+
+
+def _has_catchall(try_node):
+    for h in try_node.handlers:
+        if h.type is None:
+            return True
+        for n in ast.walk(h.type):
+            if isinstance(n, ast.Name) \
+                    and n.id in ("Exception", "BaseException"):
+                return True
+    return False
+
+
+@register_pass
+class ResourceLifecyclePass:
+    name = "resource-lifecycle"
+    description = ("allocate/release pairing on all exit paths: KV "
+                   "blocks, replica membership, recorder ring entries")
+    version = "1"
+    scan = SCAN
+    file_local = True
+
+    def run(self, ctx):
+        findings = []
+        for rel in ctx.py_files(SCAN):
+            if rel.startswith("paddle_tpu/analysis/"):
+                continue
+            sf = ctx.source(rel)
+            if sf is None:
+                continue
+            pairs = [p for p in PAIRS if rel.startswith(p[0])]
+            if not pairs or not any(p[1] in sf.text for p in pairs):
+                continue
+            try:
+                tree = sf.tree
+            except SyntaxError as e:
+                findings.append(Finding(
+                    self.name, rel, getattr(e, "lineno", 1) or 1,
+                    "unparseable", f"unparseable ({e})", symbol=rel))
+                continue
+            for qual, fn in self._functions(tree):
+                for pair in pairs:
+                    findings.extend(
+                        self._check_fn(sf, qual, fn, pair))
+        return findings
+
+    def _functions(self, tree):
+        out = []
+
+        def walk(node, prefix):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{sub.name}"
+                    out.append((qual, sub))
+                    walk(sub, f"{qual}.")
+                elif isinstance(sub, ast.ClassDef):
+                    walk(sub, f"{prefix}{sub.name}.")
+                else:
+                    walk(sub, prefix)
+
+        walk(tree, "")
+        return out
+
+    def _acquires(self, fn, pair):
+        """(call node, resource name or None) for this pair's acquires
+        lexically in `fn` (nested defs excluded — they are analyzed as
+        their own functions)."""
+        _, acquire, _, hints, _ = pair
+        skip = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not fn:
+                for inner in ast.walk(sub):
+                    skip.add(id(inner))
+        out = []
+        for node in ast.walk(fn):
+            if id(node) in skip or not isinstance(node, ast.Call):
+                continue
+            if call_name(node.func) != acquire:
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            if not _hint_match(node.func, hints):
+                continue
+            recv = node.func.value
+            if acquire == "ensure":
+                # the receiver IS the resource; attribute receivers
+                # (stream.table.ensure) are owned elsewhere — exempt
+                if isinstance(recv, ast.Name):
+                    out.append((node, recv.id))
+                continue
+            out.append((node, None))  # resource = the result, bound below
+        return out
+
+    def _check_fn(self, sf, qual, fn, pair):
+        scope, acquire, releases, hints, mode = pair
+        acquires = self._acquires(fn, pair)
+        if not acquires:
+            return []
+        ana = _FnAnalysis(fn)
+        findings = []
+        for call, resource in acquires:
+            if waived(sf, call.lineno, _WAIVE):
+                continue
+            # the statement carrying the acquire (innermost container)
+            stmt = None
+            for owner, field, stmts, idx in ana.loc.values():
+                cand = stmts[idx]
+                if cand.lineno <= call.lineno <= getattr(
+                        cand, "end_lineno", cand.lineno) \
+                        and any(sub is call for sub in ast.walk(cand)):
+                    if stmt is None or cand.lineno >= stmt.lineno:
+                        stmt = cand
+            if stmt is None:
+                continue
+            # bind the resource for result-style acquires
+            if resource is None and isinstance(stmt, ast.Assign) \
+                    and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.value is call:
+                resource = stmt.targets[0].id
+
+            if mode == "admit":
+                discarded = isinstance(stmt, ast.Expr) and stmt.value is call
+                if discarded and not any(
+                        _calls_release(s, releases, None, hints)
+                        for s in ast.walk(fn) if isinstance(s, ast.stmt)):
+                    findings.append(Finding(
+                        self.name, sf.rel, call.lineno, "unpaired-acquire",
+                        f"{acquire}(...) result discarded in {qual} with "
+                        f"no {'/'.join(releases)} in the function — a "
+                        "failure after admission cannot identify the "
+                        "replica to remove; capture the returned idx",
+                        symbol=f"{acquire}@{qual}"))
+                continue
+
+            findings.extend(self._check_strict(
+                sf, qual, ana, stmt, call, resource, pair))
+        return findings
+
+    def _check_strict(self, sf, qual, ana, stmt, call, resource, pair):
+        scope, acquire, releases, hints, mode = pair
+        # condition 1: a finally that releases, enclosing the acquire
+        for t in ana.enclosing_trys(stmt):
+            if t.finalbody and any(
+                    _calls_release(s, releases, resource, hints)
+                    for s in t.finalbody):
+                return []
+
+        unprotected_raise = None
+        for s in ana.following(stmt):
+            if _calls_release(s, releases, resource, hints):
+                if unprotected_raise is None:
+                    return []
+                return [self._leak(sf, qual, call, acquire, releases,
+                                   unprotected_raise)]
+            if _is_transfer(s, resource):
+                if unprotected_raise is None:
+                    return []
+                return [self._leak(sf, qual, call, acquire, releases,
+                                   unprotected_raise)]
+            if isinstance(s, ast.If) and (
+                    resource in _names_in(s.test) if resource else False):
+                continue  # guard clause on the failed acquire
+            if _can_raise(s) and unprotected_raise is None:
+                protected = False
+                for t in ana.enclosing_trys(s):
+                    if t.finalbody and any(
+                            _calls_release(x, releases, resource, hints)
+                            for x in t.finalbody):
+                        protected = True
+                        break
+                    if t.handlers and _has_catchall(t) and all(
+                            any(_calls_release(x, releases, resource,
+                                               hints) for x in h.body)
+                            for h in t.handlers):
+                        protected = True
+                        break
+                if not protected:
+                    unprotected_raise = s
+        # ran off the end of the function without release or transfer
+        return [Finding(
+            self.name, sf.rel, call.lineno, "unpaired-acquire",
+            f"{acquire}(...) in {qual} is never released "
+            f"({'/'.join(releases)}) and never transferred to an owner "
+            "— every exit path leaks it; pair it in a try/finally",
+            symbol=f"{acquire}@{qual}")]
+
+    def _leak(self, sf, qual, call, acquire, releases, risky):
+        return Finding(
+            self.name, sf.rel, call.lineno, "leak-on-exception",
+            f"{acquire}(...) in {qual}: line {risky.lineno} can raise "
+            f"before the {'/'.join(releases)} runs and no enclosing "
+            "try releases on that edge — move the release into a "
+            "finally or release in a catch-all handler",
+            symbol=f"{acquire}@{qual}")

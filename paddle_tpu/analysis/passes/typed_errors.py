@@ -48,6 +48,9 @@ class TypedErrorPass:
     name = "typed-error"
     description = ("serving/distributed/resilience raise the typed "
                    "hierarchy, never bare Exception/RuntimeError")
+    version = "1"
+    scan = SCAN
+    file_local = True
 
     def run(self, ctx):
         findings = []

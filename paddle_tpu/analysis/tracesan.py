@@ -1,0 +1,228 @@
+"""Runtime trace sanitizer: deterministic retrace / in-phase host-sync
+detection (the :mod:`~paddle_tpu.analysis.lockorder` analog for the
+compile contract).
+
+The compiled-step contract is "one trace per signature, no host syncs in
+the compute phase" (docs/compiled_step.md). Violations are *performance*
+bugs: a soak run shows them as a throughput cliff hours in, and the bench
+lane only catches the aggregate. This sanitizer turns each violation into
+a deterministic failure at the exact call:
+
+- **steady-state retrace** — while enabled, every compile that goes
+  through a :class:`~paddle_tpu.jit.compiled_step.CompiledTrainStep` or
+  :class:`~paddle_tpu.serving.decode.compiled_decode.CompiledDecodeStep`
+  is counted per ``(step object, signature)``. A second compile for the
+  same signature — cache eviction churn, an unhashable static arg that
+  defeats the program cache, a freshly-constructed wrapper — is a
+  :class:`RetraceViolation`.
+- **in-phase host sync** — ``Tensor.numpy()`` / ``.item()`` /
+  ``.tolist()`` / ``np.asarray(tensor)`` observed while the calling
+  thread's innermost StepTimer phase is ``step/compute`` is a
+  :class:`HostSyncViolation` (the static host-sync pass bans the lexical
+  cases; this catches the dynamic ones the pass cannot see).
+
+Usage (tests — see the ``chaos``/compiled-step fixture in
+tests/conftest.py)::
+
+    with tracesan.tracking() as san:           # mode="record"
+        ... run the scenario ...
+    assert not san.violations
+
+    with tracesan.tracking(mode="raise"):      # direct assertions
+        ...  # the violating call raises at the call site
+
+Zero real sleeps, zero timing dependence: both detections key on call
+counts and the per-thread phase stack, so a violating run fails
+identically every time. Only compiles routed through the step wrappers
+are counted — a bare ``StaticFunction`` probe (parity harnesses trace
+one signature eagerly on purpose) is not steady-state traffic.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["RetraceViolation", "HostSyncViolation", "Sanitizer",
+           "enable", "disable", "tracking"]
+
+_SYNC_PHASE = "step/compute"
+
+
+class RetraceViolation(RuntimeError):
+    """The same input signature compiled more than once at steady state."""
+
+    def __init__(self, label, key, count):
+        self.label = label
+        self.key = key
+        self.count = count
+        super().__init__(
+            f"steady-state retrace: {label} compiled signature "
+            f"{str(key)[:160]} {count} times (contract: one trace per "
+            "signature — docs/compiled_step.md, 'Trace hygiene')")
+
+
+class HostSyncViolation(RuntimeError):
+    """A device→host sync ran inside the step/compute phase."""
+
+    def __init__(self, what):
+        self.what = what
+        super().__init__(
+            f"host sync inside {_SYNC_PHASE}: {what} blocks the dispatch "
+            "pipeline mid-step (docs/compiled_step.md, 'Trace hygiene')")
+
+
+class Sanitizer:
+    """Counters + violations; installed process-globally by enable()."""
+
+    def __init__(self, mode="record"):
+        assert mode in ("record", "raise"), mode
+        self.mode = mode
+        self.violations = []
+        self.retraces = 0
+        self.host_syncs = 0
+        self.compile_counts = {}   # (id(owner), key) -> compiles observed
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    # -- retrace accounting ----------------------------------------------------
+    def _stash_train_key(self, owner, key):
+        # CompiledTrainStep._guard_retrace runs on every not-ready call
+        # (staged discovery hits it several times per key) — the stash is
+        # consumed by the ONE StaticFunction._build/_build_scan that
+        # actually traces, so discovery passes never miscount.
+        self._tls.pending = (id(owner), getattr(owner, "_label", "step"), key)
+
+    def _take_train_key(self):
+        p = getattr(self._tls, "pending", None)
+        self._tls.pending = None
+        return p
+
+    def _note_compile(self, owner_id, label, key):
+        with self._lock:
+            ident = (owner_id, key)
+            n = self.compile_counts.get(ident, 0) + 1
+            self.compile_counts[ident] = n
+        if n > 1:
+            v = RetraceViolation(label, key, n)
+            with self._lock:
+                self.retraces += 1
+                self.violations.append(v)
+            if self.mode == "raise":
+                raise v
+
+    # -- host-sync accounting --------------------------------------------------
+    def _note_host_sync(self, what):
+        from ..profiler.steptimer import get_steptimer
+        if get_steptimer().current_phase() != _SYNC_PHASE:
+            return
+        v = HostSyncViolation(what)
+        with self._lock:
+            self.host_syncs += 1
+            self.violations.append(v)
+        if self.mode == "raise":
+            raise v
+
+
+class _Handle:
+    def __init__(self, san):
+        self.sanitizer = san
+
+    def __enter__(self):
+        return self.sanitizer
+
+    def __exit__(self, *exc):
+        disable()
+        return False
+
+
+_active = [None]          # (sanitizer, saved-attr list)
+_install_lock = threading.Lock()
+
+
+def enable(mode="record"):
+    """Install the sanitizer: wrap the step wrappers' compile paths and
+    the Tensor host-sync surface. Returns the Sanitizer. Nested enables
+    are rejected — the patches are process-global state."""
+    # imports are deferred so this module stays loadable under the
+    # tools/lint.py alias loader (no jax in the linter process)
+    from ..core.tensor import Tensor
+    from ..jit.compiled_step import CompiledTrainStep
+    from ..jit.to_static import StaticFunction
+    from ..serving.decode.compiled_decode import CompiledDecodeStep
+
+    with _install_lock:
+        if _active[0] is not None:
+            raise RuntimeError("trace sanitizing already enabled")
+        san = Sanitizer(mode=mode)
+
+        saved = []
+
+        def patch(cls, name, wrapper):
+            orig = cls.__dict__[name]
+            saved.append((cls, name, orig))
+            setattr(cls, name, wrapper)
+            return orig
+
+        orig_train_guard = CompiledTrainStep._guard_retrace
+
+        def train_guard(self, key):
+            san._stash_train_key(self, key)
+            return orig_train_guard(self, key)
+
+        patch(CompiledTrainStep, "_guard_retrace", train_guard)
+
+        orig_build = StaticFunction._build
+
+        def build(self, prog, args, kwargs):
+            p = san._take_train_key()
+            if p is not None:
+                san._note_compile(p[0], p[1], p[2])
+            return orig_build(self, prog, args, kwargs)
+
+        patch(StaticFunction, "_build", build)
+
+        orig_build_scan = StaticFunction._build_scan
+
+        def build_scan(self, prog):
+            p = san._take_train_key()
+            if p is not None:
+                san._note_compile(p[0], p[1], p[2])
+            return orig_build_scan(self, prog)
+
+        patch(StaticFunction, "_build_scan", build_scan)
+
+        orig_decode_guard = CompiledDecodeStep._guard_retrace
+
+        def decode_guard(self, key):
+            # called exactly once per miss-compile (under the step lock)
+            san._note_compile(id(self), "decode_step", key)
+            return orig_decode_guard(self, key)
+
+        patch(CompiledDecodeStep, "_guard_retrace", decode_guard)
+
+        for meth in ("numpy", "item", "tolist", "__array__"):
+            orig = Tensor.__dict__[meth]
+
+            def wrapper(self, *a, __orig=orig, __name=meth, **kw):
+                san._note_host_sync(f"Tensor.{__name}()")
+                return __orig(self, *a, **kw)
+
+            patch(Tensor, meth, wrapper)
+
+        _active[0] = (san, saved)
+        return san
+
+
+def disable():
+    """Restore every patched attribute. Idempotent."""
+    with _install_lock:
+        if _active[0] is None:
+            return
+        _, saved = _active[0]
+        for cls, name, orig in reversed(saved):
+            setattr(cls, name, orig)
+        _active[0] = None
+
+
+def tracking(mode="record"):
+    """Context manager: ``with tracking() as san: ...``."""
+    return _Handle(enable(mode=mode))

@@ -40,6 +40,8 @@ class _HostPull:
     cpu = None
 
 
+# write-seam: host-staging pull rebinds _val to a device_put copy of the
+# same logical value; taint state is deliberately untouched
 def _pull_host_value(t):
     en = _HostPull.enabled
     if en is None:
@@ -143,7 +145,7 @@ class Tensor:
         return self._val
 
     @_value.setter
-    def _value(self, v):
+    def _value(self, v):   # write-seam: THE taint source — fires on_write, sets _donate_unsafe
         # hook fires BEFORE the write so tracers can snapshot the old value;
         # the new value is passed so the static builder can record the
         # assignment as a replayable node
@@ -312,7 +314,7 @@ class Tensor:
         return _Removable()
 
     # -- in-place (optimizer/runtime use; not differentiated through) -----------
-    def set_value(self, value):
+    def set_value(self, value):   # write-seam: routes through _value, invalidates _degen_cache
         if isinstance(value, Tensor):
             value = value._val
         value = jnp.asarray(value, dtype=self._val.dtype)
@@ -329,24 +331,24 @@ class Tensor:
         self.set_value(other)
         return self
 
-    def _replace_value(self, v):
+    def _replace_value(self, v):   # write-seam: routes through _value, invalidates _degen_cache
         """Internal raw replacement (functional state update)."""
         self._value = v
         # the replacement may move the value into/out of the fused-op
         # degenerate band (ops/_param_guard.py sticky cache)
         self._degen_cache = None
 
-    def scale_(self, factor):
+    def scale_(self, factor):   # write-seam: in-place op, invalidates _degen_cache
         self._value = self._val * factor
         self._degen_cache = None  # may scale into the degenerate band
         return self
 
-    def zero_(self):
+    def zero_(self):   # write-seam: in-place op, invalidates _degen_cache
         self._value = jnp.zeros_like(self._val)
         self._degen_cache = None  # zero-init recipes (ops/_param_guard.py)
         return self
 
-    def fill_(self, v):
+    def fill_(self, v):   # write-seam: in-place op, invalidates _degen_cache
         self._value = jnp.full_like(self._val, v)
         self._degen_cache = None
         return self
@@ -420,6 +422,8 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
 
 
+# write-seam: in-place rebind routes through _value and invalidates
+# _degen_cache after the tape surgery
 def inplace_assign(x, out):
     """Shared implementation of paddle's `op_(x)` in-place family: rebind
     x's buffer to `out`'s AND transplant out's tape node so autograd flows

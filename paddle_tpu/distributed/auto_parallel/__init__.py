@@ -196,6 +196,7 @@ def reshard(x, process_mesh=None, shard_spec=None, dist_attr=None):
     return shard_tensor(x, process_mesh, shard_spec, dist_attr)
 
 
+# write-seam: probe snapshot/restore plus jit write-back of XLA-owned state
 def dtensor_from_fn(fn, process_mesh, shard_spec, *args, **kwargs):
     """Build a sharded tensor directly from a creation fn. The creation runs
     under jit with out_shardings so XLA materializes shards in place — a
@@ -237,6 +238,7 @@ def dtensor_from_fn(fn, process_mesh, shard_spec, *args, **kwargs):
     da = _resolve(process_mesh, shard_spec, len(probe.shape))
     ns = da.named_sharding()
 
+    # traced-fn: jitted creation body; write-seam: tracer rebind + restore
     def pure(state_vals):
         saved = [t._val for t in written]
         try:
@@ -252,6 +254,7 @@ def dtensor_from_fn(fn, process_mesh, shard_spec, *args, **kwargs):
         tuple(t._val for t in written))
     for t, v in zip(written, new_state):
         t._val = v
+        t._donate_unsafe = False  # jit outputs are XLA-owned
     out = Tensor(made)
     out.dist_attr = da
     return out

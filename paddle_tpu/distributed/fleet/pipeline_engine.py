@@ -99,6 +99,7 @@ class _Stage:
         self._fwd_out = None
 
     # -- pure stage function over substituted parameter/buffer values --------
+    # traced-fn: jitted stage body; write-seam: tracer rebind + restore of _val
     def _run(self, param_vals, buf_vals, x, y=None):
         from ...core.dispatch import unwrap
         tensors = [t for _, t in self.params] + [t for _, t in self.buffers]

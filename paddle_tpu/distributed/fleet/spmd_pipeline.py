@@ -67,6 +67,7 @@ class PipelineStageStack(Layer):
                 p._value = jax.device_put(arr, NamedSharding(mesh, spec))
             self.add_parameter(k.replace(".", "__"), p)
 
+    # traced-fn: shard_map/jit stage body; write-seam: tracer rebind + restore
     def _stage_fn(self, param_leaves, x):
         """Run the template stage with substituted parameter values (pure)."""
         sd = self.template.state_dict()

@@ -21,6 +21,7 @@ from ...core.tensor import Tensor, _TraceHooks
 __all__ = ["recompute"]
 
 
+# write-seam: discovery snapshot/restore of _val around the probe trace
 def recompute(function, *args, **kwargs):
     kwargs.pop("preserve_rng_state", True)
     tensor_args = [a for a in args if isinstance(a, Tensor)]
@@ -105,6 +106,7 @@ def recompute(function, *args, **kwargs):
         if _fa._interpret(_vals[0]):
             _force = True
 
+    # traced-fn: checkpointed region body; write-seam: tracer rebind + restore
     def pure(*vals):
         saved = [(t, t._val) for t in closure_reads]
         # writes during the traced run (BN running stats, RNG keys) would

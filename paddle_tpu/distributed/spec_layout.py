@@ -92,6 +92,8 @@ class SpecLayout:
         return NamedSharding(mesh, spec)
 
 
+# write-seam: resharding rebind; device_put outputs are XLA-owned so the
+# host-import taint is cleared
 def shard_params(network, layout, mesh=None):
     """Place every parameter of `network` per `layout` (host → sharded
     device buffers) and record the chosen spec on ``Parameter.sharding_spec``.
@@ -106,12 +108,15 @@ def shard_params(network, layout, mesh=None):
     for name, p in network.named_parameters():
         spec = layout.param_spec(tuple(p._val.shape), name=name, mesh=mesh)
         p._val = jax.device_put(p._val, NamedSharding(mesh, spec))
+        p._donate_unsafe = False  # device_put result is XLA-owned
         p.sharding_spec = spec
         if spec != P():
             n_sharded += 1
     return n_sharded
 
 
+# write-seam: resharding rebind of the same logical value (inputs, not
+# mutated state — taint state deliberately unchanged)
 def shard_batch(layout, *tensors, mesh=None):
     """Shard each input Tensor's batch dim over the data axis (the compiled
     program's GSPMD entry point; mirrors the hand-wired
@@ -129,6 +134,8 @@ def shard_batch(layout, *tensors, mesh=None):
     return out[0] if len(out) == 1 else out
 
 
+# write-seam: resharding rebind of the same logical value (inputs, not
+# mutated state — taint state deliberately unchanged)
 def shard_stacked_batch(layout, *tensors, mesh=None):
     """Shard scan-grouped (run_steps) inputs: leading axis is the step
     index, dim 1 is the batch dim sharded over data."""
@@ -142,6 +149,8 @@ def shard_stacked_batch(layout, *tensors, mesh=None):
     return out[0] if len(out) == 1 else out
 
 
+# write-seam: gather rebinds _val to a host-imported buffer, so the
+# donation taint is re-armed
 def unshard(network):
     """Gather every parameter back to single-device values (checkpoint
     export, parity harnesses). Inverse of :func:`shard_params`."""
@@ -149,4 +158,5 @@ def unshard(network):
     import numpy as np
     for _, p in network.named_parameters():
         p._val = jnp.asarray(np.asarray(p._val))
+        p._donate_unsafe = True  # round-tripped through a host buffer
         p.sharding_spec = None

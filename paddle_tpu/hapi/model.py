@@ -116,7 +116,11 @@ class Model:
         with st.phase("step/compute"):
             loss = self._compiled_train_step(ins, labs)
             st.sync(loss)
-            out = [float(loss.item())]
+        # scalar extraction OUTSIDE the compute phase: .item() blocks on
+        # device execution, which would charge dispatch with execution
+        # wall time and stall the pipeline mid-phase (trace sanitizer
+        # enforces this — docs/compiled_step.md, 'Trace hygiene')
+        out = [float(loss.item())]
         return out
 
     def _train_steps(self, batches):
@@ -153,8 +157,10 @@ class Model:
             losses = self._compiled_train_step.run_steps(ins_stacked,
                                                          labs_stacked)
             st.sync(losses)
-            out = head + [[float(v)]
-                          for v in np.asarray(losses.numpy()).reshape(-1)]
+        # per-step loss read-back OUTSIDE the compute phase (same
+        # contract as train_batch: no host syncs mid-phase)
+        out = head + [[float(v)]
+                      for v in np.asarray(losses.numpy()).reshape(-1)]
         return out
 
     def eval_batch(self, inputs, labels=None):

@@ -58,7 +58,7 @@ class InputPrefetcher:
         self._thread.start()
 
     @staticmethod
-    def _stage(v):
+    def _stage(v):   # hot-path: overlapped h2d staging — a sync here unoverlaps it
         """Start the host→device copy for one leaf; Tensors (dataset already
         produced device values) and scalars pass through untouched."""
         import jax
@@ -66,7 +66,7 @@ class InputPrefetcher:
         from ..core.tensor import Tensor
         if isinstance(v, (Tensor, jax.Array)):
             return v
-        arr = np.asarray(v)
+        arr = np.asarray(v)   # sync-ok: loader leaves are host-resident here (device values returned above)
         if arr.dtype == object:
             return v  # non-numeric payload: let the step's own staging cope
         return jnp.asarray(arr)

@@ -351,6 +351,8 @@ class Predictor:
                                   else v)
                               for k, v in params.items()}
 
+                # traced-fn: jitted predictor body; write-seam: tracer
+                # rebind + restore of _val
                 def pure(param_vals, *xs):
                     sd = layer.state_dict()
                     saved = {k: t._val for k, t in sd.items()}

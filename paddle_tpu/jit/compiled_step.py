@@ -135,7 +135,7 @@ class CompiledTrainStep:
             RuntimeWarning, stacklevel=3)
 
     # -- single step -----------------------------------------------------------
-    def __call__(self, *args, **kwargs):
+    def __call__(self, *args, **kwargs):   # hot-path: the per-step dispatch chokepoint
         st = self._static
         if not (st._enabled and StaticFunction._default_enabled):
             return st(*args, **kwargs)  # eager oracle: no counters, no phase
@@ -156,7 +156,7 @@ class CompiledTrainStep:
         return out
 
     # -- K fused steps (lax.scan) ----------------------------------------------
-    def run_steps(self, *args, **kwargs):
+    def run_steps(self, *args, **kwargs):   # hot-path: the K-step scan dispatch chokepoint
         st = self._static
         if not (st._enabled and StaticFunction._default_enabled):
             return st.run_steps(*args, **kwargs)

@@ -423,7 +423,7 @@ class StaticFunction:
         rest_vals = (tuple(t._val for t in leaves) if i == 0
                      else tuple(t._val[i:] for t in leaves))
 
-        def _exec_scan():
+        def _exec_scan():   # write-seam: scan write-back of XLA-owned outputs clears taint
             mut_vals = tuple(t._val for t in prog.mutated)
             ro_vals = tuple(t._val for t in prog.ro)
             rest = rest_vals
@@ -478,7 +478,7 @@ class StaticFunction:
         leaves_out = [Tensor(v, stop_gradient=True) for v in outs]
         return _unflatten(prog.out_tree, leaves_out)
 
-    def _discover_throwaway(self, key, step_slice):
+    def _discover_throwaway(self, key, step_slice):   # write-seam: snapshot/rollback restore of _val
         """Discovery without advancing state: one eager pass on a batch-1
         sub-slice of the step-0 inputs, snapshotting the pre-write value of
         every tensor written (lazily-created optimizer moments roll back to
@@ -601,7 +601,7 @@ class StaticFunction:
         pure_fn = prog.pure_fn
         n_outs = prog.n_outs
 
-        def scan_fn(mut_vals, ro_vals, stacked_arg_vals):
+        def scan_fn(mut_vals, ro_vals, stacked_arg_vals):   # traced-fn: jitted K-step scan body
             def body(carry, xs):
                 flat = pure_fn(carry, ro_vals, xs)
                 return tuple(flat[n_outs:]), tuple(flat[:n_outs])
@@ -683,6 +683,7 @@ class StaticFunction:
         mutated, ro = list(prog.mutated), list(prog.ro)
         arg_tensors = _flatten_tensors((args, kwargs), [])
 
+        # traced-fn: THE jitted program body; write-seam: tracer rebind + restore of _val
         def pure_fn(mut_vals, ro_vals, arg_vals):
             all_t = mutated + ro + arg_tensors
             all_ids = {id(t) for t in all_t}
@@ -785,7 +786,7 @@ class StaticFunction:
         else:
             prog.jitted_donate = prog.jitted
 
-    def _run(self, prog, args, kwargs):
+    def _run(self, prog, args, kwargs):   # write-seam: compiled write-back of XLA-owned outputs clears taint
         arg_tensors = _flatten_tensors((args, kwargs), [])
         mut_vals = tuple(t._val for t in prog.mutated)
         ro_vals = tuple(t._val for t in prog.ro)

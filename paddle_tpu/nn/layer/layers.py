@@ -266,7 +266,7 @@ class Layer:
                     dest[lp + ("." if lp else "") + name] = b
         return dest
 
-    def set_state_dict(self, state_dict, use_structured_name=True):
+    def set_state_dict(self, state_dict, use_structured_name=True):   # write-seam: routes through _value, invalidates _degen_cache
         """load_dict parity; copies values into existing tensors (dtype-cast)."""
         import jax.numpy as jnp
         own = self.state_dict()

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 __all__ = ["degenerate_below_tol"]
 
 
+# write-seam: THE _degen_cache fill site — the memo this cache exists for
 def degenerate_below_tol(param, tol):
     """True iff `param` (a Tensor or raw array) is concretely inspectable
     AND some element sits inside the |value| <= tol band.

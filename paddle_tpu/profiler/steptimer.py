@@ -145,6 +145,13 @@ class StepTimer:
             _chrome_span(name, frame[1], dur, "step_phase")
             self._overhead_s += self._clock() - t1
 
+    def current_phase(self):
+        """The innermost phase name open on THIS thread, or None. Cheap
+        enough for per-event checks (the trace sanitizer keys its
+        in-phase host-sync detection on it)."""
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1][0] if stack else None
+
     # -- step boundaries -------------------------------------------------------
     @contextmanager
     def step(self, n_steps=1):

@@ -377,10 +377,13 @@ class ConsensusChecker:
         if not culprits:
             return digest
         self.counters["divergences"] += 1
+        # int() is evaluated before the ring entry opens so no statement
+        # between start and finish can raise and leave it "started"
+        step_i = int(step)
         if self.recorder is not None:
             entry = self.recorder.start("integrity.consensus")
             entry["culprits"] = culprits
-            entry["step"] = int(step)
+            entry["step"] = step_i
             self.recorder.finish(entry, status="divergent")
         if rank in culprits:
             # the accused self-marks: excluded from the next generation's

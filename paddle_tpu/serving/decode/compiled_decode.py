@@ -119,7 +119,7 @@ class CompiledDecodeStep:
                     for v in (tokens, positions, *leaves))
         return (int(np.shape(tokens)[0]), sig)
 
-    def run(self, tokens, positions, kv):
+    def run(self, tokens, positions, kv):   # hot-path: per-token decode dispatch
         """One decode step at the caller-chosen bucket. Returns
         ``(next_tokens, new_kv)``; ``kv``'s device buffers are consumed
         (donated) — the caller must thread ``new_kv`` into the next call."""

@@ -197,7 +197,7 @@ class DecodeEngine:
             return stream
 
     # -- the engine tick -----------------------------------------------------
-    def step(self):
+    def step(self):   # hot-path: the engine tick — every running stream waits on it
         """One scheduling round: expire deadlines, ration one prefill
         chunk, decode one token for every running stream. A replica death
         mid-round resets the backend and replays live streams. Returns the

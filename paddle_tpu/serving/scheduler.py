@@ -311,7 +311,7 @@ class Scheduler:
         maybe_inject("serving.hedge", TimeoutError)
 
     # -- dispatch --------------------------------------------------------------
-    def dispatch(self, batch):
+    def dispatch(self, batch):   # hot-path: every serving batch funnels through here
         """Run one batch on a replica (hedging to a second one when the
         primary blows its p99-derived window). Raises:
 
@@ -348,7 +348,7 @@ class Scheduler:
                 self._metrics.inc("hedge_wins")
             return outputs, rep
 
-    def _attempt(self, batch, timeout, hedged):
+    def _attempt(self, batch, timeout, hedged):   # hot-path: single placement attempt under the watchdog
         rep = self.pick(exclude=batch.tried_replicas)
         batch.tried_replicas.add(rep.idx)
         with self._lock:

@@ -267,13 +267,16 @@ class InferenceServer:
         attempts = self.config.max_retries + 1
         last_exc = None
         for attempt in range(attempts):
+            # the clock read precedes the ring-entry open: nothing between
+            # recorder.start and the try below may raise, or the entry
+            # would be stranded "started" (flight_recorder_diff false hang)
+            exec_start = self._now()
             entry = self.recorder.start(
                 "serving.batch", group=f"bucket{batch.bucket}",
                 shapes=[list(a.shape) for a in batch.arrays],
                 dtypes=[str(a.dtype) for a in batch.arrays],
                 peer={"batch": batch.id, "attempt": attempt,
                       "requests": [r.id for r in batch.requests]})
-            exec_start = self._now()
             try:
                 # a serving batch has no trainer step around it: the phase
                 # lands in the timer's global accumulators and the

@@ -90,7 +90,7 @@ class Variable(Tensor):
     def shape(self):
         return list(self.declared_shape)
 
-    def bind(self, value):
+    def bind(self, value):   # write-seam: replay bind of a trace-transparent Variable (never donated)
         self._val = value
 
     def __repr__(self):
@@ -112,7 +112,7 @@ class OpNode:
         self.multi = multi
         self.op_type = op_type
 
-    def execute(self):
+    def execute(self):   # write-seam: replay bind of trace-transparent out-Variables
         from ..core.dispatch import apply
         res = apply(self.prim, *self.args, name=self.op_type, **self.kwargs)
         rs = res if isinstance(res, (tuple, list)) else (res,)
@@ -143,7 +143,7 @@ class AssignNode:
     def op_type(self):
         return "share_data"
 
-    def execute(self):
+    def execute(self):   # write-seam: replay of a recorded in-place assignment
         self.target._val = (self.source._val
                             if isinstance(self.source, Tensor)
                             else self.source)
@@ -167,7 +167,7 @@ class RngNode:
     def op_type(self):
         return "seed_generator"
 
-    def execute(self):
+    def execute(self):   # write-seam: replay bind of the RNG out-Variable
         sub = self.generator.next_key()
         self.out._val = jax.random.key_data(sub)
         self.out._grad_node = None
@@ -191,7 +191,7 @@ class GradReadNode:
     def op_type(self):
         return "read_grad"
 
-    def execute(self):
+    def execute(self):   # write-seam: replay bind of the grad out-Variable
         g = self.source.grad
         self.out._val = (g._val if g is not None
                          else jnp.zeros(self.source._val.shape,
@@ -510,7 +510,7 @@ class _Builder:
             if src is not None:
                 self.current.add_node(AssignNode(t, src))
 
-    def flush_sandbox(self):
+    def flush_sandbox(self):   # write-seam: build-sandbox rollback restores snapshotted _val
         for t, old in self._snapshots.values():
             t._val = old
         self._snapshots.clear()
@@ -761,6 +761,7 @@ class Executor:
                 elif isinstance(n, (RngNode, GradReadNode)):
                     written_vars.append(n.out)
 
+            # write-seam: replay bind + restore of trace-transparent Variables
             def replay(*feed_vals):
                 # silence static recording so nodes execute eagerly; trace
                 # hooks are left alone — they belong to the enclosing

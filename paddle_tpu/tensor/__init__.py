@@ -75,7 +75,7 @@ def _patch():
         idx2 = _convert_index(idx)
         return apply(lambda v: v[idx2], self, name="getitem")
 
-    def _setitem(self, idx, value):
+    def _setitem(self, idx, value):   # write-seam: routes through _value, invalidates _degen_cache
         idx2 = _convert_index(idx)
         val = unwrap(value) if isinstance(value, Tensor) else value
         self._value = self._val.at[idx2].set(val)

@@ -56,3 +56,26 @@ def _lock_order(request):
     assert not tracker.violations, (
         "lock-order inversion(s) recorded during chaos test:\n" +
         "\n".join(v.args[0] for v in tracker.violations))
+
+
+@pytest.fixture(autouse=True)
+def _trace_san(request):
+    """Chaos and compiled-step tests run under the runtime trace
+    sanitizer: compiles routed through the step wrappers are counted per
+    signature and host syncs are watched inside step/compute, so a
+    steady-state retrace or an in-phase sync fails the test
+    deterministically (docs/compiled_step.md, 'Trace hygiene'). Tests
+    that exercise retrace pathologies on purpose opt out with
+    ``@pytest.mark.allow_retrace``."""
+    chaos = request.node.get_closest_marker("chaos") is not None
+    compiled = "compiled" in request.node.fspath.basename
+    if (not (chaos or compiled)
+            or request.node.get_closest_marker("allow_retrace") is not None):
+        yield
+        return
+    from paddle_tpu.analysis import tracesan
+    with tracesan.tracking(mode="record") as san:
+        yield
+    assert not san.violations, (
+        "trace-safety violation(s) recorded:\n" +
+        "\n".join(v.args[0] for v in san.violations))

@@ -103,10 +103,12 @@ def test_overlay_and_restrict_mechanics():
     assert ctx.reported([inside, outside]) == [inside]
 
 
-def test_registry_has_all_six_passes():
+def test_registry_has_all_ten_passes():
     assert set(analysis.all_passes()) == {
         "lock-discipline", "blocking-call", "typed-error",
-        "flag-hygiene", "injection-points", "metric-names"}
+        "flag-hygiene", "injection-points", "metric-names",
+        "donation-taint", "jit-hygiene", "host-sync",
+        "resource-lifecycle"}
 
 
 # ---------------------------------------------------------------------------
@@ -611,3 +613,300 @@ def test_lockorder_nested_enable_rejected_and_disable_idempotent():
             lockorder.enable()
     lockorder.disable()   # already disabled by the context: no-op
     assert threading.Lock is lockorder._real_lock
+
+
+# ---------------------------------------------------------------------------
+# donation-taint: fixtures + mutations
+# ---------------------------------------------------------------------------
+
+_TAINT_BAD = textwrap.dedent("""\
+    def swap_backing(t, v):
+        t._val = v
+
+    def rearm(t):
+        t._donate_unsafe = False
+    """)
+
+
+def test_donation_taint_flags_direct_writes_outside_seams():
+    rel = "paddle_tpu/core/_fx_taint.py"
+    found = analysis.run_pass("donation-taint", _ctx({rel: _TAINT_BAD}))
+    assert _codes(found) == ["direct-write", "direct-write"]
+    assert {f.symbol for f in found} == {
+        "_val@swap_backing", "_donate_unsafe@rearm"}
+
+
+def test_donation_taint_accepts_seams_waivers_and_init():
+    good = textwrap.dedent("""\
+        # write-seam: fixture seam — multi-line lead comment form,
+        # second line of the registration block
+        def swap_backing(t, v):
+            t._val = v
+
+
+        def rearm(t):
+            t._donate_unsafe = False   # taint-ok: fixture probe tensor
+
+
+        class Holder:
+            def __init__(self, v):
+                self._val = v          # self-write in __init__: exempt
+        """)
+    rel = "paddle_tpu/core/_fx_taint.py"
+    found = analysis.run_pass("donation-taint", _ctx({rel: good}))
+    assert found == []
+
+
+def test_mutation_stripping_write_seam_trips_unseeded():
+    """Deleting a '# write-seam:' annotation from a contracted seam must
+    itself be a finding — the contract cannot be silently disarmed."""
+    rel = "paddle_tpu/core/tensor.py"
+    real = (REPO / rel).read_text()
+    assert analysis.run_pass("donation-taint",
+                             _ctx({}, restrict={rel})) == []
+    mutated = real.replace("write-seam:", "write-seam-x:")
+    assert mutated != real
+    found = analysis.run_pass("donation-taint", _ctx({rel: mutated}))
+    codes = _codes(found)
+    assert "unseeded" in codes, codes
+    # the seams still write the contracted attrs, now unregistered
+    assert "direct-write" in codes, codes
+
+
+def test_donation_taint_seam_contract_on_neutered_setter():
+    """A Tensor._value setter that stops setting _donate_unsafe breaks
+    the contract every property write in the tree relies on."""
+    rel = "paddle_tpu/core/tensor.py"
+    neutered = textwrap.dedent("""\
+        class Tensor:
+            @property
+            def _value(self):
+                return self._val
+
+            # write-seam: fixture — deliberately forgets the taint bit
+            @_value.setter
+            def _value(self, v):
+                self._val = v
+        """)
+    found = analysis.run_pass("donation-taint", _ctx({rel: neutered}))
+    assert "seam-contract" in _codes(found)
+
+
+# ---------------------------------------------------------------------------
+# jit-hygiene: fixtures + mutations
+# ---------------------------------------------------------------------------
+
+def test_jit_hygiene_flags_hazards_in_traced_body():
+    src = textwrap.dedent("""\
+        def pure_fn(vals, x):   # traced-fn: fixture trace root
+            t0 = time.time()
+            draw = np.random.rand()
+            host = x.item()
+            arr = np.asarray(x)
+            return t0, draw, host, arr
+        """)
+    rel = "paddle_tpu/jit/_fx_trace.py"
+    found = analysis.run_pass("jit-hygiene", _ctx({rel: src}))
+    assert _codes(found) == ["host-value", "host-value",
+                             "impure-random", "impure-time"]
+
+
+def test_jit_hygiene_follows_same_module_callees():
+    src = textwrap.dedent("""\
+        def helper(x):
+            return time.perf_counter()
+
+        def pure_fn(vals, x):   # traced-fn: fixture trace root
+            return helper(x)
+        """)
+    rel = "paddle_tpu/jit/_fx_trace.py"
+    found = analysis.run_pass("jit-hygiene", _ctx({rel: src}))
+    assert _codes(found) == ["impure-time"]
+    assert "helper" in found[0].message
+
+
+def test_jit_hygiene_waiver_and_clean_twin():
+    src = textwrap.dedent("""\
+        def pure_fn(vals, x):   # traced-fn: fixture trace root
+            t0 = time.time()   # trace-ok: fixture — reviewed
+            return vals
+        """)
+    rel = "paddle_tpu/jit/_fx_trace.py"
+    assert analysis.run_pass("jit-hygiene", _ctx({rel: src})) == []
+
+
+def test_jit_hygiene_flags_step_wrapper_built_in_loop():
+    src = textwrap.dedent("""\
+        def train(fns, ins, labs):
+            for fn in fns:
+                step = CompiledTrainStep(fn)
+                step(ins, labs)
+        """)
+    rel = "paddle_tpu/jit/_fx_trace.py"
+    found = analysis.run_pass("jit-hygiene", _ctx({rel: src}))
+    assert _codes(found) == ["fresh-step-in-loop"]
+
+
+def test_mutation_time_call_in_real_traced_fn_fires():
+    """The ISSUE's canonical mutation: add time.time() to a real traced
+    body (the K-step scan_fn) and jit-hygiene must fire."""
+    rel = "paddle_tpu/jit/to_static.py"
+    real = (REPO / rel).read_text()
+    assert analysis.run_pass("jit-hygiene",
+                             _ctx({}, restrict={rel})) == []
+    needle = "def scan_fn(mut_vals, ro_vals, stacked_arg_vals):"
+    assert needle in real
+    lines = real.splitlines(keepends=True)
+    idx = next(i for i, ln in enumerate(lines) if needle in ln)
+    indent = " " * (len(lines[idx]) - len(lines[idx].lstrip()) + 4)
+    lines.insert(idx + 1, f"{indent}_mut_probe = time.time()\n")
+    found = analysis.run_pass("jit-hygiene", _ctx({rel: "".join(lines)}))
+    assert "impure-time" in _codes(found)
+
+
+def test_mutation_stripping_traced_fn_trips_unseeded():
+    rel = "paddle_tpu/jit/to_static.py"
+    real = (REPO / rel).read_text()
+    mutated = real.replace("traced-fn:", "traced-fn-x:")
+    assert mutated != real
+    found = analysis.run_pass("jit-hygiene", _ctx({rel: mutated}))
+    assert _codes(found).count("unseeded") == 2  # pure_fn + scan_fn
+
+
+# ---------------------------------------------------------------------------
+# host-sync: fixtures + mutations
+# ---------------------------------------------------------------------------
+
+def test_host_sync_flags_syncs_on_hot_path_and_honors_waiver():
+    src = textwrap.dedent("""\
+        def step(self, x):   # hot-path: fixture tick
+            v = x.numpy()
+            w = np.asarray(x)
+            y = x.item()   # sync-ok: fixture — emission boundary
+            return v, w, y
+        """)
+    rel = "paddle_tpu/serving/_fx_hot.py"
+    found = analysis.run_pass("host-sync", _ctx({rel: src}))
+    assert _codes(found) == ["host-sync", "host-sync"]
+
+    cold = src.replace("# hot-path: fixture tick", "")
+    assert analysis.run_pass("host-sync", _ctx({rel: cold})) == []
+
+
+def test_mutation_deregistering_hot_path_trips_unseeded():
+    """Deleting the '# hot-path:' annotation from a contracted hot path
+    silently disables the sync check — the SEEDED manifest catches it."""
+    rel = "paddle_tpu/jit/compiled_step.py"
+    real = (REPO / rel).read_text()
+    assert analysis.run_pass("host-sync",
+                             _ctx({}, restrict={rel})) == []
+    mutated = real.replace("hot-path:", "hot-path-x:")
+    assert mutated != real
+    found = analysis.run_pass("host-sync", _ctx({rel: mutated}))
+    assert _codes(found).count("unseeded") == 2  # __call__ + run_steps
+
+
+# ---------------------------------------------------------------------------
+# resource-lifecycle: fixtures + mutations
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_flags_leak_on_exception_and_accepts_finally():
+    bad = textwrap.dedent("""\
+        def grab(pool, n):
+            blocks = pool.try_allocate(n)
+            validate(n)
+            pool.release(blocks)
+        """)
+    rel = "paddle_tpu/serving/_fx_life.py"
+    found = analysis.run_pass("resource-lifecycle", _ctx({rel: bad}))
+    assert _codes(found) == ["leak-on-exception"]
+
+    good = textwrap.dedent("""\
+        def grab(pool, n):
+            blocks = pool.try_allocate(n)
+            try:
+                validate(n)
+            finally:
+                pool.release(blocks)
+        """)
+    assert analysis.run_pass("resource-lifecycle",
+                             _ctx({rel: good})) == []
+
+
+def test_lifecycle_flags_unpaired_acquire_and_honors_waiver():
+    bad = textwrap.dedent("""\
+        def grab(pool, n):
+            blocks = pool.try_allocate(n)
+        """)
+    rel = "paddle_tpu/serving/_fx_life.py"
+    found = analysis.run_pass("resource-lifecycle", _ctx({rel: bad}))
+    assert _codes(found) == ["unpaired-acquire"]
+
+    waived_src = bad.replace(
+        "pool.try_allocate(n)",
+        "pool.try_allocate(n)   # lifecycle-ok: fixture — reviewed")
+    assert analysis.run_pass("resource-lifecycle",
+                             _ctx({rel: waived_src})) == []
+
+
+def test_lifecycle_recorder_start_finish_pairing():
+    bad = textwrap.dedent("""\
+        def record(self, recorder):
+            entry = recorder.start("op")
+            risky()
+            recorder.finish(entry)
+        """)
+    rel = "paddle_tpu/resilience/_fx_life.py"
+    found = analysis.run_pass("resource-lifecycle", _ctx({rel: bad}))
+    assert _codes(found) == ["leak-on-exception"]
+
+
+def test_lifecycle_admit_mode_requires_captured_result():
+    bad = textwrap.dedent("""\
+        class C:
+            def admit(self, rep):
+                self.scheduler.add_replica(rep)
+        """)
+    rel = "paddle_tpu/serving/_fx_life.py"
+    found = analysis.run_pass("resource-lifecycle", _ctx({rel: bad}))
+    assert _codes(found) == ["unpaired-acquire"]
+
+    good = bad.replace("self.scheduler.add_replica(rep)",
+                       "idx = self.scheduler.add_replica(rep)")
+    assert analysis.run_pass("resource-lifecycle",
+                             _ctx({rel: good})) == []
+
+
+def test_mutation_unhoisting_integrity_int_trips_lifecycle():
+    """PR 14's real fix: int(step) is hoisted above the consensus ring
+    entry. Moving the conversion back between start and finish re-creates
+    the stranded-'started' hazard and the pass must catch it."""
+    rel = "paddle_tpu/resilience/integrity.py"
+    real = (REPO / rel).read_text()
+    assert analysis.run_pass("resource-lifecycle",
+                             _ctx({}, restrict={rel})) == []
+    mutated = real.replace('entry["step"] = step_i',
+                           'entry["step"] = int(step)')
+    assert mutated != real
+    found = analysis.run_pass("resource-lifecycle", _ctx({rel: mutated}))
+    assert "leak-on-exception" in _codes(found)
+
+
+def test_mutation_unhoisting_server_clock_trips_lifecycle():
+    """Same fix class in serving/server.py: the clock read precedes the
+    ring-entry open. Swapping them back puts a raising call between
+    start and the try, stranding the entry on that edge."""
+    rel = "paddle_tpu/serving/server.py"
+    real = (REPO / rel).read_text()
+    assert analysis.run_pass("resource-lifecycle",
+                             _ctx({}, restrict={rel})) == []
+    needle = "exec_start = self._now()\n            entry = self.recorder.start("
+    assert needle in real
+    mutated = real.replace(
+        needle,
+        "entry = self.recorder.start(", 1)
+    mutated = mutated.replace(
+        "            try:\n",
+        "            exec_start = self._now()\n            try:\n", 1)
+    found = analysis.run_pass("resource-lifecycle", _ctx({rel: mutated}))
+    assert "leak-on-exception" in _codes(found)

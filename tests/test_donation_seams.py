@@ -1,0 +1,122 @@
+"""Fix-class regressions for the trace-safety PR.
+
+Two families:
+
+- **donation/taint seams**: every registered ``# write-seam:`` function
+  must leave ``Tensor._donate_unsafe`` in the state its annotation
+  promises — shard_params clears it (device_put outputs are XLA-owned),
+  unshard re-arms it (host round-trip), dtensor_from_fn outputs are
+  XLA-owned, and the ``_value`` setter re-arms on host import. The
+  static donation-taint pass proves only *where* writes happen; these
+  prove the writes do the right thing.
+- **hapi scalar read-back**: ``Model.train_batch`` / ``_train_steps``
+  extract losses OUTSIDE the ``step/compute`` phase. Run under the
+  runtime sanitizer in raise mode, so moving ``.item()``/``.numpy()``
+  back inside the phase fails at the violating call, not as a perf
+  cliff hours into a soak.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.analysis import tracesan
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.spec_layout import (
+    SpecLayout, shard_params, unshard,
+)
+
+
+@pytest.fixture()
+def flag_guard():
+    names = ["FLAGS_compiled_step", "FLAGS_input_prefetch"]
+    old = paddle.get_flags(names)
+    yield
+    paddle.set_flags(old)
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+# ---------------------------------------------------------------------------
+# donation/taint seams
+# ---------------------------------------------------------------------------
+
+class TestTaintSeams:
+    def test_host_imported_tensor_is_taint_armed(self):
+        t = paddle.to_tensor(np.ones((4, 2), "float32"))
+        assert t._donate_unsafe is True
+
+    def test_value_setter_rearms_taint(self):
+        t = paddle.to_tensor(np.ones((4, 2), "float32"))
+        t._donate_unsafe = False  # taint-ok: test resets the bit on purpose
+        t._value = np.zeros((4, 2), "float32")
+        assert t._donate_unsafe is True
+
+    def test_shard_params_clears_and_unshard_rearms(self):
+        model = _mlp()
+        for _, p in model.named_parameters():
+            # arm the taint via the _value seam (host import) so the test
+            # proves shard_params actively clears it, not that it was
+            # already clear
+            p._value = np.asarray(p._val)
+            assert p._donate_unsafe is True
+        shard_params(model, SpecLayout())
+        for _, p in model.named_parameters():
+            assert p._donate_unsafe is False  # device_put: XLA-owned
+            assert p.sharding_spec is not None
+        unshard(model)
+        for _, p in model.named_parameters():
+            assert p._donate_unsafe is True  # host round-trip re-arms
+            assert p.sharding_spec is None
+
+    def test_dtensor_from_fn_output_untainted(self):
+        from paddle_tpu.distributed.auto_parallel import (
+            ProcessMesh, dtensor_from_fn,
+        )
+        pm = ProcessMesh(np.arange(8), dim_names=["dp"])
+        t = dtensor_from_fn(
+            lambda: paddle.zeros((8, 4)).fill_(1.0), pm, ["dp", None])
+        assert t._donate_unsafe is False  # jit output: XLA-owned
+        np.testing.assert_allclose(np.asarray(t._val), np.ones((8, 4)))
+
+
+# ---------------------------------------------------------------------------
+# hapi scalar read-back stays outside step/compute
+# ---------------------------------------------------------------------------
+
+def _prepared_model():
+    net = _mlp()
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    return m
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [([rng.randn(4, 8).astype("float32")],
+             [rng.randint(0, 4, (4,)).astype("int64")]) for _ in range(n)]
+
+
+class TestHapiReadback:
+    def test_train_batch_readback_outside_compute_phase(self, flag_guard):
+        paddle.set_flags({"FLAGS_compiled_step": True,
+                          "FLAGS_input_prefetch": False})
+        m = _prepared_model()
+        with tracesan.tracking(mode="raise"):
+            losses = [m.train_batch(ins, labs)[0]
+                      for ins, labs in _batches(3)]
+        assert all(isinstance(v, float) and np.isfinite(v) for v in losses)
+
+    def test_train_steps_readback_outside_compute_phase(self, flag_guard):
+        paddle.set_flags({"FLAGS_compiled_step": True,
+                          "FLAGS_input_prefetch": False})
+        m = _prepared_model()
+        with tracesan.tracking(mode="raise"):
+            out = m._train_steps(_batches(4))
+        assert len(out) == 4
+        assert all(np.isfinite(v[0]) for v in out)

@@ -1,0 +1,179 @@
+"""Runtime trace sanitizer (paddle_tpu/analysis/tracesan.py).
+
+Every scenario is injected and deterministic — cache eviction is forced
+by clearing the program cache, the in-phase sync by calling ``.item()``
+inside an explicit ``step/compute`` phase. No sleeps, no timing
+dependence: a violating run fails identically every time.
+
+(This file deliberately does NOT have "compiled" in its name, so the
+autouse ``_trace_san`` conftest fixture stays out of the way and each
+test installs/uninstalls the sanitizer explicitly.)
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.analysis import tracesan
+from paddle_tpu.analysis.tracesan import (
+    HostSyncViolation, RetraceViolation,
+)
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.compiled_step import CompiledTrainStep
+from paddle_tpu.profiler.steptimer import get_steptimer
+
+
+def _make_step(seed=0):
+    paddle.seed(seed)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    def _step(x, y):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return CompiledTrainStep(_step, label="tracesan.fixture")
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    x = paddle.to_tensor(rng.randn(8, 4).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 2, (8,)).astype("int64"))
+    return x, y
+
+
+def _warm(step, n=6):
+    """Run past staged discovery so the program is fully compiled."""
+    x, y = _batch()
+    for _ in range(n):
+        step(x, y)
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# retrace detection
+# ---------------------------------------------------------------------------
+
+class TestRetrace:
+    def test_steady_state_loop_is_clean(self):
+        step = _make_step()
+        with tracesan.tracking(mode="record") as san:
+            _warm(step)
+        assert san.violations == []
+        assert san.retraces == 0
+
+    def test_cache_eviction_retrace_recorded(self):
+        step = _make_step()
+        with tracesan.tracking(mode="record") as san:
+            x, y = _warm(step)
+            # injected eviction churn: same signature must recompile
+            step.static_function._programs.clear()
+            _warm(step)
+        assert san.retraces == 1
+        assert isinstance(san.violations[0], RetraceViolation)
+        assert "one trace per signature" in str(san.violations[0])
+
+    def test_retrace_raises_at_the_violating_call(self):
+        step = _make_step()
+        with tracesan.tracking(mode="raise"):
+            _warm(step)
+            step.static_function._programs.clear()
+            x, y = _batch()
+            with pytest.raises(RetraceViolation):
+                _warm(step)
+
+    def test_fresh_wrapper_is_not_a_retrace(self):
+        """A second wrapper is a second owner: its first compile per
+        signature is legitimate (the static jit-hygiene pass handles the
+        lexical fresh-step-in-loop case)."""
+        with tracesan.tracking(mode="raise") as san:
+            _warm(_make_step(seed=0))
+            _warm(_make_step(seed=1))
+        assert san.retraces == 0
+
+
+# ---------------------------------------------------------------------------
+# in-phase host-sync detection
+# ---------------------------------------------------------------------------
+
+class TestHostSync:
+    def test_sync_inside_compute_phase_recorded(self):
+        t = paddle.to_tensor(np.float32(1.5))
+        arr = paddle.to_tensor(np.ones((3,), "float32"))
+        st = get_steptimer()
+        with tracesan.tracking(mode="record") as san:
+            with st.phase("step/compute"):
+                t.item()
+                arr.numpy()
+        assert san.host_syncs == 2
+        assert all(isinstance(v, HostSyncViolation) for v in san.violations)
+        assert "step/compute" in str(san.violations[0])
+
+    def test_sync_outside_or_in_other_phase_is_clean(self):
+        t = paddle.to_tensor(np.float32(1.5))
+        st = get_steptimer()
+        with tracesan.tracking(mode="record") as san:
+            t.item()                      # no phase open
+            with st.phase("step/h2d"):
+                t.numpy()                 # different phase
+            with st.phase("step/compute"):
+                pass                      # phase open, no sync
+            t.tolist()                    # phase closed again
+        assert san.violations == []
+
+    def test_sync_raises_at_the_violating_call(self):
+        t = paddle.to_tensor(np.ones((2,), "float32"))
+        st = get_steptimer()
+        with tracesan.tracking(mode="raise"):
+            with st.phase("step/compute"):
+                with pytest.raises(HostSyncViolation):
+                    np.asarray(t)         # __array__ route
+
+    def test_innermost_phase_wins(self):
+        """current_phase() is the innermost frame: a sync inside a
+        sub-phase nested under step/compute is charged to the sub-phase,
+        not flagged."""
+        t = paddle.to_tensor(np.float32(2.0))
+        st = get_steptimer()
+        with tracesan.tracking(mode="record") as san:
+            with st.phase("step/compute"):
+                with st.phase("step/loss_readback"):
+                    t.item()
+        assert san.violations == []
+
+
+# ---------------------------------------------------------------------------
+# install / uninstall mechanics
+# ---------------------------------------------------------------------------
+
+class TestInstall:
+    def test_nested_enable_rejected(self):
+        with tracesan.tracking():
+            with pytest.raises(RuntimeError, match="already enabled"):
+                tracesan.enable()
+
+    def test_disable_restores_patches_and_is_idempotent(self):
+        orig_item = Tensor.__dict__["item"]
+        orig_guard = CompiledTrainStep.__dict__["_guard_retrace"]
+        san = tracesan.enable()
+        assert Tensor.__dict__["item"] is not orig_item
+        assert CompiledTrainStep.__dict__["_guard_retrace"] is not orig_guard
+        tracesan.disable()
+        assert Tensor.__dict__["item"] is orig_item
+        assert CompiledTrainStep.__dict__["_guard_retrace"] is orig_guard
+        tracesan.disable()  # second call: no-op
+        assert Tensor.__dict__["item"] is orig_item
+        # the detached sanitizer keeps its (empty) record
+        assert san.violations == []
+
+    def test_tracking_uninstalls_on_exception(self):
+        orig_numpy = Tensor.__dict__["numpy"]
+        with pytest.raises(ValueError, match="probe"):
+            with tracesan.tracking():
+                raise ValueError("probe")
+        assert Tensor.__dict__["numpy"] is orig_numpy

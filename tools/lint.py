@@ -9,13 +9,22 @@ lint-clean). See docs/static_analysis.md for the pass catalog, the
 annotation contracts, and the "lint failed — now what?" runbook.
 
 Like the older check_* tools this parses source with ast only — no
-paddle_tpu import, no jax — so it runs anywhere in about a second.
+paddle_tpu import, no jax — so it runs anywhere in a few seconds cold
+and well under two seconds warm (per-file result cache under
+$PADDLE_TPU_ARTIFACTS_DIR/lint_cache, keyed by content sha1 + pass
+version — see paddle_tpu/analysis/cache.py).
 
     python tools/lint.py                  # all passes, whole tree
     python tools/lint.py --changed        # only files in git diff
+    python tools/lint.py --since origin/main   # only the PR's files
     python tools/lint.py --json           # machine-readable findings
     python tools/lint.py --pass typed-error --pass flag-hygiene
+    python tools/lint.py --stats          # per-pass timing + cache hits
+    python tools/lint.py --no-cache       # bypass the result cache
     python tools/lint.py --list           # show the pass catalog
+
+Exit codes: 0 = clean (possibly with baselined waivers), 1 = new
+finding(s), 2 = usage error (unknown pass, bad --since revision).
 """
 from __future__ import annotations
 
@@ -24,6 +33,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -73,6 +83,32 @@ def _changed_files(root):
     return out
 
 
+def _since_files(root, rev):
+    """Repo-relative paths the PR touches: worktree vs the merge base
+    of ``rev`` and HEAD (what CI wants — the PR's files, not the dirty
+    worktree), plus untracked files. None = revision unusable."""
+    def git(*args):
+        try:
+            r = subprocess.run(["git"] + list(args), cwd=root,
+                               capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return r.stdout if r.returncode == 0 else None
+
+    base = git("merge-base", rev, "HEAD")
+    if base is None:
+        return None
+    diff = git("diff", "--name-only", base.strip())
+    if diff is None:
+        return None
+    out = {line.strip().strip('"') for line in diff.splitlines()
+           if line.strip()}
+    untracked = _changed_files(root)
+    if untracked:
+        out |= untracked
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="run the paddle-lint analysis passes "
@@ -83,6 +119,15 @@ def main(argv=None):
                     help="report findings only for files in git diff "
                          "(all passes still scan the whole tree so "
                          "cross-file rules stay sound)")
+    ap.add_argument("--since", default=None, metavar="REV",
+                    help="report findings only for files changed since "
+                         "the merge base with REV (CI: the PR's files, "
+                         "not the dirty worktree); implies --changed "
+                         "semantics")
+    ap.add_argument("--no-cache", action="store_true", dest="no_cache",
+                    help="bypass the per-file result cache")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-pass wall time and cache hit counts")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as JSON")
     ap.add_argument("--pass", dest="passes", action="append", default=[],
@@ -111,11 +156,21 @@ def main(argv=None):
 
     root = os.path.abspath(args.root)
     restrict = None
-    if args.changed:
+    changed_mode = args.changed or args.since is not None
+    if args.since is not None:
+        since = _since_files(root, args.since)
+        if since is None:
+            print(f"--since {args.since}: not a usable git revision "
+                  "here", file=sys.stderr)
+            return 2
+        restrict = since
+    elif args.changed:
         changed = _changed_files(root)
         if changed is not None:
             restrict = changed
     ctx = analysis.AnalysisContext(root, restrict=restrict)
+    cache = None if args.no_cache \
+        else analysis.cache.ResultCache(ctx)
 
     if args.baseline is not None:
         with open(args.baseline, encoding="utf-8") as f:
@@ -127,9 +182,17 @@ def main(argv=None):
 
     all_new, all_waived = [], []
     summaries = []
+    stats = []
     for name in selected:
         p = registry[name]()
-        findings = ctx.reported(p.run(ctx))
+        t0 = time.perf_counter()
+        if cache is not None:
+            raw, cstat = cache.run(p, ctx)
+        else:
+            raw, cstat = p.run(ctx), {"files": 0, "cached": 0,
+                                      "ran": True}
+        stats.append((name, time.perf_counter() - t0, cstat))
+        findings = ctx.reported(raw)
         new, waived = analysis.split_waived(findings, waivers)
         all_new.extend(new)
         all_waived.extend(waived)
@@ -148,21 +211,30 @@ def main(argv=None):
         print(json.dumps({
             "root": root,
             "passes": selected,
-            "changed_only": bool(args.changed),
+            "changed_only": changed_mode,
             "findings": [f.to_dict() for f in all_new],
             "waived": [f.to_dict() for f in all_waived],
+            "stats": [{"pass": n, "seconds": round(dt, 4), **c}
+                      for n, dt, c in stats],
         }, indent=2, sort_keys=True))
         return 1 if all_new else 0
 
     for line in summaries:
         print("paddle-lint", line)
+    if args.stats:
+        for n, dt, c in stats:
+            print(f"paddle-lint stats: {n:20s} {dt:7.3f}s"
+                  f"  files={c['files']} cached={c['cached']}"
+                  + ("" if c["ran"] else "  (cache hit)"))
+        print(f"paddle-lint stats: {'total':20s} "
+              f"{sum(dt for _, dt, _ in stats):7.3f}s")
     if all_new:
         print(f"paddle-lint FAILED: {len(all_new)} new finding(s) "
               "(see docs/static_analysis.md for the runbook)")
         for f in sorted(all_new, key=lambda f: (f.path, f.line)):
             print("  -", f.format())
         return 1
-    scope = "changed files" if args.changed else "tree"
+    scope = "changed files" if changed_mode else "tree"
     print(f"paddle-lint OK ({len(selected)} passes clean over the "
           f"{scope}"
           + (f"; {len(all_waived)} baselined finding(s) waived"
