@@ -7,6 +7,7 @@ from . import typed_errors      # noqa: F401
 from . import flag_hygiene      # noqa: F401
 from . import injection_points  # noqa: F401
 from . import metric_names      # noqa: F401
+from . import span_names        # noqa: F401
 from . import donation_taint    # noqa: F401
 from . import jit_hygiene       # noqa: F401
 from . import host_sync         # noqa: F401

@@ -1,0 +1,101 @@
+"""Span-name vocabulary pass (manifest in ``tools/check_span_names.py``).
+
+Request-trace span names are a FIXED vocabulary: ``request_trace.py``
+renders them, ``trace_merge.py`` overlays them, and the docs table in
+docs/observability.md explains each one — a span minted under an
+unregistered name is invisible to all three. Like the metric-name pass,
+the manifest (``SPAN_NAMES``) stays as a plain literal in the tools shim
+so tests/test_lints.py can ast-guard it and adding a span stays a
+one-line reviewed diff.
+
+Only literal (or literal-template) first arguments at call sites whose
+receiver is recognizably a trace (``trace``/``tr``/``.trace``) are
+checked; a bare-variable name cannot be extracted and is skipped — the
+vocabulary is enforced where names are minted.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, register_pass
+from .metric_names import _call_name, _template
+
+MANIFEST_FILE = "tools/check_span_names.py"
+_MANIFEST_NAMES = ("SCAN", "SPAN_NAMES", "SPAN_CALLS")
+
+
+def load_manifest(ctx):
+    sf = ctx.source(MANIFEST_FILE)
+    if sf is None:
+        raise FileNotFoundError(MANIFEST_FILE)
+    out = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) in _MANIFEST_NAMES:
+                    out[t.id] = ast.literal_eval(node.value)
+    missing = [n for n in _MANIFEST_NAMES if n not in out]
+    if missing:
+        raise ValueError(f"{MANIFEST_FILE}: missing literals {missing}")
+    return out
+
+
+def _is_trace_receiver(node):
+    """Heuristic: does this expression denote a request Trace?"""
+    if isinstance(node, ast.Call):
+        return _is_trace_receiver(node.func)
+    if isinstance(node, ast.Attribute):
+        return node.attr.lower() == "trace" \
+            or _is_trace_receiver(node.value)
+    if isinstance(node, ast.Name):
+        return "trace" in node.id.lower() or node.id == "tr"
+    return False
+
+
+@register_pass
+class SpanNamePass:
+    name = "span-names"
+    description = "request-trace spans use the fixed vocabulary"
+    version = "1"
+    scan = ["paddle_tpu", "tools", MANIFEST_FILE]
+    file_local = False          # manifest-driven: findings mix files
+
+    def run(self, ctx):
+        m = load_manifest(ctx)
+        vocabulary = set(m["SPAN_NAMES"])
+        span_calls = set(m["SPAN_CALLS"])
+        checked = 0
+        findings = []
+        for rel in ctx.py_files(m["SCAN"]):
+            sf = ctx.source(rel)
+            if sf is None:
+                continue
+            try:
+                tree = sf.tree
+            except SyntaxError as e:
+                findings.append(Finding(
+                    self.name, rel, getattr(e, "lineno", 1) or 1,
+                    "unparseable", f"{rel}: unparseable ({e})",
+                    symbol=rel))
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                if _call_name(node.func) not in span_calls:
+                    continue
+                if not isinstance(node.func, ast.Attribute) \
+                        or not _is_trace_receiver(node.func.value):
+                    continue
+                tmpl = _template(node.args[0])
+                if tmpl is None:
+                    continue   # bare variable: not a minting site
+                checked += 1
+                if tmpl not in vocabulary:
+                    findings.append(Finding(
+                        self.name, rel, node.lineno, "unknown-span",
+                        f"{rel}:{node.lineno}: span name {tmpl!r} is not "
+                        "in the fixed vocabulary (add it to SPAN_NAMES in "
+                        "tools/check_span_names.py AND the table in "
+                        "docs/observability.md)", symbol=tmpl))
+        self.spans_checked = checked
+        return findings

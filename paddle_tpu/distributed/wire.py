@@ -24,6 +24,7 @@ from ..resilience.faults import maybe_inject
 __all__ = ["encode", "decode", "send_frame", "recv_frame", "FrameError",
            "IdleTimeout", "stamp_generation", "frame_generation",
            "stamp_model_version", "frame_model_version",
+           "stamp_trace", "frame_trace",
            "stamp_stream", "frame_stream_seq", "frame_stream_end",
            "StreamReader"]
 
@@ -366,6 +367,38 @@ def frame_model_version(frame):
         v = frame.get("model_version")
         if isinstance(v, (int, float, str)):
             return v
+    return None
+
+
+# -- trace-context stamping (profiler/tracing.py) -----------------------------
+
+def stamp_trace(frame, ctx):
+    """Stamp request-trace context into an outgoing frame dict.
+
+    ``ctx`` is ``(trace_id, span_id)`` from :meth:`Trace.ctx` (or None to
+    stamp nothing). Like the generation / model-version stamps above, the
+    context rides inside the frame dict — an untraced client produces
+    byte-identical frames, and peers that predate tracing simply ignore
+    the extra key.
+    """
+    if ctx is not None and isinstance(frame, dict):
+        tid, sid = ctx
+        if isinstance(tid, str):
+            frame["trace"] = [tid, int(sid)]
+    return frame
+
+
+def frame_trace(frame):
+    """The trace context stamped into a received frame as
+    ``(trace_id, parent_span_id)``, or None when unstamped or mangled —
+    an untraced peer must read as 'no trace', never crash the reader."""
+    if isinstance(frame, dict):
+        v = frame.get("trace")
+        if (isinstance(v, (list, tuple)) and len(v) == 2
+                and isinstance(v[0], str)
+                and isinstance(v[1], int)
+                and not isinstance(v[1], bool)):
+            return (v[0], v[1])
     return None
 
 

@@ -170,6 +170,20 @@ _FLAGS: dict[str, Any] = {
     # seconds between metrics snapshots written to PADDLE_TPU_ARTIFACTS_DIR
     # (metrics_rank<N>.prom / .jsonl); 0 disables the exporter
     "FLAGS_metrics_export_interval": 60.0,
+    # request-level tracing master switch (profiler/tracing.py): every
+    # serving/decode request is traced; tail-based retention decides which
+    # traces are flushed to request_traces_rank<N>.jsonl
+    "FLAGS_request_tracing": True,
+    # a trace that ends slower than this (ms) is retained even when it
+    # terminated cleanly — the "slow but not failed" tail
+    "FLAGS_trace_slow_ms": 1000.0,
+    # deterministic head sample: every Nth trace is retained regardless of
+    # outcome (baseline for comparing against the exceptional tail);
+    # 0 disables head sampling
+    "FLAGS_trace_head_sample": 100,
+    # bound on simultaneously live traces; past it new requests run
+    # untraced (degrade, never grow without bound)
+    "FLAGS_trace_ring": 4096,
     # inert reference flags accepted for script compatibility
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_allocator_strategy": "auto_growth",

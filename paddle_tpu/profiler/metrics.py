@@ -68,7 +68,8 @@ def _labels_key(labels):
 
 
 class _Histogram:
-    __slots__ = ("bounds", "counts", "count", "sum", "min", "max")
+    __slots__ = ("bounds", "counts", "count", "sum", "min", "max",
+                 "exemplars")
 
     def __init__(self, bounds):
         self.bounds = tuple(float(b) for b in bounds)
@@ -77,10 +78,16 @@ class _Histogram:
         self.sum = 0.0
         self.min = None
         self.max = None
+        # per-bucket exemplar: the LAST trace_id observed into each bucket,
+        # linking a histogram outlier back to a retained request trace
+        self.exemplars = [None] * (len(self.bounds) + 1)
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
         v = float(value)
-        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        idx = bisect.bisect_left(self.bounds, v)
+        self.counts[idx] += 1
+        if exemplar is not None:
+            self.exemplars[idx] = exemplar
         self.count += 1
         self.sum += v
         self.min = v if self.min is None else min(self.min, v)
@@ -109,7 +116,19 @@ class _Histogram:
             seen += c
         return self.max if self.max is not None else 0.0
 
+    def le_labels(self):
+        return tuple(_prom_val(b) for b in self.bounds) + ("+Inf",)
+
     def summary(self):
+        # cumulative per-bucket counts keyed by the prometheus ``le`` label
+        # (what offline burn-rate math needs from scrape/jsonl history)
+        cum, buckets = 0, []
+        for le, c in zip(self.le_labels(), self.counts):
+            cum += c
+            buckets.append([le, cum])
+        exemplars = {le: ex for le, ex in zip(self.le_labels(),
+                                              self.exemplars)
+                     if ex is not None}
         return {
             "count": self.count,
             "sum": self.sum,
@@ -117,6 +136,8 @@ class _Histogram:
             "max": self.max,
             "p50": self.percentile(50),
             "p99": self.percentile(99),
+            "buckets": buckets,
+            "exemplars": exemplars,
         }
 
 
@@ -174,9 +195,9 @@ class MetricsRegistry:
         with self._lock:
             self._gauge_fns[name] = fn
 
-    def observe(self, name, value, buckets=None):
+    def observe(self, name, value, buckets=None, exemplar=None):
         with self._lock:
-            self._observe_locked(name, value, buckets)
+            self._observe_locked(name, value, buckets, exemplar)
 
     def observe_many(self, items):
         """Batch form of :meth:`observe` — one lock acquisition for a list
@@ -185,12 +206,12 @@ class MetricsRegistry:
             for name, value in items:
                 self._observe_locked(name, value, None)
 
-    def _observe_locked(self, name, value, buckets):
+    def _observe_locked(self, name, value, buckets, exemplar=None):
         h = self._histograms.get(name)
         if h is None:
             h = self._histograms[name] = _Histogram(
                 buckets or DEFAULT_BUCKETS_MS)
-        h.observe(value)
+        h.observe(value, exemplar)
 
     def record_sample(self, name, value, ts_us=None):
         """The always-on half of ``profiler.record_counter``: append to the
@@ -229,6 +250,19 @@ class MetricsRegistry:
         with self._lock:
             h = self._histograms.get(name)
             return h.summary() if h is not None else None
+
+    def histogram_counts(self, name):
+        """Raw bucket state for `name` — non-cumulative per-bucket counts
+        aligned with ``bounds`` (+Inf last), totals, and per-bucket
+        exemplars. The accessor SLO burn-rate math samples at window
+        boundaries (serving/metrics.py)."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                return None
+            return {"bounds": list(h.bounds), "counts": list(h.counts),
+                    "count": h.count, "sum": h.sum,
+                    "exemplars": list(h.exemplars)}
 
     def snapshot(self):
         """Plain-dict snapshot of every series (JSONL export payload)."""
@@ -272,6 +306,12 @@ class MetricsRegistry:
             for q in ("p50", "p99"):
                 lines.append(
                     f"{p}{{quantile=\"0.{q[1:]}\"}} {_prom_val(s[q])}")
+            # cumulative buckets as a sibling counter family: the summary
+            # lines above stay byte-stable for old dashboards, and offline
+            # burn-rate math gets real bucket counts from scrape history
+            lines.append(f"# TYPE {p}_bucket counter")
+            for le, cum in s.get("buckets", ()):
+                lines.append(f"{p}_bucket{{le=\"{le}\"}} {cum}")
         lines.append("# TYPE paddle_tpu_metrics_dropped_label_sets_total "
                      "counter")
         lines.append("paddle_tpu_metrics_dropped_label_sets_total "

@@ -118,7 +118,7 @@ class Request:
 
     __slots__ = ("id", "inputs", "rows", "signature", "deadline",
                  "enqueued_at", "result", "error", "_done", "priority",
-                 "on_done", "version")
+                 "on_done", "version", "trace")
 
     def __init__(self, inputs, deadline=None, now=0.0, request_id=None,
                  priority=0):
@@ -144,6 +144,9 @@ class Request:
         # the server before scatter; None until then / for failures) —
         # rides the wire frame so a client A/B is attributable
         self.version = None
+        # request-level Trace (profiler.tracing), attached by the server at
+        # admission; None when tracing is off or the ring is full
+        self.trace = None
         self._done = threading.Event()
 
     def done(self):
@@ -175,7 +178,7 @@ class Batch:
     """Requests of one signature stacked and padded to one bucket."""
 
     __slots__ = ("id", "signature", "requests", "rows", "bucket", "arrays",
-                 "tried_replicas")
+                 "tried_replicas", "dispatch_info")
 
     def __init__(self, requests, buckets):
         self.id = next(_batch_ids)
@@ -188,6 +191,12 @@ class Batch:
             for i in range(len(requests[0].inputs))]
         self.arrays = pad_rows(stacked, self.rows, self.bucket)
         self.tried_replicas = set()
+        # last dispatch attempt's placement facts (replica idx, hedge role,
+        # version, exec t0/t1) — stashed by Scheduler._attempt (two clock
+        # reads + one dict, hot-path cheap) and turned into retroactive
+        # scheduler.dispatch / replica.exec trace spans by the server,
+        # outside the hot path
+        self.dispatch_info = None
 
     def scatter_outputs(self, outputs):
         """Slice the (bucket-row) outputs back to per-request results and

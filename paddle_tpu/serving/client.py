@@ -124,37 +124,62 @@ class InferenceClient:
             self._sleep(delay)
         raise last
 
+    @staticmethod
+    def _trace_status(exc):
+        if isinstance(exc, ServerOverloaded):
+            return "shed"
+        if isinstance(exc, DeadlineExceeded):
+            return "deadline"
+        return "error"
+
     def _infer_once(self, inputs, timeout, request_id, priority):
         from ..distributed import wire
+        from ..profiler.tracing import get_tracer
+        tracer = get_tracer()
+        # client-minted trace: the id (and the submit span as parent) rides
+        # the request frame via stamp_trace, so the server's spans land in
+        # the same trace id on its side of the wire
+        trace = tracer.start(request_id=request_id, kind="client",
+                             priority=int(priority))
+        sid = trace.begin_span("client.submit")
         frame = {"inputs": [np.ascontiguousarray(a) for a in inputs],
                  "timeout": timeout, "id": request_id}
         if priority:
             frame["priority"] = int(priority)
+        wire.stamp_trace(frame, trace.ctx(sid))
         io_timeout = (timeout + 5.0) if timeout is not None else ...
-        with self._lock:
-            sock = self._conn()
-            try:
-                wire.send_frame(sock, frame, timeout=(
-                    None if io_timeout is ... else io_timeout))
-                reply = wire.recv_frame(sock, timeout=(
-                    ... if io_timeout is ... else io_timeout))
-            except (wire.FrameError, ConnectionError, OSError):
-                self.close()   # desynced/dead socket: reconnect next call
-                raise
-        if not isinstance(reply, dict):
-            raise RemoteInferenceError("BadReply", repr(reply))
-        self.last_model_version = wire.frame_model_version(reply)
-        if reply.get("error") is not None:
-            etype = reply.get("error_type", "RemoteError")
-            exc = _TYPED.get(etype)
-            if exc is not None:
-                err = exc(reply["error"])
-                hint = reply.get("retry_after")
-                if hint is not None:
-                    err.retry_after = float(hint)
-                raise err
-            raise RemoteInferenceError(etype, reply["error"])
-        return [np.asarray(o) for o in reply["outputs"]]
+        try:
+            with self._lock:
+                sock = self._conn()
+                try:
+                    wire.send_frame(sock, frame, timeout=(
+                        None if io_timeout is ... else io_timeout))
+                    reply = wire.recv_frame(sock, timeout=(
+                        ... if io_timeout is ... else io_timeout))
+                except (wire.FrameError, ConnectionError, OSError):
+                    self.close()   # desynced/dead socket: reconnect
+                    raise
+            if not isinstance(reply, dict):
+                raise RemoteInferenceError("BadReply", repr(reply))
+            self.last_model_version = wire.frame_model_version(reply)
+            if reply.get("error") is not None:
+                etype = reply.get("error_type", "RemoteError")
+                exc = _TYPED.get(etype)
+                if exc is not None:
+                    err = exc(reply["error"])
+                    hint = reply.get("retry_after")
+                    if hint is not None:
+                        err.retry_after = float(hint)
+                    raise err
+                raise RemoteInferenceError(etype, reply["error"])
+            outputs = [np.asarray(o) for o in reply["outputs"]]
+        except BaseException as e:
+            trace.end_span(sid)
+            tracer.finish(trace, status=self._trace_status(e), error=e)
+            raise
+        trace.end_span(sid, version=self.last_model_version)
+        tracer.finish(trace, status="ok")
+        return outputs
 
     def generate(self, prompt, max_new_tokens=None, timeout=None,
                  request_id=None, priority=0):
@@ -166,6 +191,11 @@ class InferenceClient:
         wait. Holds the client's lock for the whole stream — use one
         client per concurrent stream."""
         from ..distributed import wire
+        from ..profiler.tracing import get_tracer
+        tracer = get_tracer()
+        trace = tracer.start(request_id=request_id, kind="client",
+                             priority=int(priority))
+        sid = trace.begin_span("client.submit")
         frame = {"op": "generate", "id": request_id, "timeout": timeout,
                  "prompt": np.ascontiguousarray(
                      np.asarray(prompt, dtype=np.int64).reshape(-1))}
@@ -173,37 +203,46 @@ class InferenceClient:
             frame["max_new_tokens"] = int(max_new_tokens)
         if priority:
             frame["priority"] = int(priority)
+        wire.stamp_trace(frame, trace.ctx(sid))
         io_timeout = (timeout + 10.0) if timeout is not None else ...
         reader = wire.StreamReader()
-        with self._lock:
-            sock = self._conn()
-            try:
-                wire.send_frame(sock, frame, timeout=(
-                    None if io_timeout is ... else io_timeout))
-                while True:
-                    reply = wire.recv_frame(sock, timeout=(
-                        ... if io_timeout is ... else io_timeout))
-                    if not isinstance(reply, dict):
-                        raise wire.FrameError(
-                            "stream frame must be a dict, got "
-                            f"{type(reply).__name__}")
-                    _, end = reader.feed(reply)
-                    if reply.get("error") is not None:
-                        etype = reply.get("error_type", "RemoteError")
-                        exc = _TYPED.get(etype)
-                        if exc is None:
-                            raise RemoteInferenceError(etype, reply["error"])
-                        err = exc(reply["error"])
-                        hint = reply.get("retry_after")
-                        if hint is not None:
-                            err.retry_after = float(hint)
-                        raise err
-                    if end:
-                        return
-                    yield int(reply["token"])
-            except (wire.FrameError, ConnectionError, OSError):
-                self.close()   # desynced/torn stream: reconnect next call
-                raise
+        try:
+            with self._lock:
+                sock = self._conn()
+                try:
+                    wire.send_frame(sock, frame, timeout=(
+                        None if io_timeout is ... else io_timeout))
+                    while True:
+                        reply = wire.recv_frame(sock, timeout=(
+                            ... if io_timeout is ... else io_timeout))
+                        if not isinstance(reply, dict):
+                            raise wire.FrameError(
+                                "stream frame must be a dict, got "
+                                f"{type(reply).__name__}")
+                        _, end = reader.feed(reply)
+                        if reply.get("error") is not None:
+                            etype = reply.get("error_type", "RemoteError")
+                            exc = _TYPED.get(etype)
+                            if exc is None:
+                                raise RemoteInferenceError(etype,
+                                                           reply["error"])
+                            err = exc(reply["error"])
+                            hint = reply.get("retry_after")
+                            if hint is not None:
+                                err.retry_after = float(hint)
+                            raise err
+                        if end:
+                            trace.end_span(sid, frames=reader.next_seq)
+                            tracer.finish(trace, status="ok")
+                            return
+                        yield int(reply["token"])
+                except (wire.FrameError, ConnectionError, OSError):
+                    self.close()   # desynced/torn stream: reconnect
+                    raise
+        except BaseException as e:
+            trace.end_span(sid)
+            tracer.finish(trace, status=self._trace_status(e), error=e)
+            raise
 
     def close(self):
         if self._sock is not None:

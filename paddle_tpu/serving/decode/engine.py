@@ -80,7 +80,7 @@ class DecodeStream:
 
     __slots__ = ("id", "prompt", "max_new_tokens", "deadline", "priority",
                  "enqueued_at", "first_token_at", "last_token_at", "tokens",
-                 "seq", "on_token", "table", "error", "done",
+                 "seq", "on_token", "table", "error", "done", "trace",
                  "_fill", "_fill_pos", "_done_evt", "_admitted")
 
     def __init__(self, prompt, max_new_tokens, deadline, priority,
@@ -100,6 +100,9 @@ class DecodeStream:
         self.table = None
         self.error = None
         self.done = False
+        # request-level Trace (profiler.tracing), attached by join();
+        # None when tracing is off or the ring is full
+        self.trace = None
         self._fill = list(self.prompt)   # tokens still to absorb into KV
         self._fill_pos = 0               # absolute position of next fill
         self._done_evt = threading.Event()
@@ -151,50 +154,69 @@ class DecodeEngine:
         return 0.05
 
     def join(self, prompt, max_new_tokens=None, timeout=None, priority=1,
-             on_token=None, request_id=None):
+             on_token=None, request_id=None, trace_ctx=None):
         """Admit one generation request into the running batch.
 
         Refusals are typed and carry a retry-after hint: the admission
         controller sheds first (load), then the running-set cap, then the
         KV pool (memory). A refused join holds no blocks and no admission
-        slot — there is nothing to clean up.
+        slot — there is nothing to clean up. ``trace_ctx`` is an optional
+        ``(trace_id, parent_span)`` pair from ``wire.frame_trace``.
         """
         from ...profiler.metrics import get_registry
+        from ...profiler.tracing import get_tracer
+        tracer = get_tracer()
         now = self._clock()
-        with self._lock:
-            maybe_inject("decode.join", ServerOverloaded)
-            if self._admission is not None:
-                self._admission.admit(priority, now=now)
-            try:
-                if len(self._streams) >= self.config.max_running:
-                    raise ServerOverloaded(
-                        f"decode running set full "
-                        f"({self.config.max_running} streams)",
-                        retry_after=self._retry_after(priority))
-                stream = DecodeStream(
-                    prompt, max_new_tokens if max_new_tokens is not None
-                    else self.config.max_new_tokens,
-                    deadline=(now + timeout) if timeout else None,
-                    priority=priority, enqueued_at=now,
-                    on_token=on_token, request_id=request_id)
-                table = BlockTable(self.pool)
-                if not table.ensure(len(stream.prompt) + 1):
-                    raise ServerOverloaded(
-                        f"KV pool exhausted ({self.pool.free()} free blocks,"
-                        f" prompt needs "
-                        f"{self.pool.blocks_for(len(stream.prompt) + 1)})",
-                        retry_after=self._retry_after(priority))
-            except ServerOverloaded:
+        tid, parent = trace_ctx if trace_ctx else (None, 0)
+        trace = tracer.start(request_id=request_id, trace_id=tid,
+                             parent=parent, priority=int(priority),
+                             kind="decode")
+        jsid = trace.begin_span("engine.join")
+        try:
+            with self._lock:
+                maybe_inject("decode.join", ServerOverloaded)
                 if self._admission is not None:
-                    self._admission.note_done()
-                get_registry().inc_counter("decode.sheds_total")
-                raise
-            stream.table = table
-            stream._admitted = True
-            self._streams[stream.id] = stream
-            self._prefill_rr.append(stream.id)
-            get_registry().inc_counter("decode.joins_total")
-            return stream
+                    self._admission.admit(priority, now=now)
+                try:
+                    if len(self._streams) >= self.config.max_running:
+                        raise ServerOverloaded(
+                            f"decode running set full "
+                            f"({self.config.max_running} streams)",
+                            retry_after=self._retry_after(priority))
+                    stream = DecodeStream(
+                        prompt, max_new_tokens if max_new_tokens is not None
+                        else self.config.max_new_tokens,
+                        deadline=(now + timeout) if timeout else None,
+                        priority=priority, enqueued_at=now,
+                        on_token=on_token, request_id=request_id)
+                    table = BlockTable(self.pool)
+                    if not table.ensure(len(stream.prompt) + 1):
+                        raise ServerOverloaded(
+                            f"KV pool exhausted ({self.pool.free()} free "
+                            f"blocks, prompt needs "
+                            f"{self.pool.blocks_for(len(stream.prompt) + 1)})",
+                            retry_after=self._retry_after(priority))
+                except ServerOverloaded:
+                    if self._admission is not None:
+                        self._admission.note_done()
+                    get_registry().inc_counter("decode.sheds_total")
+                    raise
+                stream.table = table
+                stream._admitted = True
+                stream.trace = trace
+                trace.request_id = stream.id
+                trace.end_span(jsid, verdict="admitted",
+                               running=len(self._streams) + 1,
+                               kv_free=self.pool.free())
+                self._streams[stream.id] = stream
+                self._prefill_rr.append(stream.id)
+                get_registry().inc_counter("decode.joins_total")
+                return stream
+        except ServerOverloaded as e:
+            trace.end_span(jsid, verdict="shed")
+            trace.flag("shed")
+            tracer.finish(trace, status="shed", error=e)
+            raise
 
     # -- the engine tick -----------------------------------------------------
     def step(self):   # hot-path: the engine tick — every running stream waits on it
@@ -245,7 +267,12 @@ class DecodeEngine:
         from ...profiler.metrics import get_registry
         maybe_inject("decode.prefill", ReplicaDead)
         n = min(len(stream._fill), self.config.prefill_chunk)
-        if not stream.table.ensure(stream._fill_pos + n):
+        t_kv = self._clock()
+        grown = stream.table.ensure(stream._fill_pos + n)
+        if stream.trace is not None:
+            stream.trace.record_span("engine.kv_wait", t_kv, self._clock(),
+                                     need=stream._fill_pos + n, ok=grown)
+        if not grown:
             self._evict(stream, KVCacheExhausted(
                 f"{stream.id}: KV pool exhausted mid-prefill",
                 retry_after=self._retry_after(stream.priority)))
@@ -253,7 +280,11 @@ class DecodeEngine:
         chunk, stream._fill = stream._fill[:n], stream._fill[n:]
         start = stream._fill_pos
         stream._fill_pos += n
+        t0 = self._clock()
         token = self.backend.prefill_chunk(stream, chunk, start)
+        if stream.trace is not None:
+            stream.trace.record_span("engine.prefill_chunk", t0,
+                                     self._clock(), tokens=n, start=start)
         get_registry().inc_counter("decode.prefill_chunks_total")
         if token is not None:
             # re-read the clock: the backend's work (and a fake-clock
@@ -268,7 +299,17 @@ class DecodeEngine:
         ready = []
         for stream in runnable:
             # the consumed prefix grows by one token this round
-            if stream.table.ensure(stream._fill_pos + 1):
+            t_kv = self._clock()
+            grown = stream.table.ensure(stream._fill_pos + 1)
+            if not grown and stream.trace is not None:
+                # only the failed growth attempt earns a span — a
+                # satisfied one-token extension is the per-round common
+                # case and would double every trace's span count
+                stream.trace.record_span("engine.kv_wait", t_kv,
+                                         self._clock(),
+                                         need=stream._fill_pos + 1,
+                                         ok=False)
+            if grown:
                 ready.append(stream)
             else:
                 self._evict(stream, KVCacheExhausted(
@@ -277,12 +318,16 @@ class DecodeEngine:
                     retry_after=self._retry_after(stream.priority)))
         if not ready:
             return
+        t0 = self._clock()
         out = self.backend.decode(ready)
         now = self._clock()   # include the round's service time
         for stream, token in zip(ready, out):
             if stream.done:
                 continue   # evicted by a mid-round callback failure
             stream._fill_pos += 1
+            if stream.trace is not None:
+                stream.trace.record_span("engine.decode_tick", t0, now,
+                                         batch=len(ready), seq=stream.seq)
             self._emit(stream, int(token), now)
             self._maybe_finish(stream, int(token))
 
@@ -296,13 +341,21 @@ class DecodeEngine:
             stream.first_token_at = now
             ttft_ms = max(0.0, (now - stream.enqueued_at) * 1000.0)
             self._ttft_ms.append(ttft_ms)
-            get_registry().observe("decode.ttft_ms", ttft_ms)
+            if stream.trace is not None:
+                stream.trace.annotate(ttft_ms=ttft_ms)
+            get_registry().observe(
+                "decode.ttft_ms", ttft_ms,
+                exemplar=stream.trace.trace_id
+                if stream.trace is not None else None)
             if self._admission is not None:
                 self._admission.observe(ttft_ms / 1000.0, now=now)
         else:
             tpot_ms = max(0.0, (now - stream.last_token_at) * 1000.0)
             self._tpot_ms.append(tpot_ms)
-            get_registry().observe("decode.tpot_ms", tpot_ms)
+            get_registry().observe(
+                "decode.tpot_ms", tpot_ms,
+                exemplar=stream.trace.trace_id
+                if stream.trace is not None else None)
         stream.last_token_at = now
         self._emitted += 1
         get_registry().inc_counter("decode.tokens_total")
@@ -328,15 +381,18 @@ class DecodeEngine:
 
     def _finish(self, stream):  # requires-lock: _lock
         from ...profiler.metrics import get_registry
+        from ...profiler.tracing import get_tracer
         self._release(stream)
         stream.done = True
         get_registry().inc_counter("decode.streams_completed_total")
+        get_tracer().finish(stream.trace, status="ok")
         stream._done_evt.set()
 
     def _evict(self, stream, error):  # requires-lock: _lock
         """Terminate a stream with a typed error. Eviction must always
         complete — a fault injected here is recorded and swallowed."""
         from ...profiler.metrics import get_registry
+        from ...profiler.tracing import get_tracer
         try:
             maybe_inject("decode.evict", ConnectionError)
         except ConnectionError:
@@ -349,6 +405,13 @@ class DecodeEngine:
         get_registry().inc_counter("decode.streams_failed_total",
                                    labels={"reason": type(error).__name__})
         get_registry().inc_counter("decode.evictions_total")
+        if isinstance(error, DeadlineExceeded):
+            status = "deadline"
+        elif isinstance(error, (ServerOverloaded, KVCacheExhausted)):
+            status = "shed"
+        else:
+            status = "error"
+        get_tracer().finish(stream.trace, status=status, error=error)
         stream._done_evt.set()
 
     def _release(self, stream):  # requires-lock: _lock

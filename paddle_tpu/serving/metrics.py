@@ -16,14 +16,26 @@ Export paths:
 
 The clock is injectable (fake-clock chaos tests record deterministic
 latencies with no real sleeps).
+
+SLO accounting rides on top: an :class:`SLO` names a latency histogram, a
+target, and a goodput threshold; :meth:`ServingMetrics.slo_tick` samples the
+cumulative bucket counts at window boundaries and computes **multi-window
+burn rates** (how fast the error budget is being spent: 1.0 = exactly on
+budget, >1 = burning faster), exported as ``paddle_tpu_slo_*`` gauges.
+Good/bad is decided at bucket resolution — pick targets on (or near) bucket
+bounds of :data:`~paddle_tpu.profiler.metrics.DEFAULT_BUCKETS_MS`.
 """
 from __future__ import annotations
 
+import bisect
+import collections
 import threading
 
-__all__ = ["ServingMetrics", "percentile"]
+__all__ = ["ServingMetrics", "SLO", "percentile"]
 
 _RESERVOIR = 4096
+_SLO_WINDOWS = (60.0, 300.0, 3600.0)
+_SLO_SAMPLES = 4096     # bounded (t, total, good) history per SLO
 
 
 def percentile(values, q):
@@ -33,6 +45,66 @@ def percentile(values, q):
     vs = sorted(values)
     idx = min(len(vs) - 1, max(0, int(round(q / 100.0 * (len(vs) - 1)))))
     return float(vs[idx])
+
+
+class SLO:
+    """One latency SLO over an always-on histogram.
+
+    ``target_ms`` is the per-request latency objective (TTFT/TPOT for
+    decode); ``goodput`` is the fraction of requests that must meet it
+    (0.99 → a 1% error budget). Burn rate over a window is
+    ``bad_fraction / (1 - goodput)`` computed from cumulative histogram
+    counts sampled at tick time — the multiwindow form pages on fast burn
+    (short window) without flapping on noise (long window).
+    """
+
+    __slots__ = ("name", "metric", "target_ms", "goodput", "windows",
+                 "_samples")
+
+    def __init__(self, name, metric, target_ms, goodput=0.99,
+                 windows=_SLO_WINDOWS):
+        self.name = name
+        self.metric = metric
+        self.target_ms = float(target_ms)
+        self.goodput = min(float(goodput), 1.0 - 1e-9)
+        self.windows = tuple(float(w) for w in windows)
+        self._samples = collections.deque(maxlen=_SLO_SAMPLES)
+
+    def _counts(self, registry):
+        h = registry.histogram_counts(self.metric)
+        if h is None:
+            return 0, 0
+        # observations at or under the largest bucket bound <= target —
+        # bucket-resolution goodput, exact when the target sits on a bound
+        j = bisect.bisect_right(h["bounds"], self.target_ms)
+        return h["count"], sum(h["counts"][:j])
+
+    def sample(self, now, registry):
+        total, good = self._counts(registry)
+        self._samples.append((float(now), total, good))
+
+    def burn_rates(self, now=None):
+        """{window_s: burn rate} from the recorded samples. A window with
+        no traffic burns at 0.0 (nothing was missed)."""
+        if not self._samples:
+            return {w: 0.0 for w in self.windows}
+        t_now, total_now, good_now = self._samples[-1]
+        if now is not None:
+            t_now = float(now)
+        budget = 1.0 - self.goodput
+        out = {}
+        for w in self.windows:
+            t_lo = t_now - w
+            then = self._samples[0]
+            for s in self._samples:
+                if s[0] >= t_lo:
+                    then = s
+                    break
+            d_total = total_now - then[1]
+            d_bad = d_total - (good_now - then[2])
+            frac = (d_bad / d_total) if d_total > 0 else 0.0
+            out[w] = frac / budget
+        return out
 
 
 class ServingMetrics:
@@ -69,6 +141,8 @@ class ServingMetrics:
         self._c = dict.fromkeys(self.COUNTERS, 0)
         self._lat = []          # bounded reservoir of request latencies (s)
         self._gauges = {}       # name -> fn() -> number (e.g. queue depth)
+        self._slos = []         # guarded-by: _lock
+        self._slo_last = None   # guarded-by: _lock (last tick time)
 
     def _now(self):
         if self._clock is not None:
@@ -108,7 +182,7 @@ class ServingMetrics:
         self._registry().inc_counter("serving.requests_total", n,
                                      labels={"version": label})
 
-    def observe_latency(self, seconds):
+    def observe_latency(self, seconds, priority=None, trace_id=None):
         with self._lock:
             if len(self._lat) >= _RESERVOIR:
                 # overwrite round-robin: keeps a sliding window, O(1)
@@ -116,8 +190,56 @@ class ServingMetrics:
                     float(seconds)
             else:
                 self._lat.append(float(seconds))
+        # trace_id becomes the bucket's exemplar: the histogram's p99
+        # bucket names a real retained trace to go look at
         self._registry().observe("serving.request_latency_ms",
-                                 float(seconds) * 1e3)
+                                 float(seconds) * 1e3, exemplar=trace_id)
+        if priority is not None:
+            # per-priority-class histogram (own series: the registry's
+            # histograms are unlabeled) so class SLOs burn independently
+            self._registry().observe(
+                f"serving.request_p{int(priority)}_latency_ms",
+                float(seconds) * 1e3, exemplar=trace_id)
+
+    # -- SLO burn-rate accounting ---------------------------------------------
+    def add_slo(self, slo):
+        """Register an :class:`SLO`; its burn rates are recomputed and
+        exported as gauges on every :meth:`slo_tick`."""
+        with self._lock:
+            self._slos.append(slo)
+        self._registry().set_gauge("slo.target_ms", slo.target_ms,
+                                   labels={"slo": slo.name})
+        return slo
+
+    def slos(self):
+        with self._lock:
+            return list(self._slos)
+
+    def slo_tick(self, now=None, min_interval=1.0):
+        """Sample every SLO's cumulative counts and export burn rates as
+        ``slo.burn_rate_ratio{slo=...,window=...}`` gauges. Rate-limited —
+        cheap enough for the server's pump loop to call every round."""
+        now = self._now() if now is None else now
+        with self._lock:
+            if self._slo_last is not None \
+                    and now - self._slo_last < min_interval:
+                return False
+            self._slo_last = now
+            slos = list(self._slos)
+        registry = self._registry()
+        for slo in slos:
+            slo.sample(now, registry)
+            for w, rate in slo.burn_rates(now).items():
+                registry.set_gauge(
+                    "slo.burn_rate_ratio", rate,
+                    labels={"slo": slo.name, "window": f"{int(w)}s"})
+        return True
+
+    def slo_report(self, now=None):
+        """{slo name: {window_s: burn rate}} without exporting (tests,
+        ``stats()``)."""
+        now = self._now() if now is None else now
+        return {s.name: s.burn_rates(now) for s in self.slos()}
 
     def register_gauge(self, name, fn):
         self._gauges[name] = fn

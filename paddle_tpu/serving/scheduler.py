@@ -351,6 +351,11 @@ class Scheduler:
     def _attempt(self, batch, timeout, hedged):   # hot-path: single placement attempt under the watchdog
         rep = self.pick(exclude=batch.tried_replicas)
         batch.tried_replicas.add(rep.idx)
+        # tracing stash: two clock floats + one small dict; the server turns
+        # this into retroactive spans outside the hot path
+        info = {"replica": rep.idx, "hedged": hedged, "version": rep.version,
+                "t0": self._now(), "t1": None}
+        batch.dispatch_info = info
         with self._lock:
             rep.inflight += 1
         try:
@@ -375,6 +380,7 @@ class Scheduler:
                 f"replica {rep.idx} died running batch#{batch.id}: "
                 f"{e}") from e
         finally:
+            info["t1"] = self._now()
             with self._lock:
                 rep.inflight -= 1
         if rep.fenced_out:

@@ -40,13 +40,14 @@ import threading
 import numpy as np
 
 from ..framework.errors import FatalError, PreconditionNotMetError
+from ..profiler.tracing import get_tracer
 from ..resilience.faults import maybe_inject
 from ..resilience.recorder import FlightRecorder
 from ..resilience.watchdog import DistributedTimeout
 from .batcher import (
     BatchQueue, DeadlineExceeded, Request, ServerOverloaded, pow2_buckets,
 )
-from .metrics import ServingMetrics
+from .metrics import SLO, ServingMetrics
 from .overload import AdmissionController
 from .scheduler import ReplicaDead, Scheduler
 
@@ -145,6 +146,11 @@ class InferenceServer:
         self._autoscaler = None
         self._rollout = None
         self._decode = None
+        # default SLO: end-to-end request latency vs the AIMD target, a 1%
+        # error budget; burn rates tick from the pump loop
+        self.metrics.add_slo(SLO(
+            "request_latency", "serving.request_latency_ms",
+            target_ms=self.admission.snapshot()["target_ms"]))
         for sig in self.config.warmup_signatures:
             self.warmup(sig)
 
@@ -178,34 +184,78 @@ class InferenceServer:
 
     # -- client API ------------------------------------------------------------
     def submit(self, inputs, deadline=None, timeout=None, request_id=None,
-               priority=0):
+               priority=0, trace_ctx=None):
         """Admit one request (non-blocking). ``timeout`` is relative seconds
         (converted to an absolute deadline on the server clock); ``deadline``
         is already absolute; ``priority`` 0 is highest — lower classes are
         shed first under overload. Raises :class:`ServerOverloaded` (with a
-        ``retry_after`` hint) when shedding.
+        ``retry_after`` hint) when shedding. ``trace_ctx`` is an optional
+        ``(trace_id, parent_span)`` pair from ``wire.frame_trace`` — the
+        frontend passes it so a client-minted trace id follows the request
+        through the server's spans.
         """
         now = self._now()
         if deadline is None:
             rel = timeout if timeout is not None \
                 else self.config.default_deadline
             deadline = now + rel if rel is not None else None
+        tracer = get_tracer()
+        tid, parent = trace_ctx if trace_ctx else (None, 0)
+        trace = tracer.start(request_id=request_id, trace_id=tid,
+                             parent=parent, priority=int(priority))
+        admit_sid = trace.begin_span("server.admit")
         # AIMD gate first: it bounds requests in the whole system, the
         # queue bound below only the waiting room
-        self.admission.admit(priority=priority, now=now)
+        try:
+            self.admission.admit(priority=priority, now=now)
+        except ServerOverloaded as e:
+            snap = self.admission.snapshot()
+            trace.end_span(admit_sid, verdict="shed_admission",
+                           limit=snap["limit"], inflight=snap["inflight"])
+            trace.flag("shed")
+            tracer.finish(trace, status="shed", error=e)
+            raise
+        snap = self.admission.snapshot()
+        trace.end_span(admit_sid, verdict="admitted", limit=snap["limit"],
+                       inflight=snap["inflight"])
         req = Request(inputs, deadline=deadline, now=now,
                       request_id=request_id, priority=priority)
+        trace.request_id = req.id
+        req.trace = trace
         # the admission slot is held until the request terminates, however
         # it terminates (set_result and set_error both fire on_done once)
-        req.on_done = lambda _r: self.admission.note_done()
+        def _done(r, _trace=trace):
+            self.admission.note_done()
+            self._finish_trace(r, _trace)
+        req.on_done = _done
+        trace.begin_span("batcher.queue", depth=self.queue.depth())
         try:
             self.queue.put(req)
-        except BaseException:
+        except BaseException as e:
             # enqueue shed (queue full / unmeetable deadline): the request
             # never entered the system, give the admission slot back
             self.admission.note_done()
+            trace.end_span("batcher.queue")
+            trace.flag("shed")
+            tracer.finish(trace, status="shed", error=e)
             raise
         return req
+
+    def _finish_trace(self, req, trace):
+        """Terminate a request's trace with a status matching how the
+        request terminated; the tracer applies tail-based retention."""
+        if trace is None:
+            return
+        err = req.error
+        if err is None:
+            status = "ok"
+        elif isinstance(err, DeadlineExceeded):
+            status = "deadline"
+        elif isinstance(err, ServerOverloaded):
+            status = "shed"
+        else:
+            status = "error"
+        get_tracer().finish(trace, status=status, error=err)
 
     def infer(self, inputs, timeout=None, priority=0):
         """Synchronous convenience: submit + (pump | wait) + unwrap."""
@@ -225,6 +275,7 @@ class InferenceServer:
         rounds the scheduler housekeeps (dead-replica restarts, breaker
         half-open probes) and the autoscaler, if attached, gets a tick."""
         done = 0
+        self.metrics.slo_tick(now=self._now())
         for _ in range(max_batches):
             self.scheduler.maintain()
             if self._autoscaler is not None:
@@ -233,10 +284,21 @@ class InferenceServer:
                 self._rollout.tick()
             if self._decode is not None:
                 self._decode.step()
+            t_asm = self._now()
             batch = self.queue.assemble(self.config.buckets,
                                         max_rows=self.config.max_batch_size)
             if batch is None:
                 break
+            t_asm_end = self._now()
+            for req in batch.requests:
+                if req.trace is not None:
+                    # queued until assembly picked it up; then the
+                    # grouping/padding work itself
+                    req.trace.end_span("batcher.queue", t1=t_asm)
+                    req.trace.record_span(
+                        "batcher.batch_assemble", t_asm, t_asm_end,
+                        batch=batch.id, rows=batch.rows,
+                        bucket=batch.bucket)
             self._run_batch(batch)
             done += 1
         return done
@@ -289,6 +351,8 @@ class InferenceServer:
                 # a timeout/death is a congestion signal too: the AIMD loop
                 # sees the full elapsed wall time, not a fabricated latency
                 elapsed = self._now() - exec_start
+                self._trace_dispatch(batch, exec_start,
+                                     outcome=type(e).__name__)
                 self._observe_exec(elapsed)
                 self.admission.observe(elapsed, now=self._now())
                 last_exc = e
@@ -299,13 +363,17 @@ class InferenceServer:
                 break
             except ServerOverloaded as e:
                 self.recorder.finish(entry, status="ServerOverloaded")
+                self._trace_dispatch(batch, exec_start, outcome="shed")
                 last_exc = e
                 break
             except Exception as e:
                 self.recorder.finish(entry, status=type(e).__name__)
+                self._trace_dispatch(batch, exec_start,
+                                     outcome=type(e).__name__)
                 last_exc = e
                 break
             self.recorder.finish(entry, status="ok")
+            self._trace_dispatch(batch, exec_start, outcome="ok")
             self._observe_exec(self._now() - exec_start)
             try:
                 self._reply(batch, outputs, version=rep.version)
@@ -315,6 +383,36 @@ class InferenceServer:
                 self._fail_batch(batch, e)
             return
         self._fail_batch(batch, last_exc)
+
+    def _trace_dispatch(self, batch, t0, outcome):
+        """Turn the scheduler's ``dispatch_info`` stash into retroactive
+        ``scheduler.dispatch`` / ``replica.exec`` spans on every traced
+        request in the batch (outside the dispatch hot path)."""
+        info = batch.dispatch_info
+        t1 = self._now()
+        breaker = None
+        if info is not None:
+            rep = self.scheduler.find_replica(info["replica"])
+            if rep is not None:
+                breaker = rep.breaker.describe().get("state")
+        for req in batch.requests:
+            tr = req.trace
+            if tr is None:
+                continue
+            if info is None:
+                tr.record_span("scheduler.dispatch", t0, t1, outcome=outcome)
+                continue
+            dsid = tr.record_span(
+                "scheduler.dispatch", t0, t1, outcome=outcome,
+                replica=info["replica"], hedged=info["hedged"],
+                attempts=len(batch.tried_replicas), breaker=breaker)
+            if info["t1"] is not None:
+                tr.record_span("replica.exec", info["t0"], info["t1"],
+                               parent=dsid, replica=info["replica"],
+                               version=info["version"])
+            tr.annotate(replica=info["replica"], version=info["version"])
+            if info["hedged"]:
+                tr.flag("hedged")
 
     def _observe_exec(self, elapsed_s):
         """Feed one batch's execution latency to the scheduler's per-server
@@ -360,10 +458,17 @@ class InferenceServer:
         self._decode = DecodeEngine(backend, config=config,
                                     clock=self._clock,
                                     admission=self.admission)
+        # decode SLOs: time-to-first-token and time-per-output-token (both
+        # targets sit on DEFAULT_BUCKETS_MS bounds — bucket-exact goodput)
+        self.metrics.add_slo(SLO("decode_ttft", "decode.ttft_ms",
+                                 target_ms=500.0))
+        self.metrics.add_slo(SLO("decode_tpot", "decode.tpot_ms",
+                                 target_ms=100.0))
         return self._decode
 
     def submit_generate(self, prompt, max_new_tokens=None, timeout=None,
-                        priority=0, on_token=None, request_id=None):
+                        priority=0, on_token=None, request_id=None,
+                        trace_ctx=None):
         """Admit one generation request (non-blocking). Token-level results
         arrive via ``on_token(stream, token, seq)`` on the engine thread;
         call ``stream.wait()`` for termination. Raises
@@ -376,7 +481,8 @@ class InferenceServer:
             timeout = self.config.default_deadline
         return self._decode.join(prompt, max_new_tokens=max_new_tokens,
                                  timeout=timeout, priority=priority,
-                                 on_token=on_token, request_id=request_id)
+                                 on_token=on_token, request_id=request_id,
+                                 trace_ctx=trace_ctx)
 
     def rollout_active(self):
         """True while a rollout/rollback is converging the fleet — the
@@ -407,7 +513,10 @@ class InferenceServer:
         sojourn = 0.0
         for req in batch.requests:
             lat = max(0.0, now - req.enqueued_at)
-            self.metrics.observe_latency(lat)
+            self.metrics.observe_latency(
+                lat, priority=req.priority,
+                trace_id=req.trace.trace_id if req.trace is not None
+                else None)
             sojourn = max(sojourn, lat)
         # the AIMD congestion signal: worst end-to-end sojourn in the batch
         # (queue wait + execution) vs the latency target
@@ -621,7 +730,8 @@ class SocketFrontend:
                 prompt, max_new_tokens=msg.get("max_new_tokens"),
                 timeout=msg.get("timeout"),
                 priority=int(msg.get("priority", 0)),
-                on_token=on_token, request_id=rid)
+                on_token=on_token, request_id=rid,
+                trace_ctx=wire.frame_trace(msg))
         except BaseException as e:
             with lock:
                 return send(error_frame(e, 0))
@@ -651,7 +761,8 @@ class SocketFrontend:
             inputs = [np.asarray(a) for a in msg["inputs"]]
             req = self._server.submit(inputs, timeout=msg.get("timeout"),
                                       request_id=rid,
-                                      priority=int(msg.get("priority", 0)))
+                                      priority=int(msg.get("priority", 0)),
+                                      trace_ctx=wire.frame_trace(msg))
             req.wait(msg.get("timeout"))
             if req.error is not None:
                 raise req.error

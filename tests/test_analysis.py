@@ -103,11 +103,11 @@ def test_overlay_and_restrict_mechanics():
     assert ctx.reported([inside, outside]) == [inside]
 
 
-def test_registry_has_all_ten_passes():
+def test_registry_has_all_eleven_passes():
     assert set(analysis.all_passes()) == {
         "lock-discipline", "blocking-call", "typed-error",
         "flag-hygiene", "injection-points", "metric-names",
-        "donation-taint", "jit-hygiene", "host-sync",
+        "span-names", "donation-taint", "jit-hygiene", "host-sync",
         "resource-lifecycle"}
 
 

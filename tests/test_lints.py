@@ -36,14 +36,14 @@ def _pass_literal(module_name, var_name):
 
 LINT_PASSES = ("lock-discipline", "blocking-call", "typed-error",
                "flag-hygiene", "injection-points", "metric-names",
-               "donation-taint", "jit-hygiene", "host-sync",
+               "span-names", "donation-taint", "jit-hygiene", "host-sync",
                "resource-lifecycle")
 
 
 def test_paddle_lint_clean():
     """The tier-1 gate (docs/static_analysis.md): the full paddle-lint
-    run — all ten passes over the whole tree — must be clean with the
-    shipped (empty) waiver baseline."""
+    run — every registered pass over the whole tree — must be clean with
+    the shipped (empty) waiver baseline."""
     r = _run(REPO / "tools" / "lint.py")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "paddle-lint OK" in r.stdout
@@ -378,7 +378,8 @@ def test_metric_name_lint_manifest_guard():
 
     subsystems = set(ast.literal_eval(_assigned("SUBSYSTEMS")))
     assert {"steptimer", "metrics", "serving", "io", "integrity",
-            "ckpt", "compiled_step", "rollout", "decode"} <= subsystems
+            "ckpt", "compiled_step", "rollout", "decode",
+            "slo", "trace"} <= subsystems
     units = set(ast.literal_eval(_assigned("UNITS")))
     assert {"ms", "total", "per_sec"} <= units
     grandfathered = set(ast.literal_eval(_assigned("GRANDFATHERED")))
@@ -386,6 +387,56 @@ def test_metric_name_lint_manifest_guard():
     # pattern instead of being added here
     assert grandfathered <= {"autotune.search/{}", "fusion_policy/{}",
                              "straggler.rank{}", "{}.{}"}
+
+
+def test_span_name_lint_passes_on_tree():
+    r = _run(REPO / "tools" / "check_span_names.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "span-name lint OK" in r.stdout
+
+
+def test_span_name_lint_manifest_guard():
+    """The request-tracing PR's contract: the fixed span vocabulary the
+    explain tool / merge overlay / docs table all key on stays registered,
+    and the trace-shaped call sites stay linted. Guard the lint's own
+    manifests so a refactor can't silently gut the check."""
+    import ast
+    src = (REPO / "tools" / "check_span_names.py").read_text()
+    tree = ast.parse(src)
+
+    def _assigned(name):
+        return next(
+            node.value for node in ast.walk(tree)
+            if isinstance(node, ast.Assign)
+            and any(getattr(t, "id", None) == name for t in node.targets))
+
+    spans = set(ast.literal_eval(_assigned("SPAN_NAMES")))
+    assert {"client.submit", "server.admit", "batcher.queue",
+            "batcher.batch_assemble", "scheduler.dispatch", "replica.exec",
+            "engine.join", "engine.prefill_chunk", "engine.decode_tick",
+            "engine.kv_wait"} <= spans
+    calls = set(ast.literal_eval(_assigned("SPAN_CALLS")))
+    assert {"begin_span", "record_span", "span"} <= calls
+
+
+def test_span_manifest_matches_tracer_vocabulary():
+    """The lint manifest and the tracer's own SPAN_NAMES tuple must not
+    drift: the manifest is where review happens, the tracer constant is
+    what runtime consumers import."""
+    import ast
+    lint_src = (REPO / "tools" / "check_span_names.py").read_text()
+    lint_names = set(ast.literal_eval(next(
+        node.value for node in ast.walk(ast.parse(lint_src))
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "SPAN_NAMES"
+                for t in node.targets))))
+    tracer_src = (REPO / "paddle_tpu" / "profiler" / "tracing.py").read_text()
+    tracer_names = set(ast.literal_eval(next(
+        node.value for node in ast.walk(ast.parse(tracer_src))
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "SPAN_NAMES"
+                for t in node.targets))))
+    assert lint_names == tracer_names
 
 
 def test_compiled_step_flags_registered():
@@ -444,6 +495,12 @@ def test_trace_merge_help_smoke():
     assert "timeline" in r.stdout
 
 
+def test_request_trace_help_smoke():
+    r = _run(REPO / "tools" / "request_trace.py", "--help")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "--explain" in r.stdout
+
+
 def test_replay_step_help_smoke():
     r = _run(REPO / "tools" / "replay_step.py", "--help")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -489,6 +546,14 @@ def test_serving_bench_overload_smoke():
         assert point["completed"] > 0
         assert point["unterminated"] == 0
         assert point["shed"] == point["shed_with_hint"]
+    # tracing contract: every shed/deadline/errored request has a retained
+    # trace, retention stays inside the tail+head policy, and per-request
+    # tracer overhead stays under 1% of the modeled service time
+    for point in report["results"]:
+        assert point["trace_coverage_ok"] is True, point
+        assert point["trace_bound_ok"] is True, point
+        assert point["traces_exceptional"] == point["exceptional"]
+    assert report["results"][0]["trace_overhead_pct"] < 1.0
 
 
 def test_serving_bench_decode_smoke():
@@ -507,6 +572,9 @@ def test_serving_bench_decode_smoke():
         assert point["unterminated"] == 0
         assert point["shed"] == point["shed_with_hint"]
         assert point["compiles"] <= point["compile_bound"]
+        assert point["trace_coverage_ok"] is True, point
+        assert point["trace_bound_ok"] is True, point
+    assert report["results"][0]["trace_overhead_pct"] < 1.0
     extra = report["extra"]
     assert extra["decode_goodput_tokens_per_sec"] > 0
     for k in ("decode_ttft_p50_ms", "decode_ttft_p99_ms",

@@ -1,0 +1,582 @@
+"""Request-level tracing tests (docs/observability.md).
+
+Covers the tracer itself (span recording, dominant-span self time,
+tail-based retention matrix, ring bound, idempotent finish, flush
+failure accounting), the SLO burn-rate objects, per-bucket exemplars,
+and the acceptance scenario from the observability issue:
+
+- **chaos attribution**: one fake-clock server suffers an admission
+  shed, a hedged dispatch, a replica death, and a deadline expiry while
+  a decode stream runs slow — every exceptionally-terminated request
+  yields a flushed trace naming its dominant span, replica id, model
+  version, and admission verdict, and ``tools/request_trace.py
+  --explain`` reconstructs the story from the artifacts alone.
+"""
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import metrics as pmetrics
+from paddle_tpu.profiler import tracing
+from paddle_tpu.profiler.tracing import (
+    RequestTracer, SPAN_NAMES, Trace, set_tracer, reset_tracer,
+    trace_path_for_rank,
+)
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import (
+    InferenceServer, ServerOverloaded, ServingConfig,
+)
+from paddle_tpu.serving.metrics import SLO, ServingMetrics
+from paddle_tpu.serving.scheduler import ReplicaDead
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "tools"))
+import request_trace  # noqa: E402
+import trace_merge    # noqa: E402
+sys.path.pop(0)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_ARTIFACTS_DIR", str(tmp_path / "artifacts"))
+    faults.reset()
+    pmetrics.reset_registry()
+    reset_tracer()
+    yield
+    faults.reset()
+    pmetrics.reset_registry()
+    reset_tracer()
+    paddle.set_flags({"FLAGS_request_tracing": True,
+                      "FLAGS_trace_slow_ms": 1000.0,
+                      "FLAGS_trace_head_sample": 100,
+                      "FLAGS_trace_ring": 4096})
+
+
+def make_tracer(tmp_path, clock=None, **kw):
+    kw.setdefault("head_sample_n", 0)
+    kw.setdefault("slow_ms", 1000.0)
+    return RequestTracer(clock=clock or FakeClock(), enabled=True,
+                         artifacts=str(tmp_path), rank=0, **kw)
+
+
+def read_docs(tmp_path, rank=0):
+    path = trace_path_for_rank(rank, str(tmp_path))
+    docs = []
+    with open(path) as f:
+        for line in f:
+            docs.append(json.loads(line))
+    return docs
+
+
+# -- the Trace object --------------------------------------------------------
+
+class TestTrace:
+    def test_span_lifecycle_and_ids(self):
+        clock = FakeClock()
+        tr = Trace("t1", 1, 1, clock)
+        sid = tr.begin_span("server.admit", verdict="pending")
+        assert sid == 1
+        clock.advance(0.01)
+        tr.end_span(sid, verdict="admitted")
+        sp = tr.spans[0]
+        assert sp.name == "server.admit"
+        assert sp.t1 - sp.t0 == pytest.approx(0.01)
+        assert sp.attrs["verdict"] == "admitted"
+
+    def test_end_span_by_name_closes_last_open(self):
+        clock = FakeClock()
+        tr = Trace("t1", 1, 1, clock)
+        tr.begin_span("batcher.queue")
+        clock.advance(0.5)
+        tr.end_span("batcher.queue", depth=3)
+        assert tr.spans[0].t1 == 0.5
+        # closing an unknown name is a no-op, not an error
+        tr.end_span("engine.join")
+
+    def test_record_span_is_retroactive(self):
+        clock = FakeClock(10.0)
+        tr = Trace("t1", 1, 1, clock)
+        sid = tr.record_span("scheduler.dispatch", 4.0, 6.0, replica=1)
+        assert sid and tr.spans[0].t0 == 4.0 and tr.spans[0].t1 == 6.0
+
+    def test_dominant_span_uses_self_time(self):
+        # dispatch wall 1.0s but 0.9 of it belongs to the child exec:
+        # the child, not the parent, is to blame
+        tr = Trace("t1", 1, 1, FakeClock())
+        d = tr.record_span("scheduler.dispatch", 0.0, 1.0)
+        tr.record_span("replica.exec", 0.05, 0.95, parent=d)
+        assert tr.dominant_span() == "replica.exec"
+
+    def test_inactive_trace_is_a_noop(self):
+        tr = Trace("t1", 1, 1, FakeClock(), active=False)
+        assert tr.begin_span("server.admit") == 0
+        tr.event("x")
+        tr.annotate(a=1)
+        tr.flag("shed")
+        assert tr.spans == [] and tr.events == [] and tr.attrs == {} \
+            and tr.flags == set()
+
+    def test_span_cap_bounds_memory(self):
+        tr = Trace("t1", 1, 1, FakeClock())
+        for _ in range(tracing._MAX_SPANS + 50):
+            tr.begin_span("engine.decode_tick")
+        assert len(tr.spans) == tracing._MAX_SPANS
+
+    def test_ctx_is_wire_shaped(self):
+        tr = Trace("t1", 1, 1, FakeClock())
+        sid = tr.begin_span("client.submit")
+        assert tr.ctx(sid) == ("t1", sid)
+
+
+# -- tail-based retention ----------------------------------------------------
+
+class TestRetention:
+    @pytest.mark.parametrize("status,reason", [
+        ("shed", "shed"), ("deadline", "deadline"), ("error", "error"),
+        ("evicted", "error"),
+    ])
+    def test_exceptional_status_is_retained(self, tmp_path, status, reason):
+        tracer = make_tracer(tmp_path)
+        tr = tracer.start(request_id=7)
+        assert tracer.finish(tr, status=status) is True
+        (doc,) = read_docs(tmp_path)
+        assert doc["reason"] == reason and doc["status"] == status
+
+    def test_hedged_flag_retains_an_ok_trace(self, tmp_path):
+        tracer = make_tracer(tmp_path)
+        tr = tracer.start(request_id=7)
+        tr.flag("hedged")
+        assert tracer.finish(tr, status="ok") is True
+        (doc,) = read_docs(tmp_path)
+        assert doc["reason"] == "hedged" and doc["status"] == "ok"
+
+    def test_slow_clean_trace_is_retained(self, tmp_path):
+        clock = FakeClock()
+        tracer = make_tracer(tmp_path, clock=clock, slow_ms=100.0)
+        tr = tracer.start(request_id=7)
+        clock.advance(0.2)
+        assert tracer.finish(tr, status="ok") is True
+        (doc,) = read_docs(tmp_path)
+        assert doc["reason"] == "slow"
+        assert doc["duration_ms"] == pytest.approx(200.0)
+
+    def test_fast_clean_trace_is_dropped(self, tmp_path):
+        tracer = make_tracer(tmp_path)
+        tr = tracer.start(request_id=7)
+        assert tracer.finish(tr, status="ok") is False
+        assert tracer.stats()["dropped"] == 1
+        assert not Path(trace_path_for_rank(0, str(tmp_path))).exists()
+
+    def test_head_sample_is_deterministic(self, tmp_path):
+        tracer = make_tracer(tmp_path, head_sample_n=3)
+        for i in range(9):
+            tracer.finish(tracer.start(request_id=i), status="ok")
+        docs = read_docs(tmp_path)
+        # seq is 1-based: seq 3, 6, 9 sampled
+        assert [d["request_id"] for d in docs] == [2, 5, 8]
+        assert all(d["reason"] == "head_sample" for d in docs)
+
+    def test_finish_is_idempotent(self, tmp_path):
+        tracer = make_tracer(tmp_path)
+        tr = tracer.start(request_id=7)
+        assert tracer.finish(tr, status="shed") is True
+        assert tracer.finish(tr, status="error") is False
+        assert len(read_docs(tmp_path)) == 1
+        assert tracer.stats()["retained"] == 1
+
+    def test_ring_bound_degrades_to_untraced(self, tmp_path):
+        tracer = make_tracer(tmp_path, ring=2)
+        a, b = tracer.start(request_id=1), tracer.start(request_id=2)
+        c = tracer.start(request_id=3)     # over the ring: inactive
+        assert a.active and b.active and not c.active
+        assert tracer.stats()["ring_rejections"] == 1
+        # an inactive trace is never flushed, even with a tail status
+        assert tracer.finish(c, status="error") is False
+        # finishing a live one frees its slot
+        tracer.finish(a, status="ok")
+        assert tracer.start(request_id=4).active
+
+    def test_disabled_tracer_records_nothing(self, tmp_path):
+        tracer = RequestTracer(clock=FakeClock(), enabled=False,
+                               artifacts=str(tmp_path), rank=0)
+        tr = tracer.start(request_id=1)
+        assert not tr.active
+        assert tracer.finish(tr, status="error") is False
+
+    def test_flush_failure_is_counted_not_raised(self, tmp_path):
+        tracer = make_tracer(tmp_path / "nope")
+        # make the artifacts path unusable: a file where the dir should be
+        (tmp_path / "nope").write_text("not a directory")
+        tr = tracer.start(request_id=7)
+        assert tracer.finish(tr, status="error") is False
+        assert tracer.stats()["flush_failures"] == 1
+
+    def test_error_details_land_in_attrs(self, tmp_path):
+        tracer = make_tracer(tmp_path)
+        tr = tracer.start(request_id=7)
+        tracer.finish(tr, status="error", error=ReplicaDead("device lost"))
+        (doc,) = read_docs(tmp_path)
+        assert doc["attrs"]["error_type"] == "ReplicaDead"
+        assert "device lost" in doc["attrs"]["error"]
+
+    def test_retained_counter_labeled_by_reason(self, tmp_path):
+        tracer = make_tracer(tmp_path, registry=pmetrics.get_registry())
+        tracer.finish(tracer.start(request_id=1), status="shed")
+        tracer.finish(tracer.start(request_id=2), status="error")
+        counters = pmetrics.get_registry().snapshot()["counters"]
+        assert counters['trace.retained_total{reason="shed"}'] == 1
+        assert counters['trace.retained_total{reason="error"}'] == 1
+
+    def test_overhead_measured_on_real_clock(self, tmp_path):
+        """The span clock is fake (never advances inside instrumentation)
+        but overhead must still be > 0 — measured against the real clock,
+        so the <1% gate cannot be made vacuous by clock injection."""
+        tracer = make_tracer(tmp_path)
+        for i in range(50):
+            tr = tracer.start(request_id=i)
+            tr.begin_span("server.admit")
+            tr.end_span("server.admit")
+            tracer.finish(tr, status="ok")
+        assert tracer.stats()["overhead_ms"] > 0.0
+
+    def test_torn_tail_line_is_skipped_by_reader(self, tmp_path):
+        tracer = make_tracer(tmp_path)
+        tracer.finish(tracer.start(request_id=7), status="shed")
+        path = trace_path_for_rank(0, str(tmp_path))
+        with open(path, "a") as f:
+            f.write('{"trace_id": "torn')   # crash mid-append
+        traces = request_trace.load_traces([str(tmp_path)])
+        assert len(traces) == 1 and traces[0]["request_id"] == 7
+
+
+# -- SLO burn rates ----------------------------------------------------------
+
+class TestSLO:
+    def test_burn_rate_from_bucket_counts(self):
+        clock = FakeClock()
+        m = ServingMetrics(clock=clock)
+        slo = m.add_slo(SLO("req", "serving.request_latency_ms",
+                            target_ms=100.0, goodput=0.9))
+        assert m.slo_tick(now=0.0) is True
+        # 1 good (50ms), 1 bad (500ms): bad fraction 0.5, budget 0.1
+        m.observe_latency(0.05)
+        m.observe_latency(0.5)
+        clock.advance(10.0)
+        m.slo_tick(now=10.0)
+        rates = m.slo_report(now=10.0)["req"]
+        for w in slo.windows:
+            assert rates[w] == pytest.approx(5.0)
+
+    def test_all_good_burns_zero(self):
+        m = ServingMetrics(clock=FakeClock())
+        m.add_slo(SLO("req", "serving.request_latency_ms",
+                      target_ms=100.0, goodput=0.99))
+        m.slo_tick(now=0.0)
+        for _ in range(10):
+            m.observe_latency(0.01)
+        m.slo_tick(now=10.0)
+        assert all(r == 0.0 for r in m.slo_report(now=10.0)["req"].values())
+
+    def test_no_traffic_burns_zero(self):
+        m = ServingMetrics(clock=FakeClock())
+        m.add_slo(SLO("req", "serving.request_latency_ms", target_ms=100.0))
+        m.slo_tick(now=0.0)
+        assert all(r == 0.0 for r in m.slo_report(now=0.0)["req"].values())
+
+    def test_tick_exports_gauges_and_rate_limits(self):
+        m = ServingMetrics(clock=FakeClock())
+        m.add_slo(SLO("req", "serving.request_latency_ms", target_ms=100.0))
+        assert m.slo_tick(now=0.0) is True
+        assert m.slo_tick(now=0.5) is False     # under min_interval
+        assert m.slo_tick(now=2.0) is True
+        gauges = pmetrics.get_registry().snapshot()["gauges"]
+        assert gauges['slo.target_ms{slo="req"}'] == 100.0
+        for w in ("60s", "300s", "3600s"):
+            assert f'slo.burn_rate_ratio{{slo="req",window="{w}"}}' \
+                in gauges
+
+    def test_exemplar_links_bucket_to_trace(self):
+        m = ServingMetrics(clock=FakeClock())
+        m.observe_latency(0.3, trace_id="0-aa-00000001")   # 300ms bucket
+        h = pmetrics.get_registry().histogram_counts(
+            "serving.request_latency_ms")
+        # exemplars align with bounds: 300ms lands in the le=500 bucket
+        assert h["exemplars"][h["bounds"].index(500.0)] == "0-aa-00000001"
+
+    def test_per_priority_histograms_are_separate_series(self):
+        m = ServingMetrics(clock=FakeClock())
+        m.observe_latency(0.05, priority=2)
+        reg = pmetrics.get_registry()
+        assert reg.histogram_counts("serving.request_p2_latency_ms") \
+            is not None
+
+
+# -- end-to-end chaos attribution (the acceptance scenario) ------------------
+
+class ChaosPredictor:
+    """Doubles input[0]; a replica whose ``die`` flag is set raises
+    ReplicaDead on its next run (simulated device loss)."""
+
+    def __init__(self, clock, service_s=0.005):
+        self.clock = clock
+        self.service_s = service_s
+        self.die = False
+
+    def run(self, arrays):
+        if self.die:
+            self.die = False
+            raise ReplicaDead("simulated device loss")
+        self.clock.advance(self.service_s)
+        return [np.asarray(arrays[0]) * 2.0]
+
+
+class TestChaosAttribution:
+    def _setup(self, tmp_path):
+        clock = FakeClock()
+        art = tmp_path / "traces"
+        tracer = RequestTracer(clock=clock, enabled=True, slow_ms=1000.0,
+                               head_sample_n=0, ring=4096,
+                               artifacts=str(art), rank=0,
+                               registry=pmetrics.get_registry())
+        set_tracer(tracer)
+        predictors = {}
+
+        def factory(i):
+            predictors[i] = ChaosPredictor(clock)
+            return predictors[i]
+
+        cfg = ServingConfig(max_batch_size=4, replicas=2, max_retries=0,
+                            admission_initial=4, admission_max=4,
+                            hedge_budget=1.0)
+        srv = InferenceServer(factory, cfg, clock=clock)
+        return srv, clock, tracer, predictors, art
+
+    def _x(self, fill=1.0):
+        return [np.full((1, 3), fill, "float32")]
+
+    def test_every_exceptional_request_is_attributable(self, tmp_path):
+        srv, clock, tracer, predictors, art = self._setup(tmp_path)
+        try:
+            # -- admission shed: fill every AIMD slot, then one more ------
+            held = [srv.submit(self._x(), request_id=f"held-{i}")
+                    for i in range(4)]
+            with pytest.raises(ServerOverloaded):
+                srv.submit(self._x(), request_id="shed-victim")
+            while srv.pump(1):
+                clock.advance(0.001)
+            for r in held:
+                assert r.error is None
+
+            # -- hedged dispatch: primary hangs past the hedge window -----
+            for _ in range(20):
+                srv.scheduler.note_exec_latency(0.02)
+            faults.configure("serving.hedge:#1")
+            hedged = srv.submit(self._x(), request_id="hedged-winner")
+            srv.pump_until_done(hedged)
+            assert hedged.error is None
+            faults.reset()
+
+            # -- replica death: no retries left, the request fails --------
+            for p in predictors.values():
+                p.die = True
+            victim = srv.submit(self._x(), request_id="death-victim")
+            srv.pump_until_done(victim)
+            assert isinstance(victim.error, ReplicaDead)
+            for p in predictors.values():
+                p.die = False   # only the victim's replica actually died
+
+            # -- deadline expiry: enqueued, then the clock runs out -------
+            late = srv.submit(self._x(), request_id="late-victim",
+                              timeout=0.5)
+            clock.advance(1.0)
+            while srv.pump(1):
+                clock.advance(0.001)
+            assert late.error is not None
+
+            # -- slow-but-clean request: queued 2s before the pump --------
+            slow = srv.submit(self._x(), request_id="slow-ok")
+            clock.advance(2.0)
+            srv.pump_until_done(slow)
+            assert slow.error is None
+        finally:
+            reset_tracer()
+
+        docs = {d["request_id"]: d
+                for d in request_trace.load_traces([str(art)])}
+
+        shed = docs["shed-victim"]
+        assert shed["status"] == "shed" and shed["reason"] == "shed"
+        assert shed["dominant"] is not None
+        admit = next(s for s in shed["spans"]
+                     if s["name"] == "server.admit")
+        assert admit["attrs"]["verdict"] == "shed_admission"
+        assert admit["attrs"]["limit"] == 4
+
+        hedged_doc = docs["hedged-winner"]
+        assert hedged_doc["reason"] == "hedged"
+        assert hedged_doc["status"] == "ok"
+        dispatch = next(s for s in hedged_doc["spans"]
+                        if s["name"] == "scheduler.dispatch")
+        assert dispatch["attrs"]["hedged"] is True
+        assert "replica" in dispatch["attrs"]
+
+        death = docs["death-victim"]
+        assert death["status"] == "error" and death["reason"] == "error"
+        assert death["attrs"]["error_type"] == "ReplicaDead"
+        assert death["dominant"] is not None
+        d_dispatch = next(s for s in death["spans"]
+                          if s["name"] == "scheduler.dispatch")
+        assert d_dispatch["attrs"]["outcome"] == "ReplicaDead"
+        assert d_dispatch["attrs"]["replica"] in (0, 1)
+        assert "version" in death["attrs"]   # model version stamped
+        d_admit = next(s for s in death["spans"]
+                       if s["name"] == "server.admit")
+        assert d_admit["attrs"]["verdict"] == "admitted"
+
+        late_doc = docs["late-victim"]
+        assert late_doc["status"] == "deadline"
+        assert late_doc["reason"] == "deadline"
+        assert late_doc["dominant"] is not None
+
+        slow_doc = docs["slow-ok"]
+        assert slow_doc["reason"] == "slow"
+        assert slow_doc["dominant"] == "batcher.queue"
+
+        # the p99 bucket exemplar of the latency histogram names a real
+        # retained trace — the bridge from "p99 regressed" to one request
+        h = pmetrics.get_registry().histogram_counts(
+            "serving.request_latency_ms")
+        top_idx = max(i for i, ex in enumerate(h["exemplars"])
+                      if ex is not None)
+        assert h["exemplars"][top_idx] == slow_doc["trace_id"]
+
+    def test_explain_reproduces_from_artifacts_alone(self, tmp_path,
+                                                     capsys):
+        srv, clock, tracer, predictors, art = self._setup(tmp_path)
+        try:
+            for p in predictors.values():
+                p.die = True
+            victim = srv.submit(self._x(), request_id="death-victim")
+            srv.pump_until_done(victim)
+            assert victim.error is not None
+        finally:
+            reset_tracer()
+
+        assert request_trace.main([str(art),
+                                   "--explain", "death-victim"]) == 0
+        out = capsys.readouterr().out
+        assert "dominant span:" in out
+        assert "verdict=shed_admission" not in out
+        assert "scheduler.dispatch" in out
+        assert "server.admit" in out
+        assert "error_type=ReplicaDead" in out
+        # list mode filters by reason
+        assert request_trace.main([str(art), "--reason", "error"]) == 0
+        out = capsys.readouterr().out
+        assert "death-victim" in out
+        # unknown request → exit 1, not a traceback
+        assert request_trace.main([str(art),
+                                   "--explain", "no-such-req"]) == 1
+
+    def test_trace_merge_overlays_request_spans(self, tmp_path):
+        srv, clock, tracer, predictors, art = self._setup(tmp_path)
+        try:
+            for p in predictors.values():
+                p.die = True
+            victim = srv.submit(self._x(), request_id="death-victim")
+            srv.pump_until_done(victim)
+        finally:
+            reset_tracer()
+
+        merged, info = trace_merge.merge(
+            trace_merge.load_inputs([str(art)]))
+        assert info["request_traces"] == 1
+        req_events = [e for e in merged["traceEvents"]
+                      if e.get("cat") == "request"]
+        assert req_events
+        names = {e["name"] for e in req_events}
+        assert "server.admit" in names and "scheduler.dispatch" in names
+        (tid,) = {e["tid"] for e in req_events}
+        assert tid.startswith("req ")
+        for e in req_events:
+            assert e["ph"] == "X" and e["ts"] >= 0
+
+
+# -- decode-stream tracing ---------------------------------------------------
+
+class TestDecodeTracing:
+    def _engine(self, tmp_path, **cfg_kw):
+        from paddle_tpu.serving.decode import (
+            CompiledDecodeBackend, DecodeConfig, DecodeEngine,
+        )
+        clock = FakeClock()
+        art = tmp_path / "traces"
+        tracer = RequestTracer(clock=clock, enabled=True, slow_ms=1000.0,
+                               head_sample_n=0, ring=4096,
+                               artifacts=str(art), rank=0,
+                               registry=pmetrics.get_registry())
+        set_tracer(tracer)
+        cfg_kw.setdefault("max_running", 2)
+        cfg_kw.setdefault("max_new_tokens", 8)
+        eng = DecodeEngine(CompiledDecodeBackend(max_running=2),
+                           DecodeConfig(**cfg_kw), clock=clock)
+        return eng, clock, art
+
+    def test_slow_stream_trace_names_decode_spans(self, tmp_path):
+        eng, clock, art = self._engine(tmp_path)
+        try:
+            s = eng.join([1, 2, 3], request_id="slow-stream")
+            rounds = 0
+            while eng.running() and rounds < 100:
+                clock.advance(0.3)     # 300ms/round: ends slow
+                eng.step()
+                rounds += 1
+            assert s.done and s.error is None
+        finally:
+            reset_tracer()
+        docs = {d["request_id"]: d
+                for d in request_trace.load_traces([str(art)])}
+        doc = docs["slow-stream"]
+        assert doc["reason"] == "slow" and doc["status"] == "ok"
+        names = {s["name"] for s in doc["spans"]}
+        assert {"engine.join", "engine.prefill_chunk",
+                "engine.decode_tick"} <= names
+        join = next(s for s in doc["spans"] if s["name"] == "engine.join")
+        assert join["attrs"]["verdict"] == "admitted"
+        assert doc["attrs"]["ttft_ms"] > 0
+
+    def test_shed_join_is_retained(self, tmp_path):
+        eng, clock, art = self._engine(tmp_path, max_running=1)
+        try:
+            eng.join([1, 2], request_id="kept")
+            with pytest.raises(ServerOverloaded):
+                eng.join([3, 4], request_id="refused")
+        finally:
+            reset_tracer()
+        docs = {d["request_id"]: d
+                for d in request_trace.load_traces([str(art)])}
+        doc = docs["refused"]
+        assert doc["status"] == "shed" and doc["reason"] == "shed"
+        join = next(s for s in doc["spans"] if s["name"] == "engine.join")
+        assert join["attrs"]["verdict"] == "shed"
+
+    def test_span_vocabulary_is_frozen(self):
+        # runtime tuple mirrors the lint manifest (also asserted source-
+        # level in test_lints); a rename must touch both deliberately
+        assert len(SPAN_NAMES) == 10
+        assert len(set(SPAN_NAMES)) == 10

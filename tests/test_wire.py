@@ -225,6 +225,81 @@ class TestStreamFraming:
             assert delivered == list(range(len(delivered)))
 
 
+class TestTraceFraming:
+    """Request-trace context stamping (profiler/tracing.py): the context
+    rides inside the frame dict like the generation / model-version
+    stamps, so untraced peers stay byte-compatible and a mangled stamp
+    degrades to 'no trace' instead of crashing the reader."""
+
+    def test_stamp_and_accessor_roundtrip(self):
+        f = wire.stamp_trace({"cmd": "infer", "inputs": [1]},
+                             ("0-1a2b-00000007", 3))
+        got = wire.decode(wire.encode(f))
+        assert wire.frame_trace(got) == ("0-1a2b-00000007", 3)
+        assert got["cmd"] == "infer"
+
+    def test_none_ctx_stamps_nothing(self):
+        f = {"cmd": "infer"}
+        assert wire.stamp_trace(f, None) is f
+        assert "trace" not in f
+
+    def test_unstamped_peer_is_byte_compatible(self):
+        """An untraced client's frames must be byte-identical to the
+        pre-tracing wire format — absent key, not a null field — so old
+        and new peers interoperate in either direction."""
+        frame = {"cmd": "infer", "inputs": [1, 2], "request_id": 9}
+        assert wire.encode(wire.stamp_trace(dict(frame), None)) \
+            == wire.encode(frame)
+        # And a traced server reading an unstamped frame sees 'no trace'.
+        assert wire.frame_trace(wire.decode(wire.encode(frame))) is None
+
+    @pytest.mark.parametrize("bad", [
+        "not-a-list",                    # wrong container
+        ["tid-only"],                    # wrong arity
+        ["tid", 1, 2],                   # wrong arity
+        [7, 1],                          # trace id not a str
+        ["tid", "1"],                    # span id not an int
+        ["tid", True],                   # bool is not a span id
+        None,                            # explicit null
+    ], ids=repr)
+    def test_mangled_stamp_reads_as_no_trace(self, bad):
+        assert wire.frame_trace({"cmd": "x", "trace": bad}) is None
+
+    def test_frame_trace_tolerates_non_dict(self):
+        for junk in (None, 42, "frame", [1, 2], b"bytes"):
+            assert wire.frame_trace(junk) is None
+
+    def test_truncated_stamped_frames_always_raise(self):
+        """A stamped frame torn at every possible cut point must surface
+        as a typed error — the trace stamp adds bytes, not failure
+        modes."""
+        enc = wire.encode(wire.stamp_trace(
+            {"cmd": "infer", "inputs": [1.5]}, ("0-ab-00000001", 2)))
+        for i in range(len(enc)):
+            with pytest.raises((wire.FrameError, ValueError)):
+                wire.decode(enc[:i])
+
+    def test_bitflipped_stamped_frames_decode_or_raise(self):
+        """Seeded corruption over stamped frames: each either decodes
+        (yielding a valid context or None — never a malformed tuple) or
+        raises in the typed FrameError/ValueError family."""
+        rng = random.Random(0x71ACE)
+        enc = wire.encode(wire.stamp_trace(
+            {"cmd": "infer", "request_id": 5}, ("0-99-00000042", 1)))
+        for _ in range(300):
+            buf = bytearray(enc)
+            buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+            try:
+                f = wire.decode(bytes(buf))
+            except (ValueError, TypeError):
+                continue
+            ctx = wire.frame_trace(f)
+            if ctx is not None:
+                tid, sid = ctx
+                assert isinstance(tid, str)
+                assert isinstance(sid, int) and not isinstance(sid, bool)
+
+
 class TestSocketTimeouts:
     def _pair(self):
         srv = socket.socket()

@@ -47,9 +47,11 @@ SUBSYSTEMS = [
     "profiler",      # profiler-internal (samples/sec, ...)
     "rollout",       # live model rollout (serving/rollout.py)
     "serving",       # inference server
+    "slo",           # SLO burn-rate accounting (serving/metrics.py)
     "steptime",      # per-rank step-time health beacons
     "steptimer",     # phase attribution (docs/observability.md)
     "straggler",     # straggler-quarantine ratios
+    "trace",         # request tracer health (profiler/tracing.py)
 ]
 
 # Unit suffixes a metric name must end with (after stripping ``{}`` fields).

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Lint: request-trace span names come from the fixed vocabulary.
+
+The tracer (paddle_tpu/profiler/tracing.py) accepts any span name, but
+``tools/request_trace.py --explain``, the ``trace_merge.py`` overlay, and
+the span table in docs/observability.md all assume the fixed vocabulary
+below — a span minted under a freelance name renders as noise nobody can
+look up. The check itself lives in the unified analysis framework
+(paddle_tpu/analysis/passes/span_names.py, run with the rest of the
+passes by ``tools/lint.py``); this shim keeps the standalone CLI and —
+deliberately — the manifest: ``SPAN_NAMES`` stays a plain literal HERE
+because tests/test_lints.py ast-parses it to guard the vocabulary, and
+this file remains where a new span is registered (a one-line reviewed
+diff, alongside its row in the docs table).
+
+Only literal first arguments at trace-shaped call sites are checked;
+dynamic names are skipped (enforced where names are minted).
+
+Run directly or via tests/test_lints.py.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Directories scanned (relative to repo root).
+SCAN = ["paddle_tpu", "tools"]
+
+# The fixed span vocabulary — keep in sync with
+# paddle_tpu.profiler.tracing.SPAN_NAMES and the docs/observability.md
+# table. A new span fails the lint until registered here.
+SPAN_NAMES = [
+    "client.submit",           # client-side submit -> reply wall time
+    "server.admit",            # admission verdict + AIMD limit snapshot
+    "batcher.queue",           # time spent queued (put -> assemble)
+    "batcher.batch_assemble",  # signature grouping + bucket padding
+    "scheduler.dispatch",      # placement + attempts (replica/hedge)
+    "replica.exec",            # the executor run (model version stamp)
+    "engine.join",             # decode admission: AIMD + slots + KV
+    "engine.prefill_chunk",    # one rationed prefill chunk
+    "engine.decode_tick",      # one decode round the stream was in
+    "engine.kv_wait",          # KV block-table growth attempt
+]
+
+# Methods whose first argument mints a span name (on a trace receiver).
+SPAN_CALLS = ["begin_span", "record_span", "span"]
+
+
+def _analysis():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from lint import load_analysis
+    finally:
+        sys.path.pop(0)
+    return load_analysis(REPO)
+
+
+def check(repo=REPO):
+    """([problems], spans_checked) (framework-backed)."""
+    analysis = _analysis()
+    ctx = analysis.AnalysisContext(repo)
+    p = analysis.get_pass("span-names")()
+    findings = p.run(ctx)
+    return [f.message for f in findings], p.spans_checked
+
+
+def main():
+    problems, checked = check()
+    if problems:
+        print("span-name lint FAILED:")
+        for p in problems:
+            print("  -", p)
+        return 1
+    print(f"span-name lint OK ({checked} span call sites checked, "
+          f"{len(SPAN_NAMES)} spans registered)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

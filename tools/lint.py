@@ -203,6 +203,9 @@ def main(argv=None):
         elif name == "metric-names":
             extra = (f", {getattr(p, 'templates_checked', 0)} "
                      "name templates checked")
+        elif name == "span-names":
+            extra = (f", {getattr(p, 'spans_checked', 0)} "
+                     "span call sites checked")
         summaries.append(
             f"{name}: {len(new)} finding(s)"
             + (f", {len(waived)} waived" if waived else "") + extra)

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""List and explain tail-retained request traces.
+
+Reads the ``request_traces_rank<N>.jsonl`` files the
+:class:`paddle_tpu.profiler.tracing.RequestTracer` flushes into
+``PADDLE_TPU_ARTIFACTS_DIR`` (only traces that ended *interesting* — shed,
+errored, deadline-exceeded, hedged, slow — plus the deterministic head
+sample survive tail-based retention; see docs/observability.md).
+
+Two modes:
+
+- **list** (default): one row per retained trace — retention reason,
+  status, duration, dominant span, request id — filterable by
+  ``--reason`` / ``--status`` / ``--slower-than``;
+- **--explain <request_id>**: reconstruct one request's span tree from the
+  artifacts alone and name what to blame: the dominant (largest self-time)
+  span, the admission verdict and AIMD limit, the replica id + breaker
+  state + hedge role from dispatch, and the model version that served it.
+  Matches request id or trace id; exits 1 when no retained trace matches
+  (the request was either never traced or dropped by the tail policy).
+
+Exit code 0 = ok, 1 = --explain target not found, 2 = bad/missing input.
+Torn jsonl tail lines (a crash mid-append) are skipped, same contract as
+the recovery journal readers. Pure stdlib, no jax.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+__all__ = ["load_traces", "filter_traces", "find_trace", "format_row",
+           "format_explain", "main"]
+
+
+def _artifacts_dir():
+    return os.environ.get("PADDLE_TPU_ARTIFACTS_DIR",
+                          "/tmp/paddle_tpu_artifacts")
+
+
+def load_traces(paths):
+    """Parse every trace doc from the given files/dirs (dirs are globbed
+    for ``request_traces_rank*.jsonl``). Torn tail lines are skipped."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(
+                os.path.join(p, "request_traces_rank*.jsonl"))))
+        else:
+            files.append(p)
+    traces = []
+    for fn in files:
+        with open(fn) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line (crash mid-append)
+                if isinstance(doc, dict) and "trace_id" in doc:
+                    traces.append(doc)
+    return traces
+
+
+def filter_traces(traces, reason=None, status=None, slower_than_ms=None):
+    out = []
+    for t in traces:
+        if reason is not None and t.get("reason") != reason:
+            continue
+        if status is not None and t.get("status") != status:
+            continue
+        if slower_than_ms is not None \
+                and t.get("duration_ms", 0.0) <= slower_than_ms:
+            continue
+        out.append(t)
+    return out
+
+
+def find_trace(traces, ident):
+    """The trace whose request_id or trace_id equals ``ident`` (request
+    ids may be ints on the server side — compare stringified too)."""
+    for t in traces:
+        if t.get("trace_id") == ident or t.get("request_id") == ident \
+                or str(t.get("request_id")) == ident:
+            return t
+    return None
+
+
+def format_row(t):
+    return (f"{str(t.get('request_id', '?')):<16} "
+            f"{t.get('reason', '?'):<12} {str(t.get('status', '?')):<9} "
+            f"{t.get('duration_ms', 0.0):>10.3f}ms  "
+            f"dominant={t.get('dominant') or '-'}  "
+            f"trace={t.get('trace_id', '?')}")
+
+
+def _span_context(t):
+    """Pull the attribution facts out of the span attrs: admission
+    verdict/limit, replica + breaker + hedge role, model version."""
+    ctx = {}
+    for sp in t.get("spans", ()):
+        attrs = sp.get("attrs") or {}
+        name = sp.get("name")
+        if name in ("server.admit", "engine.join"):
+            ctx.setdefault("admission", attrs.get("verdict"))
+            if "limit" in attrs:
+                ctx.setdefault("admission_limit", attrs["limit"])
+        elif name == "scheduler.dispatch":
+            # last dispatch wins: retries overwrite earlier attempts
+            for k in ("replica", "breaker", "hedged", "attempts",
+                      "outcome"):
+                if k in attrs:
+                    ctx[k] = attrs[k]
+        elif name == "replica.exec" and attrs.get("version") is not None:
+            ctx["version"] = attrs["version"]
+    root = t.get("attrs") or {}
+    for k in ("replica", "version", "error_type", "error", "ttft_ms"):
+        if k in root and k not in ctx:
+            ctx[k] = root[k]
+    return ctx
+
+
+def format_explain(t):
+    """Render one trace: header, attribution context, span tree (children
+    indented under their parent), point events."""
+    lines = [
+        f"request {t.get('request_id', '?')}  "
+        f"trace {t.get('trace_id', '?')}  rank {t.get('rank', '?')}",
+        f"  status={t.get('status')}  retained={t.get('reason')}  "
+        f"duration={t.get('duration_ms', 0.0):.3f}ms  "
+        f"flags={','.join(t.get('flags', [])) or '-'}",
+        f"  dominant span: {t.get('dominant') or '(none closed)'}",
+    ]
+    ctx = _span_context(t)
+    if ctx:
+        lines.append("  context: " + "  ".join(
+            f"{k}={ctx[k]}" for k in sorted(ctx)))
+    spans = list(t.get("spans", ()))
+    children = {}
+    for sp in spans:
+        children.setdefault(sp.get("parent", 0), []).append(sp)
+    dominant = t.get("dominant")
+
+    def render(sp, depth):
+        t0, t1 = sp.get("t0"), sp.get("t1")
+        dur = f"{(t1 - t0) * 1e3:9.3f}ms" if t0 is not None \
+            and t1 is not None else "     open "
+        attrs = sp.get("attrs") or {}
+        extra = "  ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        mark = "  <-- dominant" if sp.get("name") == dominant else ""
+        lines.append(f"  {'  ' * depth}{dur}  {sp.get('name')}"
+                     + (f"  [{extra}]" if extra else "") + mark)
+        for ch in children.get(sp.get("sid"), ()):
+            render(ch, depth + 1)
+
+    for sp in children.get(0, ()):
+        render(sp, 0)
+    for ev in t.get("events", ()):
+        lines.append(f"    @{ev.get('t')}  {ev.get('name')} "
+                     f"{ev.get('attrs') or ''}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="list / explain tail-retained request traces")
+    ap.add_argument("inputs", nargs="*",
+                    help="artifact dir(s) or request_traces jsonl files "
+                         "(default: $PADDLE_TPU_ARTIFACTS_DIR)")
+    ap.add_argument("--reason", default=None,
+                    help="only traces retained for this reason (shed / "
+                         "deadline / error / hedged / slow / head_sample)")
+    ap.add_argument("--status", default=None,
+                    help="only traces with this terminal status")
+    ap.add_argument("--slower-than", type=float, default=None,
+                    metavar="MS", help="only traces slower than MS")
+    ap.add_argument("--explain", default=None, metavar="REQUEST_ID",
+                    help="print one request's span tree + attribution "
+                         "context (matches request id or trace id)")
+    ns = ap.parse_args(argv)
+    paths = ns.inputs or [_artifacts_dir()]
+    try:
+        traces = load_traces(paths)
+    except OSError as e:
+        print(f"request_trace: bad input: {e}", file=sys.stderr)
+        return 2
+    if ns.explain is not None:
+        t = find_trace(traces, ns.explain)
+        if t is None:
+            print(f"request_trace: no retained trace for '{ns.explain}' "
+                  f"in {paths} ({len(traces)} trace(s) scanned) — it was "
+                  "either never traced or dropped by tail-based retention",
+                  file=sys.stderr)
+            return 1
+        print(format_explain(t))
+        return 0
+    kept = filter_traces(traces, reason=ns.reason, status=ns.status,
+                         slower_than_ms=ns.slower_than)
+    kept.sort(key=lambda t: t.get("duration_ms", 0.0), reverse=True)
+    print(f"{len(kept)} retained trace(s) "
+          f"({len(traces)} scanned) from {paths}")
+    for t in kept:
+        print(format_row(t))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

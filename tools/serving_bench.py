@@ -14,7 +14,11 @@ multiples of estimated capacity (up to 10x). It asserts **graceful
 degradation** — at every multiplier goodput stays positive, every admitted
 request terminates, and the admitted-latency p99 stays under the deadline
 (excess load is shed with retry_after hints instead of dragging admitted
-work over its SLO). Exit code 1 means the overload-control layer collapsed.
+work over its SLO). Both deterministic sweeps also gate the request-tracing
+contract (docs/observability.md): every exceptional termination must have a
+tail-retained trace, retention must stay inside the tail+head policy, and
+per-request tracer overhead must stay under 1% of the modeled service time.
+Exit code 1 means the overload-control layer collapsed.
 Zero real sleeps; ``--overload --smoke`` is fast enough for tier-1
 (tests/test_lints.py runs exactly that).
 
@@ -166,6 +170,67 @@ class _FakeClock:
         self.t += dt
 
 
+def _install_tracer(clock):
+    """Install a fresh fake-clock request tracer flushing into a private
+    tmp dir (one per bench point, so retention counts are exact). Returns
+    (tracer, artifacts_dir, restore_fn)."""
+    import tempfile
+
+    from paddle_tpu.profiler import tracing
+
+    art = tempfile.mkdtemp(prefix="serving_bench_traces_")
+    tracer = tracing.RequestTracer(clock=clock, enabled=True, artifacts=art,
+                                   rank=0)
+    prev = tracing.set_tracer(tracer)
+
+    def restore():
+        tracing.set_tracer(prev)
+    return tracer, art, restore
+
+
+def _trace_gates(tracer, art, exceptional, service_ms):
+    """Tracing-contract verdicts for one bench point: every exceptional
+    termination (shed / deadline / error) has a retained trace, retention
+    stays inside the tail+head policy, and the tracer's self-measured
+    (real-clock, steptimer contract) per-request overhead is reported as a
+    percentage of the modeled per-request service time ``service_ms`` —
+    the fake clock makes simulated wall time useless as a baseline, and
+    the synthetic predictor makes the bench's own real wall unrepresentative
+    of a request that runs an actual model."""
+    import glob
+    import shutil
+
+    docs = []
+    for fn in sorted(glob.glob(
+            os.path.join(art, "request_traces_rank*.jsonl"))):
+        with open(fn) as f:
+            for line in f:
+                try:
+                    docs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    shutil.rmtree(art, ignore_errors=True)
+    stats = tracer.stats()
+    exceptional_docs = sum(1 for d in docs if d.get("status") != "ok")
+    head = sum(1 for d in docs if d.get("reason") == "head_sample")
+    allowed = {"shed", "deadline", "error", "hedged", "slow", "head_sample"}
+    head_bound = stats["seq"] // max(1, tracer.head_sample_n) + 1 \
+        if tracer.head_sample_n > 0 else 0
+    bound_ok = (all(d.get("reason") in allowed for d in docs)
+                and head <= head_bound
+                and stats["retained"] == len(docs))
+    per_request_ms = stats["overhead_ms"] / max(1, stats["seq"])
+    return {
+        "traces_retained": len(docs),
+        "traces_exceptional": exceptional_docs,
+        "exceptional": exceptional,
+        "trace_coverage_ok": exceptional_docs == exceptional,
+        "trace_bound_ok": bound_ok,
+        "trace_overhead_pct": per_request_ms / service_ms * 100.0
+        if service_ms > 0 else 0.0,
+    }
+
+
 def run_overload_point(args, multiplier):
     """One offered-load point at ``multiplier`` x estimated capacity on a
     fresh fake-clock server. Returns the point's report dict."""
@@ -175,6 +240,7 @@ def run_overload_point(args, multiplier):
 
     clock = _FakeClock()
     service_s = args.service_ms / 1e3
+    tracer, trace_art, restore_tracer = _install_tracer(clock)
 
     class SyntheticPredictor:
         # fixed service time: running a batch advances the fake clock —
@@ -222,11 +288,16 @@ def run_overload_point(args, multiplier):
             break
     clock.advance(deadline + 1.0)
     srv.pump(1)          # expire anything whose deadline passed in queue
+    restore_tracer()
     snap = srv.stats()
     ok = [r for r in accepted if r.done() and r.error is None]
     unterminated = sum(1 for r in accepted if not r.done())
     offered = len(accepted) + sheds
+    exceptional = sheds + sum(1 for r in accepted
+                              if r.done() and r.error is not None)
+    gates = _trace_gates(tracer, trace_art, exceptional, args.service_ms)
     return {
+        **gates,
         "multiplier": multiplier,
         "offered": offered,
         "accepted": len(accepted),
@@ -266,7 +337,10 @@ def run_overload(args):
              and r["unterminated"] == 0
              and r["latency_ms_p99"] <= r["deadline_ms"]
              and r["shed_with_hint"] == r["shed"]
-             for r in results)
+             and r["trace_coverage_ok"]
+             and r["trace_bound_ok"]
+             for r in results) \
+        and results[0]["trace_overhead_pct"] < 1.0
     return results, ok
 
 
@@ -283,6 +357,7 @@ def run_decode_point(args, multiplier):
 
     clock = _FakeClock()
     round_s = args.token_ms / 1e3
+    tracer, trace_art, restore_tracer = _install_tracer(clock)
 
     def service(kind, n):
         # one decode round costs token_ms regardless of occupancy (the
@@ -330,12 +405,18 @@ def run_decode_point(args, multiplier):
         eng.step()
         clock.advance(dt)
         rounds += 1
+    restore_tracer()
     snap = eng.stats()
     ok = [s for s in joined if s.done and s.error is None]
     unterminated = sum(1 for s in joined if not s.done)
     goodput = sum(len(s.tokens) for s in ok) / clock()
     offered = len(joined) + sheds
+    exceptional = sheds + sum(1 for s in joined
+                              if s.done and s.error is not None)
+    gates = _trace_gates(tracer, trace_art, exceptional,
+                         stream_service_s * 1e3)
     return {
+        **gates,
         "multiplier": multiplier,
         "offered": offered,
         "joined": len(joined),
@@ -378,8 +459,11 @@ def run_decode(args):
              and r["shed_with_hint"] == r["shed"]
              and (r["compiles"] is None
                   or r["compiles"] <= r["compile_bound"])
+             and r["trace_coverage_ok"]
+             and r["trace_bound_ok"]
              for r in results) \
-        and (nominal["ttft_ms_p99"] or 0.0) <= nominal["deadline_ms"]
+        and (nominal["ttft_ms_p99"] or 0.0) <= nominal["deadline_ms"] \
+        and nominal["trace_overhead_pct"] < 1.0
     return results, ok
 
 

@@ -16,6 +16,13 @@ files):
   seconds already.
 - ``recovery_journal_*.jsonl``     — recovery journal events
   (paddle_tpu/resilience/recovery.py), wall-clock ``ts`` seconds.
+- ``request_traces_rank<N>.jsonl`` — tail-retained request traces
+  (paddle_tpu/profiler/tracing.py). Span times are injectable-clock
+  seconds; each trace carries the tracer's ``anchor`` {wall_s, mono_s}
+  used to place its spans on the same wall clock as the rank timelines
+  (one tid per trace id, under the flushing rank's pid). Serving
+  flight-recorder dumps (the per-server request ring) fold through the
+  same ``entries`` path as the collective dumps.
 
 Dumps written across an elastic re-rendezvous carry different generation
 stamps; merging a pre-restart rank's trace with post-restart peers produces
@@ -61,14 +68,26 @@ def load_inputs(paths):
     for p in paths:
         if os.path.isdir(p):
             for pat in ("trace_rank*.json", "flight_recorder_rank*.json",
+                        "request_traces_rank*.jsonl",
                         "recovery_journal_*.jsonl",
                         "recovery_journal_*.jsonl.1"):
                 files.extend(sorted(glob.glob(os.path.join(p, pat))))
         else:
             files.append(p)
-    out = {"traces": {}, "recorders": {}, "journal": []}
+    out = {"traces": {}, "recorders": {}, "journal": [], "requests": []}
     for fn in files:
         base = os.path.basename(fn)
+        if base.startswith("request_traces") and ".jsonl" in base:
+            with open(fn) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out["requests"].append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # torn tail line (crash mid-append)
+            continue
         if base.endswith(".jsonl") or base.endswith(".jsonl.1"):
             with open(fn) as f:
                 for line in f:
@@ -110,9 +129,13 @@ def group_sources_by_generation(inputs):
             by_gen.setdefault(_generation(doc), set()).add(rank)
     if not by_gen:
         return 0, {"traces": {}, "recorders": {},
-                   "journal": list(inputs["journal"])}, {}
+                   "journal": list(inputs["journal"]),
+                   "requests": list(inputs.get("requests", ()))}, {}
     gen, _ranks = max(by_gen.items(), key=lambda kv: (len(kv[1]), kv[0]))
-    kept = {"traces": {}, "recorders": {}, "journal": []}
+    # request traces carry no generation stamp (a request's trace is its
+    # own consistency unit) — they always ride along
+    kept = {"traces": {}, "recorders": {}, "journal": [],
+            "requests": list(inputs.get("requests", ()))}
     stale = {}
     for kind in ("traces", "recorders"):
         for rank, doc in inputs[kind].items():
@@ -174,6 +197,30 @@ def merge(inputs):
                 ev["s"] = "p"
                 ev["name"] = f"{ev['name']} (pending)"
             events.append(ev)
+    skipped_requests = 0
+    for doc in kept.get("requests", ()):
+        a = doc.get("anchor") or {}
+        if "mono_s" not in a or "wall_s" not in a:
+            skipped_requests += 1   # unanchored: cannot be wall-aligned
+            continue
+        rank = int(doc.get("rank", -1))
+        tid = f"req {doc.get('trace_id', '?')}"
+        args_root = {"trace_id": doc.get("trace_id"),
+                     "request_id": doc.get("request_id"),
+                     "status": doc.get("status"),
+                     "reason": doc.get("reason"),
+                     "dominant": doc.get("dominant")}
+        for sp in doc.get("spans", ()):
+            t0, t1 = sp.get("t0"), sp.get("t1")
+            if t0 is None or t1 is None:
+                continue
+            args = dict(args_root)
+            args.update(sp.get("attrs") or {})
+            events.append({
+                "name": sp.get("name", "?"), "ph": "X", "pid": rank,
+                "tid": tid, "cat": "request",
+                "ts": (a["wall_s"] + (t0 - a["mono_s"])) * 1e6,
+                "dur": max(0.0, (t1 - t0) * 1e6), "args": args})
     for e in kept["journal"]:
         ts = e.get("ts")
         if ts is None:
@@ -194,7 +241,9 @@ def merge(inputs):
              "ranks": ranks,
              "stale_ranks": stale}
     info = {"generation": gen, "ranks": ranks, "stale": stale,
-            "unaligned_ranks": unaligned, "events": len(events)}
+            "unaligned_ranks": unaligned, "events": len(events),
+            "request_traces": len(kept.get("requests", ())),
+            "unanchored_request_traces": skipped_requests}
     return trace, info
 
 
@@ -240,6 +289,12 @@ def format_summary(info, summary):
     if info["unaligned_ranks"]:
         lines.append(f"  unaligned (no wall-clock anchor, skipped): ranks "
                      f"{info['unaligned_ranks']}")
+    if info.get("request_traces"):
+        line = f"  request traces overlaid: {info['request_traces']}"
+        if info.get("unanchored_request_traces"):
+            line += (f" ({info['unanchored_request_traces']} unanchored, "
+                     "skipped)")
+        lines.append(line)
     step = summary.get("step")
     if step:
         for rank, s in step.items():
@@ -276,9 +331,10 @@ def main(argv=None):
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"trace_merge: bad input: {e}", file=sys.stderr)
         return 2
-    if not inputs["traces"] and not inputs["recorders"]:
-        print("trace_merge: no per-rank traces or flight-recorder dumps "
-              "found", file=sys.stderr)
+    if not inputs["traces"] and not inputs["recorders"] \
+            and not inputs.get("requests"):
+        print("trace_merge: no per-rank traces, flight-recorder dumps, or "
+              "request traces found", file=sys.stderr)
         return 2
     trace, info = merge(inputs)
     summary = summarize(trace)
