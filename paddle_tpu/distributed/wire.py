@@ -443,13 +443,23 @@ class StreamReader:
     Any gap, regression, unstamped frame, or frame after end means the
     stream is torn — the reader raises :class:`FrameError` and the caller
     must drop the connection, exactly like a mid-frame socket timeout.
+
+    The reader is also **generation-fenced**: the first frame's
+    :func:`frame_generation` stamp (0 when unstamped) pins the stream's
+    generation, and any later frame stamped differently — a rendezvous or
+    membership change raced the stream mid-flight — tears the stream with
+    a typed :class:`FrameError` instead of silently delivering pages from
+    two incarnations interleaved. Pass ``generation=`` to pin it up front
+    (a KV migration pins the exporting replica set's generation before the
+    first page arrives).
     """
 
-    __slots__ = ("next_seq", "ended")
+    __slots__ = ("next_seq", "ended", "generation")
 
-    def __init__(self):
+    def __init__(self, generation=None):
         self.next_seq = 0
         self.ended = False
+        self.generation = None if generation is None else int(generation)
 
     def feed(self, frame):
         """Validate one frame; returns ``(seq, end)``."""
@@ -458,6 +468,13 @@ class StreamReader:
         seq = frame_stream_seq(frame)
         if seq is None:
             raise FrameError("torn stream: unstamped frame inside a stream")
+        gen = frame_generation(frame)
+        if self.generation is None:
+            self.generation = gen
+        elif gen != self.generation:
+            raise FrameError(
+                f"torn stream: generation fence (stream pinned to "
+                f"generation {self.generation}, frame stamped {gen})")
         if seq != self.next_seq:
             raise FrameError(
                 f"torn stream: expected seq {self.next_seq}, got {seq}")

@@ -122,6 +122,14 @@ _FLAGS: dict[str, Any] = {
     # weight-only quantization for decode replicas at load time
     # ("" = off, "int8" = per-channel absmax int8; slim/ptq.py)
     "FLAGS_decode_quantize": "",
+    # disaggregated prefill/decode serving (serving/disagg.py,
+    # docs/serving.md "Disaggregated prefill/decode"): burn-rate window
+    # (seconds) the per-stage BurnGates read, the burn multiple above
+    # which a stage refuses new work, and the cap on handoffs in flight
+    # between the prefill and decode classes
+    "FLAGS_disagg_burn_window": 60.0,
+    "FLAGS_disagg_burn_high": 2.0,
+    "FLAGS_disagg_max_inflight": 8,
     # hardware health & SDC defense (resilience/{integrity,health}.py):
     # steps between cross-replica parameter-checksum consensus rounds;
     # 0 disables in-training SDC detection

@@ -54,6 +54,10 @@ SPAN_NAMES = (
     "engine.prefill_chunk",  # one rationed prefill chunk
     "engine.decode_tick",   # one decode round this stream participated in
     "engine.kv_wait",       # KV block-table growth attempt
+    "disagg.route",         # prefill-replica placement (disagg controller)
+    "migrate.export",       # KV pages serialized to stamped wire frames
+    "migrate.transfer",     # frames through the codec + StreamReader
+    "migrate.adopt",        # decode-side admission of the migrated stream
 )
 
 _MAX_SPANS = 512     # per-trace span cap: a decode stream emits one

@@ -28,8 +28,20 @@ under load". This controller closes that loop:
 ``scale_up``/``scale_down`` carry the ``serving.scale`` fault-injection
 site: an injected failure is journaled and retried on a later tick, never
 raised into the serving loop. Everything runs on the injectable clock.
+
+Two signal sources share the one controller:
+
+- **queue mode** (the original): attached to a server, watermarks are
+  queue depth per healthy replica;
+- **fleet mode** (disaggregated serving): attached to a replica-class
+  *fleet* (``count()`` / ``grow()`` / ``shrink()`` protocol) with an
+  :class:`~.metrics.SLO`, watermarks are that class's **burn rate** — the
+  prefill fleet grows on TTFT burn, the decode fleet on TPOT burn, each
+  blind to the other's signal (serving/disagg.py).
 """
 from __future__ import annotations
+
+import threading
 
 from ..resilience.faults import maybe_inject
 from ..resilience.recovery import RecoveryJournal
@@ -66,18 +78,32 @@ class Autoscaler:
     call ``tick`` directly with a fake clock.
     """
 
-    def __init__(self, server, config=None, journal=None, clock=None,
-                 job_id="serving-autoscale"):
+    def __init__(self, server=None, config=None, journal=None, clock=None,
+                 job_id="serving-autoscale", fleet=None, slo=None,
+                 burn_window=None, metrics=None, name="serving"):
+        if (server is None) == (fleet is None):
+            raise ValueError("exactly one of server= (queue mode) or "
+                             "fleet= (burn-rate mode) must be given")
         self.server = server
-        self.scheduler = server.scheduler
+        self.scheduler = server.scheduler if server is not None else \
+            getattr(fleet, "scheduler", None)
         self.config = config or AutoscalerConfig()
-        self._clock = clock if clock is not None else server._clock
+        self._fleet = fleet
+        self._slo = slo
+        self._burn_window = burn_window
+        self.name = name
+        self._clock = clock if clock is not None else \
+            (server._clock if server is not None else None)
         self.journal = journal or RecoveryJournal(job_id=job_id,
                                                   clock=self._clock)
-        self._metrics = server.metrics
-        self._up_streak = 0
-        self._down_streak = 0
-        self._draining = {}     # replica idx -> drain start time
+        self._metrics = server.metrics if server is not None else metrics
+        # streaks/drains are read by describe() from other threads (the
+        # stats endpoint) while tick() mutates them on the pump thread —
+        # one lock serializes both (RLock: scale_down runs under tick)
+        self._lock = threading.RLock()
+        self._up_streak = 0     # guarded-by: _lock
+        self._down_streak = 0   # guarded-by: _lock
+        self._draining = {}     # guarded-by: _lock (replica idx -> t0)
 
     def _now(self):
         if self._clock is not None:
@@ -87,48 +113,69 @@ class Autoscaler:
 
     # -- controller --------------------------------------------------------
     def replica_count(self):
-        """Replicas that count toward capacity: healthy and not draining."""
+        """Replicas that count toward capacity: healthy and not draining
+        (queue mode) or the fleet's own count (burn-rate mode)."""
+        if self._fleet is not None:
+            return self._fleet.count()
         return len([r for r in self.scheduler.replicas
                     if r.healthy and not r.draining])
+
+    def _signal(self, now):
+        """The pressure signal the watermarks are compared against: queue
+        depth per healthy replica, or the attached SLO's burn rate."""
+        if self._fleet is not None:
+            if self._slo is None:
+                return 0.0
+            return self._slo.burn(window=self._burn_window, now=now)
+        depth = self.server.queue.depth()
+        n = self.replica_count()
+        return depth / n if n else float("inf")
 
     def tick(self, now=None):
         """One control round. Returns a dict describing any action taken
         (for tests and the bench tool); never raises — a failed resize is
         journaled and retried on a later tick."""
         now = self._now() if now is None else now
-        action = {"scaled_up": False, "scaled_down": False, "removed": []}
-        action["removed"] = self._finish_drains(now)
-        if getattr(self.server, "rollout_active", lambda: False)():
-            # a rollout/rollback is converging the fleet: hold resizes so
-            # the roll's capacity math (and which replica scale_down would
-            # pick — highest idx = the just-added new-version one) can't
-            # fight the controller. Streaks reset: demand evidence from
-            # during the roll is polluted by the extra canary capacity.
-            self._up_streak = 0
-            self._down_streak = 0
-            action["held_for_rollout"] = True
+        with self._lock:
+            action = {"scaled_up": False, "scaled_down": False,
+                      "removed": []}
+            action["removed"] = self._finish_drains(now)
+            if self.server is not None and \
+                    getattr(self.server, "rollout_active", lambda: False)():
+                # a rollout/rollback is converging the fleet: hold resizes
+                # so the roll's capacity math (and which replica scale_down
+                # would pick — highest idx = the just-added new-version
+                # one) can't fight the controller. Streaks reset: demand
+                # evidence from during the roll is polluted by the extra
+                # canary capacity.
+                self._up_streak = 0
+                self._down_streak = 0
+                action["held_for_rollout"] = True
+                return action
+            signal = self._signal(now)
+            action["signal"] = signal
+            n = self.replica_count()
+            if signal > self.config.high_watermark:
+                self._up_streak += 1
+                self._down_streak = 0
+            elif signal <= self.config.low_watermark:
+                self._down_streak += 1
+                self._up_streak = 0
+            else:
+                self._up_streak = 0
+                self._down_streak = 0
+            if self._up_streak >= self.config.up_stable and \
+                    n < self.config.max_replicas:
+                action["scaled_up"] = self._try(self.scale_up, now)
+                self._up_streak = 0
+            elif self._down_streak >= self.config.down_stable and \
+                    n > self.config.min_replicas and not self._draining:
+                action["scaled_down"] = self._try(self.scale_down, now)
+                self._down_streak = 0
             return action
-        depth = self.server.queue.depth()
-        n = self.replica_count()
-        per_replica = depth / n if n else float("inf")
-        if per_replica > self.config.high_watermark:
-            self._up_streak += 1
-            self._down_streak = 0
-        elif per_replica <= self.config.low_watermark:
-            self._down_streak += 1
-            self._up_streak = 0
-        else:
-            self._up_streak = 0
-            self._down_streak = 0
-        if self._up_streak >= self.config.up_stable and \
-                n < self.config.max_replicas:
-            action["scaled_up"] = self._try(self.scale_up, now)
-            self._up_streak = 0
-        elif self._down_streak >= self.config.down_stable and \
-                n > self.config.min_replicas and not self._draining:
-            action["scaled_down"] = self._try(self.scale_down, now)
-            self._down_streak = 0
-        return action
+
+    def _generation(self):
+        return self.scheduler.generation if self.scheduler is not None else 0
 
     def _try(self, op, now):
         try:
@@ -137,43 +184,62 @@ class Autoscaler:
         except Exception as e:
             # capacity changes are best-effort: journal and retry later
             self.journal.record("serving_scale_failed", op=op.__name__,
-                                error=repr(e),
-                                scheduler_generation=self.scheduler.generation)
+                                error=repr(e), fleet=self.name,
+                                scheduler_generation=self._generation())
             if self._metrics:
                 self._metrics.inc("scale_failures")
             return False
 
     # -- resize operations -------------------------------------------------
     def scale_up(self, now=None):
-        """Warm + preflight a new replica, then admit it to dispatch."""
+        """Warm + preflight a new replica, then admit it to dispatch
+        (queue mode); grow the fleet by one (burn-rate mode)."""
         maybe_inject("serving.scale", RuntimeError)
         now = self._now() if now is None else now
-        idx = self.scheduler.add_replica()
+        idx = self._fleet.grow() if self._fleet is not None \
+            else self.scheduler.add_replica()
         if self._metrics:
             self._metrics.inc("scale_ups")
         self.journal.record("serving_scale_up", replica=idx,
-                            replicas=self.replica_count(),
-                            scheduler_generation=self.scheduler.generation)
+                            replicas=self.replica_count(), fleet=self.name,
+                            scheduler_generation=self._generation())
         return idx
 
     def scale_down(self, now=None):
         """Begin draining the highest-index eligible replica: placement
         stops now; teardown happens in :meth:`_finish_drains` once its
-        in-flight work completes (or ``drain_timeout`` force-fences it)."""
+        in-flight work completes (or ``drain_timeout`` force-fences it).
+        Burn-rate mode delegates to the fleet's own ``shrink`` (which may
+        decline by returning None — e.g. every member still holds work)."""
         maybe_inject("serving.scale", RuntimeError)
         now = self._now() if now is None else now
-        victims = [r for r in self.scheduler.replicas
-                   if r.healthy and not r.draining]
-        if len(victims) <= self.config.min_replicas:
-            return None
-        victim = max(victims, key=lambda r: r.idx)
-        self.scheduler.begin_drain(victim.idx)
-        self._draining[victim.idx] = now
-        self.journal.record("serving_scale_down_begin", replica=victim.idx,
-                            scheduler_generation=self.scheduler.generation)
-        return victim.idx
+        with self._lock:
+            if self._fleet is not None:
+                if self._fleet.count() <= self.config.min_replicas:
+                    return None
+                idx = self._fleet.shrink()
+                if idx is None:
+                    return None
+                if self._metrics:
+                    self._metrics.inc("scale_downs")
+                self.journal.record(
+                    "serving_scale_down", replica=idx, forced=False,
+                    replicas=self.replica_count(), fleet=self.name,
+                    scheduler_generation=self._generation())
+                return idx
+            victims = [r for r in self.scheduler.replicas
+                       if r.healthy and not r.draining]
+            if len(victims) <= self.config.min_replicas:
+                return None
+            victim = max(victims, key=lambda r: r.idx)
+            self.scheduler.begin_drain(victim.idx)
+            self._draining[victim.idx] = now
+            self.journal.record(
+                "serving_scale_down_begin", replica=victim.idx,
+                scheduler_generation=self._generation())
+            return victim.idx
 
-    def _finish_drains(self, now):
+    def _finish_drains(self, now):  # requires-lock: _lock
         """Tear down drained replicas whose in-flight count reached zero;
         force-remove (and fence) any that exceeded ``drain_timeout``."""
         removed = []
@@ -193,14 +259,16 @@ class Autoscaler:
             self.journal.record(
                 "serving_scale_down", replica=idx, forced=forced,
                 replicas=self.replica_count(),
-                scheduler_generation=self.scheduler.generation)
+                scheduler_generation=self._generation())
         return removed
 
     def describe(self):
-        return {"replicas": self.replica_count(),
-                "min": self.config.min_replicas,
-                "max": self.config.max_replicas,
-                "draining": sorted(self._draining),
-                "up_streak": self._up_streak,
-                "down_streak": self._down_streak,
-                "scheduler_generation": self.scheduler.generation}
+        with self._lock:
+            return {"replicas": self.replica_count(),
+                    "min": self.config.min_replicas,
+                    "max": self.config.max_replicas,
+                    "fleet": self.name,
+                    "draining": sorted(self._draining),
+                    "up_streak": self._up_streak,
+                    "down_streak": self._down_streak,
+                    "scheduler_generation": self._generation()}

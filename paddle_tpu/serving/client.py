@@ -186,10 +186,14 @@ class InferenceClient:
         """Stream one generation: yields ``int`` tokens as the server emits
         them (seq-validated — a torn stream raises ``FrameError``, a typed
         server error raises as itself with any ``retry_after`` hint
-        attached). The generator returns after the end-of-stream frame;
-        ``timeout`` travels as the request deadline and bounds each frame
-        wait. Holds the client's lock for the whole stream — use one
-        client per concurrent stream."""
+        attached). Any error that escapes mid-stream — a replica retired
+        under the stream (``ReplicaRetired``), a peer abort, a torn wire —
+        carries ``tokens_delivered``, the count of tokens already yielded,
+        so a caller can resume from ``prompt + received`` without
+        re-reading what it has. The generator returns after the
+        end-of-stream frame; ``timeout`` travels as the request deadline
+        and bounds each frame wait. Holds the client's lock for the whole
+        stream — use one client per concurrent stream."""
         from ..distributed import wire
         from ..profiler.tracing import get_tracer
         tracer = get_tracer()
@@ -206,6 +210,7 @@ class InferenceClient:
         wire.stamp_trace(frame, trace.ctx(sid))
         io_timeout = (timeout + 10.0) if timeout is not None else ...
         reader = wire.StreamReader()
+        delivered = 0
         try:
             with self._lock:
                 sock = self._conn()
@@ -236,11 +241,19 @@ class InferenceClient:
                             tracer.finish(trace, status="ok")
                             return
                         yield int(reply["token"])
+                        delivered += 1
                 except (wire.FrameError, ConnectionError, OSError):
                     self.close()   # desynced/torn stream: reconnect
                     raise
         except BaseException as e:
-            trace.end_span(sid)
+            # progress marker for resumption: how many tokens the caller
+            # already holds when the stream died under it
+            if not hasattr(e, "tokens_delivered"):
+                try:
+                    e.tokens_delivered = delivered
+                except (AttributeError, TypeError):
+                    pass  # exceptions with __slots__ can't carry it
+            trace.end_span(sid, delivered=delivered)
             tracer.finish(trace, status=self._trace_status(e), error=e)
             raise
 

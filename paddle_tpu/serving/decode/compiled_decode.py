@@ -251,3 +251,20 @@ class CompiledDecodeBackend:
         """Replica death: all device-side KV state is lost. The engine
         re-prefills every live stream (prompt + emitted tokens)."""
         self._rows.clear()
+
+    # -- KV migration hooks (serving/decode/kv_migrate.py) -------------------
+    def export_state(self, stream):
+        """Wire-codec-friendly snapshot of one stream's KV state, for a
+        prefill→decode handoff. Returns None when the stream has no state
+        here (the migrator aborts typed instead of shipping nothing)."""
+        row, pos = self._rows.get(stream.id, (None, 0))
+        if row is None:
+            return None
+        return {"row": [float(v) for v in row], "pos": int(pos)}
+
+    def adopt_state(self, stream, state):
+        """Install a migrated stream's KV state. The row/pos pair is the
+        exact state :meth:`export_state` produced on the prefill replica,
+        so the next :meth:`decode` round continues token-for-token."""
+        self._rows[stream.id] = (
+            np.asarray(state["row"], dtype="float32"), int(state["pos"]))

@@ -154,23 +154,27 @@ class DecodeEngine:
         return 0.05
 
     def join(self, prompt, max_new_tokens=None, timeout=None, priority=1,
-             on_token=None, request_id=None, trace_ctx=None):
+             on_token=None, request_id=None, trace_ctx=None, trace=None):
         """Admit one generation request into the running batch.
 
         Refusals are typed and carry a retry-after hint: the admission
         controller sheds first (load), then the running-set cap, then the
         KV pool (memory). A refused join holds no blocks and no admission
         slot — there is nothing to clean up. ``trace_ctx`` is an optional
-        ``(trace_id, parent_span)`` pair from ``wire.frame_trace``.
+        ``(trace_id, parent_span)`` pair from ``wire.frame_trace``;
+        ``trace`` is an already-started Trace the caller owns (the disagg
+        controller hands its request trace across the prefill→decode
+        boundary so the whole lifecycle lands in one trace).
         """
         from ...profiler.metrics import get_registry
         from ...profiler.tracing import get_tracer
         tracer = get_tracer()
         now = self._clock()
-        tid, parent = trace_ctx if trace_ctx else (None, 0)
-        trace = tracer.start(request_id=request_id, trace_id=tid,
-                             parent=parent, priority=int(priority),
-                             kind="decode")
+        if trace is None:
+            tid, parent = trace_ctx if trace_ctx else (None, 0)
+            trace = tracer.start(request_id=request_id, trace_id=tid,
+                                 parent=parent, priority=int(priority),
+                                 kind="decode")
         jsid = trace.begin_span("engine.join")
         try:
             with self._lock:
@@ -214,6 +218,88 @@ class DecodeEngine:
                 return stream
         except ServerOverloaded as e:
             trace.end_span(jsid, verdict="shed")
+            trace.flag("shed")
+            tracer.finish(trace, status="shed", error=e)
+            raise
+
+    def adopt(self, prompt, *, fill_pos, state, tokens=(),
+              max_new_tokens=None, deadline=None, priority=1, on_token=None,
+              request_id=None, enqueued_at=None, trace=None):
+        """Admit a stream whose prefill already ran on a prefill-class
+        replica (serving/disagg.py): the prompt is fully absorbed into
+        migrated KV state, so the stream enters the decode tick directly
+        with nothing left to fill.
+
+        Admission mirrors :meth:`join` — AIMD controller, running-set cap,
+        then the KV pool — except the pool shortage here is the *decode
+        side's* refusal of a migration and raises the typed
+        :class:`~.kv_cache.KVCacheExhausted` (with ``retry_after``)
+        **before any page is claimed**, per the two-phase handoff contract.
+        ``state`` is the backend's :meth:`export_state` snapshot;
+        ``tokens`` are tokens the prefill side already produced (usually
+        the first token), re-emitted here so TTFT and the client callback
+        see them exactly once. ``enqueued_at`` is the original submit time
+        so TTFT spans the whole disaggregated path, not just adoption.
+        """
+        from ...profiler.metrics import get_registry
+        from ...profiler.tracing import get_tracer
+        tracer = get_tracer()
+        now = self._clock()
+        if trace is None:
+            trace = tracer.start(request_id=request_id,
+                                 priority=int(priority), kind="decode")
+        asid = trace.begin_span("engine.join")
+        try:
+            with self._lock:
+                maybe_inject("decode.join", ServerOverloaded)
+                if self._admission is not None:
+                    self._admission.admit(priority, now=now)
+                try:
+                    if len(self._streams) >= self.config.max_running:
+                        raise ServerOverloaded(
+                            f"decode running set full "
+                            f"({self.config.max_running} streams)",
+                            retry_after=self._retry_after(priority))
+                    stream = DecodeStream(
+                        prompt, max_new_tokens if max_new_tokens is not None
+                        else self.config.max_new_tokens,
+                        deadline=deadline, priority=priority,
+                        enqueued_at=enqueued_at if enqueued_at is not None
+                        else now,
+                        on_token=on_token, request_id=request_id)
+                    table = BlockTable(self.pool)
+                    if not table.ensure(int(fill_pos) + 1):
+                        raise KVCacheExhausted(
+                            f"decode-side KV pool exhausted "
+                            f"({self.pool.free()} free blocks, adoption "
+                            f"needs "
+                            f"{self.pool.blocks_for(int(fill_pos) + 1)})",
+                            retry_after=self._retry_after(priority))
+                except (ServerOverloaded, KVCacheExhausted):
+                    if self._admission is not None:
+                        self._admission.note_done()
+                    get_registry().inc_counter("decode.sheds_total")
+                    raise
+                stream.table = table
+                stream._admitted = True
+                stream.trace = trace
+                trace.request_id = stream.id
+                stream._fill = []
+                stream._fill_pos = int(fill_pos)
+                self.backend.adopt_state(stream, state)
+                trace.end_span(asid, verdict="adopted",
+                               running=len(self._streams) + 1,
+                               kv_free=self.pool.free())
+                self._streams[stream.id] = stream
+                get_registry().inc_counter("decode.adoptions_total")
+                for t in tokens:
+                    if stream.done:
+                        break
+                    self._emit(stream, int(t), now)
+                    self._maybe_finish(stream, int(t))
+                return stream
+        except (ServerOverloaded, KVCacheExhausted) as e:
+            trace.end_span(asid, verdict="shed")
             trace.flag("shed")
             tracer.finish(trace, status="shed", error=e)
             raise
@@ -459,6 +545,13 @@ class DecodeEngine:
     def running(self):
         with self._lock:
             return len(self._streams)
+
+    def latency_reservoirs(self):
+        """Copies of the (ttft_ms, tpot_ms) reservoirs — the disagg
+        controller pools them across its decode fleet for class-level
+        percentiles."""
+        with self._lock:
+            return list(self._ttft_ms), list(self._tpot_ms)
 
     def stats(self):
         with self._lock:

@@ -149,6 +149,16 @@ class BlockTable:
         self.num_tokens = max(self.num_tokens, int(tokens))
         return True
 
+    def pages(self):
+        """``(block_id, tokens_held)`` per page in table order — the unit a
+        KV migration (serving/decode/kv_migrate.py) exports one wire frame
+        for. The final page may be partially filled."""
+        remaining = self.num_tokens
+        for b in self.blocks:
+            held = min(self.pool.block_size, max(0, remaining))
+            remaining -= held
+            yield b, held
+
     def release(self):
         """Free every block exactly once (idempotent per table)."""
         blocks, self.blocks = self.blocks, []

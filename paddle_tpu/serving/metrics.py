@@ -106,6 +106,18 @@ class SLO:
             out[w] = frac / budget
         return out
 
+    def burn(self, window=None, now=None):
+        """Burn rate over one window — the nearest recorded window to
+        ``window``, or the shortest (the fast-burn signal per-stage
+        admission and per-class autoscaling key off) when None."""
+        rates = self.burn_rates(now)
+        if not rates:
+            return 0.0
+        if window is None:
+            return rates[min(rates)]
+        w = min(self.windows, key=lambda x: abs(x - float(window)))
+        return rates.get(w, 0.0)
+
 
 class ServingMetrics:
     COUNTERS = (

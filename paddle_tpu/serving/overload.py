@@ -39,7 +39,8 @@ import threading
 
 from .batcher import ServerOverloaded
 
-__all__ = ["AdmissionController", "CircuitBreaker", "PRIORITY_HEADROOM"]
+__all__ = ["AdmissionController", "BurnGate", "CircuitBreaker",
+           "PRIORITY_HEADROOM"]
 
 
 def _flag(name, default):
@@ -169,6 +170,91 @@ class AdmissionController:
         with self._lock:
             return {"limit": self.limit, "inflight": self.inflight,
                     "shed": self.shed, "target_ms": self.target_s() * 1e3}
+
+
+class BurnGate:
+    """Stage admission priced on an SLO burn rate (disaggregated serving).
+
+    The AIMD controller prices *total* concurrency; a disaggregated
+    deployment additionally needs **per-stage** pricing — prefill admission
+    on the TTFT burn rate, decode-side adoption on the TPOT burn rate
+    (both PR 15 :class:`~.metrics.SLO` objects) — so one stage's pain
+    refuses new work for *that stage only* instead of collapsing the whole
+    pipeline. The gate refuses (typed :class:`ServerOverloaded`, with a
+    ``retry_after`` scaled by how hot the burn is) when the SLO's
+    fast-window burn exceeds ``high`` × the priority class's headroom:
+    class 0 sees the full threshold, lower classes are refused earlier —
+    the same shed order as the AIMD limiter.
+
+    Purely read-side over the SLO's recorded samples — admitting holds no
+    slot and needs no ``note_done``; refusal-rate accounting is the only
+    state.
+    """
+
+    def __init__(self, slo, high=None, window=None, retry_after_base=None,
+                 headroom=None, clock=None):
+        self.slo = slo
+        self._high = high
+        self._window = window
+        self._retry_after_base = retry_after_base
+        self._headroom = tuple(headroom) if headroom else PRIORITY_HEADROOM
+        self._clock = clock
+        self.admitted = 0  # guarded-by: _lock
+        self.shed = 0      # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    # -- config read per call so paddle.set_flags retunes a live gate ------
+    def high(self):
+        return float(self._high if self._high is not None
+                     else _flag("FLAGS_disagg_burn_high", 2.0))
+
+    def window(self):
+        return float(self._window if self._window is not None
+                     else _flag("FLAGS_disagg_burn_window", 60.0))
+
+    def retry_after_base(self):
+        if self._retry_after_base is not None:
+            return self._retry_after_base
+        return float(_flag("FLAGS_serving_retry_after", 0.1))
+
+    def _now(self):
+        if self._clock is not None:
+            return self._clock()
+        import time
+        return time.monotonic()
+
+    def burn(self, now=None):
+        """The gated SLO's burn rate over the gate's window."""
+        return self.slo.burn(window=self.window(),
+                             now=self._now() if now is None else now)
+
+    # -- admission ---------------------------------------------------------
+    def admit(self, priority=0, now=None):
+        """Admit or refuse. Raises :class:`ServerOverloaded` with
+        ``retry_after`` when the stage's error budget is burning faster
+        than ``high`` × the class headroom."""
+        burn = self.burn(now)
+        p = max(0, min(int(priority), len(self._headroom) - 1))
+        threshold = self.high() * self._headroom[p]
+        if burn <= threshold:
+            with self._lock:
+                self.admitted += 1
+            return
+        with self._lock:
+            self.shed += 1
+        hint = self.retry_after_base() * min(
+            8.0, burn / max(threshold, 1e-9))
+        raise ServerOverloaded(
+            f"{self.slo.name} error budget burning at {burn:.2f}x "
+            f"(threshold {threshold:.2f} for priority {priority}); "
+            f"retry after {hint:.3f}s", retry_after=hint)
+
+    def snapshot(self):
+        with self._lock:
+            admitted, shed = self.admitted, self.shed
+        return {"slo": self.slo.name, "admitted": admitted, "shed": shed,
+                "burn": self.burn(), "high": self.high(),
+                "window_s": self.window()}
 
 
 class CircuitBreaker:

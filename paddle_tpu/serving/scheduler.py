@@ -422,6 +422,18 @@ class Scheduler:
                 if self._metrics:
                     self._metrics.inc("replica_deaths")
 
+    def mark_dead(self, idx, exc):
+        """Public death notice for work the scheduler didn't dispatch
+        itself (the disagg controller's prefill handoffs run outside
+        :meth:`dispatch`): the replica leaves placement now, its breaker
+        is fed, and :meth:`restart_dead` rebuilds it once its in-flight
+        work unwinds. Returns the replica, or None when already removed."""
+        rep = self.find_replica(idx)
+        if rep is not None:
+            self._note_failure(rep, count_in_failures=False)
+            self._mark_dead(rep, exc)
+        return rep
+
     def maintain(self):
         """One housekeeping round for the serving loop: restart dead
         replicas and probe open breakers whose cooldown elapsed. Returns

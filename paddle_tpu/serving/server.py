@@ -146,6 +146,7 @@ class InferenceServer:
         self._autoscaler = None
         self._rollout = None
         self._decode = None
+        self._disagg = None
         # default SLO: end-to-end request latency vs the AIMD target, a 1%
         # error budget; burn rates tick from the pump loop
         self.metrics.add_slo(SLO(
@@ -284,6 +285,8 @@ class InferenceServer:
                 self._rollout.tick()
             if self._decode is not None:
                 self._decode.step()
+            if self._disagg is not None:
+                self._disagg.step(self._now())
             t_asm = self._now()
             batch = self.queue.assemble(self.config.buckets,
                                         max_rows=self.config.max_batch_size)
@@ -466,6 +469,22 @@ class InferenceServer:
                                  target_ms=100.0))
         return self._decode
 
+    def attach_disagg(self, config=None, journal=None, journal_dir=None,
+                      job_id="disagg"):
+        """Enable disaggregated prefill/decode serving (serving/disagg.py,
+        docs/serving.md "Disaggregated prefill/decode"). The controller
+        runs its own prefill-class Scheduler and decode-engine fleet but
+        shares this server's clock and metrics registry, and is stepped
+        once per batching round like the decode engine. Generation
+        requests go to :meth:`DisaggController.submit`; the two-phase KV
+        handoffs it performs are journaled under ``job_id``. Returns the
+        DisaggController."""
+        from .disagg import DisaggController
+        self._disagg = DisaggController(
+            config=config, clock=self._clock, journal=journal,
+            metrics=self.metrics, job_id=job_id, journal_dir=journal_dir)
+        return self._disagg
+
     def submit_generate(self, prompt, max_new_tokens=None, timeout=None,
                         priority=0, on_token=None, request_id=None,
                         trace_ctx=None):
@@ -573,6 +592,8 @@ class InferenceServer:
                         self._rollout.tick()
                     if self._decode is not None:
                         self._decode.step()
+                    if self._disagg is not None:
+                        self._disagg.step(self._now())
                     continue
                 # brief accumulation window lets concurrent submitters fill
                 # the bucket (classic batching-delay/throughput tradeoff)
@@ -595,6 +616,8 @@ class InferenceServer:
             self.metrics.inc("shed", n)
         if self._decode is not None:
             self._decode.drain(ServerOverloaded("server stopped"))
+        if self._disagg is not None:
+            self._disagg.drain(ServerOverloaded("server stopped"))
         return self
 
     def __enter__(self):
@@ -616,6 +639,8 @@ class InferenceServer:
             snap["rollout"] = self._rollout.describe()
         if self._decode is not None:
             snap["decode"] = self._decode.stats()
+        if self._disagg is not None:
+            snap["disagg"] = self._disagg.stats()
         snap["compiles"] = sum(r.compile_count
                                for r in self.scheduler.replicas)
         snap["crashed"] = repr(self._crashed) if self._crashed else None

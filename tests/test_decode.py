@@ -566,3 +566,26 @@ class TestSocketStreaming:
         assert len(outs) == 4
         for k, toks in outs.items():
             assert len(toks) == 4
+
+    def test_midstream_death_carries_delivered_count(self, served):
+        """Satellite regression: a stream killed mid-flight (its replica
+        retired under it) used to surface with no progress information —
+        the caller had tokens in hand and no way to know the error agreed.
+        The raised error now carries ``tokens_delivered`` equal to the
+        count already yielded, so resumption needs no re-read."""
+        from paddle_tpu.serving.client import RemoteInferenceError
+        from paddle_tpu.serving.scheduler import ReplicaRetired
+        srv, fe = served
+        received = []
+        with InferenceClient(fe.address) as cli:
+            with pytest.raises(RemoteInferenceError) as ei:
+                for tok in cli.generate([5], max_new_tokens=100000,
+                                        timeout=30.0):
+                    received.append(tok)
+                    if len(received) == 3:
+                        # the decode replica retires with the stream live
+                        srv._decode.drain(ReplicaRetired(
+                            "replica retired under live stream"))
+        assert len(received) >= 3
+        assert ei.value.error_type == "ReplicaRetired"
+        assert ei.value.tokens_delivered == len(received)

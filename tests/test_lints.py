@@ -355,6 +355,29 @@ def test_injection_lint_covers_decode_entry_points():
         ("paddle_tpu/serving/decode/engine.py", "class:DecodeEngine")])
 
 
+def test_injection_lint_covers_disagg_entry_points():
+    """The disagg PR's contract: the chaos suite must be able to kill the
+    prefill side of a KV handoff (kv.export), tear the wire mid-transfer
+    (kv.transfer), fail decode-side adoption (kv.adopt), and break routing
+    itself (disagg.route) — every edge has to land as a typed refusal or a
+    journaled fallback re-prefill, never a lost stream. Guard the MANIFEST
+    so a refactor can't silently drop the requirement along with the
+    hook."""
+    import ast
+    src = (REPO / "tools" / "check_injection_points.py").read_text()
+    tree = ast.parse(src)
+    required = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "REQUIRED" for t in node.targets))
+    manifest = ast.literal_eval(required)
+    entries = {(rel, scope): names for rel, scope, names in manifest}
+    assert {"export", "transfer", "adopt"} <= set(entries[
+        ("paddle_tpu/serving/decode/kv_migrate.py", "class:KVMigrator")])
+    assert "route" in entries[
+        ("paddle_tpu/serving/disagg.py", "class:DisaggController")]
+
+
 def test_metric_name_lint_passes_on_tree():
     r = _run(REPO / "tools" / "check_metric_names.py")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -489,6 +512,29 @@ def test_decode_flags_registered():
     assert int(defaults["FLAGS_decode_max_new_tokens"]) >= 1
 
 
+def test_disagg_flags_registered():
+    """The disagg PR's knobs stay registered with their contracted
+    defaults: the burn window and high-watermark drive per-stage admission
+    (BurnGate), and the in-flight migration cap bounds decode-side memory
+    exposure during handoffs. Parsed from source, not live state."""
+    import ast
+    src = (REPO / "paddle_tpu" / "framework" / "flags.py").read_text()
+    tree = ast.parse(src)
+    defaults_node = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.AnnAssign)
+        and getattr(node.target, "id", None) == "_FLAGS")
+    defaults = {}
+    for key, val in zip(defaults_node.keys, defaults_node.values):
+        try:
+            defaults[ast.literal_eval(key)] = ast.literal_eval(val)
+        except ValueError:
+            pass
+    assert float(defaults["FLAGS_disagg_burn_window"]) > 0
+    assert float(defaults["FLAGS_disagg_burn_high"]) > 0
+    assert int(defaults["FLAGS_disagg_max_inflight"]) >= 1
+
+
 def test_trace_merge_help_smoke():
     r = _run(REPO / "tools" / "trace_merge.py", "--help")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -597,3 +643,34 @@ def test_serving_bench_rollout_soak_smoke():
     for gate in ("zero_shed", "zero_unterminated", "stamps_match_outputs",
                  "converged_to_newest_good", "poison_rolled_back"):
         assert gates[gate] is True, (gate, report["results"])
+
+
+def test_serving_bench_disagg_smoke():
+    """The disagg comparison must keep demonstrating the PR's headline:
+    at the top load multiplier with a bimodal prompt mix, the
+    prefill/decode-disaggregated fleet beats the colocated baseline on
+    both TTFT p99 and TPOT p99, an injected prefill death mid-handoff
+    resolves as a fallback re-prefill with zero streams lost, every shed
+    carries a retry hint, and no KV block leaks. Fake clock, so this runs
+    in a few seconds of wall time."""
+    import json
+    r = _run(REPO / "tools" / "serving_bench.py", "--disagg", "--smoke")
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["disagg_ok"] is True
+    for point in report["results"]:
+        assert point["unterminated"] == 0
+        assert point["leaked_blocks"] == 0
+        gates = point["gates"]
+        assert gates["zero_lost_streams"] is True, point
+        assert gates["sheds_hinted"] is True, point
+        assert gates["zero_leaked_blocks"] is True, point
+    top = report["results"][-1]
+    assert top["injected_prefill_death"] is True
+    assert top["gates"]["ttft_p99_better"] is True, top
+    assert top["gates"]["tpot_p99_better"] is True, top
+    assert top["gates"]["fallback_exercised"] is True, top
+    assert top["fallback_prefills"] >= 1
+    extra = report["extra"]
+    for k in ("disagg_ttft_p99_ms", "disagg_tpot_p99_ms"):
+        assert isinstance(extra[k], (int, float)), (k, extra)

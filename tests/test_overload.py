@@ -20,6 +20,7 @@ real sleeps:
 """
 import json
 import os
+import threading
 
 import numpy as np
 import pytest
@@ -586,6 +587,48 @@ class TestAutoscaler:
             asc.tick()
             clock.advance(0.1)
         assert asc.replica_count() == 2
+
+    def test_concurrent_tick_and_describe(self, tmp_path):
+        """Satellite: describe() reads the streak/drain state tick()
+        mutates — both now serialize on the autoscaler lock, so threads
+        hammering both must never see an exception or a torn snapshot
+        (streaks are ints, draining is a list, the band holds)."""
+        srv, asc, clock = self.make(tmp_path, min_r=1, max_r=3)
+        for _ in range(80):
+            srv.submit(x())
+        stop = threading.Event()
+        failures = []
+
+        def driver():
+            try:
+                for _ in range(2000):
+                    asc.tick()
+                    clock.advance(0.01)
+            except BaseException as e:   # pragma: no cover - failure path
+                failures.append(e)
+            finally:
+                stop.set()
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    d = asc.describe()
+                    assert isinstance(d["up_streak"], int)
+                    assert isinstance(d["down_streak"], int)
+                    assert isinstance(d["draining"], list)
+                    assert 1 <= d["replicas"] <= 3
+            except BaseException as e:   # pragma: no cover - failure path
+                failures.append(e)
+
+        threads = [threading.Thread(target=driver),
+                   threading.Thread(target=reader),
+                   threading.Thread(target=reader)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not failures
+        assert 1 <= asc.replica_count() <= 3
 
 
 # -- satellites --------------------------------------------------------------

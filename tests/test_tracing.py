@@ -578,5 +578,5 @@ class TestDecodeTracing:
     def test_span_vocabulary_is_frozen(self):
         # runtime tuple mirrors the lint manifest (also asserted source-
         # level in test_lints); a rename must touch both deliberately
-        assert len(SPAN_NAMES) == 10
-        assert len(set(SPAN_NAMES)) == 10
+        assert len(SPAN_NAMES) == 14
+        assert len(set(SPAN_NAMES)) == 14

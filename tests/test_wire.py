@@ -224,6 +224,40 @@ class TestStreamFraming:
                     break   # typed failure tears the stream; reader stops
             assert delivered == list(range(len(delivered)))
 
+    def test_reader_rejects_newer_generation_mid_stream(self):
+        """A frame stamped with a newer generation arriving mid-stream
+        (the sender restarted / a KV migration raced a rendezvous) must
+        raise a typed FrameError, not splice two incarnations' tokens
+        into one stream."""
+        r = wire.StreamReader()
+        r.feed(wire.stamp_generation(wire.stamp_stream({"t": 0}, 0), 3))
+        r.feed(wire.stamp_generation(wire.stamp_stream({"t": 1}, 1), 3))
+        with pytest.raises(wire.FrameError, match="generation"):
+            r.feed(wire.stamp_generation(wire.stamp_stream({"t": 2}, 2), 4))
+
+    def test_reader_accepts_consistent_generation(self):
+        """Same generation throughout (including gen-0/unstamped legacy
+        streams) feeds clean end to end."""
+        r = wire.StreamReader()
+        for i in range(4):
+            r.feed(wire.stamp_generation(wire.stamp_stream({"t": i}, i), 7))
+        assert r.feed(wire.stamp_generation(
+            wire.stamp_stream({}, 4, end=True), 7)) == (4, True)
+        legacy = wire.StreamReader()
+        for i in range(3):
+            legacy.feed(wire.stamp_stream({"t": i}, i))
+
+    def test_reader_generation_pin_rejects_stale_sender(self):
+        """A reader pinned to the current generation at construction
+        refuses frames from an older incarnation outright — the first
+        frame, not just a mid-stream flip."""
+        r = wire.StreamReader(generation=5)
+        with pytest.raises(wire.FrameError, match="generation"):
+            r.feed(wire.stamp_generation(wire.stamp_stream({"t": 0}, 0), 4))
+        ok = wire.StreamReader(generation=5)
+        assert ok.feed(wire.stamp_generation(
+            wire.stamp_stream({"t": 0}, 0), 5)) == (0, False)
+
 
 class TestTraceFraming:
     """Request-trace context stamping (profiler/tracing.py): the context

@@ -98,6 +98,15 @@ REQUIRED = [
     # itself (decode.evict — termination must still complete)
     ("paddle_tpu/serving/decode/engine.py", "class:DecodeEngine",
      ["join", "_prefill", "step", "_evict"]),
+    # disaggregated serving (disagg PR): the chaos suite must be able to
+    # kill the prefill side of a KV handoff (kv.export), tear the wire
+    # mid-transfer (kv.transfer), fail decode-side adoption (kv.adopt),
+    # and break routing itself (disagg.route) — every edge must land as a
+    # typed refusal or a journaled fallback re-prefill, never a lost stream
+    ("paddle_tpu/serving/decode/kv_migrate.py", "class:KVMigrator",
+     ["export", "transfer", "adopt"]),
+    ("paddle_tpu/serving/disagg.py", "class:DisaggController",
+     ["route"]),
 ]
 
 # _injected_run is HDFSClient's hook-carrying chokepoint: routing a call

@@ -40,6 +40,7 @@ SUBSYSTEMS = [
     "ckpt",          # zero-stall checkpointing (resilience/snapshot.py)
     "compiled_step", # whole-step compilation (jit/compiled_step.py)
     "decode",        # continuous-batching decode (serving/decode/)
+    "disagg",        # disaggregated prefill/decode (serving/disagg.py)
     "fusion_policy", # measured fusion decisions
     "integrity",     # SDC defense (checksum consensus, replay)
     "io",            # input pipeline / data workers

@@ -42,6 +42,10 @@ SPAN_NAMES = [
     "engine.prefill_chunk",    # one rationed prefill chunk
     "engine.decode_tick",      # one decode round the stream was in
     "engine.kv_wait",          # KV block-table growth attempt
+    "disagg.route",            # prefill-replica placement (disagg)
+    "migrate.export",          # KV pages -> stamped wire frames
+    "migrate.transfer",        # frames through codec + StreamReader
+    "migrate.adopt",           # decode-side admission of migrated KV
 ]
 
 # Methods whose first argument mints a span name (on a trace receiver).

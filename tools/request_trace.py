@@ -117,6 +117,18 @@ def _span_context(t):
                     ctx[k] = attrs[k]
         elif name == "replica.exec" and attrs.get("version") is not None:
             ctx["version"] = attrs["version"]
+        elif name == "disagg.route":
+            # which prefill-class replica the handoff was placed on
+            if "replica" in attrs:
+                ctx["prefill_replica"] = attrs["replica"]
+        elif name in ("migrate.export", "migrate.transfer",
+                      "migrate.adopt"):
+            # KV handoff attribution: pages shipped + how far it got —
+            # a slow/aborted migration shows up as the dominant span and
+            # this names the phase to go look at
+            ctx["migration"] = name.split(".", 1)[1]
+            if "pages" in attrs:
+                ctx.setdefault("migration_pages", attrs["pages"])
     root = t.get("attrs") or {}
     for k in ("replica", "version", "error_type", "error", "ttft_ms"):
         if k in root and k not in ctx:
