@@ -14,6 +14,7 @@ Spec grammar (comma-separated entries)::
     collective.all_reduce:1.0
     fs.mv:#3               # deterministic: fail exactly the 3rd evaluation
     fs.mv:#3+              # deterministic: fail the 3rd and every later one
+    fs.mv:#3-5             # windowed burst: fail evaluations 3..5 inclusive
     fs:0.5                 # dot-prefix match: any fs.* site
 
 Longest dot-prefix wins, so ``fs:0.1,fs.upload:1.0`` pins uploads at 1.0
@@ -49,14 +50,21 @@ class _SiteRule:
         self.rate = None
         self.index = None       # 1-based evaluation index
         self.from_index = False  # '#N+' → N and onward
+        self.to_index = None     # '#N-M' → N..M inclusive
         if raw.startswith("#"):
             body = raw[1:]
             if body.endswith("+"):
                 self.from_index = True
                 body = body[:-1]
+            elif "-" in body:
+                body, _, hi = body.partition("-")
+                self.to_index = int(hi)
             self.index = int(body)
             if self.index < 1:
                 raise ValueError(f"call index must be >=1: {raw!r}")
+            if self.to_index is not None and self.to_index < self.index:
+                raise ValueError(
+                    f"window end must be >= start: {raw!r}")
         else:
             self.rate = float(raw)
             if not 0.0 <= self.rate <= 1.0:
@@ -64,8 +72,11 @@ class _SiteRule:
 
     def fires(self, count, rng):
         if self.index is not None:
-            return count >= self.index if self.from_index else \
-                count == self.index
+            if self.from_index:
+                return count >= self.index
+            if self.to_index is not None:
+                return self.index <= count <= self.to_index
+            return count == self.index
         # always draw so the stream position depends only on the evaluation
         # count, not on rate changes
         return rng.random() < self.rate
@@ -192,10 +203,14 @@ def should_inject(site):
     would). The call site asks the registry whether this evaluation is
     corrupted and applies the perturbation itself. Same spec grammar,
     streams, and counters as :func:`maybe_inject`.
+
+    Returns the 1-based evaluation count (truthy int) when this evaluation
+    is corrupted, so call sites can record *which* evaluation was perturbed
+    in flight-recorder notes; returns a falsy value otherwise.
     """
     if not _REGISTRY.active:
         return False
-    return bool(_REGISTRY.should_fail(site))
+    return _REGISTRY.should_fail(site)
 
 
 def _init_from_flags():

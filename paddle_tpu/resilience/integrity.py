@@ -120,8 +120,15 @@ def checksum_state(objs):
         sd = obj.state_dict() if hasattr(obj, "state_dict") else obj
         _hash_tree(h, f"#{i}", sd)
     digest = h.hexdigest()
-    if should_inject("device.bitflip"):
+    corrupted_at = should_inject("device.bitflip")
+    if corrupted_at:
+        from .recorder import get_recorder
         digest = format(int(digest[0], 16) ^ 0x1, "x") + digest[1:]
+        # note which evaluation was corrupted (seq = the registry's
+        # evaluation count for the site) so a consensus post-mortem can
+        # line the flip up against the fault schedule
+        note = get_recorder().start("device.bitflip", seq=int(corrupted_at))
+        get_recorder().finish(note, status="corrupted")
     return digest
 
 

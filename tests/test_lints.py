@@ -674,3 +674,53 @@ def test_serving_bench_disagg_smoke():
     extra = report["extra"]
     for k in ("disagg_ttft_p99_ms", "disagg_tpot_p99_ms"):
         assert isinstance(extra[k], (int, float)), (k, extra)
+
+
+def test_injection_site_manifest_matches_tree():
+    """The chaos-campaign PR's contract: SITES in
+    tools/check_injection_points.py is the single source of truth the
+    schedule sampler draws from (via known_sites()), so it must name
+    exactly the injection sites present in the tree — a site added
+    without a manifest entry would never be scheduled (silent coverage
+    hole), and a stale entry would burn schedule rules on a site that
+    can never fire. Source-level on purpose: the literal must stay
+    ast-parseable."""
+    import ast
+    import re
+    src = (REPO / "tools" / "check_injection_points.py").read_text()
+    tree = ast.parse(src)
+    lit = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "SITES" for t in node.targets))
+    manifest = set(ast.literal_eval(lit))
+    pat = re.compile(
+        r'(?:maybe_inject|should_inject|fault_point)\(\s*[\'"]([a-z0-9_.]+)[\'"]')
+    in_tree = set()
+    for path in (REPO / "paddle_tpu").rglob("*.py"):
+        in_tree |= set(pat.findall(path.read_text()))
+    assert manifest == in_tree, (
+        f"missing from SITES: {sorted(in_tree - manifest)}; "
+        f"stale in SITES: {sorted(manifest - in_tree)}")
+
+
+def test_chaos_campaign_smoke_gate():
+    """The chaos-campaign gate: >=25 mixed fake-clock episodes across the
+    training and serving scenarios, sampled from the full injection-site
+    manifest, must terminate with ZERO invariant violations (typed
+    termination, no KV leaks, journal consistency, bounded progress,
+    training-loss parity, metrics/journal agreement) while evaluating at
+    least 90% of the manifest's sites. Deterministic by construction, so
+    a failure here is a real regression and the printed bundle path holds
+    a shrunken repro."""
+    import json
+    r = _run(REPO / "tools" / "chaos_campaign.py", "--smoke")
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["episodes_run"] >= 25
+    assert report["violations_total"] == 0, report["artifact_bundles"]
+    cov = report["coverage"]
+    assert cov["ratio"] >= 0.9, cov["uncovered_sites"]
+    # both scenarios actually ran
+    assert {e["scenario"] for e in report["episodes"]} == {"training",
+                                                           "serving"}

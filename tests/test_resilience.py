@@ -100,6 +100,22 @@ class TestFaultRegistry:
         st = faults.stats()
         assert st["s.t"] == {"evaluations": 1, "injected": 1}
 
+    def test_window_rule(self):
+        faults.configure("w.x:#2-4", seed=0)
+        outcomes = []
+        for _ in range(5):
+            try:
+                faults.maybe_inject("w.x")
+                outcomes.append("ok")
+            except faults.FaultInjected:
+                outcomes.append("fail")
+        assert outcomes == ["ok", "fail", "fail", "fail", "ok"]
+
+    def test_window_parse_errors(self):
+        for bad in ("w.x:#5-2", "w.x:#0-3", "w.x:#3-", "w.x:#-4"):
+            with pytest.raises(ValueError):
+                faults.configure(bad)
+
     def test_flags_route_into_registry(self):
         paddle.set_flags({"FLAGS_fault_injection": "f.g:1.0",
                           "FLAGS_fault_injection_seed": 5})

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Chaos-campaign CLI (docs/resilience.md "Chaos campaigns").
+
+Runs randomized multi-site fault schedules through the end-to-end training
+and serving scenarios on a fake clock, checks the global invariants after
+every episode, and reports per-site injection coverage. On a violation the
+engine shrinks the schedule to a minimal repro and writes an artifact
+bundle under PADDLE_TPU_ARTIFACTS_DIR.
+
+Modes:
+  --smoke                  the tier-1 gate: >=25 mixed episodes, zero
+                           invariant violations, >=90% manifest-site
+                           coverage (tests/test_lints.py runs this)
+  --episodes N --seed S    a custom campaign
+  --spec 'site:rule,...'   replay one exact (scenario, spec, fault-seed)
+                           episode — what a repro.json bundle points at
+
+Exit codes: 0 clean; 1 invariant violations; 2 coverage below the floor.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SMOKE_EPISODES = 26
+SMOKE_SEED = 0
+SMOKE_COVERAGE_FLOOR = 0.9
+
+
+def _parse_spec(spec):
+    rules = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, _, raw = entry.partition(":")
+        if not raw:
+            raise SystemExit(f"bad spec entry {entry!r}: want 'site:rule'")
+        rules.append((site.strip(), raw.strip()))
+    return rules
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 gate: %d mixed episodes, zero violations, "
+                         ">=%d%% site coverage"
+                         % (SMOKE_EPISODES, int(SMOKE_COVERAGE_FLOOR * 100)))
+    ap.add_argument("--episodes", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--scenario", choices=["training", "serving", "mix"],
+                    default="mix")
+    ap.add_argument("--spec", default=None,
+                    help="replay one exact schedule instead of sampling")
+    ap.add_argument("--fault-seed", type=int, default=1,
+                    help="fault-registry seed for --spec replay")
+    ap.add_argument("--coverage-floor", type=float, default=None,
+                    help="fail (exit 2) when covered/manifest falls below "
+                         "this ratio (default: gate only under --smoke)")
+    ap.add_argument("--max-rules", type=int, default=4)
+    ap.add_argument("--no-shrink", action="store_true")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="print the full report as JSON")
+    args = ap.parse_args(argv)
+
+    # environment hygiene BEFORE importing paddle_tpu: flags read the env
+    # at import, and campaigns must never really sleep or touch a device
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("FLAGS_retry_backoff_base", "0.0")
+    if "PADDLE_TPU_ARTIFACTS_DIR" not in os.environ:
+        import tempfile
+        os.environ["PADDLE_TPU_ARTIFACTS_DIR"] = tempfile.mkdtemp(
+            prefix="chaos_campaign_artifacts_")
+    sys.path.insert(0, REPO)
+    from paddle_tpu.resilience import campaign as C
+
+    if args.spec is not None:
+        scenario = {"training": C.TrainingScenario(),
+                    "serving": C.ServingScenario()}.get(args.scenario)
+        if scenario is None:
+            raise SystemExit("--spec replay needs --scenario "
+                             "training|serving (not mix)")
+        engine = C.CampaignEngine(episodes=1, seed=args.seed,
+                                  scenarios=[scenario],
+                                  shrink=not args.no_shrink)
+        schedule = C.Schedule(_parse_spec(args.spec))
+        info, violations = engine.run_episode(scenario, schedule,
+                                              args.fault_seed)
+        out = {"scenario": scenario.name, "spec": schedule.spec(),
+               "fault_seed": args.fault_seed,
+               "outcome": info.get("outcome"),
+               "typed_faults": len(info.get("typed", ())),
+               "violations": violations}
+        print(json.dumps(out, indent=1, sort_keys=True, default=str))
+        return 1 if violations else 0
+
+    episodes = SMOKE_EPISODES if args.smoke else args.episodes
+    seed = SMOKE_SEED if args.smoke else args.seed
+    floor = SMOKE_COVERAGE_FLOOR if args.smoke else args.coverage_floor
+    scenarios = None
+    if args.scenario == "training":
+        scenarios = [C.TrainingScenario()]
+    elif args.scenario == "serving":
+        scenarios = [C.ServingScenario()]
+    engine = C.CampaignEngine(episodes=episodes, seed=seed,
+                              scenarios=scenarios,
+                              max_rules=args.max_rules,
+                              shrink=not args.no_shrink)
+    report = engine.run()
+    report["smoke"] = bool(args.smoke)
+    report["coverage_floor"] = floor
+    cov = report["coverage"]
+    if args.as_json or args.smoke:
+        print(json.dumps(report, indent=1, sort_keys=True, default=str))
+    else:
+        print(f"chaos campaign: {episodes} episodes, seed {seed}")
+        print(f"  violations: {report['violations_total']}")
+        print(f"  site coverage: {cov['covered']}/{cov['manifest_sites']} "
+              f"({cov['ratio']:.0%})")
+        for s in cov["uncovered_sites"]:
+            print(f"    uncovered: {s}")
+        for b in report["artifact_bundles"]:
+            print(f"  bundle: {b}")
+    if report["violations_total"]:
+        return 1
+    if floor is not None and cov["ratio"] < floor:
+        print(f"site coverage {cov['ratio']:.0%} below the "
+              f"{floor:.0%} floor; uncovered: "
+              + ", ".join(cov["uncovered_sites"]), file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

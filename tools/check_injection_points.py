@@ -109,6 +109,47 @@ REQUIRED = [
      ["route"]),
 ]
 
+# Every injection-site *name* in the tree — the single source of truth the
+# chaos-campaign sampler (paddle_tpu/resilience/campaign.py) draws schedules
+# from, exposed via known_sites(). Like REQUIRED, this stays a plain literal
+# HERE because tests/test_lints.py ast-parses it, and reviewers add new
+# sites in the same commit that adds the maybe_inject/should_inject call.
+SITES = [
+    # storage
+    "fs.upload", "fs.download", "fs.mv", "fs.write", "fs.remove",
+    # collectives
+    "collective.all_reduce", "collective.all_gather", "collective.broadcast",
+    "collective.scatter", "collective.reduce_scatter", "collective.alltoall",
+    "collective.send", "collective.recv", "collective.barrier",
+    "collective.reduce",
+    # elastic store / transport
+    "store.put", "store.heartbeat", "store.gc",
+    "p2p.send", "p2p.recv", "p2p.barrier",
+    "wire.send_frame", "wire.recv_frame",
+    # recovery / integrity
+    "recovery.rendezvous", "recovery.restart",
+    "integrity.preflight", "integrity.checksum", "integrity.replay",
+    "device.bitflip",
+    # checkpointing
+    "ckpt.snapshot", "ckpt.serialize", "ckpt.commit",
+    # serving front door
+    "serving.enqueue", "serving.dispatch", "serving.replica_run",
+    "serving.reply", "serving.hedge", "serving.scale",
+    # rollout
+    "rollout.watch", "rollout.load", "rollout.swap", "rollout.verify",
+    # continuous-batching decode
+    "decode.join", "decode.prefill", "decode.step", "decode.evict",
+    # disaggregated serving
+    "kv.export", "kv.transfer", "kv.adopt", "disagg.route",
+]
+
+
+def known_sites():
+    """The full injection-site manifest, read at call time so a SITES edit
+    propagates to every consumer (notably the chaos-campaign sampler)."""
+    return tuple(SITES)
+
+
 # _injected_run is HDFSClient's hook-carrying chokepoint: routing a call
 # through it counts as hooked (its body holds the maybe_inject). _attempt
 # is Scheduler.dispatch's equivalent (both the primary and the hedged

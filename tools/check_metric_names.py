@@ -37,6 +37,7 @@ SCAN = ["paddle_tpu", "bench.py"]
 # review.
 SUBSYSTEMS = [
     "autotune",      # kernel-tier block autotuning
+    "campaign",      # chaos-campaign engine (resilience/campaign.py)
     "ckpt",          # zero-stall checkpointing (resilience/snapshot.py)
     "compiled_step", # whole-step compilation (jit/compiled_step.py)
     "decode",        # continuous-batching decode (serving/decode/)
