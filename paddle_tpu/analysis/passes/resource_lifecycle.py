@@ -54,6 +54,10 @@ PAIRS = [
      ("pool",), "strict"),
     ("paddle_tpu/serving/", "ensure", ("release",),
      ("table",), "strict"),
+    # prefix sharing: a taken reference must be dropped (unref) or handed
+    # to an owner that drops it (a BlockTable release / the cache's evict)
+    ("paddle_tpu/serving/", "ref", ("unref", "release"),
+     ("pool",), "strict"),
     ("paddle_tpu/", "start", ("finish",),
      ("rec", "recorder"), "strict"),
     ("paddle_tpu/serving/", "add_replica",

@@ -122,6 +122,14 @@ _FLAGS: dict[str, Any] = {
     # weight-only quantization for decode replicas at load time
     # ("" = off, "int8" = per-channel absmax int8; slim/ptq.py)
     "FLAGS_decode_quantize": "",
+    # prefix-sharing KV cache (serving/decode/prefix.py, docs/serving.md
+    # "Prefix sharing & speculative decoding"): warm joins adopt
+    # radix-matched cached prompt pages (refcounted, copy-on-write)
+    "FLAGS_decode_prefix_sharing": False,
+    # speculative decoding draft length: the draft proposes up to this
+    # many tokens per tick, verified in one batched target step
+    # (0 = off; also needs a DraftModel on the DecodeConfig)
+    "FLAGS_decode_spec_k": 0,
     # disaggregated prefill/decode serving (serving/disagg.py,
     # docs/serving.md "Disaggregated prefill/decode"): burn-rate window
     # (seconds) the per-stage BurnGates read, the burn multiple above

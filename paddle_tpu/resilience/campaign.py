@@ -189,11 +189,17 @@ def _exercise(fn, typed_log, label):
     """Run one ancillary coverage op; injected (typed) faults are logged
     and swallowed — ancillary ops must never abort an episode. Quarantined
     is SystemExit-based (a real rank would exit 117) and counts as typed
-    here: the campaign simulates every rank in-process."""
+    here: the campaign simulates every rank in-process. ExecuteError is
+    accepted only here, not in ``_typed_exceptions``: these coverage ops
+    call raw store/fs primitives without the ``retry_call`` wrapper that
+    production paths use to convert transient ExecuteError into a typed
+    DistributedError — a raw ExecuteError escaping the *main* loop is
+    still a typed-termination violation (a missing retry wrapper)."""
+    from ..distributed.fleet.fs import ExecuteError
     from .health import Quarantined
     try:
         fn()
-    except _typed_exceptions() as e:
+    except _typed_exceptions() + (ExecuteError,) as e:
         typed_log.append(f"{label}:{type(e).__name__}")
     except Quarantined:
         typed_log.append(f"{label}:Quarantined")
@@ -569,9 +575,16 @@ class ServingScenario(Scenario):
         # deliberately unmeetable deadline exercises decode.evict
         from ..serving.decode.compiled_decode import CompiledDecodeBackend
         from ..serving.decode.engine import DecodeConfig
-        deng = srv.attach_decode(CompiledDecodeBackend(max_running=4),
-                                 DecodeConfig(max_running=4,
-                                              max_new_tokens=self.gen_tokens))
+        from ..serving.decode.specdecode import MirrorDraft
+        # prefix sharing + speculation run hot here: the repeated [5, 6]
+        # prompt exercises prefix.lookup/share (warm joins) every round,
+        # the draft drives spec.draft/verify every tick, and the
+        # corrupt_every draft forces the rejection/truncate path too
+        deng = srv.attach_decode(
+            CompiledDecodeBackend(max_running=4),
+            DecodeConfig(max_running=4, max_new_tokens=self.gen_tokens,
+                         prefix_sharing=True, spec_k=2,
+                         draft=MirrorDraft(corrupt_every=5)))
 
         info = {"scenario": self.name, "typed": [], "untyped": [],
                 "requests": [], "journal": [], "deadlock": False}
@@ -675,8 +688,10 @@ class ServingScenario(Scenario):
         info["refusals_without_hint"] = len(hintless)
         # disagg's accounting covers its own prefill/decode pools; the
         # colocated engine's pool must be audited separately or a leak in
-        # the decode-side eviction path would be invisible here
-        colocated_leak = deng.pool.used() if deng.running() == 0 else 0
+        # the decode-side eviction path would be invisible here. Blocks the
+        # prefix cache retains after streams finish are warm state, not a
+        # leak — kv_leaked() subtracts them (and drain clears them).
+        colocated_leak = deng.kv_leaked() if deng.running() == 0 else 0
         info["leaked_blocks"] = ctl.leaked_blocks() + colocated_leak
         info["journal"] = list(journal.entries())
         info["stats"] = {k: v for k, v in ctl.stats().items()

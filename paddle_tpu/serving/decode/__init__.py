@@ -8,15 +8,23 @@ Layered under :class:`paddle_tpu.serving.server.InferenceServer`:
   join/leave, rationed chunked prefill, deadline/priority admission,
   replica-death replay);
 - :mod:`.compiled_decode` — donated jitted decode programs, one per
-  (bucket, signature), under PR 10's taint contract.
+  (bucket, signature), under PR 10's taint contract;
+- :mod:`.prefix` — prefix-sharing KV cache: content-addressed radix
+  index over the pool with refcounts, copy-on-write forks, and
+  refcount-then-LRU eviction (warm prompts skip prefill);
+- :mod:`.specdecode` — speculative decoding: draft-K proposals verified
+  in one batched target step, token-identical to greedy decode.
 
-See docs/serving.md, "Continuous-batching decode".
+See docs/serving.md, "Continuous-batching decode" and "Prefix sharing &
+speculative decoding".
 """
 from __future__ import annotations
 
 from .compiled_decode import CompiledDecodeBackend, CompiledDecodeStep
 from .engine import DecodeConfig, DecodeEngine, DecodeStream
 from .kv_cache import BlockTable, KVBlockPool, KVCacheExhausted
+from .prefix import PrefixCache, PrefixHit
+from .specdecode import DraftModel, MirrorDraft, NGramDraft, SpecDecoder
 
 __all__ = [
     "BlockTable",
@@ -25,8 +33,14 @@ __all__ = [
     "DecodeConfig",
     "DecodeEngine",
     "DecodeStream",
+    "DraftModel",
     "KVBlockPool",
     "KVCacheExhausted",
+    "MirrorDraft",
+    "NGramDraft",
+    "PrefixCache",
+    "PrefixHit",
+    "SpecDecoder",
     "load_decode_model",
 ]
 

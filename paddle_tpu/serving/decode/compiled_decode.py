@@ -181,6 +181,35 @@ def _toy_step_fn(vocab):
     return step
 
 
+def _toy_verify_fn(vocab):
+    """Teacher-forced verifier for the toy stepper: consumes the last
+    emitted token then each draft token in turn, emitting the target's
+    greedy choice at every position. Iteration ``j=0`` is exactly
+    :func:`_toy_step_fn`'s update, so verification with an empty draft
+    degenerates to the plain decode step — the greedy-equivalence the
+    spec-decode tests assert token-for-token. The unrolled K is a static
+    shape (``drafts.shape[1]``), so the program cache keys it like any
+    other signature: one compile per (bucket, K)."""
+    import jax.numpy as jnp
+
+    def verify(tokens, positions, kvd):
+        kv, drafts = kvd
+        k = drafts.shape[1]
+        last, pos, cur = tokens, positions, kv
+        outs, rows = [], []
+        for j in range(k + 1):
+            cur = cur.at[:, 0].add(last.astype(cur.dtype)
+                                   + pos.astype(cur.dtype))
+            nxt = (cur[:, 0].astype(jnp.int32) + pos + 1) % vocab
+            outs.append(nxt.astype(jnp.int32))
+            rows.append(cur)
+            if j < k:
+                last = drafts[:, j]
+                pos = pos + 1
+        return jnp.stack(outs, axis=1), jnp.stack(rows, axis=1)
+    return verify
+
+
 class CompiledDecodeBackend:
     """Reference :class:`~.engine.DecodeEngine` backend over a compiled,
     donated step. Per-stream state is one KV row (width ``kv_width``);
@@ -197,6 +226,14 @@ class CompiledDecodeBackend:
         self.step = CompiledDecodeStep(
             step_fn if step_fn is not None else _toy_step_fn(self.vocab),
             label="decode_backend", max_cached=max_cached)
+        # Speculative verify rides its own program cache: (bucket, K) keys
+        # are disjoint from the plain step's, so enabling speculation never
+        # disturbs the step's compile bound the soaks assert. Only the
+        # reference stepper has a matching verifier — a custom step_fn must
+        # bring its own verify or run without speculation.
+        self.vstep = CompiledDecodeStep(
+            _toy_verify_fn(self.vocab), label="decode_verify",
+            max_cached=max_cached) if step_fn is None else None
         # optional cost hook: called (kind, n_tokens) so fake-clock harnesses
         # charge prefill/decode work to the injected clock
         self._service = service
@@ -240,6 +277,56 @@ class CompiledDecodeBackend:
             _, pos = self._rows[s.id]
             self._rows[s.id] = (new_kv[i].copy(), pos + 1)
             out.append(int(nxt[i]))
+        if self._service is not None:
+            self._service("decode", n)
+        return out
+
+    def verify(self, streams, drafts):
+        """Speculative verify: teacher-force each stream's K draft tokens
+        (plus one bonus position) in a single compiled pass, then accept
+        host-side the longest draft prefix matching the target's greedy
+        choices. Returns the per-stream emitted tokens — accepted drafts
+        followed by the target's own token at the divergence (or the bonus
+        token on full acceptance). The KV row installed afterwards is the
+        one *at the accepted position*: rejected draft state is simply
+        never adopted, which is what makes the emitted stream
+        token-identical to non-speculative greedy decode.
+
+        Cost model: one verify pass is charged like one decode round — the
+        entire point of speculation is that accepted tokens ride along for
+        free.
+        """
+        if self.vstep is None:
+            from ...framework.errors import UnimplementedError
+            raise UnimplementedError(
+                "speculative verify requires the reference step_fn "
+                "(custom steppers must bring their own verifier)")
+        n = len(streams)
+        k = max(len(d) for d in drafts)
+        bucket = bucket_for(n, self.buckets)
+        tokens = np.zeros((bucket,), dtype="int32")
+        positions = np.zeros((bucket,), dtype="int32")
+        kv = np.zeros((bucket, self.kv_width), dtype="float32")
+        dr = np.full((bucket, k), -1, dtype="int32")
+        for i, s in enumerate(streams):
+            row, pos = self._rows[s.id]
+            tokens[i] = s.tokens[-1]
+            positions[i] = pos
+            kv[i] = row
+            dr[i, :len(drafts[i])] = drafts[i]
+        targets, rows = self.vstep.run(tokens, positions, (kv, dr))
+        targets = np.asarray(targets)
+        rows = np.asarray(rows)
+        out = []
+        for i, s in enumerate(streams):
+            d = drafts[i]
+            a = 0
+            while a < len(d) and int(d[a]) == int(targets[i, a]):
+                a += 1
+            emitted = [int(t) for t in d[:a]] + [int(targets[i, a])]
+            _, pos = self._rows[s.id]
+            self._rows[s.id] = (rows[i, a].copy(), pos + a + 1)
+            out.append(emitted)
         if self._service is not None:
             self._service("decode", n)
         return out

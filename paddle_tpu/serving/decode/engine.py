@@ -26,6 +26,15 @@ production-shaped:
   exact continuation. Chaos sites ``decode.{join,prefill,step,evict}`` make
   the whole lifecycle drivable from :mod:`paddle_tpu.resilience.faults`.
 
+Two opt-in accelerators ride the same loop (both off by default, both
+preserving every contract above): **prefix sharing**
+(``FLAGS_decode_prefix_sharing``, :mod:`.prefix`) adopts radix-matched
+cached prompt pages at join so warm prompts skip prefill — chaos sites
+``prefix.{lookup,share,evict}`` — and **speculative decoding**
+(``FLAGS_decode_spec_k`` + a :class:`~.specdecode.DraftModel`) turns the
+decode tick into a draft-K/verify-1 round, token-identical to greedy —
+chaos sites ``spec.{draft,verify}``.
+
 The clock is injectable; the chaos soak and ``serving_bench --decode`` run
 entirely on a fake clock with zero real sleeps.
 """
@@ -40,6 +49,8 @@ from ..batcher import DeadlineExceeded, ServerOverloaded
 from ..metrics import percentile
 from ..scheduler import ReplicaDead
 from .kv_cache import BlockTable, KVBlockPool, KVCacheExhausted
+from .prefix import PrefixCache
+from .specdecode import DRAFT_PAD, SpecDecoder
 
 __all__ = ["DecodeConfig", "DecodeStream", "DecodeEngine"]
 
@@ -56,7 +67,8 @@ class DecodeConfig:
     """Engine knobs. ``None`` means "read the FLAGS_decode_* default"."""
 
     def __init__(self, max_running=8, num_blocks=None, block_size=None,
-                 prefill_chunk=None, max_new_tokens=None, eos_token=None):
+                 prefill_chunk=None, max_new_tokens=None, eos_token=None,
+                 prefix_sharing=None, spec_k=None, draft=None):
         self.max_running = int(max_running)
         self.num_blocks = num_blocks
         self.block_size = block_size
@@ -66,10 +78,22 @@ class DecodeConfig:
             max_new_tokens if max_new_tokens is not None
             else _flag("FLAGS_decode_max_new_tokens", 64))
         self.eos_token = eos_token
+        # prefix sharing (serving/decode/prefix.py): warm joins adopt the
+        # cached prefix pages instead of re-prefilling
+        self.prefix_sharing = bool(
+            _flag("FLAGS_decode_prefix_sharing", False)
+            if prefix_sharing is None else prefix_sharing)
+        # speculative decoding (serving/decode/specdecode.py): draft
+        # proposes up to spec_k tokens per tick, one verify pass accepts
+        self.spec_k = int(_flag("FLAGS_decode_spec_k", 0)
+                          if spec_k is None else spec_k)
+        self.draft = draft
         if self.max_running < 1 or self.prefill_chunk < 1 \
                 or self.max_new_tokens < 1:
             raise ValueError("max_running, prefill_chunk and max_new_tokens "
                              "must all be >= 1")
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0 (0 disables speculation)")
 
 
 class DecodeStream:
@@ -141,11 +165,29 @@ class DecodeEngine:
         self._tpot_ms = []     # guarded-by: _lock
         self._emitted = 0      # guarded-by: _lock
         self._lock = threading.RLock()
+        # Prefix sharing needs backend state snapshots at block boundaries
+        # (export) and warm installs (adopt) — without both hooks a "warm"
+        # stream could not skip prefill, so sharing silently disables.
+        sharing = self.config.prefix_sharing \
+            and hasattr(backend, "export_state") \
+            and hasattr(backend, "adopt_state")
+        self._prefix = PrefixCache(self.pool) if sharing else None
+        # Speculation needs a draft and a backend verify pass; the
+        # reference backend only carries one for its own toy stepper.
+        wants_spec = self.config.spec_k > 0 and self.config.draft is not None
+        can_spec = callable(getattr(backend, "verify", None)) \
+            and getattr(backend, "vstep", True) is not None
+        self._spec = SpecDecoder(self.config.draft, self.config.spec_k) \
+            if wants_spec and can_spec else None
         from ...profiler.metrics import get_registry
         # the gauge fn runs on the exporter thread — go through the
         # locked accessor, never the raw dict
         get_registry().register_gauge_fn(
             "decode.running_count", lambda: self.running())
+        get_registry().register_gauge_fn(
+            "decode.spec_accept_ratio",
+            lambda: self._spec.accept_ratio() if self._spec is not None
+            else 0.0)
 
     # -- admission -----------------------------------------------------------
     def _retry_after(self, priority):
@@ -194,7 +236,18 @@ class DecodeEngine:
                         priority=priority, enqueued_at=now,
                         on_token=on_token, request_id=request_id)
                     table = BlockTable(self.pool)
-                    if not table.ensure(len(stream.prompt) + 1):
+                    # radix match before any fresh allocation: a warm hit
+                    # adopts the cached prefix pages (shared, refcounted)
+                    # and only the suffix still needs pool capacity
+                    hit = self._prefix.lookup(stream.prompt) \
+                        if self._prefix is not None else None
+                    if hit is not None:
+                        table.adopt_shared(hit.blocks, hit.tokens,
+                                           ref_held=True)
+                    if not self._kv_ensure(table, len(stream.prompt) + 1):
+                        # a refused join holds nothing: drop the adopted
+                        # shared references before raising
+                        table.release()
                         raise ServerOverloaded(
                             f"KV pool exhausted ({self.pool.free()} free "
                             f"blocks, prompt needs "
@@ -209,12 +262,27 @@ class DecodeEngine:
                 stream._admitted = True
                 stream.trace = trace
                 trace.request_id = stream.id
+                if hit is not None:
+                    # skip the matched prefill: install the cached backend
+                    # state and fill only the unmatched suffix (a full
+                    # match fills nothing and emits its cached first token
+                    # below — prefill is skipped entirely)
+                    stream._fill = list(stream.prompt[hit.tokens:])
+                    stream._fill_pos = int(hit.tokens)
+                    self.backend.adopt_state(stream, hit.state)
+                    get_registry().inc_counter("decode.warm_joins_total")
                 trace.end_span(jsid, verdict="admitted",
                                running=len(self._streams) + 1,
-                               kv_free=self.pool.free())
+                               kv_free=self.pool.free(),
+                               warm=int(hit is not None))
                 self._streams[stream.id] = stream
-                self._prefill_rr.append(stream.id)
+                if stream._fill:
+                    self._prefill_rr.append(stream.id)
                 get_registry().inc_counter("decode.joins_total")
+                if hit is not None and not stream._fill:
+                    tok = int(hit.token)
+                    self._emit(stream, tok, now)
+                    self._maybe_finish(stream, tok)
                 return stream
         except ServerOverloaded as e:
             trace.end_span(jsid, verdict="shed")
@@ -268,7 +336,7 @@ class DecodeEngine:
                         else now,
                         on_token=on_token, request_id=request_id)
                     table = BlockTable(self.pool)
-                    if not table.ensure(int(fill_pos) + 1):
+                    if not self._kv_ensure(table, int(fill_pos) + 1):
                         raise KVCacheExhausted(
                             f"decode-side KV pool exhausted "
                             f"({self.pool.free()} free blocks, adoption "
@@ -287,6 +355,13 @@ class DecodeEngine:
                 stream._fill = []
                 stream._fill_pos = int(fill_pos)
                 self.backend.adopt_state(stream, state)
+                if self._prefix is not None:
+                    # migrating a shared prefix exports once; re-sharing it
+                    # here seeds the decode-side radix index so later
+                    # identical prompts join warm on this replica too
+                    self._prefix.share(
+                        list(stream.prompt)[:stream._fill_pos], table,
+                        state, token=int(tokens[0]) if tokens else None)
                 trace.end_span(asid, verdict="adopted",
                                running=len(self._streams) + 1,
                                kv_free=self.pool.free())
@@ -346,6 +421,19 @@ class DecodeEngine:
                 self._prefill_rr.append(self._prefill_rr.pop(0))
             return
 
+    def _kv_ensure(self, table, tokens):  # requires-lock: _lock
+        """``table.ensure`` with prefix-cache pressure relief: a pool
+        shortage first evicts cold cache entries (refcount-then-LRU) and
+        retries once — cache retention must never starve a live stream."""
+        if table.ensure(tokens):  # lifecycle-ok: table is stream-owned; _release (or the refusal path) frees it
+            return True
+        if self._prefix is None:
+            return False
+        need = self.pool.blocks_for(tokens) - len(table.blocks)
+        if self._prefix.evict(need) <= 0:
+            return False
+        return table.ensure(tokens)  # lifecycle-ok: same stream-owned table as above
+
     def _prefill(self, stream, now):  # requires-lock: _lock
         """Absorb at most one ``prefill_chunk`` of this stream's pending
         tokens into the KV cache; emits the first new token when the fill
@@ -353,8 +441,16 @@ class DecodeEngine:
         from ...profiler.metrics import get_registry
         maybe_inject("decode.prefill", ReplicaDead)
         n = min(len(stream._fill), self.config.prefill_chunk)
+        if self._prefix is not None:
+            # clamp the chunk to end on a page boundary when it can reach
+            # one, so every share point below carries a backend snapshot
+            # taken exactly at a page edge (the radix index's granularity)
+            bs = self.pool.block_size
+            aligned = ((stream._fill_pos + n) // bs) * bs
+            if stream._fill_pos < aligned < stream._fill_pos + n:
+                n = aligned - stream._fill_pos
         t_kv = self._clock()
-        grown = stream.table.ensure(stream._fill_pos + n)
+        grown = self._kv_ensure(stream.table, stream._fill_pos + n)
         if stream.trace is not None:
             stream.trace.record_span("engine.kv_wait", t_kv, self._clock(),
                                      need=stream._fill_pos + n, ok=grown)
@@ -372,6 +468,20 @@ class DecodeEngine:
             stream.trace.record_span("engine.prefill_chunk", t0,
                                      self._clock(), tokens=n, start=start)
         get_registry().inc_counter("decode.prefill_chunks_total")
+        if self._prefix is not None:
+            done = not stream._fill
+            if done or stream._fill_pos % self.pool.block_size == 0:
+                # index the consumed prefix (content-addressed, so replay
+                # fills — prompt + emitted — index just as well); at fill
+                # completion the entry turns terminal: it carries the
+                # first generated token and lets the next identical
+                # prompt skip prefill entirely
+                consumed = (list(stream.prompt)
+                            + list(stream.tokens))[:stream._fill_pos]
+                self._prefix.share(
+                    consumed, stream.table,
+                    self.backend.export_state(stream),
+                    token=token if done else None)
         if token is not None:
             # re-read the clock: the backend's work (and a fake-clock
             # harness's service charge) happened since `now` was taken
@@ -382,20 +492,37 @@ class DecodeEngine:
     def _decode_tick(self, now):  # requires-lock: _lock
         runnable = [s for s in self._streams.values()
                     if not s.done and not s._fill and s.tokens]
+        if not runnable:
+            return
+        # speculative round? one draft pass for the whole tick (None =
+        # injected fault or no guesses — fall back to the plain tick)
+        drafts = self._spec.propose(runnable) \
+            if self._spec is not None else None
+        dmap = {s.id: d for s, d in zip(runnable, drafts)} \
+            if drafts is not None else {}
         ready = []
         for stream in runnable:
-            # the consumed prefix grows by one token this round
+            # the consumed prefix grows by one token this round — plus up
+            # to the stream's real (non-pad) draft tokens when speculating
+            horizon = 1 + sum(1 for t in dmap.get(stream.id, ())
+                              if t != DRAFT_PAD)
             t_kv = self._clock()
-            grown = stream.table.ensure(stream._fill_pos + 1)
-            if not grown and stream.trace is not None:
+            grown = self._kv_ensure(stream.table,
+                                    stream._fill_pos + horizon)
+            # COW fork: generation writes into the page covering the next
+            # position — a warm stream's first token must not scribble on
+            # a shared prefix page
+            writable = grown and (self._prefix is None
+                                  or self._cow(stream))
+            if not (grown and writable) and stream.trace is not None:
                 # only the failed growth attempt earns a span — a
                 # satisfied one-token extension is the per-round common
                 # case and would double every trace's span count
                 stream.trace.record_span("engine.kv_wait", t_kv,
                                          self._clock(),
-                                         need=stream._fill_pos + 1,
+                                         need=stream._fill_pos + horizon,
                                          ok=False)
-            if grown:
+            if grown and writable:
                 ready.append(stream)
             else:
                 self._evict(stream, KVCacheExhausted(
@@ -403,6 +530,9 @@ class DecodeEngine:
                     f"{len(stream.tokens)} tokens",
                     retry_after=self._retry_after(stream.priority)))
         if not ready:
+            return
+        if dmap:
+            self._spec_round(ready, dmap)
             return
         t0 = self._clock()
         out = self.backend.decode(ready)
@@ -416,6 +546,47 @@ class DecodeEngine:
                                          batch=len(ready), seq=stream.seq)
             self._emit(stream, int(token), now)
             self._maybe_finish(stream, int(token))
+
+    def _cow(self, stream):  # requires-lock: _lock
+        """Fork any shared page the next write would land on; on a pool
+        shortage, shed cold cache entries and retry once (same pressure
+        valve as :meth:`_kv_ensure`)."""
+        if stream.table.ensure_writable(stream._fill_pos):
+            return True
+        self._prefix.evict(2)
+        return stream.table.ensure_writable(stream._fill_pos)
+
+    def _spec_round(self, ready, dmap):  # requires-lock: _lock
+        """Draft-K/verify-1: one batched teacher-forced verify pass for
+        the tick's whole ready set (chaos site ``spec.verify`` — a death
+        here is a replica death, and :meth:`step`'s handler replays; the
+        replay is token-identical through speculation because only
+        *emitted* tokens replay, and those are greedy-equivalent by the
+        acceptance rule). Each stream emits its accepted draft prefix plus
+        the target's correction (or bonus) token, then
+        ``BlockTable.truncate`` returns the pages over-reserved for
+        rejected drafts."""
+        maybe_inject("spec.verify", ReplicaDead)
+        t0 = self._clock()
+        results = self.backend.verify(ready, [dmap[s.id] for s in ready])
+        now = self._clock()
+        for stream, emitted in zip(ready, results):
+            if stream.done:
+                continue   # evicted by a mid-round callback failure
+            real = sum(1 for t in dmap[stream.id] if t != DRAFT_PAD)
+            self._spec.note(real, len(emitted) - 1)
+            stream._fill_pos += len(emitted)
+            if stream.trace is not None:
+                stream.trace.record_span("engine.decode_tick", t0, now,
+                                         batch=len(ready), seq=stream.seq,
+                                         spec_accepted=len(emitted) - 1)
+            for token in emitted:
+                if stream.done:
+                    break
+                self._emit(stream, int(token), now)
+                self._maybe_finish(stream, int(token))
+            if not stream.done:
+                stream.table.truncate(stream._fill_pos + 1)
 
     # -- emission & termination ----------------------------------------------
     def _emit(self, stream, token, now):  # requires-lock: _lock
@@ -539,12 +710,31 @@ class DecodeEngine:
             for stream in live:
                 self._evict(stream, error if error is not None
                             else ServerOverloaded("decode engine drained"))
+            if self._prefix is not None:
+                # shutdown audit contract: after drain, every cache
+                # reference is dropped and the pool's refcount map is empty
+                self._prefix.clear()
             return len(live)
 
     # -- observability -------------------------------------------------------
     def running(self):
         with self._lock:
             return len(self._streams)
+
+    def kv_leaked(self):
+        """Pool blocks accounted to no live stream's table and not held by
+        the prefix cache — the soak/campaign leak audit. Cache retention
+        after streams finish is intentional warm state, not a leak;
+        :meth:`drain` clears it so a shutdown audit can additionally
+        assert ``pool.used() == 0``."""
+        with self._lock:
+            owned = set()
+            for s in self._streams.values():
+                if s.table is not None:
+                    owned.update(s.table.blocks)
+            if self._prefix is not None:
+                owned.update(self._prefix.blocks())
+            return self.pool.used() - len(owned)
 
     def latency_reservoirs(self):
         """Copies of the (ttft_ms, tpot_ms) reservoirs — the disagg
@@ -571,4 +761,11 @@ class DecodeEngine:
             if step is not None and hasattr(step, "compile_count"):
                 snap["compiles"] = step.compile_count
                 snap["compile_cache_hits"] = step.cache_hits
+            if self._prefix is not None:
+                p = self._prefix.stats()
+                snap["prefix_hits"] = p["hits"]
+                snap["prefix_misses"] = p["misses"]
+                snap["prefix_entries"] = p["entries"]
+            if self._spec is not None:
+                snap["spec_accept_ratio"] = self._spec.accept_ratio()
             return snap

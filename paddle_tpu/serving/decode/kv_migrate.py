@@ -111,8 +111,18 @@ class KVMigrator:
         pages = list(handoff.table.pages()) if handoff.table is not None \
             else []
         for k, (block, held) in enumerate(pages):
+            # a shared (prefix-cache) page still crosses the wire exactly
+            # once per migration — the flag tells the decode side this page
+            # has other referents on the source, so the source-side release
+            # below only detaches from it. The decode engine re-shares the
+            # adopted prefix into its own radix index (engine.adopt), or
+            # COW-materializes on first divergent write; either way no
+            # per-referent re-export ever happens.
+            rc = handoff.table.pool.refcount(block) \
+                if hasattr(handoff.table.pool, "refcount") else 1
             frames.append({"op": "kv_page", "stream": handoff.id, "page": k,
-                           "block": int(block), "tokens": int(held)})
+                           "block": int(block), "tokens": int(held),
+                           "shared": bool(rc > 1)})
         frames.append({"op": "kv_meta", "stream": handoff.id,
                        "fill_pos": int(handoff.fill_pos),
                        "prompt_len": len(handoff.prompt),

@@ -35,7 +35,8 @@ from paddle_tpu.serving import InferenceClient, InferenceServer, \
 from paddle_tpu.serving.batcher import DeadlineExceeded
 from paddle_tpu.serving.decode import (
     BlockTable, CompiledDecodeBackend, DecodeConfig, DecodeEngine,
-    KVBlockPool, KVCacheExhausted, load_decode_model,
+    KVBlockPool, KVCacheExhausted, MirrorDraft, NGramDraft,
+    load_decode_model,
 )
 from paddle_tpu.serving.overload import AdmissionController
 
@@ -589,3 +590,326 @@ class TestSocketStreaming:
         assert len(received) >= 3
         assert ei.value.error_type == "ReplicaRetired"
         assert ei.value.tokens_delivered == len(received)
+
+
+# -- prefix sharing: refcounts, truncate, copy-on-write (substrate) ----------
+
+class TestPoolRefcounts:
+    def test_allocation_starts_at_one_reference(self):
+        pool = KVBlockPool(num_blocks=4, block_size=2)
+        got = pool.try_allocate(2)
+        assert [pool.refcount(b) for b in got] == [1, 1]
+        pool.ref(got)
+        pool.release(got)              # 2 -> 1: still allocated
+        assert pool.free() == 2
+        assert all(pool.refcount(b) == 1 for b in got)
+        pool.release(got)              # last reference: back on the free list
+        assert pool.free() == 4
+        assert pool.refcounts() == {}
+
+    def test_ref_of_a_free_block_is_a_bug(self):
+        pool = KVBlockPool(num_blocks=2, block_size=2)
+        got = pool.try_allocate(1)
+        free_block = next(b for b in range(2) if b != got[0])
+        with pytest.raises(ValueError, match="unallocated"):
+            pool.ref([free_block])
+        # validation precedes any increment: a bad batch changes nothing
+        with pytest.raises(ValueError, match="unallocated"):
+            pool.ref([got[0], free_block])
+        assert pool.refcount(got[0]) == 1
+        pool.release(got)
+
+    def test_over_unref_is_a_double_free(self):
+        pool = KVBlockPool(num_blocks=2, block_size=2)
+        got = pool.try_allocate(1)
+        pool.unref(got)
+        with pytest.raises(ValueError, match="double/invalid"):
+            pool.unref(got)
+
+
+class TestBlockTableTruncate:
+    def test_truncate_releases_whole_trailing_blocks(self):
+        pool = KVBlockPool(num_blocks=8, block_size=4)
+        table = BlockTable(pool)
+        assert table.ensure(16)            # 4 blocks
+        assert table.truncate(9) == 1      # ceil(9/4) = 3 blocks stay
+        assert pool.free() == 5
+        assert table.truncate(9) == 0      # idempotent at the same length
+        assert table.truncate(12) == 0     # never re-grows
+        table.release()
+        assert pool.free() == 8
+
+    def test_truncate_of_a_shared_block_only_drops_this_ref(self):
+        pool = KVBlockPool(num_blocks=4, block_size=4)
+        table = BlockTable(pool)
+        assert table.ensure(8)
+        tail = table.blocks[1]
+        pool.ref([tail])                   # a prefix-cache-style reference
+        assert table.truncate(4) == 1
+        assert pool.refcount(tail) == 1    # still allocated for the cache
+        assert pool.used() == 2
+        pool.unref([tail])
+        assert pool.used() == 1
+        table.release()
+        assert pool.used() == 0
+
+
+class TestCopyOnWrite:
+    def test_ensure_writable_forks_shared_pages(self):
+        pool = KVBlockPool(num_blocks=4, block_size=4)
+        table = BlockTable(pool)
+        assert table.ensure(8)
+        shared = table.blocks[1]
+        pool.ref([shared])                 # simulate the prefix cache
+        assert table.ensure_writable(5)    # next write lands in block 1
+        assert table.blocks[1] != shared   # forked a private copy
+        assert pool.refcount(shared) == 1  # the cache's reference survives
+        assert pool.refcount(table.blocks[1]) == 1
+        pool.unref([shared])
+        table.release()
+        assert pool.used() == 0
+
+    def test_ensure_writable_is_a_noop_on_exclusive_pages(self):
+        pool = KVBlockPool(num_blocks=2, block_size=4)
+        table = BlockTable(pool)
+        assert table.ensure(8)
+        before = list(table.blocks)
+        assert table.ensure_writable(0)
+        assert table.blocks == before
+        table.release()
+
+    def test_ensure_writable_shortage_forks_nothing(self):
+        pool = KVBlockPool(num_blocks=2, block_size=4)
+        table = BlockTable(pool)
+        assert table.ensure(8)
+        shared = list(table.blocks)
+        pool.ref(shared)
+        assert not table.ensure_writable(0)    # no free block to fork into
+        assert table.blocks == shared          # nothing half-forked
+        pool.unref(shared)
+        table.release()
+
+
+# -- prefix sharing: the radix cache through the engine ----------------------
+
+class TestPrefixSharing:
+    # 24 tokens = exactly 3 aligned blocks of 8 (terminal node carries the
+    # cached first generated token, so a repeat join skips prefill entirely)
+    PROMPT = list(range(100, 124))
+
+    def _engine(self, sharing=True, **over):
+        cfg = dict(max_running=4, num_blocks=64, block_size=8,
+                   prefill_chunk=8, max_new_tokens=6)
+        cfg.update(over)
+        clock = FakeClock()
+        eng = DecodeEngine(
+            CompiledDecodeBackend(max_running=cfg["max_running"]),
+            DecodeConfig(prefix_sharing=sharing, **cfg), clock=clock)
+        return eng, clock
+
+    def test_full_hit_skips_prefill_and_matches_cold_tokens(self):
+        cold_eng, cold_clock = self._engine(sharing=False)
+        ref = cold_eng.join(list(self.PROMPT))
+        drive(cold_eng, cold_clock)
+
+        eng, clock = self._engine()
+        first = eng.join(list(self.PROMPT))
+        drive(eng, clock)
+        warm = eng.join(list(self.PROMPT))
+        # full radix hit: nothing left to prefill, the cached first token
+        # is already emitted at join time (TTFT ~ 0)
+        assert not warm._fill
+        assert list(warm.tokens) == list(first.tokens)[:1]
+        drive(eng, clock)
+        assert list(warm.tokens) == list(first.tokens) == list(ref.tokens)
+        assert eng.stats()["prefix_hits"] >= 1
+        assert eng.kv_leaked() == 0
+
+    def test_partial_hit_prefills_only_the_suffix(self):
+        eng, clock = self._engine()
+        a = eng.join(list(self.PROMPT) + [1, 2])
+        drive(eng, clock)
+        b = eng.join(list(self.PROMPT) + [3, 4, 5])
+        assert b._fill_pos == len(self.PROMPT)   # adopted the aligned part
+        assert len(b._fill) == 3                 # only the suffix remains
+        drive(eng, clock)
+        assert b.done and b.error is None
+        assert eng.kv_leaked() == 0
+
+    def test_cow_forks_the_shared_tail_and_leaves_the_index_valid(self):
+        # 20 tokens = 2 aligned blocks + a 4-token tail: a warm full hit
+        # adopts the tail page too, and the first generated token would
+        # land in it — ensure_writable must fork, not scribble
+        prompt = list(range(500, 520))
+        eng, clock = self._engine()
+        first = eng.join(list(prompt))
+        drive(eng, clock)
+        entries = eng.stats()["prefix_entries"]
+        warm = eng.join(list(prompt))
+        drive(eng, clock)
+        assert list(warm.tokens) == list(first.tokens)
+        snap = eng.stats()
+        assert snap["prefix_entries"] == entries   # COW never edits the index
+        assert eng.kv_leaked() == 0
+        # both streams are gone: every remaining reference is the cache's own
+        assert set(eng.pool.refcounts().values()) <= {1}
+
+    def test_cache_yields_to_live_streams_under_pool_pressure(self):
+        eng, clock = self._engine(num_blocks=8, max_running=2)
+        a = eng.join(list(range(200, 216)))      # 16 tokens -> 2 cached blocks
+        drive(eng, clock)
+        assert eng.stats()["prefix_entries"] > 0
+        b = eng.join(list(range(300, 356)))      # 57-token need: whole pool
+        drive(eng, clock)
+        assert b.done and b.error is None
+        # a's cached pages were the eviction victims: its prompt is cold
+        # again (b's own prefix may have re-filled the index since)
+        misses = eng.stats()["prefix_misses"]
+        eng.join(list(range(200, 216)))
+        assert eng.stats()["prefix_misses"] == misses + 1
+        drive(eng, clock)
+        assert a.done and eng.kv_leaked() == 0
+
+    def test_injected_lookup_fault_degrades_to_cold_miss(self):
+        eng, clock = self._engine()
+        first = eng.join(list(self.PROMPT))
+        drive(eng, clock)
+        faults.configure("prefix.lookup:1", seed=3)
+        warm = eng.join(list(self.PROMPT))
+        assert warm._fill          # cold: the full prompt queues for prefill
+        drive(eng, clock)
+        faults.reset()
+        assert list(warm.tokens) == list(first.tokens)
+
+    def test_drain_clears_every_cache_reference(self):
+        eng, clock = self._engine()
+        for sfx in ([1], [2], [3]):
+            eng.join(list(self.PROMPT) + sfx)
+        drive(eng, clock)
+        assert eng.stats()["prefix_entries"] > 0
+        assert eng.pool.used() > 0       # warm retention is intentional...
+        eng.drain()
+        assert eng.pool.used() == 0      # ...until shutdown drops it all
+        assert eng.pool.refcounts() == {}
+
+
+# -- speculative decoding ----------------------------------------------------
+
+class TestSpeculativeDecoding:
+    def _run(self, spec_k=0, draft=None, fault=None):
+        clock = FakeClock()
+        eng = DecodeEngine(
+            CompiledDecodeBackend(max_running=4),
+            DecodeConfig(max_running=4, max_new_tokens=12, prefill_chunk=8,
+                         spec_k=spec_k, draft=draft),
+            clock=clock)
+        streams = [eng.join([7, 3, 5]), eng.join(list(range(9)))]
+        if fault:
+            faults.configure(fault, seed=11)
+        rounds = drive(eng, clock)
+        faults.reset()
+        return [list(s.tokens) for s in streams], eng, rounds
+
+    def test_perfect_drafts_are_token_identical_in_fewer_rounds(self):
+        ref, _, ref_rounds = self._run()
+        got, eng, rounds = self._run(spec_k=4, draft=MirrorDraft())
+        assert got == ref                  # greedy equivalence, exactly
+        assert eng.stats()["spec_accept_ratio"] == 1.0
+        assert rounds < ref_rounds         # speculation actually paid off
+
+    def test_corrupted_drafts_reject_but_stay_token_identical(self):
+        ref, _, _ = self._run()
+        got, eng, _ = self._run(spec_k=4, draft=MirrorDraft(corrupt_every=3))
+        assert got == ref
+        ratio = eng.stats()["spec_accept_ratio"]
+        assert 0.0 < ratio < 1.0           # rejections happened, harmlessly
+
+    def test_draft_fault_degrades_to_plain_ticks(self):
+        ref, _, _ = self._run()
+        got, eng, _ = self._run(spec_k=4, draft=MirrorDraft(),
+                                fault="spec.draft:1")
+        assert got == ref
+        assert eng.stats()["spec_accept_ratio"] == 0.0
+
+    def test_verify_death_replays_token_identical_through_drafts(self):
+        ref, _, _ = self._run()
+        got, _, _ = self._run(spec_k=4, draft=MirrorDraft(),
+                              fault="spec.verify:#2")
+        # only *emitted* tokens replay, and those are greedy-equivalent by
+        # the acceptance rule — so recovery matches plain decode exactly
+        assert got == ref
+
+    def test_ngram_draft_proposes_the_continuation_of_a_repeat(self):
+        class _Ctx:
+            prompt = [1, 2, 3, 9, 1, 2, 3]
+            tokens = []
+        assert NGramDraft(n=2).propose(_Ctx(), 3) == [9, 1, 2]
+        assert NGramDraft(n=2).propose(_Ctx(), 1) == [9]
+
+
+# -- chaos soak with sharing + speculation on (acceptance) -------------------
+
+class TestPrefixSpecChaosSoak:
+    def test_soak_sharing_and_speculation_all_sites(self):
+        """The decode chaos soak rerun with prefix sharing and speculative
+        decoding enabled and every prefix.*/spec.* site armed alongside the
+        decode.* sites. The shared-prefix arrival mix (3 prompt bases, short
+        random suffixes) keeps the radix cache hot so lookup/share/evict all
+        fire for real. Invariants: every accepted stream terminates with
+        tokens or a typed error, the leak audit holds mid-soak and at the
+        end, drain returns every page (no dangling refcounts), and both the
+        decode and the verify program caches stay bucket-bounded."""
+        clock = FakeClock()
+        backend = CompiledDecodeBackend(max_running=6)
+        eng = DecodeEngine(
+            backend,
+            DecodeConfig(max_running=6, num_blocks=24, block_size=4,
+                         prefill_chunk=8, max_new_tokens=12,
+                         prefix_sharing=True, spec_k=2,
+                         draft=MirrorDraft(corrupt_every=4)),
+            clock=clock)
+        faults.configure(
+            "decode.join:0.03,decode.step:0.03,decode.prefill:0.03,"
+            "decode.evict:0.2,prefix.lookup:0.05,prefix.share:0.05,"
+            "prefix.evict:0.2,spec.draft:0.05,spec.verify:0.02", seed=9)
+
+        rng = np.random.RandomState(7)
+        bases = [list(rng.randint(0, 1000, size=10)) for _ in range(3)]
+        accepted, refusals = [], []
+        for round_no in range(400):
+            if rng.random() < 0.5:
+                prompt = list(bases[int(rng.randint(0, 3))]) + list(
+                    rng.randint(0, 1000, size=int(rng.randint(1, 6))))
+                try:
+                    accepted.append(eng.join(
+                        prompt, timeout=float(rng.uniform(0.05, 0.4)),
+                        priority=int(rng.randint(0, 3))))
+                except ServerOverloaded as e:
+                    refusals.append(e)
+            eng.step()
+            clock.advance(0.002)
+            if round_no % 97 == 0:
+                assert eng.kv_leaked() == 0, "mid-soak block leak"
+        faults.reset()
+        drive(eng, clock, dt=0.002)
+
+        assert len(accepted) > 20, "soak admitted too little to mean much"
+        for e in refusals:
+            # engine-issued refusals carry the hint; injected decode.join
+            # faults are raw ServerOverloaded by construction
+            if "injected fault" not in str(e):
+                assert getattr(e, "retry_after", None) is not None
+        for s in accepted:
+            assert s.done, f"stream {s.id} never terminated"
+            if s.error is None:
+                assert len(s.tokens) == s.max_new_tokens
+            else:
+                assert isinstance(
+                    s.error, (ServerOverloaded, KVCacheExhausted,
+                              DeadlineExceeded, ConnectionError))
+        assert eng.kv_leaked() == 0
+        eng.drain()
+        assert eng.pool.used() == 0
+        assert eng.pool.refcounts() == {}
+        assert backend.step.compile_count <= len(backend.buckets)
+        assert backend.vstep.compile_count <= len(backend.buckets)

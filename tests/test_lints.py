@@ -162,6 +162,10 @@ def test_resource_lifecycle_manifest_guard():
              for prefix, acq, rels, recv, mode
              in _pass_literal("resource_lifecycle", "PAIRS")}
     assert ("try_allocate", ("release",)) in pairs
+    # prefix-sharing PR: every pool.ref must meet a pool.unref (or the
+    # release alias) on all paths — the refcount layer under the radix cache
+    prefix, recv, mode = pairs[("ref", ("unref", "release"))]
+    assert "pool" in recv and mode == "strict"
     prefix, recv, mode = pairs[("start", ("finish",))]
     assert "recorder" in recv and mode == "strict"
     prefix, recv, mode = pairs[
@@ -378,6 +382,31 @@ def test_injection_lint_covers_disagg_entry_points():
         ("paddle_tpu/serving/disagg.py", "class:DisaggController")]
 
 
+def test_injection_lint_covers_prefix_spec_entry_points():
+    """The prefix-sharing/speculation PR's contract: the radix match
+    (prefix.lookup must degrade to a cold miss), indexing (prefix.share
+    stays cold), eviction (prefix.evict must still complete), the draft
+    pass (spec.draft falls back to a plain tick), and the verify pass
+    (spec.verify must resolve as a token-identical replay) all stay
+    chaos-testable. Guard the MANIFEST so a refactor can't silently drop
+    the requirement along with the hook."""
+    import ast
+    src = (REPO / "tools" / "check_injection_points.py").read_text()
+    tree = ast.parse(src)
+    required = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "REQUIRED" for t in node.targets))
+    manifest = ast.literal_eval(required)
+    entries = {(rel, scope): names for rel, scope, names in manifest}
+    assert {"lookup", "share", "evict", "clear"} <= set(entries[
+        ("paddle_tpu/serving/decode/prefix.py", "class:PrefixCache")])
+    assert "propose" in entries[
+        ("paddle_tpu/serving/decode/specdecode.py", "class:SpecDecoder")]
+    assert "_spec_round" in entries[
+        ("paddle_tpu/serving/decode/engine.py", "class:DecodeEngine")]
+
+
 def test_metric_name_lint_passes_on_tree():
     r = _run(REPO / "tools" / "check_metric_names.py")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -402,7 +431,7 @@ def test_metric_name_lint_manifest_guard():
     subsystems = set(ast.literal_eval(_assigned("SUBSYSTEMS")))
     assert {"steptimer", "metrics", "serving", "io", "integrity",
             "ckpt", "compiled_step", "rollout", "decode",
-            "slo", "trace"} <= subsystems
+            "slo", "trace", "prefix", "spec"} <= subsystems
     units = set(ast.literal_eval(_assigned("UNITS")))
     assert {"ms", "total", "per_sec"} <= units
     grandfathered = set(ast.literal_eval(_assigned("GRANDFATHERED")))
@@ -535,6 +564,29 @@ def test_disagg_flags_registered():
     assert int(defaults["FLAGS_disagg_max_inflight"]) >= 1
 
 
+def test_prefix_spec_flags_registered():
+    """The prefix-sharing/speculation PR's knobs stay registered with
+    their contracted defaults: both ship OFF (sharing is opt-in per
+    deployment; spec_k=0 disables drafting) so the features never change
+    serving behavior until explicitly enabled. Parsed from source, not
+    live state."""
+    import ast
+    src = (REPO / "paddle_tpu" / "framework" / "flags.py").read_text()
+    tree = ast.parse(src)
+    defaults_node = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.AnnAssign)
+        and getattr(node.target, "id", None) == "_FLAGS")
+    defaults = {}
+    for key, val in zip(defaults_node.keys, defaults_node.values):
+        try:
+            defaults[ast.literal_eval(key)] = ast.literal_eval(val)
+        except ValueError:
+            pass
+    assert defaults["FLAGS_decode_prefix_sharing"] is False
+    assert int(defaults["FLAGS_decode_spec_k"]) == 0
+
+
 def test_trace_merge_help_smoke():
     r = _run(REPO / "tools" / "trace_merge.py", "--help")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -626,6 +678,37 @@ def test_serving_bench_decode_smoke():
     for k in ("decode_ttft_p50_ms", "decode_ttft_p99_ms",
               "decode_tpot_p50_ms", "decode_tpot_p99_ms"):
         assert isinstance(extra[k], (int, float)), (k, extra)
+
+
+def test_serving_bench_prefix_share_smoke():
+    """The prefix-sharing A/B must keep demonstrating the PR's headline:
+    on the identical seeded shared-prefix mix and KV budget, warm-prefix
+    TTFT p99 improves >= 5x over the no-sharing baseline and goodput
+    >= 2x; speculation accepts drafts while staying token-identical to
+    greedy decode; and the chaos leg (decode/prefix/spec sites armed)
+    leaks nothing — zero leaked blocks and zero live refcounts after
+    drain. Fake clock, so this runs in a few seconds of wall time."""
+    import json
+    r = _run(REPO / "tools" / "serving_bench.py",
+             "--decode", "--prefix-share", "--smoke")
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["prefix_ok"] is True
+    results = report["results"]
+    assert results["warm_ttft_gain"] >= 5.0
+    assert results["goodput_gain"] >= 2.0
+    assert results["spec_token_identical"] is True
+    assert results["spec_parity_accept_ratio"] > 0.0
+    for leg in results["legs"]:
+        assert leg["unterminated"] == 0
+        assert leg["leaked_blocks"] == 0
+        assert leg["kv_used_after_drain"] == 0
+        assert leg["nonzero_refcounts_after_drain"] == 0
+    chaos = results["legs"][-1]
+    assert chaos["chaos"] is True and chaos["completed"] > 0
+    extra = report["extra"]
+    assert extra["prefix_warm_ttft_gain"] >= 5.0
+    assert extra["prefix_goodput_gain"] >= 2.0
 
 
 def test_serving_bench_rollout_soak_smoke():

@@ -49,6 +49,11 @@ _THROUGHPUT_KEYS = (
     # continuous-batching decode (tools/serving_bench.py --decode):
     # completed-in-deadline token throughput
     "decode_goodput_tokens_per_sec",
+    # prefix-sharing A/B (tools/serving_bench.py --decode --prefix-share):
+    # sharing-vs-baseline ratios on the identical seeded mix — a drop means
+    # the radix cache stopped paying for itself
+    "prefix_warm_ttft_gain",
+    "prefix_goodput_gain",
 )
 
 # decode latency extras (LOWER is better, ms): gated with the same wide

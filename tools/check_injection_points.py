@@ -97,7 +97,18 @@ REQUIRED = [
     # must resolve as a replay, not a loss), and fail the eviction cleanup
     # itself (decode.evict — termination must still complete)
     ("paddle_tpu/serving/decode/engine.py", "class:DecodeEngine",
-     ["join", "_prefill", "step", "_evict"]),
+     ["join", "_prefill", "step", "_evict", "_spec_round"]),
+    # prefix sharing + speculative decoding (prefix/spec PR): the chaos
+    # suite must be able to fail the radix match (prefix.lookup → cold
+    # miss), skip indexing a finished prefix (prefix.share → stays cold),
+    # fail eviction itself (prefix.evict — must still complete, like
+    # decode.evict), drop a draft pass (spec.draft → plain decode tick),
+    # and kill the replica inside the verify pass (spec.verify — must
+    # resolve as a replay that is token-identical through drafts)
+    ("paddle_tpu/serving/decode/prefix.py", "class:PrefixCache",
+     ["lookup", "share", "evict", "clear"]),
+    ("paddle_tpu/serving/decode/specdecode.py", "class:SpecDecoder",
+     ["propose"]),
     # disaggregated serving (disagg PR): the chaos suite must be able to
     # kill the prefill side of a KV handoff (kv.export), tear the wire
     # mid-transfer (kv.transfer), fail decode-side adoption (kv.adopt),
@@ -141,6 +152,9 @@ SITES = [
     "decode.join", "decode.prefill", "decode.step", "decode.evict",
     # disaggregated serving
     "kv.export", "kv.transfer", "kv.adopt", "disagg.route",
+    # prefix sharing + speculative decoding
+    "prefix.lookup", "prefix.share", "prefix.evict",
+    "spec.draft", "spec.verify",
 ]
 
 

@@ -46,10 +46,12 @@ SUBSYSTEMS = [
     "integrity",     # SDC defense (checksum consensus, replay)
     "io",            # input pipeline / data workers
     "metrics",       # the registry/exporter's own health
+    "prefix",        # prefix-sharing KV cache (serving/decode/prefix.py)
     "profiler",      # profiler-internal (samples/sec, ...)
     "rollout",       # live model rollout (serving/rollout.py)
     "serving",       # inference server
     "slo",           # SLO burn-rate accounting (serving/metrics.py)
+    "spec",          # speculative decoding (serving/decode/specdecode.py)
     "steptime",      # per-rank step-time health beacons
     "steptimer",     # phase attribution (docs/observability.md)
     "straggler",     # straggler-quarantine ratios
