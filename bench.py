@@ -770,6 +770,108 @@ def bench_lenet():
     }
 
 
+def bench_moe():
+    """Elastic expert-parallel lane (BENCH_MODEL=moe): the fault-tolerance
+    contract measured as a bench. A golden ExpertParallelEngine trains
+    uninjected; a second engine trains the same stream while losing an ep
+    rank mid-run (resize 8→7, orphan re-adoption from the expert-sharded
+    manifest, rewind to the last committed step) and taking the rank back
+    (7→8). Gate: the chaos leg's loss curve must equal the golden curve
+    EXACTLY — faults may rewind training, never change what it computes.
+    Emits steps/s of the chaos leg plus drop/adoption accounting."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.distributed.fleet.expert_parallel import (
+        ExpertParallelEngine,
+    )
+    from paddle_tpu.resilience.snapshot import AsyncCheckpointer
+
+    steps = int(os.environ.get("BENCH_STEPS", 24))
+    batch = int(os.environ.get("BENCH_BATCH", 256))
+    n_exp, d_model, ranks = 8, 16, tuple(range(8))
+    ckpt_every = max(2, steps // 6)
+    kill_at = steps // 2
+    rejoin_at = 3 * steps // 4
+
+    def data(step):
+        rng = np.random.RandomState(9000 + step)
+        return (rng.randn(batch, d_model), rng.randn(batch, d_model))
+
+    def make(ck=None):
+        return ExpertParallelEngine(n_exp, d_model, ranks, top_k=2,
+                                    capacity_factor=1.1, seed=11,
+                                    checkpointer=ck)
+
+    golden_eng = make()
+    golden = []
+    for s in range(steps):
+        x, t = data(s)
+        golden.append(golden_eng.step(x, t))
+
+    root = tempfile.mkdtemp(prefix="bench_moe_ckpt_")
+    try:
+        ck = AsyncCheckpointer(root, background=False)
+        eng = ExpertParallelEngine(n_exp, d_model, ranks, top_k=2,
+                                   capacity_factor=1.1, seed=11,
+                                   checkpointer=ck)
+        eng.save(step=0)
+        losses, step, resizes = [], 0, []
+        t0 = time.perf_counter()
+        wall_steps = 0
+        while step < steps:
+            if step == kill_at and len(eng.placement.ranks) == 8:
+                eng.drop_rank(7)
+                adopted = eng.resize(ranks[:7])
+                step = eng.restore()
+                del losses[step:]
+                resizes.append({"to": 7, "adopted": adopted,
+                                "rewound_to": step})
+                continue
+            if step == rejoin_at and len(eng.placement.ranks) == 7:
+                adopted = eng.resize(ranks)
+                resizes.append({"to": 8, "adopted": adopted})
+            x, t = data(step)
+            loss = eng.step(x, t)
+            del losses[step:]
+            losses.append(loss)
+            step += 1
+            wall_steps += 1
+            if step % ckpt_every == 0:
+                eng.save(step=step)
+        dt = time.perf_counter() - t0
+        ck.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    parity = losses == golden
+    if not parity:
+        diverged = next(i for i, (a, b) in enumerate(zip(losses, golden))
+                        if a != b)
+        raise AssertionError(
+            f"moe loss-curve parity gate FAILED: chaos leg diverged from "
+            f"the uninjected golden at step {diverged} "
+            f"({losses[diverged]} != {golden[diverged]})")
+    _LAST_CURVE["moe"] = [round(float(l), 6) for l in losses]
+    return {
+        "metric": "moe_elastic_train_steps_per_sec",
+        "value": round(wall_steps / dt, 2),
+        "unit": "steps/s",
+        "vs_baseline": None,
+        "mfu": None,
+        "precision": "f64",
+        "extra": {
+            "moe_loss_parity": parity,
+            "moe_resizes": resizes,
+            "moe_tokens_dropped_total": int(eng.tokens_dropped_total),
+            "moe_capacity_utilization": round(
+                float(eng.last_stats.get("capacity_utilization", 0.0)), 4),
+            "moe_aux_loss": round(float(eng.aux_loss), 4),
+            "moe_final_ep_degree": eng.ep_degree,
+        },
+    }
+
+
 def bench_opbench():
     """Kernel-tier lane: run the per-op microbench (tools/op_bench.py — full
     shapes on an accelerator, --smoke on CPU) and gate the artifact through
@@ -830,7 +932,8 @@ _BENCHES = {"bert": bench_bert, "resnet50": bench_resnet50,
             "ernie": lambda: bench_bert(arch="ernie"),
             "gpt1p3b": lambda: bench_gpt(slice_1p3b=True),
             "opbench": bench_opbench,
-            "compiled": bench_compiled}
+            "compiled": bench_compiled,
+            "moe": bench_moe}
 
 def _release_bench_state():
     """Free the previous bench's device state (params, fp32 masters, f32

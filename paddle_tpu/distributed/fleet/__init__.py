@@ -31,6 +31,10 @@ from .utils import recompute  # noqa: F401,E402
 from . import fs  # noqa: F401,E402  (fleet.utils.fs parity)
 from .fs import HDFSClient, LocalFS  # noqa: F401,E402
 from . import elastic  # noqa: F401,E402  (fleet.elastic parity)
+from . import expert_parallel  # noqa: F401,E402  (elastic expert-parallel)
+from .expert_parallel import (  # noqa: F401,E402
+    ExpertParallelEngine, ExpertPlacement,
+)
 from . import metrics  # noqa: F401,E402  (fleet.metrics parity)
 from . import meta_optimizers  # noqa: F401,E402
 from ..checkpoint import (  # noqa: F401,E402  (hybrid save/load parity)

@@ -36,6 +36,10 @@ class MoELayer(nn.Layer):
         self.num_experts = num_experts
         self.top_k = min(top_k, num_experts)
         self.capacity_factor = capacity_factor
+        if gate_noise < 0:
+            from ..framework.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"gate_noise must be >= 0, got {gate_noise}")
         self.gate_noise = gate_noise
         self.expert_axis = expert_axis  # mesh axis name for expert sharding
         self.gate = nn.Linear(d_model, num_experts, bias_attr=False)
@@ -65,6 +69,20 @@ class MoELayer(nn.Layer):
                               * self.capacity_factor))
 
         logits = self.gate(xf)                       # (N, E)
+        if self.gate_noise > 0 and self.training:
+            # GShard-style jittered gating: seeded through the global
+            # generator (paddle.seed reproducible, consumed per step like
+            # dropout) and OFF in eval mode so inference routing is
+            # deterministic.
+            from ..core.random import next_key_data
+            kd = next_key_data()
+            scale = float(self.gate_noise)
+
+            def jitter(lg, key_data):
+                key = jax.random.wrap_key_data(key_data)
+                return lg + scale * jax.random.normal(key, lg.shape,
+                                                      lg.dtype)
+            logits = apply(jitter, logits, kd, name="moe_gate_noise")
         probs = nn.functional.softmax(logits, axis=-1)
 
         # load-balancing auxiliary loss (GShard eq.4): E * sum_e f_e * p_e

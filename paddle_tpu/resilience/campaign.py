@@ -356,6 +356,32 @@ class TrainingScenario(Scenario):
         for m in mgrs:
             _exercise(m.heartbeat, typed_log, "store.heartbeat")
 
+    def _moe(self, journal, typed_log, workdir):
+        """Expert-parallel episode segment: a tiny ExpertParallelEngine
+        runs fenced dispatch/combine steps, commits an expert-sharded
+        checkpoint, then loses a rank and resizes — evaluating
+        moe.dispatch / moe.combine / moe.resize while the schedule is
+        armed. Returns the engine so the post-disarm drain can replay any
+        resize the chaos killed mid-flight (the journal-consistency
+        invariant requires every moe_resize_started to reach a terminal
+        record)."""
+        from ..distributed.fleet.expert_parallel import ExpertParallelEngine
+        from .snapshot import AsyncCheckpointer
+
+        ck = AsyncCheckpointer(os.path.join(workdir, "moe_ckpt"),
+                               background=False, journal=journal)
+        eng = ExpertParallelEngine(4, 4, (0, 1), top_k=2, seed=7,
+                                   checkpointer=ck, journal=journal)
+        rng = np.random.RandomState(77)
+        x = rng.randn(12, 4).astype(np.float64)
+        t = rng.randn(12, 4).astype(np.float64)
+        _exercise(lambda: eng.step(x, t), typed_log, "moe.step")
+        _exercise(lambda: eng.save(step=1) and None, typed_log, "moe.save")
+        _exercise(lambda: (eng.drop_rank(1), eng.resize((0,))) and None,
+                  typed_log, "moe.resize")
+        _exercise(lambda: eng.step(x, t), typed_log, "moe.step")
+        return eng, ck
+
     def run(self, workdir, arm):
         from ..distributed.fleet.elastic import ElasticManager, FileStore
         from .health import Quarantined
@@ -428,6 +454,7 @@ class TrainingScenario(Scenario):
         active = set(ranks)
         arm()
         self._ancillary(clock, store, mgrs.values(), typed_log, workdir)
+        moe_eng, moe_ck = self._moe(journal, typed_log, workdir)
 
         step, losses = 0, []
         restart_failures = 0
@@ -498,6 +525,13 @@ class TrainingScenario(Scenario):
                   "controlled-restart")
 
         self._disarm(info)
+        # fault-free drain of the MoE segment: a resize the chaos killed
+        # mid-flight is replayed from its moe_resize_started journal
+        # record (the restart contract) so every resize reaches a
+        # terminal record before the journal-consistency check
+        _exercise(lambda: moe_eng.replay_pending_resizes() and None,
+                  typed_log, "moe.replay")
+        moe_ck.close()
         from .integrity import checksum_state
         info["outcome"] = outcome
         info["final_digest"] = checksum_state([models[0], opts[0]]) \
@@ -706,6 +740,7 @@ class ServingScenario(Scenario):
 _MIGRATION_TERMINAL = {"migration_release", "migration_aborted",
                        "migration_refused"}
 _ROLLOUT_TERMINAL = {"rollout_completed", "rollout_rolled_back"}
+_MOE_RESIZE_TERMINAL = {"moe_resize_completed", "moe_resize_aborted"}
 
 
 def check_invariants(info, golden=None):
@@ -737,6 +772,7 @@ def check_invariants(info, golden=None):
     journal = info.get("journal", ())
     exports, terminal = set(), set()
     rollout_started = rollout_terminal = 0
+    moe_started, moe_terminal = set(), set()
     for e in journal:
         ev = e.get("event", "")
         if ev == "migration_export":
@@ -747,6 +783,10 @@ def check_invariants(info, golden=None):
             rollout_started += 1
         elif ev in _ROLLOUT_TERMINAL:
             rollout_terminal += 1
+        elif ev == "moe_resize_started":
+            moe_started.add(e.get("resize"))
+        elif ev in _MOE_RESIZE_TERMINAL:
+            moe_terminal.add(e.get("resize"))
     for sid in sorted(exports - terminal, key=str):
         _fail("journal-consistency",
               f"migration_export for stream {sid} has no terminal record")
@@ -754,6 +794,11 @@ def check_invariants(info, golden=None):
         _fail("journal-consistency",
               f"{rollout_started - rollout_terminal} rollout_started "
               "record(s) never reached a terminal record")
+    for rid in sorted(moe_started - moe_terminal, key=str):
+        _fail("journal-consistency",
+              f"moe_resize_started {rid} never reached a terminal record "
+              "(completed/aborted) — a mid-resize death must be replayed "
+              "on restart")
 
     if info.get("deadlock"):
         _fail("bounded-progress",

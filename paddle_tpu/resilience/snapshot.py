@@ -472,9 +472,15 @@ class AsyncCheckpointer:
     def save(self, files, step=None, meta=None, blocking=False):
         """Snapshot + enqueue one checkpoint.
 
-        ``files`` maps relpath (under root) → payload or (payload, kind);
-        kind defaults from the extension (``.pdparams`` → model, ``.pdopt``
-        → optimizer, ``.pdstate`` → train_state). The device→host copy
+        ``files`` maps relpath (under root) → payload, (payload, kind) or
+        (payload, kind, info); kind defaults from the extension
+        (``.pdparams`` → model, ``.pdopt`` → optimizer, ``.pdstate`` →
+        train_state). ``info`` is an optional JSON-serializable dict merged
+        into that file's manifest entry (the expert-parallel engine records
+        ``expert_ids``/``ep_degree`` per ``expert_shard`` file this way, so
+        restore-across-resize can index files without loading them); the
+        reserved ``sha256``/``bytes``/``kind`` keys stay authoritative.
+        The device→host copy
         happens HERE (fault site ``ckpt.snapshot``, metric
         ``ckpt.snapshot_ms``); with ``blocking=True`` (the sync fallback)
         the commit also runs inline and raises on failure. Returns the
@@ -488,9 +494,16 @@ class AsyncCheckpointer:
         maybe_inject("ckpt.snapshot", CheckpointCommitError)
         job_files = []
         for rel, val in files.items():
-            payload, kind = val if isinstance(val, tuple) \
-                else (val, _kind_of(rel))
-            job_files.append((rel, host_snapshot(payload), kind))
+            info = None
+            if isinstance(val, tuple):
+                if len(val) == 3:
+                    payload, kind, info = val
+                else:
+                    payload, kind = val
+            else:
+                payload, kind = val, _kind_of(rel)
+            job_files.append((rel, host_snapshot(payload), kind,
+                              dict(info) if info else None))
         with self._cv:
             self._seq += 1
             seq = self._seq
@@ -574,13 +587,15 @@ class AsyncCheckpointer:
         try:
             entries = {}
             aliases = []
-            for rel, payload, kind in job["files"]:
+            for rel, payload, kind, info in job["files"]:
                 maybe_inject("ckpt.commit", CheckpointCommitError)
                 prel = f"{_data_dir(seq)}/{rel}"
                 digest, nbytes = serialize_file(
                     payload, os.path.join(self.root, prel))
-                entries[prel] = {"sha256": digest, "bytes": nbytes,
-                                 "kind": kind}
+                entry = dict(info or {})
+                entry.update({"sha256": digest, "bytes": nbytes,
+                              "kind": kind})
+                entries[prel] = entry
                 aliases.append((prel, rel))
             maybe_inject("ckpt.commit", CheckpointCommitError)
             man = {"version": 1, "seq": seq, "step": job["step"],

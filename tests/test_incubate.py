@@ -48,6 +48,46 @@ class TestMoE:
         outs = [np.asarray(fwd(x)._val) for _ in range(4)]
         np.testing.assert_allclose(outs[2], outs[3], rtol=1e-5)
 
+    def test_gate_noise_rejects_negative(self):
+        from paddle_tpu.framework.errors import InvalidArgumentError
+        with pytest.raises(InvalidArgumentError):
+            paddle.incubate.MoELayer(16, 32, 4, gate_noise=-0.1)
+
+    def test_gate_noise_perturbs_training_and_is_seeded(self):
+        """Regression: gate_noise used to be stored and never applied. In
+        train mode it must jitter the routing (consecutive forwards draw
+        fresh noise → different outputs) yet stay reproducible from
+        paddle.seed like dropout."""
+        paddle.seed(0)
+        moe = paddle.incubate.MoELayer(d_model=16, d_hidden=32,
+                                       num_experts=4, top_k=1,
+                                       capacity_factor=0.5, gate_noise=4.0)
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(64, 16).astype("float32"))
+        paddle.seed(42)
+        a = np.asarray(moe(x)._val)
+        b = np.asarray(moe(x)._val)  # second draw from the stream
+        assert not np.allclose(a, b)
+        paddle.seed(42)
+        a2 = np.asarray(moe(x)._val)
+        np.testing.assert_array_equal(a, a2)
+
+    def test_gate_noise_off_in_eval(self):
+        paddle.seed(0)
+        moe = paddle.incubate.MoELayer(d_model=16, d_hidden=32,
+                                       num_experts=4, top_k=1,
+                                       capacity_factor=0.5, gate_noise=4.0)
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(64, 16).astype("float32"))
+        moe.eval()
+        e1 = np.asarray(moe(x)._val)
+        e2 = np.asarray(moe(x)._val)
+        np.testing.assert_array_equal(e1, e2)  # no stream consumed
+        # eval routing matches an explicitly noise-free layer
+        moe.gate_noise = 0.0
+        moe.train()
+        np.testing.assert_array_equal(e1, np.asarray(moe(x)._val))
+
 
 class TestGlobalScatter:
     def test_scatter_gather_roundtrip(self):

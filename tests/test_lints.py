@@ -804,6 +804,17 @@ def test_chaos_campaign_smoke_gate():
     assert report["violations_total"] == 0, report["artifact_bundles"]
     cov = report["coverage"]
     assert cov["ratio"] >= 0.9, cov["uncovered_sites"]
+    # the expert-parallel sites are in the sampled manifest AND the ≥90%
+    # bar holds with them present: the TrainingScenario MoE segment must
+    # keep evaluating them, not dilute coverage by merely registering them
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from check_injection_points import known_sites
+    finally:
+        sys.path.pop(0)
+    moe_sites = {"moe.dispatch", "moe.combine", "moe.resize"}
+    assert moe_sites <= set(known_sites())
+    assert not moe_sites & set(cov["uncovered_sites"]), cov
     # both scenarios actually ran
     assert {e["scenario"] for e in report["episodes"]} == {"training",
                                                            "serving"}

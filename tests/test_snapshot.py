@@ -140,6 +140,26 @@ class TestCommitBoundaries:
         assert list_manifests(root) == []
         assert os.listdir(root) == []  # nothing staged, nothing torn
 
+    def test_per_file_extra_info_lands_in_manifest(self, tmp_path):
+        """(payload, kind, info) file values: the info dict merges into the
+        manifest entry next to sha256/bytes/kind (what expert-sharded
+        checkpoints use to record expert_ids/ep_degree per file) and the
+        reserved integrity keys always win over the caller's dict."""
+        ck = AsyncCheckpointer(str(tmp_path / "ck"), background=False)
+        mp = ck.save({"a.pdexpert": (_payload(1.0), "expert_shard",
+                                     {"expert_ids": [0, 4], "ep_degree": 4,
+                                      "kind": "spoofed"}),
+                      "b.pdparams": (_payload(2.0), "model")},
+                     step=1, blocking=True)
+        files = verify_manifest(mp)["files"]
+        by_name = {os.path.basename(rel): fi for rel, fi in files.items()}
+        a = by_name["a.pdexpert"]
+        assert a["expert_ids"] == [0, 4]
+        assert a["ep_degree"] == 4
+        assert a["kind"] == "expert_shard"  # reserved key not spoofable
+        assert a["sha256"] and a["bytes"] > 0
+        assert "expert_ids" not in by_name["b.pdparams"]
+
 
 # -- async semantics: errors surface via flush, never raise -------------------
 

@@ -118,6 +118,14 @@ REQUIRED = [
      ["export", "transfer", "adopt"]),
     ("paddle_tpu/serving/disagg.py", "class:DisaggController",
      ["route"]),
+    # elastic expert parallelism (MoE PR): the chaos suite must be able to
+    # fail the token dispatch (moe.dispatch) and combine (moe.combine)
+    # exchanges — both must land typed, never as silent token loss — and
+    # kill a placement resize in flight (moe.resize — the journal's
+    # moe_resize_started record must replay on restart)
+    ("paddle_tpu/distributed/fleet/expert_parallel.py",
+     "class:ExpertParallelEngine",
+     ["dispatch", "combine", "resize"]),
 ]
 
 # Every injection-site *name* in the tree — the single source of truth the
@@ -155,6 +163,8 @@ SITES = [
     # prefix sharing + speculative decoding
     "prefix.lookup", "prefix.share", "prefix.evict",
     "spec.draft", "spec.verify",
+    # elastic expert parallelism
+    "moe.dispatch", "moe.combine", "moe.resize",
 ]
 
 

@@ -46,6 +46,7 @@ SUBSYSTEMS = [
     "integrity",     # SDC defense (checksum consensus, replay)
     "io",            # input pipeline / data workers
     "metrics",       # the registry/exporter's own health
+    "moe",           # elastic expert parallelism (fleet/expert_parallel.py)
     "prefix",        # prefix-sharing KV cache (serving/decode/prefix.py)
     "profiler",      # profiler-internal (samples/sec, ...)
     "rollout",       # live model rollout (serving/rollout.py)

@@ -108,6 +108,26 @@ def _inspect_manifest(root, mpath, verify=True):
                ts=man.get("ts"), file_count=len(files),
                total_bytes=sum(int(i.get("bytes") or 0)
                                for i in files.values()))
+    # per-file kinds + expert-shard placement: restore-across-resize
+    # debugging needs "which manifest holds expert 7, at what ep degree"
+    # answerable without unpickling anything
+    kinds = {}
+    shards = []
+    for rel, info in sorted(files.items()):
+        kind = info.get("kind") or "?"
+        kinds[kind] = kinds.get(kind, 0) + 1
+        if kind == "expert_shard":
+            shards.append({
+                "file": rel,
+                "expert_ids": list(info.get("expert_ids") or []),
+                "ep_degree": info.get("ep_degree"),
+                "ep_rank": info.get("ep_rank")})
+    rec["kinds"] = kinds
+    if shards:
+        rec["expert_shards"] = shards
+        rec["ep_degree"] = next(
+            (s["ep_degree"] for s in shards
+             if s["ep_degree"] is not None), None)
     for rel, info in sorted(files.items()):
         fp = os.path.join(root, rel)
         if not os.path.exists(fp):
@@ -182,11 +202,16 @@ def main(argv=None):
             return 1
         for r in reports:
             if "seq" in r:
+                kinds = ",".join(f"{k}x{n}" for k, n in
+                                 sorted((r.get("kinds") or {}).items()))
                 head = (f"{r['manifest']}  step={r['step']} "
                         f"gen={r.get('generation') or '-'} "
                         f"tag={r.get('tag') or '-'} "
-                        f"files={r['file_count']} "
+                        f"files={r['file_count']}"
+                        f"{'[' + kinds + ']' if kinds else ''} "
                         f"size={_fmt_bytes(r['total_bytes'])}")
+                if r.get("ep_degree") is not None:
+                    head += f" ep={r['ep_degree']}"
             else:
                 head = r["manifest"]
             mark = "OK " if not r["problems"] else \
@@ -194,6 +219,10 @@ def main(argv=None):
             if r.get("pinned"):
                 head += "  PIN"
             print(f"  {mark:4s}{head}")
+            for s in r.get("expert_shards", ()):
+                ids = ",".join(str(i) for i in s["expert_ids"])
+                print(f"        shard {s['file']}: rank={s['ep_rank']} "
+                      f"ep_degree={s['ep_degree']} experts=[{ids}]")
             for p in r["problems"]:
                 print(f"        - {p}")
         if pick:
