@@ -112,6 +112,7 @@ _LAST_DISTINCT = {}  # model-name -> number of DISTINCT batches in the run
 _LAST_BREAKDOWN = {}  # model-name -> step_breakdown block (phase attribution)
 _LAST_CKPT_STALL = {}  # ckpt_stall_ms block (zero-stall checkpointing)
 _LAST_COMPILED = {}  # compiled_speedup block (whole-step compilation)
+_LAST_LANES = {}  # lane_speedup / reducer_overlap blocks (compiled lanes)
 
 
 def _bench_compiled_speedup():
@@ -223,6 +224,277 @@ def _bench_compiled_speedup():
                 round(compiled_s / steps, 5)
     finally:
         paddle.set_flags(old)
+
+
+def _bench_lane_speedup():
+    """Compiled-lanes evidence (BENCH_MODEL=lanes): each hand-wired
+    MULTICHIP lane timed through its eager oracle and through its compiled
+    program on the 8-device virtual mesh, recorded as
+    ``extra.lane_speedup[lane] = eager_s / compiled_s`` and held to
+    absolute per-lane floors by tools/check_bench_regression.py. The
+    compiled legs double as the lane parity gates
+    (tests/test_compiled_lanes.py holds the same contract per-commit): pp
+    losses within rtol 1e-5 of the eager run, MoE losses BITWISE identical
+    (routing math never enters the traced region), and every compiled
+    timed window runs under the raise-mode trace sanitizer so a
+    steady-state retrace fails the bench at the violating call.
+
+    ``extra.reducer_overlap`` measures the bucketed async allreduce's
+    overlap window: how many buckets were genuinely in flight when
+    finalize entered (the structural proof that issue-at-hook/
+    drain-at-boundary is what runs — every bucket should have fired
+    before the backward boundary), plus per-backward wall time with the
+    fused collective blocked at the hook (strawman sync reducer) vs the
+    shipped deferred drain. On this single-process lane the collective
+    itself is a no-op, so the wall delta is scheduling noise and is
+    recorded as context only — the in-flight counter is the evidence, and
+    the wall numbers become meaningful on a multi-host run where the
+    fused DCN collective has real latency to hide."""
+    import contextlib
+    import time as _time
+
+    import jax as _jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.analysis import tracesan
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.jit.compiled_step import compile_stats, \
+        reset_compile_stats
+
+    ndev = len(_jax.devices())
+    if ndev < 8:
+        raise RuntimeError(
+            "BENCH_MODEL=lanes needs 8 devices (run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8); found {ndev}")
+    steps = max(2, int(os.environ.get("BENCH_LANE_STEPS", 6)))
+    speed = _LAST_LANES.setdefault("lane_speedup", {})
+
+    def record(lane, eager_s, compiled_s, n=None):
+        n = n or steps
+        speed[lane] = round(eager_s / compiled_s, 3) if compiled_s else 0.0
+        _LAST_LANES.setdefault("lane_step_s", {})[lane] = \
+            round(compiled_s / n, 5)
+
+    def sanitized(compiled):
+        return tracesan.tracking(mode="raise") if compiled \
+            else contextlib.nullcontext()
+
+    def assert_no_retrace(lane):
+        stats = compile_stats()
+        if stats["compiles"] != 0:
+            raise RuntimeError(
+                f"lane {lane}: steady-state trace contract violated in the "
+                f"timed window: {stats}")
+
+    # --- pp: 1F1B over per-stage compiled programs vs the eager engine ---
+    def pp_leg(compiled):
+        paddle.set_flags({"FLAGS_compiled_step": bool(compiled)})
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.base import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {**strategy.hybrid_configs,
+                                   "dp_degree": 4, "pp_degree": 2}
+        fleet._fleet._is_initialized = False
+        fleet.init(is_collective=True, strategy=strategy)
+        strategy.pipeline_configs = {"accumulate_steps": 4}
+        paddle.seed(21)
+        dim, vocab = 16, 32
+        block = lambda: nn.Sequential(nn.Linear(dim, dim), nn.Tanh())
+        model = PipelineLayer(
+            [nn.Embedding(vocab, dim), block(), block(),
+             nn.Linear(dim, vocab)], num_stages=2,
+            loss_fn=lambda o, y: F.cross_entropy(o, y))
+        dist = fleet.distributed_model(model)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        rng = np.random.RandomState(13)
+
+        def batch():
+            x = paddle.to_tensor(
+                rng.randint(0, vocab, (16, 6)).astype("int32"))
+            y = paddle.to_tensor(
+                rng.randint(0, vocab, (16, 6)).astype("int64"))
+            return float(dist.train_batch((x, y), opt).item())
+
+        losses = [batch()]  # warm-up: every stage program traces here
+        if compiled:
+            reset_compile_stats()
+        t0 = _time.perf_counter()
+        with sanitized(compiled):
+            for _ in range(steps):
+                losses.append(batch())
+        dt = _time.perf_counter() - t0
+        if compiled:
+            assert_no_retrace("pp")
+        return dt, losses
+
+    eager_s, eager_l = pp_leg(False)
+    _release_bench_state()
+    compiled_s, compiled_l = pp_leg(True)
+    if not np.allclose(compiled_l, eager_l, rtol=1e-5):
+        raise AssertionError(
+            f"pp lane parity gate FAILED: compiled losses {compiled_l} != "
+            f"eager losses {eager_l}")
+    record("pp", eager_s, compiled_s)
+    _release_bench_state()
+
+    # --- ring-SP: cached jit(shard_map) program vs per-call eager ---
+    from paddle_tpu.distributed.fleet.sequence_parallel import ring_attention
+    build_mesh({"sep": ndev})
+    rng = np.random.RandomState(1)
+    q, k, v = [paddle.to_tensor(
+        rng.randn(2, ndev * 8, 2, 16).astype("float32") * 0.5)
+        for _ in range(3)]
+
+    ring_steps = steps * 4  # ~3 ms/call: widen the window past timer noise
+
+    def ring_leg(compiled):
+        out = ring_attention(q, k, v, is_causal=True, compiled=compiled)
+        np.asarray(out._val)  # warm + sync
+        if compiled:
+            reset_compile_stats()
+        t0 = _time.perf_counter()
+        with sanitized(compiled):
+            for _ in range(ring_steps):
+                out = ring_attention(q, k, v, is_causal=True,
+                                     compiled=compiled)
+        res = np.asarray(out._val)  # sync
+        dt = _time.perf_counter() - t0
+        if compiled:
+            assert_no_retrace("ring_sp")
+        return dt, res
+
+    eager_s, eager_out = ring_leg(False)
+    compiled_s, compiled_out = ring_leg(True)
+    np.testing.assert_allclose(compiled_out, eager_out, rtol=1e-5,
+                               atol=1e-6,
+                               err_msg="ring_sp lane parity gate FAILED")
+    record("ring_sp", eager_s, compiled_s, ring_steps)
+    build_mesh()
+    _release_bench_state()
+
+    # --- MoE ep: dispatch/combine exchange through CompiledTrainStep ---
+    from paddle_tpu.distributed.fleet.expert_parallel import (
+        ExpertParallelEngine,
+    )
+
+    def moe_data(s):
+        r = np.random.RandomState(500 + s)
+        return r.randn(64, 16), r.randn(64, 16)
+
+    moe_steps = steps * 16  # ~1 ms/step: widen the window past timer noise
+    moe_batches = [moe_data(1 + s) for s in range(moe_steps)]
+
+    def moe_leg(compiled):
+        eng = ExpertParallelEngine(8, 16, tuple(range(8)), top_k=2,
+                                   capacity_factor=1.1, seed=11,
+                                   compiled=compiled)
+        eng.step(*moe_data(0))  # warm: the exchange program traces here
+        if compiled:
+            reset_compile_stats()
+        losses = []
+        t0 = _time.perf_counter()
+        with sanitized(compiled):
+            for xb, tb in moe_batches:
+                losses.append(eng.step(xb, tb))
+        dt = _time.perf_counter() - t0
+        if compiled:
+            assert_no_retrace("moe")
+        return dt, losses
+
+    eager_s, eager_l = moe_leg(False)
+    compiled_s, compiled_l = moe_leg(True)
+    if compiled_l != eager_l:  # exact, not approx: routing stays host-side
+        raise AssertionError(
+            f"moe lane BITWISE parity gate FAILED: {compiled_l} != "
+            f"{eager_l}")
+    record("moe", eager_s, compiled_s, moe_steps)
+    _release_bench_state()
+
+    # --- reducer: issue-at-hook/drain-at-finalize vs block-at-hook ---
+    from paddle_tpu.distributed.reducer import Reducer
+    paddle.seed(3)
+    layers = []
+    for _ in range(6):
+        layers += [nn.Linear(256, 256), nn.Tanh()]
+    model = nn.Sequential(*layers)
+    params = list(model.parameters())
+    rng = np.random.RandomState(5)
+    x = paddle.to_tensor(rng.randn(32, 256).astype("float32"))
+
+    def backward_once():
+        for p in params:
+            p.clear_grad()
+        out = model(x)
+        (out * out).mean().backward()
+
+    def overlap_leg(sync):
+        red = Reducer(params, comm_buffer_size=1)
+        orig_flush, orig_fin = Reducer._flush, Reducer.finalize
+        inflight = []
+
+        def blocking_flush(self, b, firing, firing_grad):
+            r = orig_flush(self, b, firing, firing_grad)
+            # strawman sync reducer: block on the fused result right at
+            # the hook, so nothing overlaps the rest of backward
+            np.asarray(self._pending[-1][1]._val)
+            return r
+
+        def counting_finalize(self):
+            inflight.append(len(self._pending))
+            return orig_fin(self)
+
+        if sync:
+            Reducer._flush = blocking_flush
+        Reducer.finalize = counting_finalize
+        try:
+            backward_once()  # warm the op-executable caches
+            t0 = _time.perf_counter()
+            for _ in range(steps):
+                backward_once()
+            dt = _time.perf_counter() - t0
+        finally:
+            Reducer._flush, Reducer.finalize = orig_flush, orig_fin
+            red.detach()
+        return dt, max(inflight), len(red.buckets)
+
+    sync_s, _, _ = overlap_leg(sync=True)
+    async_s, inflight, nbuckets = overlap_leg(sync=False)
+    _LAST_LANES["reducer_overlap"] = {
+        "buckets_in_flight_at_finalize": inflight,
+        "buckets_total": nbuckets,
+        "hook_blocking_backward_s": round(sync_s / steps, 5),
+        "async_backward_s": round(async_s / steps, 5),
+    }
+    if inflight < 1:
+        raise AssertionError(
+            "reducer overlap contract FAILED: no fused bucket was in "
+            "flight at the backward boundary — the hook is not issuing "
+            "collectives ahead of finalize")
+
+
+def bench_lanes():
+    """Standalone driver for the compiled-lanes evidence (BENCH_MODEL=
+    lanes): pp/ring-SP/MoE eager-vs-compiled ratios plus the bucketed
+    reducer's overlap window, reporting the worst lane's ratio as the
+    headline value (the per-lane absolute floors apply in
+    tools/check_bench_regression.py)."""
+    import paddle_tpu as paddle
+    old_flags = paddle.get_flags(["FLAGS_compiled_step"])
+    try:
+        _bench_lane_speedup()
+    finally:
+        paddle.set_flags(old_flags)
+        from paddle_tpu.distributed.mesh import build_mesh
+        build_mesh()
+    ratios = _LAST_LANES.get("lane_speedup", {})
+    val = min(ratios.values()) if ratios else 0.0
+    return {"metric": "lane_speedup_min", "value": round(val, 3),
+            "unit": "x", "vs_baseline": round(val, 3), "mfu": 0.0,
+            "precision": "float32"}
 
 
 def _bench_ckpt_stall(model, opt):
@@ -933,6 +1205,7 @@ _BENCHES = {"bert": bench_bert, "resnet50": bench_resnet50,
             "gpt1p3b": lambda: bench_gpt(slice_1p3b=True),
             "opbench": bench_opbench,
             "compiled": bench_compiled,
+            "lanes": bench_lanes,
             "moe": bench_moe}
 
 def _release_bench_state():
@@ -1101,6 +1374,11 @@ def main():
         # eager-vs-compiled steps/s ratio per toy LM lane (whole-step
         # compilation) — gated higher-is-better (>= 1.15x floor)
         result.setdefault("extra", {}).update(_LAST_COMPILED)
+    if _LAST_LANES:
+        # eager-vs-compiled ratio per MULTICHIP lane (pp 1F1B / ring-SP /
+        # MoE exchange) plus the bucketed reducer's overlap window — the
+        # lane ratios are held to per-lane absolute floors
+        result.setdefault("extra", {}).update(_LAST_LANES)
     if _LAST_CURVE and os.environ.get("BENCH_LOSS_CURVES", "1") != "0":
         # loss-curve evidence (BASELINE "loss parity"; precision-regime
         # parity is asserted in tests/test_loss_parity.py — these are the

@@ -54,11 +54,12 @@ class _TrackedLock:
     rest (``locked``, ...).
     """
 
-    def __init__(self, inner, tracker, name, reentrant):
+    def __init__(self, inner, tracker, name, reentrant, uid):
         self._inner = inner
         self._tracker = tracker
         self._name = name
         self._reentrant = reentrant
+        self._uid = uid
 
     # -- core protocol ---------------------------------------------------------
     def acquire(self, blocking=True, timeout=-1):
@@ -120,8 +121,12 @@ class Tracker:
         self.violations = []          # LockOrderViolation instances
         self._tls = threading.local()
         self._graph_lock = _real_lock()
-        self._edges = {}              # id(lock) -> set(id(lock))
-        self._names = {}              # id(lock) -> display name
+        # keyed by the wrapper's _uid, NOT id(): the tracker holds no
+        # reference to wrappers, so a GC'd lock's address can be reused by
+        # a later one — id keys would splice the dead lock's edges onto
+        # the new tenant and report phantom cycles.
+        self._edges = {}              # uid -> set(uid)
+        self._names = {}              # uid -> display name
         self._counter = 0
 
     # -- factory side ----------------------------------------------------------
@@ -130,9 +135,9 @@ class Tracker:
         self._counter += 1
         kind = "RLock" if reentrant else "Lock"
         name = f"{kind}#{self._counter}@{caller}"
-        lk = _TrackedLock(inner, self, name, reentrant)
+        lk = _TrackedLock(inner, self, name, reentrant, self._counter)
         with self._graph_lock:
-            self._names[id(lk)] = name
+            self._names[lk._uid] = name
         return lk
 
     # -- hold bookkeeping ------------------------------------------------------
@@ -146,9 +151,9 @@ class Tracker:
         held = self._held()
         if any(h is lk for h in held):
             return  # RLock re-entry: no new ordering information
-        me = id(lk)
+        me = lk._uid
         with self._graph_lock:
-            new_edges = [(id(h), me) for h in held]
+            new_edges = [(h._uid, me) for h in held]
             for a, b in new_edges:
                 self._edges.setdefault(a, set()).add(b)
             cycle = self._find_cycle(me) if new_edges else None

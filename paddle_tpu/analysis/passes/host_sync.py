@@ -38,6 +38,10 @@ _WAIVE = "sync-ok"
 SEEDED = [
     ("paddle_tpu/jit/compiled_step.py", "CompiledTrainStep.__call__"),
     ("paddle_tpu/jit/compiled_step.py", "CompiledTrainStep.run_steps"),
+    ("paddle_tpu/jit/compiled_step.py", "CompiledStageProgram.__call__"),
+    ("paddle_tpu/distributed/reducer.py", "Reducer._flush"),
+    ("paddle_tpu/distributed/fleet/pipeline_engine.py",
+     "PipelineEngine._to_stage"),
     ("paddle_tpu/serving/decode/compiled_decode.py",
      "CompiledDecodeStep.run"),
     ("paddle_tpu/serving/decode/engine.py", "DecodeEngine.step"),

@@ -9,7 +9,9 @@ lane only catches the aggregate. This sanitizer turns each violation into
 a deterministic failure at the exact call:
 
 - **steady-state retrace** — while enabled, every compile that goes
-  through a :class:`~paddle_tpu.jit.compiled_step.CompiledTrainStep` or
+  through a :class:`~paddle_tpu.jit.compiled_step.CompiledTrainStep`, a
+  :class:`~paddle_tpu.jit.compiled_step.CompiledStageProgram` (pipeline
+  stage / ring-attention lane programs), or a
   :class:`~paddle_tpu.serving.decode.compiled_decode.CompiledDecodeStep`
   is counted per ``(step object, signature)``. A second compile for the
   same signature — cache eviction churn, an unhashable static arg that
@@ -145,7 +147,7 @@ def enable(mode="record"):
     # imports are deferred so this module stays loadable under the
     # tools/lint.py alias loader (no jax in the linter process)
     from ..core.tensor import Tensor
-    from ..jit.compiled_step import CompiledTrainStep
+    from ..jit.compiled_step import CompiledStageProgram, CompiledTrainStep
     from ..jit.to_static import StaticFunction
     from ..serving.decode.compiled_decode import CompiledDecodeStep
 
@@ -198,6 +200,15 @@ def enable(mode="record"):
             return orig_decode_guard(self, key)
 
         patch(CompiledDecodeStep, "_guard_retrace", decode_guard)
+
+        orig_stage_note = CompiledStageProgram._note_stage_compile
+
+        def stage_note(self, key):
+            # called exactly once per new signature, before the jit build
+            san._note_compile(id(self), getattr(self, "_label", "stage"), key)
+            return orig_stage_note(self, key)
+
+        patch(CompiledStageProgram, "_note_stage_compile", stage_note)
 
         for meth in ("numpy", "item", "tolist", "__array__"):
             orig = Tensor.__dict__[meth]

@@ -9,6 +9,11 @@ reference also ships a static per-op latency table
 (static_op_benchmark.json); here the equivalent table is measured on first
 use and cached in-process (this environment publishes no vendored numbers —
 see BASELINE.md).
+
+Lane-level calibration lives beside the per-op model:
+``calibration.json`` + :func:`load_calibration` carry the measured per-lane
+step times and compiled-vs-eager ratios from the bench lanes (the
+parallelism planner's real inputs — ROADMAP item 4).
 """
 from __future__ import annotations
 
@@ -16,7 +21,12 @@ import time
 
 import numpy as np
 
-__all__ = ["CostModel", "CostData"]
+from .calibration import (  # noqa: F401
+    CALIBRATION_PATH, Calibration, LaneCost, load_calibration,
+)
+
+__all__ = ["CostModel", "CostData", "Calibration", "LaneCost",
+           "load_calibration", "CALIBRATION_PATH"]
 
 
 class CostData:

@@ -5,16 +5,28 @@ schedule: warmup forwards, steady-state 1F1B interleave, cooldown backwards)
 over pp_utils/p2p_communication.py:216 send_v2/recv_v2 NCCL p2p.
 
 TPU-native redesign (single controller, no per-stage process):
-  - each stage is a contiguous segment of a PipelineLayer, compiled to XLA
-    programs (forward; recompute-vjp backward — megatron-style full
-    recompute, so no activation tensors cross the jit boundary),
+  - each stage is a contiguous segment of a PipelineLayer, compiled to ONE
+    donated XLA program per direction through
+    :class:`~paddle_tpu.jit.compiled_step.CompiledStageProgram` (forward;
+    recompute-vjp backward — megatron-style full recompute, so no activation
+    tensors cross the jit boundary; the backward donates its stashed
+    activation, whose buffer XLA reuses for the outgoing cotangent). The
+    wrapper gives stage programs the same compile lifecycle as the
+    whole-step lane: steady state is all cache hits, builds run under
+    ``step/compile``, and the trace sanitizer hard-fails retraces.
+    ``compiled=False`` keeps the stages as plain eager closures — the
+    debug/parity oracle the compiled schedule is asserted against,
   - non-trainable state (BatchNorm running stats) is functionalized: buffer
     values are explicit stage inputs/outputs threaded microbatch-to-
     microbatch and written back after the batch,
   - stage s's parameters live on the sub-mesh obtained by fixing the 'pipe'
     axis coordinate to s (keeping any tensor-parallel sharding_spec on the
     remaining axes); activations are device_put between consecutive
-    sub-meshes (the ICI p2p transfer ≈ send_v2/recv_v2),
+    sub-meshes (the ICI p2p transfer ≈ send_v2/recv_v2), with placement
+    derived from the lane ``SpecLayout`` and every transfer fenced on the
+    recovery generation — a re-rendezvous mid-batch fails typed
+    (StaleGeneration) instead of shipping a pre-recovery activation into a
+    post-recovery compiled region,
   - the host issues (stage, microbatch, fwd|bwd) units in 1F1B order; JAX's
     async dispatch overlaps units that run on disjoint sub-meshes, which is
     exactly the pipeline overlap the reference gets from per-process NCCL,
@@ -125,28 +137,52 @@ class _Stage:
             for t, v in zip(tensors, saved):
                 t._val = v
 
-    def compile(self):
+    def compile(self, idx=0, compiled=True, donate_act=False):
+        """Build this stage's programs. `compiled=True` wraps each direction
+        in one signature-keyed :class:`CompiledStageProgram` (donated,
+        compile-counted, sanitizer-visible); `compiled=False` keeps plain
+        eager closures — the parity oracle. `donate_act` donates the stashed
+        activation into the backward program (its buffer is reused for the
+        same-shaped outgoing cotangent); the engine only enables it when it
+        owns that buffer."""
         run = self._run
         if self.is_last:
-            self._fwd = jax.jit(lambda pv, bv, x, y: run(pv, bv, x, y))
-            self._bwd = jax.jit(
-                lambda pv, bv, x, y, g: jax.vjp(
-                    lambda pv_, x_: run(pv_, bv, x_, y)[0], pv, x)[1](g))
+            fwd = lambda pv, bv, x, y: run(pv, bv, x, y)
+            bwd = lambda pv, bv, x, y, g: jax.vjp(
+                lambda pv_, x_: run(pv_, bv, x_, y)[0], pv, x)[1](g)
         else:
-            self._fwd = jax.jit(lambda pv, bv, x: run(pv, bv, x))
-            self._bwd = jax.jit(
-                lambda pv, bv, x, g: jax.vjp(
-                    lambda pv_, x_: run(pv_, bv, x_)[0], pv, x)[1](g))
+            fwd = lambda pv, bv, x: run(pv, bv, x)
+            bwd = lambda pv, bv, x, g: jax.vjp(
+                lambda pv_, x_: run(pv_, bv, x_)[0], pv, x)[1](g)
         # label-free forward (predict path); buffer updates dropped (eval)
-        self._fwd_out = jax.jit(lambda pv, bv, x: run(pv, bv, x, None)[0])
+        fwd_out = lambda pv, bv, x: run(pv, bv, x, None)[0]
+        if not compiled:
+            self._fwd, self._bwd, self._fwd_out = fwd, bwd, fwd_out
+            return
+        from ...jit.compiled_step import CompiledStageProgram
+        self._fwd = CompiledStageProgram(fwd, label=f"pp.s{idx}.fwd")
+        self._bwd = CompiledStageProgram(
+            bwd, label=f"pp.s{idx}.bwd",
+            donate_argnums=(2,) if donate_act else ())
+        self._fwd_out = CompiledStageProgram(
+            fwd_out, label=f"pp.s{idx}.fwd_out")
 
 
 class PipelineEngine:
     def __init__(self, pipeline_layer, num_microbatches, axis="pipe",
-                 seg_method="uniform"):
+                 seg_method="uniform", compiled=None, layout=None):
+        """`compiled=None` follows FLAGS_compiled_step (the lane default);
+        `compiled=False` runs the same 1F1B schedule over eager stage
+        closures — the parity oracle the compiled path is asserted against.
+        `layout` (SpecLayout) drives activation placement between stages."""
+        from ...jit.compiled_step import compiled_step_enabled
+        from ..spec_layout import SpecLayout
         self.pl = pipeline_layer
         self.M = max(int(num_microbatches), 1)
         self.axis = axis
+        self.compiled = compiled_step_enabled() if compiled is None \
+            else bool(compiled)
+        self.layout = layout if layout is not None else SpecLayout()
         layers = list(pipeline_layer.run_function)
         S = pipeline_layer.num_stages
         deg = axis_degree(axis)
@@ -162,14 +198,37 @@ class PipelineEngine:
         else:
             segments = _segment_uniform(layers, S)
         self.S = S
+        self._submeshes = self._build_submeshes(deg)
         self.stages = [
             _Stage(seg, pipeline_layer.loss_fn, is_last=(s == S - 1))
             for s, seg in enumerate(segments)]
-        for st in self.stages:
-            st.compile()
-        self._submeshes = self._build_submeshes(deg)
+        from ...framework.flags import get_flag
+        donate = bool(get_flag("FLAGS_donate_state_buffers", True))
+        for s, st in enumerate(self.stages):
+            # a stage's backward may donate its stashed activation only when
+            # the engine owns that buffer: stage 0's input aliases the
+            # caller's batch unless the sub-mesh transfer re-placed it
+            st.compile(idx=s, compiled=self.compiled,
+                       donate_act=donate and self.compiled
+                       and (s > 0 or self._submeshes[0] is not None))
         self._shared_ids = self._find_shared_param_ids()
         self._place_params()
+        self._gen0 = self._generation()
+
+    @staticmethod
+    def _generation():
+        from ...resilience.recovery import current_generation
+        return current_generation()
+
+    def _fence(self, where):
+        """Generation fence on every inter-stage activation/cotangent
+        transfer: a p2p hop that straddles an elastic re-rendezvous must
+        fail typed, never feed a pre-recovery buffer into a post-recovery
+        compiled region."""
+        gen = self._generation()
+        if gen != self._gen0:
+            from ...resilience.watchdog import StaleGeneration
+            raise StaleGeneration(self._gen0, gen, section=where)
 
     # -- placement -----------------------------------------------------------
     def _build_submeshes(self, deg):
@@ -215,11 +274,13 @@ class PipelineEngine:
                 t._value = jax.device_put(t._val, self._sub_sharding(t, sub))
 
     def _act_sharding(self, sub, ndim):
-        if "data" in sub.axis_names:
-            return NamedSharding(sub, P("data", *([None] * (ndim - 1))))
-        return NamedSharding(sub, P())
+        # SpecLayout-driven: the same layout object that shards compiled-step
+        # batches decides the stage activation placement on the sub-mesh
+        return NamedSharding(sub, self.layout.activation_spec(ndim, mesh=sub))
 
+    # hot-path: per-unit activation/cotangent hop between compiled regions
     def _to_stage(self, arr, s):
+        self._fence(f"pp.p2p.s{s}")
         sub = self._submeshes[s]
         if sub is None:
             return arr
@@ -259,6 +320,7 @@ class PipelineEngine:
         """Run one 1F1B pipelined batch; accumulates param .grad, returns the
         mean loss. `scale` multiplies the seed cotangent (GradScaler)."""
         M, S = self.M, self.S
+        self._gen0 = self._generation()  # fence epoch for this batch's p2p
         if inputs.shape[0] % M:
             raise ValueError(
                 f"batch size {inputs.shape[0]} not divisible by "
@@ -362,6 +424,7 @@ class PipelineEngine:
     def eval_batch(self, inputs, labels=None, compute_loss=True):
         # eval tolerates ragged batches: fall back to one whole-batch
         # microbatch when the training accumulate_steps doesn't divide it
+        self._gen0 = self._generation()
         M = self.M if inputs.shape[0] % self.M == 0 else 1
         x_chunks = jnp.split(inputs, M, axis=0) if M > 1 else [inputs]
         y_chunks = (jnp.split(labels, M, axis=0) if M > 1 else [labels]) \

@@ -6,6 +6,17 @@ sequence sharded over the mesh 'sep' axis; each step computes blockwise
 attention against the currently-held K/V shard with online-softmax merging,
 then rotates K/V around the ring with collective-permute (compute overlaps the
 permute under XLA's scheduler). Backward = jax autodiff through ppermute.
+
+GSPMD can't express the rotation schedule, so the step is written
+shard_map-style — and compiled through ONE cached
+:class:`~paddle_tpu.jit.compiled_step.CompiledStageProgram` per
+(mesh, axis, causal, scale) configuration instead of rebuilding the
+shard_map wrapper on every call: steady state is a jit cache hit, builds
+are counted/attributed like every other compiled lane, and the trace
+sanitizer hard-fails retraces. The in/out specs come from the lane
+``SpecLayout`` (``sequence_spec``), the same layout object that drives the
+dp/ZeRO compiled step. ``compiled=False`` (or FLAGS_compiled_step=0) keeps
+the per-call eager shard_map — the parity oracle.
 """
 from __future__ import annotations
 
@@ -16,9 +27,12 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ...core.dispatch import apply, unwrap
-from ..mesh import axis_degree, get_mesh
+from ..mesh import axis_degree, get_mesh, shard_map
 
 __all__ = ["ring_attention", "split_sequence", "gather_sequence"]
+
+# (mesh, axis, causal, scale) -> CompiledStageProgram over jit(shard_map)
+_RING_PROGRAMS = {}
 
 
 def _blockwise_update(q, k_blk, v_blk, m, l, acc, scale, causal, q_start,
@@ -41,8 +55,11 @@ def _blockwise_update(q, k_blk, v_blk, m, l, acc, scale, causal, q_start,
     return m_new, l_new, acc_new
 
 
-def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
-    n = jax.lax.axis_size(axis_name)
+def _ring_attention_local(q, k, v, *, axis_name, axis_size, causal, scale):
+    # axis_size is closed over statically (from the mesh) so the scan
+    # length is concrete; the shard_map wrapper runs check_rep=False, so
+    # the replicated-initialized carry needs no varying-cast
+    n = axis_size
     idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     q_start = idx * s_local
@@ -50,9 +67,6 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
     m0 = jnp.full((b, h, s_local), -1e30, dtype=jnp.float32)
     l0 = jnp.zeros((b, h, s_local), dtype=jnp.float32)
     acc0 = jnp.zeros((b, h, s_local, d), dtype=jnp.float32)
-    # mark the (replicated-initialized) carry as device-varying so the scan
-    # carry type stays consistent across iterations under shard_map
-    m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), axis_name, to="varying")
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, i):
@@ -71,35 +85,65 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-def ring_attention(query, key, value, is_causal=True, axis="sep", scale=None):
+def _ring_spec(axis, ndim=4, seq_dim=1):
+    """The lane's operand PartitionSpec, derived from SpecLayout so ring-SP
+    shares the one layout vocabulary with every other compiled lane."""
+    from ..spec_layout import SpecLayout
+    return SpecLayout(sep_axis=axis).sequence_spec(ndim, seq_dim=seq_dim)
+
+
+def _ring_program(mesh, axis, causal, scale, compiled):
+    """Build (or fetch) the ring-attention step for one configuration.
+    Compiled: jit(shard_map) wrapped in a CompiledStageProgram, cached so
+    repeat calls are cache hits, not rebuilds. Eager: a fresh shard_map
+    executed op-by-op — the parity oracle."""
+    spec = _ring_spec(axis)
+    inner = functools.partial(_ring_attention_local, axis_name=axis,
+                              axis_size=int(mesh.shape[axis]),
+                              causal=causal, scale=scale)
+    if not compiled:
+        return shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_rep=False)
+    key = (mesh, axis, bool(causal), float(scale))
+    prog = _RING_PROGRAMS.get(key)
+    if prog is None:
+        from ...jit.compiled_step import CompiledStageProgram
+        fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
+        prog = CompiledStageProgram(fn, label=f"ring_attention.{axis}")
+        _RING_PROGRAMS[key] = prog
+    return prog
+
+
+def ring_attention(query, key, value, is_causal=True, axis="sep", scale=None,
+                   compiled=None):
     """(B, S_local, H, D) shards in, same out. Falls back to plain SDPA when
-    the mesh has no (>1) `axis` dimension."""
+    the mesh has no (>1) `axis` dimension. `compiled=None` follows
+    FLAGS_compiled_step; False forces the eager shard_map oracle."""
     mesh = get_mesh()
     degree = axis_degree(axis)
     if degree <= 1:
         from ...ops.attention import scaled_dot_product_attention
         return scaled_dot_product_attention(query, key, value,
                                             is_causal=is_causal, scale=scale)
+    if compiled is None:
+        from ...jit.compiled_step import compiled_step_enabled
+        compiled = compiled_step_enabled()
     d = query.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    spec = P(None, axis, None, None)
-    inner = functools.partial(_ring_attention_local, axis_name=axis,
-                              causal=is_causal, scale=scale)
-    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+    fn = _ring_program(mesh, axis, is_causal, scale, compiled)
     return apply(fn, query, key, value, name="ring_attention")
 
 
 def split_sequence(x, axis="sep", seq_dim=1):
-    """Shard a full-sequence tensor over the ring (device_put with a
-    sequence-sharded NamedSharding)."""
+    """Shard a full-sequence tensor over the ring (device_put with the
+    SpecLayout-derived sequence-sharded NamedSharding)."""
     import jax as _jax
     from jax.sharding import NamedSharding
     mesh = get_mesh()
-    spec = [None] * unwrap(x).ndim
-    spec[seq_dim] = axis
+    spec = _ring_spec(axis, ndim=unwrap(x).ndim, seq_dim=seq_dim)
     from ...core.tensor import Tensor
-    return Tensor(_jax.device_put(unwrap(x), NamedSharding(mesh, P(*spec))),
+    return Tensor(_jax.device_put(unwrap(x), NamedSharding(mesh, spec)),
                   stop_gradient=x.stop_gradient)
 
 

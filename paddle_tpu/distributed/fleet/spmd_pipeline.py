@@ -22,7 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...core.dispatch import apply, unwrap
 from ...core.tensor import Parameter, Tensor
 from ...nn.layer.layers import Layer
-from ..mesh import axis_degree, get_mesh
+from ..mesh import axis_degree, get_mesh, shard_map
 
 __all__ = ["PipelineStageStack"]
 
@@ -104,15 +104,16 @@ class PipelineStageStack(Layer):
 
         def pipe_fn(xv, *param_vals):
             def local(x_loc, *locs):
-                nn_ = jax.lax.axis_size(axis)
+                # axis size is static (num_stages == pipe degree, checked in
+                # __init__); check_rep=False so the replicated-zeros carry
+                # needs no varying-cast
+                nn_ = n
                 idx = jax.lax.axis_index(axis)
                 locs_sq = [l[0] for l in locs]  # strip the local stage dim
                 b = x_loc.shape[0]
                 mb = b // m
                 micro = x_loc.reshape((m, mb) + x_loc.shape[1:])
-                act0 = jax.lax.pcast(
-                    jnp.zeros((mb,) + x_loc.shape[1:], x_loc.dtype), axis,
-                    to="varying")
+                act0 = jnp.zeros((mb,) + x_loc.shape[1:], x_loc.dtype)
 
                 def tick(act, t):
                     t_in = jnp.minimum(t, m - 1)
@@ -130,12 +131,12 @@ class PipelineStageStack(Layer):
                 final = gathered[nn_ - 1, nn_ - 1:]
                 return final.reshape((m * mb,) + x_loc.shape[1:])
 
-            return jax.shard_map(
+            return shard_map(
                 local, mesh=mesh,
                 in_specs=(P(),) + tuple(
                     P(axis, *([None] * (pv.ndim - 1))) for pv in param_vals),
                 out_specs=P(),
-                check_vma=False,
+                check_rep=False,
             )(xv, *param_vals)
 
         return apply(pipe_fn, x, *params, name="spmd_pipeline")

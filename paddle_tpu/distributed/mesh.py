@@ -63,3 +63,18 @@ def axis_degree(axis):
     if axis in m.axis_names:
         return m.devices.shape[m.axis_names.index(axis)]
     return 1
+
+
+def shard_map(fn, mesh, in_specs, out_specs, check_rep=True):
+    """Version-portable shard_map: top-level ``jax.shard_map`` when the
+    installed jax has it (replication checking spelled ``check_vma``),
+    ``jax.experimental.shard_map`` otherwise (spelled ``check_rep``). The
+    lane engines route through this so one jax pin change doesn't strand
+    every shard_map call site."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_rep)
+    from jax.experimental.shard_map import shard_map as sm
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=check_rep)

@@ -22,7 +22,7 @@ __all__ = ["DataParallel"]
 
 
 class DataParallel(Layer):
-    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+    def __init__(self, layers, strategy=None, comm_buffer_size=None,
                  last_comm_buffer_size=1, find_unused_parameters=False,
                  group=None):
         super().__init__()
@@ -38,7 +38,11 @@ class DataParallel(Layer):
             old = getattr(layers, "_pt_dp_reducer", None)
             if old is not None:
                 old.detach()
-            from .reducer import Reducer
+            from .reducer import Reducer, reducer_bucket_bytes
+            if comm_buffer_size is None:
+                # FLAGS_reducer_bucket_mb: fused-bucket size cap (MB); the
+                # reference exposes it per-wrap, we default it fleet-wide
+                comm_buffer_size = reducer_bucket_bytes() >> 20
             self._reducer = Reducer(
                 list(layers.parameters()),
                 comm_buffer_size=comm_buffer_size,

@@ -1,4 +1,4 @@
-"""Bucketed gradient reducer for the eager (cross-process / DCN) DP path.
+"""Bucketed async gradient reducer for the eager (cross-process / DCN) DP path.
 
 Reference: paddle/fluid/imperative/reducer.{h,cc} (1,122 LoC) — params are
 grouped into size-capped buckets in reverse order; backward hooks mark vars
@@ -12,9 +12,25 @@ multi-process path (one controller per host, DCN collectives), where fusing
 many small host collectives into few large ones is the same latency
 amortization the reference gets from NCCL bucket fusion.
 
+Overlap contract (docs/distributed.md "Bucketed async allreduce"): a
+completed bucket's fused allreduce is ISSUED from the backward hook the
+moment the bucket fills — overlapping the collective with the rest of
+backward — but the scatter back into per-param grads is DEFERRED to
+``finalize()`` at the backward boundary, where the wait is attributed to the
+``step/collective_wait`` phase. Bucket assembly order is deterministic
+across ranks: buckets are built over the reversed registration order, hooks
+fire in autograd order (identical for identical graphs), drained buckets
+replay in fire order, and straggler buckets reduce per-param in bucket-index
+order.
+
 Correctness beyond the reference's assumption: if a param accumulates again
 AFTER its bucket already flushed (multi-consumer leaf), the extra local
 contribution is recorded and finalize() re-reduces just that delta.
+
+Elastic safety: ``resume()`` rebuilds buckets and re-arms hooks when the
+parameter membership changed while paused, or when the recovery generation
+bumped (re-rendezvous) — armed hooks must never reference pre-recovery
+buckets or in-flight pre-recovery collectives.
 """
 from __future__ import annotations
 
@@ -22,10 +38,18 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..resilience.faults import maybe_inject
 from .collective import ReduceOp, all_reduce
 from .env import get_world_size
 
-__all__ = ["Reducer"]
+__all__ = ["Reducer", "reducer_bucket_bytes"]
+
+
+def reducer_bucket_bytes():
+    """The FLAGS_reducer_bucket_mb seam: size cap (bytes) for one fused
+    gradient bucket. DataParallel resolves its default through this."""
+    from ..framework.flags import get_flag
+    return int(get_flag("FLAGS_reducer_bucket_mb", 25)) * (1 << 20)
 
 
 class _Bucket:
@@ -47,18 +71,17 @@ class Reducer:
         self.op = op
         self.comm_dtype = comm_dtype
         self._paused = False
+        self._cap_bytes = comm_buffer_size * (1 << 20)
+        self._last_cap_bytes = last_comm_buffer_size * (1 << 20)
+        self._gen = self._current_generation()
         params = [p for p in parameters if not p.stop_gradient]
-        self.buckets = self._build_buckets(
-            params, comm_buffer_size * (1 << 20),
-            last_comm_buffer_size * (1 << 20))
-        self._bucket_of = {}
-        for b in self.buckets:
-            for p in b.params:
-                self._bucket_of[id(p)] = b
+        self._params = params
+        self._pending = []  # (bucket, fused Tensor, orig dtype), fire order
         self._extras = {}   # id(param) -> local delta after its flush
         self._extra_params = {}
         self._dirty = False  # any grad activity since the last finalize
-        self._hooks = [p.register_hook(self._make_hook(p)) for p in params]
+        self._hooks = []
+        self._arm(params)
         from ..core import autograd as _ag
         self._seen_backward = _ag.backward_run_counter[0]
         # finalize at every backward boundary (Reducer::FinalizeBackward
@@ -78,6 +101,28 @@ class Reducer:
 
         self._pb_cb = _cb
         _ag.post_backward_callbacks.append(_cb)
+
+    @staticmethod
+    def _current_generation():
+        from ..resilience.recovery import current_generation
+        return current_generation()
+
+    def _arm(self, params):
+        """(Re)build buckets over `params` and register backward hooks."""
+        for h in self._hooks:
+            h.remove()
+        self._params = params
+        self.buckets = self._build_buckets(
+            params, self._cap_bytes, self._last_cap_bytes)
+        self._bucket_of = {}
+        for b in self.buckets:
+            for p in b.params:
+                self._bucket_of[id(p)] = b
+        self._pending = []
+        self._extras.clear()
+        self._extra_params.clear()
+        self._dirty = False
+        self._hooks = [p.register_hook(self._make_hook(p)) for p in params]
 
     def detach(self):
         """Remove all grad hooks (re-wrapping a model must not stack
@@ -102,7 +147,10 @@ class Reducer:
     @staticmethod
     def _build_buckets(params, cap_bytes, last_cap_bytes):
         """Reverse order (backward produces trailing layers first), grouped
-        by dtype (fused buffers are homogeneous), size-capped."""
+        by dtype (fused buffers are homogeneous), size-capped. The order is
+        a pure function of (registration order, shapes, dtypes, caps) —
+        identical on every rank, which is what lets the async flushes match
+        up without a coordination round."""
         buckets, cur, cur_bytes = [], [], 0
         cap = last_cap_bytes  # reference: first-filled (last layers) small
         for p in reversed(params):
@@ -141,10 +189,18 @@ class Reducer:
             return None
         return hook
 
+    # hot-path: fires from backward hooks mid-backward; issue the fused
+    # collective asynchronously, never pull results host-side here
     def _flush(self, b, firing, firing_grad):
-        """Fused allreduce of one completed bucket. The firing param's grad
-        is not yet assigned — combine it manually; everyone else reads
-        .grad. Returns the value the engine should assign to `firing`."""
+        """Fused allreduce of one completed bucket, fired as backward
+        produces grads. The collective is ISSUED here (JAX dispatch is
+        async, so it overlaps with the rest of backward); the scatter back
+        into per-param grads is deferred to finalize() at the backward
+        boundary. The firing param's grad is not yet assigned — combine it
+        manually; everyone else reads .grad. Returns None: the engine keeps
+        accumulating the raw local grad, which finalize() overwrites with
+        the reduced value."""
+        maybe_inject("reducer.flush")
         b.flushed = True
         vals = []
         for p in b.params:
@@ -162,23 +218,8 @@ class Reducer:
             flat = flat.astype(self.comm_dtype)  # fp16_allreduce knob
         fused = Tensor(flat)
         all_reduce(fused, op=self.op, group=self.group)
-        out = fused._val.astype(orig_dtype)
-        ofs = 0
-        ret = None
-        for p, n in zip(b.params, b.numels):
-            piece = out[ofs:ofs + n].reshape(p.shape)
-            ofs += n
-            if p is firing:
-                if p.grad is None:
-                    ret = Tensor(piece, stop_gradient=True)
-                else:
-                    p.grad._value = piece
-                    ret = Tensor(jnp.zeros_like(piece), stop_gradient=True)
-            elif p.grad is not None:
-                p.grad._value = piece
-            else:
-                p.grad = Tensor(piece, stop_gradient=True)
-        return ret
+        self._pending.append((b, fused, orig_dtype))
+        return None
 
     def _reduce_value(self, arr):
         """all_reduce one array honoring the comm_dtype knob."""
@@ -189,37 +230,67 @@ class Reducer:
         all_reduce(t, op=self.op, group=self.group)
         return t._val.astype(orig)
 
+    def _drain_pending(self):
+        """Scatter every in-flight fused result back into per-param grads,
+        in the order the buckets fired (deterministic across ranks). A
+        param that accumulated again after its bucket flushed gets its
+        late delta reduced and folded in here, so the final grad is
+        avg(pre-flush) + avg(delta)."""
+        for b, fused, orig_dtype in self._pending:
+            out = fused._val.astype(orig_dtype)
+            ofs = 0
+            for p, n in zip(b.params, b.numels):
+                piece = out[ofs:ofs + n].reshape(p.shape)
+                ofs += n
+                delta = self._extras.pop(id(p), None)
+                if delta is not None:
+                    self._extra_params.pop(id(p), None)
+                    piece = piece + self._reduce_value(delta)
+                if p.grad is None:
+                    p.grad = Tensor(piece, stop_gradient=True)
+                else:
+                    p.grad._value = piece
+        self._pending = []
+
     def finalize(self):
-        """Backward/step boundary: flush incomplete buckets (unused-param
-        case) and reconcile post-flush local deltas, then reset. Idempotent:
+        """Backward/step boundary: wait on in-flight bucket reductions and
+        scatter them back, flush incomplete buckets (unused-param case) and
+        reconcile post-flush local deltas, then reset. The wait + scatter
+        is what `step/collective_wait` measures on this lane — everything
+        issued earlier already overlapped with backward compute. Idempotent:
         runs only when grad activity happened since the last finalize, so the
         auto post-backward call and an explicit apply_collective_grads()
         don't double-reduce."""
         if self._paused or not self._dirty:
             return
         from ..core.selected_rows import SelectedRows
-        for b in self.buckets:
-            if not b.flushed and b.ready:
-                # some params never produced grads (unused); reduce the ones
-                # that did, per-param (reference find_unused_parameters)
-                for p in b.params:
-                    if p.grad is not None:
-                        if isinstance(p.grad, SelectedRows):
-                            p.grad = Tensor(p.grad.to_dense(),
-                                            stop_gradient=True)
-                        p.grad._value = self._reduce_value(p.grad._val)
-                b.flushed = True
-        for pid, delta in self._extras.items():
-            p = self._extra_params[pid]
-            # p.grad currently = avg(pre-flush) + local_delta; replace the
-            # local delta with its group average
-            p.grad._value = p.grad._val - delta + self._reduce_value(delta)
+        from ..profiler.steptimer import get_steptimer
+        with get_steptimer().phase("step/collective_wait"):
+            self._drain_pending()
+            for b in self.buckets:
+                if not b.flushed and b.ready:
+                    # some params never produced grads (unused); reduce the
+                    # ones that did, per-param (reference
+                    # find_unused_parameters), in bucket-index order
+                    for p in b.params:
+                        if p.grad is not None:
+                            if isinstance(p.grad, SelectedRows):
+                                p.grad = Tensor(p.grad.to_dense(),
+                                                stop_gradient=True)
+                            p.grad._value = self._reduce_value(p.grad._val)
+                    b.flushed = True
+            for pid, delta in self._extras.items():
+                p = self._extra_params[pid]
+                # p.grad currently = avg(pre-flush) + local_delta; replace
+                # the local delta with its group average
+                p.grad._value = p.grad._val - delta + self._reduce_value(delta)
         self.reset()
 
     def reset(self):
         for b in self.buckets:
             b.ready.clear()
             b.flushed = False
+        self._pending = []
         self._extras.clear()
         self._extra_params.clear()
         self._dirty = False
@@ -227,5 +298,21 @@ class Reducer:
     def pause(self):
         self._paused = True
 
-    def resume(self):
+    def resume(self, parameters=None):
+        """Re-enable grad sync. Safe across elastic re-rendezvous: if the
+        parameter membership changed while paused (pass the new list), or
+        the recovery generation bumped under us, the armed hooks reference
+        pre-recovery buckets — rebuild buckets and re-arm before syncing
+        again, dropping any in-flight pre-recovery collectives."""
+        gen = self._current_generation()
+        if parameters is not None:
+            params = [p for p in parameters if not p.stop_gradient]
+            if [id(p) for p in params] != [id(p) for p in self._params]:
+                self._arm(params)
+        elif gen != self._gen:
+            # membership may have been rebuilt in place by recovery: re-arm
+            # against the surviving param objects so no hook points at a
+            # pre-recovery bucket or pending fused buffer
+            self._arm([p for p in self._params])
+        self._gen = gen
         self._paused = False

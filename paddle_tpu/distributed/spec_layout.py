@@ -14,7 +14,17 @@ mesh names them after the reference's topology.py order):
   ``data``   — batch dimension replication group (plain DP),
   ``fsdp``   — parameter/optimizer-state sharding (ZeRO), mesh axis
                ``"sharding"``,
-  ``tp``     — tensor parallel, mesh axis ``"model"``.
+  ``tp``     — tensor parallel, mesh axis ``"model"``,
+  ``pipe``   — pipeline stage placement (1F1B sub-meshes), mesh axis
+               ``"pipe"``,
+  ``sep``    — sequence parallelism (ring attention), mesh axis ``"sep"``.
+
+The pipe/sep axes don't shard compiled-step *inputs* the way data/fsdp do —
+GSPMD can't express the 1F1B schedule or the ring rotation — but the lane
+engines (``fleet/pipeline_engine.py``, ``fleet/sequence_parallel.py``)
+derive their activation and sequence PartitionSpecs from the same layout
+object, so every MULTICHIP lane asserts parity through one SpecLayout-driven
+description instead of hand-built specs per lane.
 
 An axis that is absent from the current mesh (or has degree 1) simply drops
 out of every spec — the same layout object describes the serial run, the
@@ -46,6 +56,8 @@ class SpecLayout:
     data_axis: str = "data"
     fsdp_axis: str = "sharding"
     tp_axis: str = "model"
+    pipe_axis: str = "pipe"
+    sep_axis: str = "sep"
     shard_params: bool = False
 
     # -- mesh interrogation ----------------------------------------------------
@@ -85,6 +97,23 @@ class SpecLayout:
                 spec[i] = self.fsdp_axis
                 return P(*spec)
         return P()
+
+    def activation_spec(self, ndim, mesh=None):
+        """Pipeline-stage activation placement inside one stage's sub-mesh:
+        batch dim over the data axis, like :meth:`batch_spec`, evaluated
+        against the stage's own (pipe-fixed) mesh. The p2p transfer between
+        stages re-places the same spec on the next sub-mesh."""
+        return self.batch_spec(ndim, mesh=mesh)
+
+    def sequence_spec(self, ndim, seq_dim=1, mesh=None):
+        """Ring-attention operand placement: the sequence dim shards over
+        the sep axis, everything else replicates. This is both the
+        shard_map in/out spec and the data placement for the lane."""
+        if ndim <= seq_dim or self._degree(self.sep_axis, mesh) <= 1:
+            return P()
+        spec = [None] * ndim
+        spec[seq_dim] = self.sep_axis
+        return P(*spec)
 
     # -- appliers --------------------------------------------------------------
     def sharding_for(self, spec, mesh=None):

@@ -25,9 +25,15 @@ _FLAGS: dict[str, Any] = {
     "FLAGS_donate_state_buffers": True,
     # whole-step compilation (jit/compiled_step.py, docs/compiled_step.md):
     # route hapi train_batch/fit and the bench LM lanes through ONE donated,
-    # sharding-annotated jitted program per step (fwd+bwd+optimizer). Off by
-    # default — the eager path is the debug/parity oracle.
-    "FLAGS_compiled_step": False,
+    # sharding-annotated jitted program per step (fwd+bwd+optimizer). ON by
+    # default since the compiled lane passed its eager-parity gates; set 0
+    # to opt back into eager, which stays the debug/parity oracle.
+    "FLAGS_compiled_step": True,
+    # fused-bucket size cap (MB) for the eager DP gradient Reducer
+    # (distributed/reducer.py, docs/distributed.md): backward hooks fire a
+    # bucket's single async allreduce the moment it fills, overlapping the
+    # collective with the rest of backward
+    "FLAGS_reducer_bucket_mb": 25,
     # distinct input signatures one compiled step fn may trace before the
     # retrace-storm guard warns through the flight recorder; 0 disables
     "FLAGS_compiled_step_max_retraces": 8,

@@ -3,6 +3,9 @@
 The reference keeps two adapters (StaticGraphAdapter:247 / DynamicGraphAdapter
 :666); here there is ONE path — eager semantics with the train step
 `to_static`-compiled, which IS the static-graph performance mode on TPU.
+The compiled step is the shipped default (``FLAGS_compiled_step=True``);
+flipping the flag off selects the eager per-op oracle for debugging and
+parity work — see docs/compiled_step.md for the migration notes.
 """
 from __future__ import annotations
 

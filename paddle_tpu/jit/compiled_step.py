@@ -17,10 +17,16 @@ makes the compile lifecycle *observable*:
   mirroring the serving compile-cache bound that caught the same pathology
   on the inference side.
 
-The flag seam: ``FLAGS_compiled_step`` (default off) routes
+The flag seam: ``FLAGS_compiled_step`` (default ON) routes
 ``hapi.Model.train_batch``/``fit`` and the bench LM lanes through this
-wrapper; the eager path stays the debug/parity oracle (bit-exact f32 — see
-tests/test_compiled_step.py). Sharding comes in through the inputs:
+wrapper; setting it to 0 opts back into the eager path, which stays the
+debug/parity oracle (bit-exact f32 — see tests/test_compiled_step.py).
+
+``CompiledStageProgram`` is the same lifecycle for lanes GSPMD can't place
+as one program: pipeline 1F1B stage programs and the shard_map ring-attention
+step compile ONE raw-jax program per input signature and share the
+compile/cache-hit counters (and the trace sanitizer's retrace accounting)
+with the whole-step wrapper. Sharding comes in through the inputs:
 parameters placed by ``distributed.spec_layout.shard_params`` and batches by
 ``shard_batch`` carry ``NamedSharding``s, and jit propagates them through
 the whole fused program (GSPMD), folding the hand-wired MULTICHIP dp/ZeRO
@@ -43,17 +49,18 @@ from ..profiler import steptimer as _steptimer
 from .to_static import StaticFunction, _discovery_passes, _sig_of, \
     _sig_of_step
 
-__all__ = ["CompiledTrainStep", "compiled_step_enabled", "compile_stats",
-           "reset_compile_stats"]
+__all__ = ["CompiledTrainStep", "CompiledStageProgram",
+           "compiled_step_enabled", "compile_stats", "reset_compile_stats"]
 
 _stats_lock = threading.Lock()
 _STATS = {"compiles": 0, "cache_hits": 0, "retrace_warnings": 0}
 
 
 def compiled_step_enabled():
-    """The FLAGS_compiled_step seam (default off: eager stays the oracle)."""
+    """The FLAGS_compiled_step seam (default ON since the compiled lane
+    passed its eager-parity gates; eager stays the debug/parity oracle)."""
     from ..framework.flags import get_flag
-    return bool(get_flag("FLAGS_compiled_step", False))
+    return bool(get_flag("FLAGS_compiled_step", True))
 
 
 def compile_stats():
@@ -175,3 +182,56 @@ class CompiledTrainStep:
         if prog is not None and prog.scanned_ready and not ready_before:
             _note_compile()
         return out
+
+
+def _stage_sig(args):
+    """Signature of raw-jax stage-program operands: nested lists/tuples of
+    arrays (or scalars). Symbolic — shapes/dtypes only, no device sync."""
+    out = []
+    for a in args:
+        if isinstance(a, (list, tuple)):
+            out.append(_stage_sig(a))
+        elif hasattr(a, "shape") and hasattr(a, "dtype"):
+            out.append((tuple(a.shape), str(a.dtype)))
+        else:
+            out.append(("py", a if isinstance(
+                a, (int, float, str, bool, type(None))) else str(type(a))))
+    return tuple(out)
+
+
+class CompiledStageProgram:
+    """One donated, signature-keyed jitted program for a lane stage.
+
+    The pipeline 1F1B engine and the ring-attention step operate on raw jax
+    arrays below the Tensor/StaticFunction layer, but they need the same
+    compile lifecycle as :class:`CompiledTrainStep`: steady state must be
+    all cache hits, every build runs under the ``step/compile`` phase and
+    bumps ``compiled_step.compiles_total``, and the trace sanitizer patches
+    :meth:`_note_stage_compile` to hard-fail steady-state retraces. `label`
+    names the stage in stats/flight-recorder output. `donate_argnums` is
+    forwarded to ``jax.jit`` (stage programs donate operands whose last use
+    is this call — e.g. the stashed activation consumed by the recompute
+    backward)."""
+
+    def __init__(self, fn, label="stage", donate_argnums=(),
+                 static_argnums=()):
+        import jax
+        self._jit = jax.jit(fn, donate_argnums=donate_argnums,
+                            static_argnums=static_argnums)
+        self._label = label
+        self._seen = set()
+
+    def _note_stage_compile(self, key):
+        """Called exactly once per new input signature, before the build.
+        The trace sanitizer monkeypatches this to attribute/raise."""
+        _note_compile()
+
+    def __call__(self, *args):   # hot-path: per-unit lane dispatch chokepoint
+        key = _stage_sig(args)
+        if key in self._seen:
+            _note_cache_hit()
+            return self._jit(*args)
+        self._seen.add(key)
+        self._note_stage_compile((key, self._label))
+        with _steptimer.get_steptimer().phase("step/compile"):
+            return self._jit(*args)

@@ -578,6 +578,32 @@ def test_lockorder_consistent_order_and_rlock_reentry_are_clean():
         assert tracker.violations == []
 
 
+def test_lockorder_gc_address_reuse_is_not_an_inversion():
+    """A GC'd tracked lock's memory address is routinely reused by the
+    next allocation. Edge/name keys must be per-tracker uids, not id():
+    with id() keys the new tenant inherits the dead lock's edges, and a
+    churn-heavy scenario (chaos campaigns creating and dropping
+    controllers per episode) reports phantom cycles between locks that
+    never coexisted."""
+    lockorder = _lockorder()
+    with lockorder.tracking() as tracker:
+        anchor = threading.Lock()
+        for _ in range(200):
+            doomed = threading.Lock()
+            with doomed:          # doomed -> anchor
+                with anchor:
+                    pass
+            del doomed            # address now reusable
+            fresh = threading.Lock()
+            with anchor:          # anchor -> fresh: if fresh inherited
+                with fresh:       # doomed's key this closes a phantom
+                    pass          # anchor -> doomed -> anchor cycle
+            del fresh
+        assert tracker.violations == []
+        # every lock kept a distinct key despite address reuse
+        assert len(tracker._names) == 401
+
+
 def test_lockorder_condition_over_tracked_lock():
     """Condition(wrapped Lock) round-trips _release_save /
     _acquire_restore, so the held-set stays accurate across wait()."""
@@ -803,7 +829,8 @@ def test_mutation_deregistering_hot_path_trips_unseeded():
     mutated = real.replace("hot-path:", "hot-path-x:")
     assert mutated != real
     found = analysis.run_pass("host-sync", _ctx({rel: mutated}))
-    assert _codes(found).count("unseeded") == 2  # __call__ + run_steps
+    # CompiledTrainStep.__call__ + run_steps + CompiledStageProgram.__call__
+    assert _codes(found).count("unseeded") == 3
 
 
 # ---------------------------------------------------------------------------

@@ -9,7 +9,7 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
 from check_bench_regression import (  # noqa: E402
-    compare, split_waivers, _flat_metrics, _round_of,
+    compare, split_waivers, _flat_metrics, _round_of, _LANE_FLOORS,
 )
 
 
@@ -71,6 +71,58 @@ class TestCompare:
                     "reason": "bench split into its own artifact"}]
         regs, waived, _ = compare(_doc(), new, waivers=waivers)
         assert regs == [] and waived
+
+
+class TestLaneFloors:
+    """extra.lane_speedup.{pp,ring_sp,moe} (BENCH_MODEL=lanes): gated both
+    round-over-round (via _flat_metrics) and against absolute per-lane
+    floors checked on the NEW artifact alone, so the very first artifact
+    carrying the lane is already held to the contract."""
+
+    def _lanes_doc(self, pp=9.0, ring_sp=150.0, moe=1.4):
+        return {"metric": "lane_speedup_min", "value": min(pp, ring_sp, moe),
+                "extra": {"lane_speedup": {"pp": pp, "ring_sp": ring_sp,
+                                           "moe": moe}}}
+
+    def test_lane_ratios_are_flat_metrics(self):
+        keys = _flat_metrics(self._lanes_doc())
+        assert keys["lane_speedup.pp"] == 9.0
+        assert keys["lane_speedup.ring_sp"] == 150.0
+        assert keys["lane_speedup.moe"] == 1.4
+
+    def test_healthy_lanes_pass_floors(self):
+        regs, _, _ = compare(self._lanes_doc(), self._lanes_doc())
+        assert regs == []
+
+    def test_floor_violation_fails_even_without_history(self):
+        """First artifact with the lane (old has no lane_speedup): a ratio
+        below the absolute floor must still fail — e.g. the MoE exchange
+        re-growing a per-call in-program collective (measured 0.29x)."""
+        regs, _, _ = compare(_doc(), self._lanes_doc(moe=0.29))
+        bad = [r for r in regs if r["metric"] == "lane_speedup.moe"]
+        assert bad and bad[0]["direction"] == "absolute_floor"
+        assert bad[0]["old"] == _LANE_FLOORS["moe"]
+
+    def test_round_over_round_drop_fails_above_floor(self):
+        """A lane that halves but stays above its floor is still a
+        round-over-round regression via the ordinary 3% tolerance."""
+        regs, _, _ = compare(self._lanes_doc(pp=9.0), self._lanes_doc(pp=4.0))
+        assert any(r["metric"] == "lane_speedup.pp" for r in regs)
+
+    def test_floor_violation_can_be_waived(self):
+        waivers = [{"metric": "lane_speedup.moe", "reason": "scoped"}]
+        regs, waived, _ = compare(self._lanes_doc(moe=0.5),
+                                  self._lanes_doc(moe=0.5),
+                                  waivers=waivers)
+        assert regs == []
+        assert any(w["metric"] == "lane_speedup.moe" for w in waived)
+
+    def test_unknown_lane_has_no_floor(self):
+        doc = self._lanes_doc()
+        doc["extra"]["lane_speedup"]["future_lane"] = 0.01
+        regs, _, _ = compare(_doc(), doc)
+        assert not any(r["metric"] == "lane_speedup.future_lane"
+                       for r in regs)
 
 
 class TestWaiverScoping:

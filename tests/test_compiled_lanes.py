@@ -1,0 +1,373 @@
+"""Compiled-by-default lane parity (the unified compiled-lanes contract).
+
+Every MULTICHIP lane that used to be hand-wired now routes through a
+compiled program when `FLAGS_compiled_step` is on (the default):
+
+- pp 1F1B: one donated `CompiledStageProgram` per stage per direction
+  (`fleet/pipeline_engine.py`);
+- ring-SP: one cached jit(shard_map) program per
+  (mesh, axis, causal, scale) (`fleet/sequence_parallel.py`);
+- MoE ep: the dispatch/combine count exchange through one
+  `CompiledTrainStep` (`fleet/expert_parallel.py`).
+
+Each lane asserts loss/output parity against its eager oracle
+(`compiled=False` / flag off) under the trace sanitizer in **raise**
+mode — a steady-state retrace or an in-phase host sync fails at the
+violating call, so "zero retraces after warmup" is checked per call,
+not per aggregate. The bucketed async reducer's overlap and elastic
+contracts (docs/distributed.md "Bucketed async allreduce") are pinned
+here too: the fused collective fires from backward hooks, the scatter
+drains at finalize, fire order is deterministic, and pause/resume
+across membership change or a generation bump rebuilds buckets.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.analysis import tracesan
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.mesh import build_mesh, get_mesh
+from paddle_tpu.jit.compiled_step import compile_stats, reset_compile_stats
+
+NDEV = len(jax.devices())
+pytestmark = pytest.mark.skipif(NDEV < 8, reason="needs 8 virtual devices")
+
+
+@pytest.fixture()
+def mesh_guard():
+    yield
+    build_mesh()
+
+
+@pytest.fixture()
+def flag_guard():
+    """Restore FLAGS_compiled_step after a test toggles it."""
+    before = paddle.get_flags(["FLAGS_compiled_step"])["FLAGS_compiled_step"]
+    yield
+    paddle.set_flags({"FLAGS_compiled_step": before})
+
+
+def _fresh_fleet(hybrid_configs):
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.base import DistributedStrategy
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {**strategy.hybrid_configs, **hybrid_configs}
+    fleet._fleet._is_initialized = False
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet, strategy
+
+
+class TestPipeline1F1BCompiled:
+    """pp 1F1B through per-stage compiled programs vs the eager oracle."""
+
+    def _descs(self, vocab=32, dim=16):
+        paddle.seed(21)
+        block = lambda: nn.Sequential(nn.Linear(dim, dim), nn.Tanh())
+        return [nn.Embedding(vocab, dim), block(), block(),
+                nn.Linear(dim, vocab)]
+
+    def _run(self, steps=3):
+        from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+        fleet, strategy = _fresh_fleet({"dp_degree": 4, "pp_degree": 2})
+        strategy.pipeline_configs = {"accumulate_steps": 4}
+        model = PipelineLayer(self._descs(), num_stages=2,
+                              loss_fn=lambda o, y: F.cross_entropy(o, y))
+        dist = fleet.distributed_model(model)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        rng = np.random.RandomState(13)
+        losses = []
+        for _ in range(steps):
+            x = paddle.to_tensor(rng.randint(0, 32, (16, 6)).astype("int32"))
+            y = paddle.to_tensor(rng.randint(0, 32, (16, 6)).astype("int64"))
+            losses.append(float(dist.train_batch((x, y), opt).item()))
+        return dist._engine, losses
+
+    @pytest.mark.allow_retrace  # explicit raise-mode tracking below
+    def test_compiled_matches_eager_oracle(self, mesh_guard, flag_guard):
+        paddle.set_flags({"FLAGS_compiled_step": False})
+        eng_e, eager = self._run()
+        assert eng_e is not None and not eng_e.compiled
+
+        paddle.set_flags({"FLAGS_compiled_step": True})
+        with tracesan.tracking(mode="raise"):
+            eng_c, compiled = self._run()
+        assert eng_c.compiled
+        np.testing.assert_allclose(compiled, eager, rtol=1e-5)
+
+    @pytest.mark.allow_retrace
+    def test_zero_steady_state_retraces(self, mesh_guard, flag_guard):
+        """After the warm-up batch compiles each stage program once, later
+        batches must be pure cache hits — counted per call by the raise-mode
+        sanitizer AND by the compile counters."""
+        paddle.set_flags({"FLAGS_compiled_step": True})
+        with tracesan.tracking(mode="raise"):
+            from paddle_tpu.distributed.fleet.meta_parallel import (
+                PipelineLayer,
+            )
+            fleet, strategy = _fresh_fleet({"dp_degree": 4, "pp_degree": 2})
+            strategy.pipeline_configs = {"accumulate_steps": 4}
+            model = PipelineLayer(self._descs(), num_stages=2,
+                                  loss_fn=lambda o, y: F.cross_entropy(o, y))
+            dist = fleet.distributed_model(model)
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=model.parameters())
+            rng = np.random.RandomState(13)
+
+            def batch():
+                x = paddle.to_tensor(
+                    rng.randint(0, 32, (16, 6)).astype("int32"))
+                y = paddle.to_tensor(
+                    rng.randint(0, 32, (16, 6)).astype("int64"))
+                return dist.train_batch((x, y), opt)
+
+            batch()  # warm-up: every stage program traces here
+            reset_compile_stats()
+            batch()
+            batch()
+            stats = compile_stats()
+        assert stats["compiles"] == 0, stats
+        assert stats["cache_hits"] > 0, stats
+
+
+class TestRingSPCompiled:
+    """Ring attention through the cached jit(shard_map) program."""
+
+    def _qkv(self):
+        rng = np.random.RandomState(1)
+        return [paddle.to_tensor(
+            rng.randn(2, NDEV * 4, 2, 8).astype("float32") * 0.5)
+            for _ in range(3)]
+
+    @pytest.mark.allow_retrace
+    def test_compiled_matches_eager_and_dense(self, mesh_guard):
+        from paddle_tpu.distributed.fleet.sequence_parallel import (
+            ring_attention,
+        )
+        build_mesh({"sep": NDEV})
+        q, k, v = self._qkv()
+        eager = np.asarray(
+            ring_attention(q, k, v, is_causal=True, compiled=False)._val)
+        reset_compile_stats()
+        with tracesan.tracking(mode="raise"):
+            out1 = ring_attention(q, k, v, is_causal=True, compiled=True)
+            out2 = ring_attention(q, k, v, is_causal=True, compiled=True)
+        stats = compile_stats()
+        assert stats["compiles"] <= 1 and stats["cache_hits"] >= 1, stats
+        np.testing.assert_allclose(np.asarray(out1._val), eager, rtol=1e-5,
+                                   atol=1e-6)
+        # repeat call is the SAME cached executable: bitwise stable
+        assert np.array_equal(np.asarray(out1._val), np.asarray(out2._val))
+
+        from paddle_tpu.ops.attention import scaled_dot_product_attention
+        dense = scaled_dot_product_attention(q, k, v, is_causal=True)
+        np.testing.assert_allclose(np.asarray(out1._val),
+                                   np.asarray(dense._val), atol=1e-4)
+
+    @pytest.mark.allow_retrace
+    def test_backward_through_compiled_program(self, mesh_guard):
+        from paddle_tpu.distributed.fleet.sequence_parallel import (
+            ring_attention, split_sequence,
+        )
+        build_mesh({"sep": NDEV})
+        q, k, v = self._qkv()
+        for t in (q, k, v):
+            t.stop_gradient = False
+        # split_sequence re-places the data on the ring: the sharded
+        # tensors are the autograd leaves of the lane
+        qs, ks, vs = (split_sequence(t) for t in (q, k, v))
+        with tracesan.tracking(mode="raise"):
+            out = ring_attention(qs, ks, vs, is_causal=True, compiled=True)
+            out.sum().backward()
+        for t in (qs, ks, vs):
+            assert t.grad is not None
+            assert np.isfinite(np.asarray(t.grad._val)).all()
+
+
+class TestMoECompiledExchange:
+    """ExpertParallelEngine with the dispatch/combine exchange routed
+    through CompiledTrainStep: the loss curve must be BITWISE identical to
+    the eager-exchange oracle (the routing math never enters the traced
+    region)."""
+
+    def _losses(self, compiled, steps=4):
+        from paddle_tpu.distributed.fleet.expert_parallel import (
+            ExpertParallelEngine,
+        )
+        eng = ExpertParallelEngine(NDEV, 8, tuple(range(NDEV)), seed=13,
+                                   compiled=compiled)
+        out = []
+        for s in range(steps):
+            r = np.random.RandomState(700 + s)
+            out.append(eng.step(r.randn(16, 8), r.randn(16, 8)))
+        return out
+
+    @pytest.mark.allow_retrace
+    def test_bitwise_parity_and_single_trace(self, mesh_guard):
+        eager = self._losses(compiled=False)
+        reset_compile_stats()
+        with tracesan.tracking(mode="raise"):
+            compiled = self._losses(compiled=True)
+        assert compiled == eager  # exact, not approx
+        stats = compile_stats()
+        # one exchange signature (fixed ep degree) traced once; the other
+        # 2 * steps - 1 dispatch/combine rides are cache hits
+        assert stats["compiles"] == 1, stats
+        assert stats["cache_hits"] >= 3, stats
+
+    def test_chaos_site_fires_in_compiled_mode(self, mesh_guard):
+        """The collective.alltoall site must keep firing per exchange even
+        though the exchange itself is a cached compiled program."""
+        from paddle_tpu.distributed.fleet.expert_parallel import (
+            ExpertParallelEngine,
+        )
+        from paddle_tpu.resilience import faults
+        eng = ExpertParallelEngine(NDEV, 8, tuple(range(NDEV)), seed=13,
+                                   compiled=True)
+        r = np.random.RandomState(700)
+        x, y = r.randn(16, 8), r.randn(16, 8)
+        eng.step(x, y)  # warm: the exchange program is cached now
+        faults.configure("collective.alltoall:1")
+        try:
+            with pytest.raises(faults.FaultInjected):
+                eng.step(x, y)
+        finally:
+            faults.reset()
+
+
+class TestReducerAsyncOverlap:
+    """Bucketed async allreduce: issue-at-hook, drain-at-finalize,
+    deterministic order, elastic pause/resume."""
+
+    def _mlp(self, seed=0):
+        paddle.seed(seed)
+        return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+    def _fake_allreduce(self, monkeypatch, factor=3.0):
+        from paddle_tpu.distributed import reducer as red_mod
+        calls = []
+
+        def fake(tensor, op=None, group=None, **kw):
+            calls.append(int(np.prod(tensor.shape)))
+            tensor._value = tensor._val * factor
+            return tensor
+
+        monkeypatch.setattr(red_mod, "all_reduce", fake)
+        return calls
+
+    def _backward(self, model, seed=0):
+        rng = np.random.RandomState(seed)
+        x = paddle.to_tensor(rng.randn(8, 8).astype("f4"))
+        y = paddle.to_tensor(rng.randint(0, 4, (8, 1)).astype("int64"))
+        F.cross_entropy(model(x), y).backward()
+
+    def test_scatter_deferred_to_finalize(self, monkeypatch):
+        """The fused collective is ISSUED from the backward hook (so it
+        overlaps backward), but the scatter back into p.grad happens in
+        finalize() — observed as a non-empty pending queue at finalize
+        entry."""
+        from paddle_tpu.distributed.reducer import Reducer
+        model = self._mlp(seed=4)
+        calls = self._fake_allreduce(monkeypatch)
+        red = Reducer(list(model.parameters()), comm_buffer_size=25)
+        pending_at_finalize = []
+        orig = Reducer.finalize
+        monkeypatch.setattr(
+            Reducer, "finalize",
+            lambda self: (pending_at_finalize.append(len(self._pending)),
+                          orig(self))[1])
+        self._backward(model)  # post-backward callback runs finalize
+        assert calls, "fused collective never fired"
+        assert pending_at_finalize and pending_at_finalize[0] >= 1, (
+            "no bucket was in flight at the backward boundary — the "
+            "flush/drain split is not overlapping")
+        for p in model.parameters():
+            assert p.grad is not None
+
+    def test_deterministic_fire_order(self, monkeypatch):
+        """Bucket assembly and fire order are a pure function of the param
+        list — two identical runs must issue identical fused collectives in
+        identical order (what keeps ranks matched without coordination)."""
+        from paddle_tpu.distributed.reducer import Reducer
+
+        def one_run(seed):
+            model = self._mlp(seed=7)
+            calls = self._fake_allreduce(monkeypatch)
+            red = Reducer(list(model.parameters()), comm_buffer_size=25)
+            self._backward(model, seed=seed)
+            red.detach()
+            return list(calls)
+
+        assert one_run(3) == one_run(3)
+
+    def test_resume_rebuilds_buckets_on_membership_change(self, monkeypatch):
+        """Satellite regression: pause()/resume() across an elastic resize
+        that changed the parameter membership must rebuild buckets — armed
+        hooks referencing pre-recovery buckets would scatter into dropped
+        params (or miss new ones) after recovery."""
+        from paddle_tpu.distributed.reducer import Reducer
+        model_a = self._mlp(seed=1)
+        calls = self._fake_allreduce(monkeypatch)
+        red = Reducer(list(model_a.parameters()), comm_buffer_size=25)
+        old_bucket_ids = set(red._bucket_of)
+
+        red.pause()
+        model_b = self._mlp(seed=2)  # post-recovery replica: new params
+        red.resume(parameters=list(model_b.parameters()))
+
+        new_ids = {id(p) for p in model_b.parameters()}
+        assert set(red._bucket_of) == new_ids
+        assert not (set(red._bucket_of) & old_bucket_ids)
+        assert red._pending == [] and not red._dirty
+
+        # new membership actually syncs...
+        self._backward(model_b)
+        assert calls, "post-resume backward never hit the collective"
+        # ...and the detached pre-recovery params no longer do
+        n = len(calls)
+        self._backward(model_a)
+        assert len(calls) == n, "stale hook on pre-recovery params fired"
+
+    def test_resume_after_generation_bump_rearms(self, monkeypatch):
+        """Same membership, but the recovery generation bumped while
+        paused: resume() must re-arm (clearing any in-flight pre-recovery
+        fused buffers) instead of trusting stale bucket state."""
+        from paddle_tpu.distributed.reducer import Reducer
+        from paddle_tpu.resilience.recovery import (
+            reset_generation, set_generation,
+        )
+        model = self._mlp(seed=5)
+        self._fake_allreduce(monkeypatch)
+        red = Reducer(list(model.parameters()), comm_buffer_size=25)
+        try:
+            red.pause()
+            # simulate an in-flight pre-recovery bucket
+            red.buckets[0].flushed = True
+            red._pending.append((red.buckets[0], Tensor(jnp.zeros(4)),
+                                 jnp.float32))
+            set_generation(red._gen + 1)
+            red.resume()
+            assert red._gen == Reducer._current_generation()
+            assert red._pending == []
+            assert not any(b.flushed for b in red.buckets)
+            self._backward(model)
+            for p in model.parameters():
+                assert p.grad is not None
+        finally:
+            reset_generation()
+
+    def test_bucket_cap_flag_respected(self):
+        """FLAGS_reducer_bucket_mb drives DataParallel's default cap."""
+        from paddle_tpu.distributed.reducer import reducer_bucket_bytes
+        before = paddle.get_flags(["FLAGS_reducer_bucket_mb"])[
+            "FLAGS_reducer_bucket_mb"]
+        try:
+            paddle.set_flags({"FLAGS_reducer_bucket_mb": 7})
+            assert reducer_bucket_bytes() == 7 * (1 << 20)
+        finally:
+            paddle.set_flags({"FLAGS_reducer_bucket_mb": before})

@@ -1,0 +1,85 @@
+"""Lane calibration artifact (paddle_tpu/cost_model/calibration.json):
+the planner's measured inputs must load, validate, and keep provenance
+attached — a CPU-dryrun wall time and a hardware throughput must never be
+silently commensurable."""
+import json
+
+import pytest
+
+from paddle_tpu.cost_model import (
+    CALIBRATION_PATH, Calibration, load_calibration,
+)
+
+
+@pytest.fixture(scope="module")
+def cal():
+    return load_calibration()
+
+
+class TestPackagedArtifact:
+    def test_loads_and_validates(self, cal):
+        assert isinstance(cal, Calibration)
+        assert cal.lanes
+
+    def test_compiled_lanes_present_with_measured_ratios(self, cal):
+        """The three newly compiled MULTICHIP lanes plus the whole-step
+        lanes all carry a measured eager/compiled ratio."""
+        for lane in ("pp_1f1b", "ring_sp", "moe_ep",
+                     "compiled_step_bert", "compiled_step_gpt"):
+            lc = cal.lane(lane)
+            assert cal.step_seconds(lane) > 0
+            assert cal.compiled_speedup(lane) > 0
+            assert lc.source in cal.provenance, (
+                f"{lane}: source {lc.source!r} has no provenance block")
+
+    def test_provenance_is_honest_about_environments(self, cal):
+        """Every referenced source resolves to a provenance block, and the
+        CPU-dryrun block names the exact command + flags it measured
+        under (numbers without reproduction instructions are claims)."""
+        for src in cal.sources():
+            assert src in cal.provenance, src
+        cpu = cal.provenance["cpu_dryrun"]
+        assert "BENCH_MODEL=lanes" in cpu["cmd"]
+        assert cpu["flags"]["FLAGS_compiled_step"] is True
+
+    def test_reducer_overlap_contract_recorded(self, cal):
+        ov = cal.reducer_overlap
+        assert ov["buckets_in_flight_at_finalize"] >= 1
+        assert ov["buckets_in_flight_at_finalize"] <= ov["buckets_total"]
+
+    def test_throughput_entries_carry_source(self, cal):
+        assert "bert" in cal.throughput
+        for name, row in cal.throughput.items():
+            assert row.get("source"), name
+            assert row.get("mfu") is not None, name
+
+
+class TestLoaderValidation:
+    def test_schema_drift_fails_loudly(self, tmp_path):
+        p = tmp_path / "cal.json"
+        p.write_text(json.dumps({"schema": 99, "lanes": {}}))
+        with pytest.raises(ValueError, match="schema"):
+            load_calibration(p)
+
+    def test_unknown_lane_names_available_ones(self, cal):
+        with pytest.raises(KeyError, match="pp_1f1b"):
+            cal.lane("warp_drive")
+
+    def test_lane_without_step_time_refuses_step_seconds(self, tmp_path):
+        p = tmp_path / "cal.json"
+        p.write_text(json.dumps({
+            "schema": 1,
+            "provenance": {"x": {}},
+            "lanes": {"tput_only": {"source": "x", "steps_per_s": 10.0}}}))
+        cal = load_calibration(p)
+        with pytest.raises(ValueError, match="step_s"):
+            cal.step_seconds("tput_only")
+        with pytest.raises(ValueError, match="compiled ratio"):
+            cal.compiled_speedup("tput_only")
+
+    def test_override_path_round_trips(self, tmp_path):
+        src = json.load(open(CALIBRATION_PATH))
+        p = tmp_path / "copy.json"
+        p.write_text(json.dumps(src))
+        cal = load_calibration(p)
+        assert sorted(cal.lanes) == sorted(src["lanes"])

@@ -407,6 +407,31 @@ def test_injection_lint_covers_prefix_spec_entry_points():
         ("paddle_tpu/serving/decode/engine.py", "class:DecodeEngine")]
 
 
+def test_injection_lint_covers_reducer_entry_points():
+    """The compiled-by-default PR's contract: the bucketed reducer's
+    fused-bucket dispatch (reducer.flush) stays chaos-testable — it is
+    the only point where a collective fault can land inside the
+    backward/communication overlap window, so dropping the hook would
+    make that whole failure mode unschedulable. Guard the MANIFEST so a
+    refactor can't silently drop the requirement along with the hook."""
+    import ast
+    src = (REPO / "tools" / "check_injection_points.py").read_text()
+    tree = ast.parse(src)
+    required = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "REQUIRED" for t in node.targets))
+    manifest = ast.literal_eval(required)
+    entries = {(rel, scope): names for rel, scope, names in manifest}
+    assert "_flush" in entries[
+        ("paddle_tpu/distributed/reducer.py", "class:Reducer")]
+    sites = next(
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.Assign)
+        and any(getattr(t, "id", None) == "SITES" for t in node.targets))
+    assert "reducer.flush" in ast.literal_eval(sites)
+
+
 def test_metric_name_lint_passes_on_tree():
     r = _run(REPO / "tools" / "check_metric_names.py")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -492,11 +517,13 @@ def test_span_manifest_matches_tracer_vocabulary():
 
 
 def test_compiled_step_flags_registered():
-    """The compiled-step PR's knobs stay registered with their contracted
-    defaults: FLAGS_compiled_step ships OFF (eager is the parity oracle;
-    compilation is an explicit opt-in), the retrace-storm bound stays
-    finite, and prefetch/donation stay on. Parsed from source, not live
-    state, so another test mutating flags can't flake this guard."""
+    """The compiled-step knobs stay registered with their contracted
+    defaults: FLAGS_compiled_step ships ON (compiled-by-default PR —
+    eager stays the debug/parity oracle behind `0`), the retrace-storm
+    bound stays finite, prefetch/donation stay on, and the reducer's
+    bucket cap stays at the measured 25 MiB sweet spot. Parsed from
+    source, not live state, so another test mutating flags can't flake
+    this guard."""
     import ast
     src = (REPO / "paddle_tpu" / "framework" / "flags.py").read_text()
     tree = ast.parse(src)
@@ -510,10 +537,11 @@ def test_compiled_step_flags_registered():
             defaults[ast.literal_eval(key)] = ast.literal_eval(val)
         except ValueError:
             pass  # computed defaults (e.g. 1 << 20) — not ours
-    assert defaults["FLAGS_compiled_step"] is False
+    assert defaults["FLAGS_compiled_step"] is True
     assert int(defaults["FLAGS_compiled_step_max_retraces"]) >= 1
     assert defaults["FLAGS_input_prefetch"] is True
     assert defaults["FLAGS_donate_state_buffers"] is True
+    assert int(defaults["FLAGS_reducer_bucket_mb"]) >= 1
 
 
 def test_decode_flags_registered():

@@ -126,6 +126,13 @@ REQUIRED = [
     ("paddle_tpu/distributed/fleet/expert_parallel.py",
      "class:ExpertParallelEngine",
      ["dispatch", "combine", "resize"]),
+    # bucketed async allreduce (compiled-by-default PR): the chaos suite
+    # must be able to fail a gradient bucket's fused all_reduce at the
+    # moment backward fires it (reducer.flush) — the overlap window between
+    # backward compute and the deferred finalize() drain is exactly where a
+    # collective fault would otherwise surface as a silent wrong gradient
+    ("paddle_tpu/distributed/reducer.py", "class:Reducer",
+     ["_flush"]),
 ]
 
 # Every injection-site *name* in the tree — the single source of truth the
@@ -165,6 +172,8 @@ SITES = [
     "spec.draft", "spec.verify",
     # elastic expert parallelism
     "moe.dispatch", "moe.combine", "moe.resize",
+    # bucketed async allreduce
+    "reducer.flush",
 ]
 
 
